@@ -1,0 +1,66 @@
+"""pslite_tpu — a TPU-native parameter-server framework.
+
+A from-scratch re-design of the capabilities of bytedance/ps-lite for TPU:
+the same contract (worker/server/scheduler roles, KV push/pull with async
+timestamps, pluggable transports, barriers, heartbeats, recovery), with the
+data plane re-architected as jit-compiled XLA collectives over an ICI device
+mesh (the ``ici`` van) and a TCP van for the DCN/control plane.
+"""
+
+from . import base, environment
+from .base import (
+    ALL_GROUP,
+    SCHEDULER_GROUP,
+    SERVER_GROUP,
+    WORKER_GROUP,
+)
+from .kv import (
+    KVMeta,
+    KVPairs,
+    KVServer,
+    KVServerDefaultHandle,
+    KVWorker,
+    SimpleApp,
+)
+from .message import Command, Control, Message, Meta, Node, Role
+from .postoffice import Postoffice
+from .ps import finalize, num_instances, postoffice, start_ps
+from .range import Range
+from .sarray import DeviceType, SArray
+
+__version__ = "0.1.0"
+
+# Reference-style spellings.
+StartPS = start_ps
+Finalize = finalize
+
+__all__ = [
+    "ALL_GROUP",
+    "SCHEDULER_GROUP",
+    "SERVER_GROUP",
+    "WORKER_GROUP",
+    "Command",
+    "Control",
+    "DeviceType",
+    "Finalize",
+    "KVMeta",
+    "KVPairs",
+    "KVServer",
+    "KVServerDefaultHandle",
+    "KVWorker",
+    "Message",
+    "Meta",
+    "Node",
+    "Postoffice",
+    "Range",
+    "Role",
+    "SArray",
+    "SimpleApp",
+    "StartPS",
+    "base",
+    "environment",
+    "finalize",
+    "num_instances",
+    "postoffice",
+    "start_ps",
+]

@@ -1,0 +1,132 @@
+"""Customer — per-app request/response tracker and receive pump.
+
+Capability parity with the reference's ``include/ps/internal/customer.h`` /
+``src/customer.cc``: ``new_request(recver)`` allocates a timestamp and records
+how many responses to expect; a dedicated thread pops the receive queue, runs
+the app's handle, then counts the response (the count is incremented *after*
+the handle runs, which KVWorker's completion logic relies on —
+``customer.cc:59-74``).
+
+One extension for the TPU data plane: a timestamp can carry *completion
+hooks* (e.g. ``jax.Array.block_until_ready``) so ICI-van requests — which
+never produce response messages — still honor ``wait_request`` semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .message import Message
+from .utils.queues import ThreadsafeQueue
+
+
+class Customer:
+    def __init__(
+        self,
+        app_id: int,
+        customer_id: int,
+        recv_handle: Callable[[Message], None],
+        postoffice,
+    ):
+        self.app_id = app_id
+        self.customer_id = customer_id
+        self._recv_handle = recv_handle
+        self._po = postoffice
+        self._tracker: List[List[int]] = []  # [expected, received] per ts
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
+        self._hooks: Dict[int, List[Callable[[], None]]] = {}
+        self._thread = threading.Thread(
+            target=self._receiving, name=f"customer-{app_id}-{customer_id}", daemon=True
+        )
+        self._thread.start()
+        postoffice.add_customer(self)
+
+    # -- request tracking ----------------------------------------------------
+
+    def new_request(self, recver: int, num_responses: Optional[int] = None) -> int:
+        """Allocate a timestamp expecting one response per addressed node.
+
+        With instance groups, a worker instance only talks to the matching
+        server instance in each group, so the expected count is
+        ``len(node_ids(recver)) / group_size`` (reference: customer.cc:32-40).
+        """
+        if num_responses is None:
+            ids = self._po.get_node_ids(recver)
+            if recver < 8:
+                # Group bitmask: one response per matching instance of each
+                # group — the scheduler (a singleton) is counted apart so it
+                # is not swallowed by the group_size division.
+                sched = 1 if any(i == 1 for i in ids) else 0
+                num = max(sched + (len(ids) - sched) // self._po.group_size, 1)
+            else:  # direct node id
+                num = len(ids)
+        else:
+            num = num_responses
+        with self._cv:
+            self._tracker.append([num, 0])
+            return len(self._tracker) - 1
+
+    def wait_request(self, timestamp: int, timeout: Optional[float] = None) -> bool:
+        hooks = self._take_hooks(timestamp)
+        for hook in hooks:
+            hook()
+        with self._cv:
+            if timeout is None:
+                self._cv.wait_for(
+                    lambda: self._tracker[timestamp][0] <= self._tracker[timestamp][1]
+                )
+                return True
+            return self._cv.wait_for(
+                lambda: self._tracker[timestamp][0] <= self._tracker[timestamp][1],
+                timeout,
+            )
+
+    def num_response(self, timestamp: int) -> int:
+        with self._mu:
+            return self._tracker[timestamp][1]
+
+    def add_response(self, timestamp: int, num: int = 1) -> None:
+        with self._cv:
+            self._tracker[timestamp][1] += num
+            self._cv.notify_all()
+
+    def add_wait_hook(self, timestamp: int, hook: Callable[[], None]) -> None:
+        """Attach a device-completion hook run by wait_request (ICI path)."""
+        with self._mu:
+            self._hooks.setdefault(timestamp, []).append(hook)
+
+    def _take_hooks(self, timestamp: int) -> List[Callable[[], None]]:
+        with self._mu:
+            return self._hooks.pop(timestamp, [])
+
+    # -- receive pump --------------------------------------------------------
+
+    def accept(self, msg: Message) -> None:
+        self._queue.push(msg)
+
+    def _receiving(self) -> None:
+        while True:
+            msg = self._queue.wait_and_pop()
+            if msg is None or msg.meta.control.cmd.name == "TERMINATE":
+                break
+            try:
+                self._recv_handle(msg)
+            except Exception as exc:
+                # A handler bug must not kill the pump: responses still have
+                # to be counted or every waiter on this node hangs silently.
+                from .utils import logging as _log
+
+                _log.warning(f"recv handle raised: {exc!r}")
+            finally:
+                if not msg.meta.request:
+                    with self._cv:
+                        self._tracker[msg.meta.timestamp][1] += 1
+                        self._cv.notify_all()
+
+    def stop(self) -> None:
+        self._queue.push(None)
+        self._thread.join(timeout=5)
+        self._po.remove_customer(self)

@@ -1,0 +1,12 @@
+from .kv_app import KVMeta, KVPairs, KVServer, KVServerDefaultHandle, KVWorker
+from .simple_app import SimpleApp, SimpleData
+
+__all__ = [
+    "KVMeta",
+    "KVPairs",
+    "KVServer",
+    "KVServerDefaultHandle",
+    "KVWorker",
+    "SimpleApp",
+    "SimpleData",
+]

@@ -1,0 +1,434 @@
+"""KV app layer: KVPairs, KVWorker, KVServer, default server handle.
+
+Capability parity with the reference's ``include/ps/kv_app.h``:
+
+- ``KVWorker.push/pull`` (aka ``ZPush/ZPull``) allocate a Customer timestamp,
+  slice the sorted key array across server key ranges (``DefaultSlicer``,
+  kv_app.h:566-636 — empty slices are skipped and pre-credited as responses),
+  and send one message per server group; with instance groups, worker
+  instance *i* only talks to server instance *i* of each group
+  (kv_app.h:644-647).
+- Pull responses are stashed per timestamp; the last response reassembles
+  per-server chunks sorted by first key into the caller's buffer
+  (kv_app.h:686-792) — skipped entirely in zero-copy mode where the
+  transport already delivered in place.
+- ``KVServer`` converts messages to ``KVMeta``+``KVPairs`` for the user
+  handler, which must call ``response`` (kv_app.h:499-564);
+  ``register_recv_buffer`` pre-pins per-(worker, key) receive buffers
+  (kv_app.h:396-403).
+- ``KVServerDefaultHandle``: push => ``store[key] += val``, pull => return
+  ``store[key]`` (kv_app.h:430-452).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import ps as ps_mod
+from ..base import SERVER_GROUP, server_rank_to_id
+from ..customer import Customer
+from ..message import Message, Role
+from ..range import Range, find_range
+from ..sarray import SArray
+from ..utils import logging as log
+
+
+@dataclass
+class KVPairs:
+    """Sorted unique keys + values (+ optional per-key value lengths)."""
+
+    keys: np.ndarray = field(default_factory=lambda: np.empty(0, np.uint64))
+    vals: np.ndarray = field(default_factory=lambda: np.empty(0, np.float32))
+    lens: Optional[np.ndarray] = None
+    priority: int = 0
+
+    def empty(self) -> bool:
+        return len(self.keys) == 0
+
+
+@dataclass
+class KVMeta:
+    """Request metadata handed to the server handler (kv_app.h:72-96)."""
+
+    cmd: int = 0
+    push: bool = False
+    pull: bool = False
+    sender: int = 0
+    timestamp: int = 0
+    customer_id: int = 0
+    key: int = 0
+    addr: int = 0
+    val_len: int = 0
+    option: int = 0
+
+
+def default_slicer(
+    kvs: KVPairs, ranges: List[Range]
+) -> List[Optional[KVPairs]]:
+    """Partition sorted keys over server key ranges (kv_app.h:566-621)."""
+    n = len(ranges)
+    out: List[Optional[KVPairs]] = [None] * n
+    if kvs.empty():
+        return out
+    keys = kvs.keys
+    if kvs.lens is not None:
+        log.check_eq(len(kvs.lens), len(keys), "lens/keys size mismatch")
+        val_offsets = np.concatenate(
+            ([0], np.cumsum(np.asarray(kvs.lens, dtype=np.int64)))
+        )
+        k = None
+    else:
+        log.check(
+            len(keys) == 0 or len(kvs.vals) % len(keys) == 0,
+            "vals not divisible by keys",
+        )
+        k = len(kvs.vals) // max(len(keys), 1)
+        val_offsets = None
+    for i, rng in enumerate(ranges):
+        pos = find_range(keys, rng.begin, rng.end)
+        if pos.size() == 0:
+            continue
+        if k is not None:
+            vb, ve = pos.begin * k, pos.end * k
+            lens = None
+        else:
+            vb, ve = int(val_offsets[pos.begin]), int(val_offsets[pos.end])
+            lens = kvs.lens[pos.begin : pos.end]
+        out[i] = KVPairs(
+            keys=keys[pos.begin : pos.end],
+            vals=kvs.vals[vb:ve],
+            lens=lens,
+            priority=kvs.priority,
+        )
+    return out
+
+
+class KVWorker:
+    """Client of the KV store (kv_app.h:134-300)."""
+
+    def __init__(self, app_id: int, customer_id: int = 0, postoffice=None):
+        self.po = postoffice or ps_mod.postoffice(Role.WORKER)
+        self._customer = Customer(app_id, customer_id, self._process, self.po)
+        self._mu = threading.Lock()
+        self._callbacks: Dict[int, Callable[[], None]] = {}
+        self._recv_kvs: Dict[int, List[KVPairs]] = {}
+        self._pull_dst: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = {}
+        self._slicer = default_slicer
+        # Zero-copy transports (ici/shm) deliver pulls in place; message
+        # transports reassemble on completion (kv_app.h is_worker_zpull_).
+        self._zero_copy_pull = self.po.van.__class__.__name__ in (
+            "IciVan",
+            "ShmVan",
+        )
+
+    @property
+    def engine(self):
+        """Collective engine when running over the ICI van, else None."""
+        return getattr(self.po.van, "engine", None)
+
+    def set_slicer(self, slicer) -> None:
+        """Custom slicer hook (kv_app.h:256-265)."""
+        self._slicer = slicer
+
+    # -- public ops ----------------------------------------------------------
+
+    def push(
+        self,
+        keys,
+        vals,
+        lens=None,
+        cmd: int = 0,
+        callback: Optional[Callable[[], None]] = None,
+        priority: int = 0,
+    ) -> int:
+        """Zero-copy push; caller must not mutate buffers until wait(ts)
+        (kv_app.h:210-231)."""
+        kvs = _as_kvs(keys, vals, lens, priority)
+        ts = self._customer.new_request(SERVER_GROUP)
+        if callback is not None:
+            with self._mu:
+                self._callbacks[ts] = callback
+        self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs)
+        return ts
+
+    def pull(
+        self,
+        keys,
+        vals: np.ndarray,
+        lens: Optional[np.ndarray] = None,
+        cmd: int = 0,
+        callback: Optional[Callable[[], None]] = None,
+        priority: int = 0,
+    ) -> int:
+        """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792)."""
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        ts = self._customer.new_request(SERVER_GROUP)
+        with self._mu:
+            if callback is not None:
+                self._callbacks[ts] = callback
+            self._pull_dst[ts] = (keys, vals, lens)
+        kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
+        self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
+                   val_dtype=vals.dtype, val_nbytes=vals.nbytes)
+        return ts
+
+    def push_pull(
+        self,
+        keys,
+        vals,
+        outs: np.ndarray,
+        lens=None,
+        cmd: int = 0,
+        callback: Optional[Callable[[], None]] = None,
+        priority: int = 0,
+    ) -> int:
+        """Fused push+pull round trip (the benchmark hot path)."""
+        kvs = _as_kvs(keys, vals, lens, priority)
+        ts = self._customer.new_request(SERVER_GROUP)
+        with self._mu:
+            if callback is not None:
+                self._callbacks[ts] = callback
+            self._pull_dst[ts] = (kvs.keys, outs, lens)
+        self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs)
+        return ts
+
+    def wait(self, timestamp: int) -> None:
+        self._customer.wait_request(timestamp)
+
+    # aliases matching the reference spelling
+    ZPush = push
+    ZPull = pull
+    ZPushPull = push_pull
+    Wait = wait
+
+    def stop(self) -> None:
+        self._customer.stop()
+
+    # -- internals -----------------------------------------------------------
+
+    def _send(
+        self,
+        ts: int,
+        push: bool,
+        pull: bool,
+        cmd: int,
+        kvs: KVPairs,
+        val_dtype=None,
+        val_nbytes: int = 0,
+    ) -> None:
+        ranges = self.po.get_server_key_ranges()
+        sliced = self._slicer(kvs, ranges)
+        skipped = sum(1 for s in sliced if s is None or s.empty())
+        if skipped:
+            self._customer.add_response(ts, skipped)
+            if skipped == len(sliced):
+                self._finish(ts)  # also releases any _pull_dst entry
+                return
+        for group_rank, part in enumerate(sliced):
+            if part is None or part.empty():
+                continue
+            msg = Message()
+            m = msg.meta
+            m.app_id = self._customer.app_id
+            m.customer_id = self._customer.customer_id
+            m.request = True
+            m.push = push
+            m.pull = pull
+            m.head = cmd
+            m.timestamp = ts
+            m.recver = server_rank_to_id(
+                group_rank * self.po.group_size + self.po.instance_idx
+            )
+            m.key = int(part.keys[0]) if len(part.keys) else 0
+            if pull and not push:
+                m.val_len = val_nbytes
+            else:
+                m.val_len = part.vals.nbytes
+            m.addr = id(part.vals)  # address token for same-process fast paths
+            msg.add_data(SArray(part.keys))
+            msg.add_data(SArray(part.vals))
+            if part.lens is not None:
+                msg.add_data(SArray(np.asarray(part.lens, dtype=np.int32)))
+            self.po.van.send(msg)
+
+    def _process(self, msg: Message) -> None:
+        if msg.meta.request:
+            return  # workers only receive responses
+        ts = msg.meta.timestamp
+        if msg.meta.pull and len(msg.data) >= 2:
+            kvs = KVPairs(
+                keys=msg.data[0].astype_view(np.uint64).numpy(),
+                vals=msg.data[1].numpy(),
+                lens=(msg.data[2].astype_view(np.int32).numpy()
+                      if len(msg.data) > 2 else None),
+            )
+            with self._mu:
+                self._recv_kvs.setdefault(ts, []).append(kvs)
+        # The Customer increments the response count *after* this handle, so
+        # "last response" is expected-1 (reference: kv_app.h:686-710).
+        expected = self.po.num_servers
+        if self._customer.num_response(ts) + 1 >= expected:
+            self._finish(ts)
+
+    def _finish(self, ts: int) -> None:
+        with self._mu:
+            chunks = self._recv_kvs.pop(ts, [])
+            dst = self._pull_dst.pop(ts, None)
+        if dst is not None and chunks and not self._zero_copy_pull:
+            keys, vals_out, lens_out = dst
+            chunks.sort(key=lambda kv: int(kv.keys[0]) if len(kv.keys) else 0)
+            total = sum(c.vals.nbytes for c in chunks)
+            log.check(
+                total <= vals_out.nbytes,
+                f"pull response too large: {total} > {vals_out.nbytes}",
+            )
+            flat = vals_out.reshape(-1).view(np.uint8)
+            off = 0
+            for c in chunks:
+                raw = c.vals.reshape(-1).view(np.uint8)
+                flat[off : off + raw.nbytes] = raw
+                off += raw.nbytes
+            if lens_out is not None:
+                loff = 0
+                for c in chunks:
+                    if c.lens is not None:
+                        lens_out[loff : loff + len(c.lens)] = c.lens
+                        loff += len(c.lens)
+        self._run_callback(ts)
+
+    def _run_callback(self, ts: int) -> None:
+        with self._mu:
+            cb = self._callbacks.pop(ts, None)
+        if cb is not None:
+            cb()
+
+
+class KVServer:
+    """Holder of a key-range shard of the store (kv_app.h:304-420)."""
+
+    def __init__(self, app_id: int, postoffice=None):
+        self.po = postoffice or ps_mod.postoffice(Role.SERVER)
+        self._customer = Customer(app_id, app_id, self._process, self.po)
+        self._handle: Optional[Callable[[KVMeta, KVPairs, "KVServer"], None]] = None
+        self._recv_buffers: Dict[Tuple[int, int], np.ndarray] = {}
+
+    def set_request_handle(
+        self, handle: Callable[[KVMeta, KVPairs, "KVServer"], None]
+    ) -> None:
+        self._handle = handle
+
+    def register_recv_buffer(
+        self, sender_id: int, key: int, buffer: np.ndarray
+    ) -> None:
+        """Pre-pin the receive buffer for (worker, key) — pushes for that key
+        land in exactly this buffer (kv_app.h:396-403, 457-496)."""
+        self._recv_buffers[(sender_id, key)] = buffer
+        hook = getattr(self.po.van, "register_recv_buffer", None)
+        if hook is not None:
+            hook(sender_id, key, buffer)
+
+    def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
+        """Reply to a request; echoes routing fields so one-sided transports
+        can deliver in place (kv_app.h:536-564)."""
+        msg = Message()
+        m = msg.meta
+        m.app_id = self._customer.app_id
+        m.customer_id = req.customer_id
+        m.request = False
+        m.push = req.push
+        m.pull = req.pull
+        m.head = req.cmd
+        m.timestamp = req.timestamp
+        m.recver = req.sender
+        m.key = req.key
+        m.addr = req.addr
+        m.val_len = req.val_len
+        m.option = req.option
+        if res is not None and not res.empty():
+            msg.add_data(SArray(res.keys))
+            msg.add_data(SArray(res.vals))
+            if res.lens is not None:
+                msg.add_data(SArray(np.asarray(res.lens, dtype=np.int32)))
+        self.po.van.send(msg)
+
+    def stop(self) -> None:
+        self._customer.stop()
+
+    def _process(self, msg: Message) -> None:
+        if msg.meta.simple_app:
+            return
+        meta = KVMeta(
+            cmd=msg.meta.head,
+            push=msg.meta.push,
+            pull=msg.meta.pull,
+            sender=msg.meta.sender,
+            timestamp=msg.meta.timestamp,
+            customer_id=msg.meta.customer_id,
+            key=msg.meta.key,
+            addr=msg.meta.addr,
+            val_len=msg.meta.val_len,
+            option=msg.meta.option,
+        )
+        kvs = KVPairs()
+        if len(msg.data) >= 2:
+            kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
+            kvs.vals = msg.data[1].numpy()
+            if len(msg.data) > 2:
+                kvs.lens = msg.data[2].astype_view(np.int32).numpy()
+        if meta.push and len(kvs.keys):
+            reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
+            if reg is not None:
+                # Deliver into the pre-registered buffer and alias it, so the
+                # app-level address-identity check of the reference benchmark
+                # (test_benchmark.cc:169-181) holds.
+                flat = reg.reshape(-1).view(np.uint8)
+                raw = kvs.vals.reshape(-1).view(np.uint8)
+                flat[: raw.nbytes] = raw
+                kvs.vals = reg.reshape(-1)[: len(kvs.vals.reshape(-1).view(reg.dtype))]
+        log.check(self._handle is not None, "KVServer handle not set")
+        self._handle(meta, kvs, self)
+
+
+class KVServerDefaultHandle:
+    """push => store[key] += vals; pull => store[key] (kv_app.h:430-452)."""
+
+    def __init__(self):
+        self.store: Dict[int, np.ndarray] = {}
+
+    def __call__(self, req_meta: KVMeta, req_data: KVPairs, server: KVServer):
+        if req_meta.push:
+            n = len(req_data.keys)
+            if n:
+                log.check(len(req_data.vals) % n == 0, "bad push shape")
+                k = len(req_data.vals) // n
+                for i, key in enumerate(req_data.keys):
+                    key = int(key)
+                    seg = req_data.vals[i * k : (i + 1) * k]
+                    if key in self.store:
+                        self.store[key] = self.store[key] + seg
+                    else:
+                        self.store[key] = seg.copy()
+        if req_meta.pull:
+            for k in req_data.keys:
+                # A missing key must fail loudly: a zero-length chunk would
+                # silently shift later keys' values in the caller's buffer.
+                log.check(int(k) in self.store, f"pull of unknown key {k}")
+            vals = [self.store[int(k)] for k in req_data.keys]
+            res = KVPairs(
+                keys=req_data.keys,
+                vals=(np.concatenate(vals) if vals else np.empty(0, np.float32)),
+            )
+            server.response(req_meta, res)
+        else:
+            server.response(req_meta)
+
+
+def _as_kvs(keys, vals, lens, priority: int) -> KVPairs:
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+    vals = np.ascontiguousarray(np.asarray(vals))
+    lens_arr = None if lens is None else np.asarray(lens, dtype=np.int32)
+    return KVPairs(keys=keys, vals=vals, lens=lens_arr, priority=priority)

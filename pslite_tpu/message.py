@@ -1,0 +1,188 @@
+"""Message model: Node, Control, Meta, Message.
+
+Capability parity with the reference's ``include/ps/internal/message.h``:
+``Meta`` carries head/app/customer/timestamp/routing/flags plus the zero-copy
+fields (``key``, ``addr``, ``val_len``, ``option``, ``sid``) that let a
+transport deliver payloads straight into a pre-registered destination buffer;
+``Control`` carries the bootstrap/barrier/heartbeat plane; ``Node`` describes
+a process (role, id, address, devices, recovery flag, preferred rank).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from .base import EMPTY_ID
+from .sarray import DeviceType, SArray
+
+
+class Role(enum.IntEnum):
+    SERVER = 0
+    WORKER = 1
+    SCHEDULER = 2
+    JOINT = 3  # worker + server hosted in one process (reference: ps.h:59-76)
+
+
+class Command(enum.IntEnum):
+    """Control commands (reference: message.h:163-164)."""
+
+    EMPTY = 0
+    TERMINATE = 1
+    ADD_NODE = 2
+    BARRIER = 3
+    ACK = 4
+    HEARTBEAT = 5
+    BOOTSTRAP = 6
+    ADDR_REQUEST = 7
+    ADDR_RESOLVED = 8
+    INSTANCE_BARRIER = 9
+
+
+# Wire dtype codes (stable across hosts; independent of numpy internals).
+_DTYPE_TO_CODE = {
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint8): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.uint16): 4,
+    np.dtype(np.int32): 5,
+    np.dtype(np.uint32): 6,
+    np.dtype(np.int64): 7,
+    np.dtype(np.uint64): 8,
+    np.dtype(np.float16): 9,
+    np.dtype(np.float32): 10,
+    np.dtype(np.float64): 11,
+}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+# bfloat16 rides as code 12 when ml_dtypes is present.
+try:  # pragma: no cover - availability depends on environment
+    import ml_dtypes
+
+    _DTYPE_TO_CODE[np.dtype(ml_dtypes.bfloat16)] = 12
+    _CODE_TO_DTYPE[12] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def dtype_code(dt) -> int:
+    return _DTYPE_TO_CODE.get(np.dtype(dt), 2)  # default: raw bytes
+
+
+def code_dtype(code: int):
+    return _CODE_TO_DTYPE.get(code, np.dtype(np.uint8))
+
+
+@dataclass
+class Node:
+    """One process in the cluster (reference: message.h:66-134)."""
+
+    role: Role = Role.SCHEDULER
+    id: int = EMPTY_ID
+    customer_id: int = 0
+    hostname: str = ""
+    ports: List[int] = field(default_factory=list)
+    dev_types: List[int] = field(default_factory=list)
+    dev_ids: List[int] = field(default_factory=list)
+    is_recovery: bool = False
+    # Opaque transport endpoint name (libfabric-style); unused by tcp/ici.
+    endpoint_name: bytes = b""
+    # Preferred rank (or transport-specific connection-tracking value).
+    aux_id: int = EMPTY_ID
+
+    @property
+    def port(self) -> int:
+        return self.ports[0] if self.ports else 0
+
+    def addr_key(self) -> str:
+        return f"{self.hostname}:{self.port}"
+
+    def short_debug(self) -> str:
+        return (
+            f"[role={self.role.name}, id={self.id}, ip={self.hostname}, "
+            f"ports={self.ports}, is_recovery={self.is_recovery}, "
+            f"aux_id={self.aux_id}]"
+        )
+
+
+@dataclass
+class Control:
+    """System control plane payload (reference: message.h:136-175)."""
+
+    cmd: Command = Command.EMPTY
+    node: List[Node] = field(default_factory=list)
+    barrier_group: int = 0
+    msg_sig: int = 0
+
+    def empty(self) -> bool:
+        return self.cmd == Command.EMPTY
+
+
+@dataclass
+class Meta:
+    """Message metadata (reference: message.h:177-258)."""
+
+    head: int = EMPTY_ID
+    app_id: int = EMPTY_ID
+    customer_id: int = 0
+    timestamp: int = EMPTY_ID
+    sender: int = EMPTY_ID
+    recver: int = EMPTY_ID
+    request: bool = False
+    push: bool = False
+    pull: bool = False
+    simple_app: bool = False
+    body: bytes = b""
+    data_type: List[int] = field(default_factory=list)
+    control: Control = field(default_factory=Control)
+    # Zero-copy routing: logical key, destination address token, value length,
+    # transport option (rkey-equivalent), and per-peer sequence id.
+    key: int = 0
+    addr: int = 0
+    val_len: int = 0
+    option: int = 0
+    sid: int = EMPTY_ID
+    data_size: int = 0
+    src_dev_type: int = int(DeviceType.UNK)
+    src_dev_id: int = -1
+    dst_dev_type: int = int(DeviceType.UNK)
+    dst_dev_id: int = -1
+
+
+@dataclass
+class Message:
+    """Meta plus zero-copy data segments (reference: message.h:260-301)."""
+
+    meta: Meta = field(default_factory=Meta)
+    data: List[SArray] = field(default_factory=list)
+
+    def add_data(self, arr) -> None:
+        sa = arr if isinstance(arr, SArray) else SArray(np.asarray(arr))
+        self.data.append(sa)
+        self.meta.data_type.append(dtype_code(sa.dtype))
+        self.meta.data_size += sa.nbytes
+
+    def debug_string(self) -> str:
+        m = self.meta
+        parts = [
+            f"Meta: request={m.request}",
+            f"timestamp={m.timestamp}",
+            f"sender={m.sender}",
+            f"recver={m.recver}",
+        ]
+        if not m.control.empty():
+            parts.append(f"control={{cmd={m.control.cmd.name}, "
+                         f"barrier_group={m.control.barrier_group}, "
+                         f"nodes={[n.short_debug() for n in m.control.node]}}}")
+        else:
+            parts.append(
+                f"app={m.app_id} customer={m.customer_id} push={m.push} "
+                f"simple_app={m.simple_app} key={m.key}"
+            )
+        if m.body:
+            parts.append(f"body={m.body[:64]!r}")
+        if self.data:
+            parts.append(f"data_bytes={[d.nbytes for d in self.data]}")
+        return " ".join(parts)

@@ -1,0 +1,264 @@
+"""Postoffice — per-role-instance center of the system.
+
+Capability parity with the reference's ``include/ps/internal/postoffice.h`` /
+``src/postoffice.cc``: env parsing, van creation, node-id bookkeeping and
+group membership tables, barriers, server key ranges, the heartbeat registry,
+the customer registry (with the 5 s readiness wait), and lifecycle
+(start/finalize).  One Postoffice exists per role *instance*; instance groups
+(``DMLC_GROUP_SIZE``) and the JOINT role put several in one process
+(reference: ps.h:59-138, postoffice.cc:20-43).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import environment, vans
+from .base import (
+    ALL_GROUP,
+    EMPTY_ID,
+    MAX_KEY,
+    SCHEDULER_GROUP,
+    SCHEDULER_ID,
+    SERVER_GROUP,
+    WORKER_GROUP,
+    group_members,
+    id_to_rank,
+    is_server_id,
+    is_worker_id,
+    server_rank_to_id,
+    worker_rank_to_id,
+)
+from .message import Command, Message, Node, Role
+from .range import Range
+from .utils import logging as log
+
+
+class Postoffice:
+    def __init__(
+        self,
+        role: Role,
+        instance_idx: int = 0,
+        env: Optional[environment.Environment] = None,
+    ):
+        log.check(role in (Role.WORKER, Role.SERVER, Role.SCHEDULER),
+                  "JOINT is expanded by start_ps, not hosted by one Postoffice")
+        self.env = env or environment.get()
+        self.role = role
+        self.instance_idx = instance_idx
+        self.num_workers = self.env.find_int("DMLC_NUM_WORKER", 0)
+        self.num_servers = self.env.find_int("DMLC_NUM_SERVER", 0)
+        self.group_size = max(self.env.find_int("DMLC_GROUP_SIZE", 1), 1)
+        self.verbose = self.env.find_int("PS_VERBOSE", 0)
+        log.set_verbosity(self.verbose)
+        self._preferred_group_rank = self.env.find_int("DMLC_RANK", EMPTY_ID)
+
+        self._customers: Dict[tuple, object] = {}
+        self._customers_cv = threading.Condition()
+        self._barrier_mu = threading.Lock()
+        self._barrier_cv = threading.Condition(self._barrier_mu)
+        self._barrier_done = False
+        self._heartbeats: Dict[int, float] = {}
+        self._heartbeat_mu = threading.Lock()
+        self._start_time = time.time()
+        self._exit_callback: Optional[Callable[[], None]] = None
+        self._server_key_ranges: List[Range] = []
+        self._server_key_ranges_mu = threading.Lock()
+        self._node_ids: Dict[int, List[int]] = {}
+        self._build_node_id_table()
+
+        van_type = self.env.find("PS_VAN_TYPE") or self.env.find(
+            "DMLC_ENABLE_RDMA"
+        ) or "tcp"
+        self.van = vans.create(van_type, self)
+
+    # -- role & rank ---------------------------------------------------------
+
+    @property
+    def is_worker(self) -> bool:
+        return self.role == Role.WORKER
+
+    @property
+    def is_server(self) -> bool:
+        return self.role == Role.SERVER
+
+    @property
+    def is_scheduler(self) -> bool:
+        return self.role == Role.SCHEDULER
+
+    def role_str(self) -> str:
+        return self.role.name.lower()
+
+    @property
+    def num_worker_instances(self) -> int:
+        return self.num_workers * self.group_size
+
+    @property
+    def num_server_instances(self) -> int:
+        return self.num_servers * self.group_size
+
+    @property
+    def preferred_rank(self) -> int:
+        """Preferred *instance* rank sent in ADD_NODE aux_id (DMLC_RANK)."""
+        if self._preferred_group_rank == EMPTY_ID:
+            return EMPTY_ID
+        return self._preferred_group_rank * self.group_size + self.instance_idx
+
+    def my_rank(self) -> int:
+        """My instance rank within my role."""
+        return id_to_rank(self.van.my_node.id)
+
+    def my_group_rank(self) -> int:
+        return self.my_rank() // self.group_size
+
+    def id_to_group_rank(self, node_id: int) -> int:
+        """Group rank of any node id; scheduler maps to -1."""
+        if node_id == SCHEDULER_ID:
+            return -1
+        return id_to_rank(node_id) // self.group_size
+
+    def instance_rank_to_id(self, role: Role, instance_rank: int) -> int:
+        if role == Role.WORKER:
+            return worker_rank_to_id(instance_rank)
+        return server_rank_to_id(instance_rank)
+
+    @property
+    def is_recovery(self) -> bool:
+        return self.van.my_node.is_recovery
+
+    def on_id_assigned(self, node: Node) -> None:
+        log.vlog(1, f"assigned id {node.id} (rank {id_to_rank(node.id)}) to me")
+
+    # -- group membership ----------------------------------------------------
+
+    def _build_node_id_table(self) -> None:
+        """Group bitmask -> member instance ids (reference:
+        postoffice.cc:115-137)."""
+        worker_ids = [
+            worker_rank_to_id(i) for i in range(self.num_worker_instances)
+        ]
+        server_ids = [
+            server_rank_to_id(i) for i in range(self.num_server_instances)
+        ]
+        for group in range(1, 8):
+            sched, srv, wrk = group_members(group)
+            ids: List[int] = []
+            if sched:
+                ids.append(SCHEDULER_ID)
+            if srv:
+                ids.extend(server_ids)
+            if wrk:
+                ids.extend(worker_ids)
+            self._node_ids[group] = ids
+
+    def get_node_ids(self, group_or_id: int) -> List[int]:
+        if group_or_id in self._node_ids:
+            return self._node_ids[group_or_id]
+        return [group_or_id]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, customer_id: int = 0, do_barrier: bool = True) -> None:
+        self._start_time = time.time()
+        self.van.start(customer_id)
+        # A recovered node must not block on the startup barrier: the
+        # original cohort passed it long ago (reference: van.cc:292-332).
+        if do_barrier and not self.van.my_node.is_recovery:
+            self.barrier(customer_id, ALL_GROUP, instance=True)
+        log.vlog(1, f"{self.role_str()}[{self.instance_idx}] started")
+
+    def finalize(self, customer_id: int = 0, do_barrier: bool = True) -> None:
+        if do_barrier:
+            self.barrier(customer_id, ALL_GROUP, instance=True)
+        if customer_id == 0:
+            self.van.stop()
+            if self._exit_callback is not None:
+                self._exit_callback()
+
+    def register_exit_callback(self, cb: Callable[[], None]) -> None:
+        self._exit_callback = cb
+
+    # -- barriers ------------------------------------------------------------
+
+    def barrier(
+        self, customer_id: int, group: int = ALL_GROUP, instance: bool = False
+    ) -> None:
+        """Block until every member of ``group`` reaches the barrier
+        (reference: postoffice.cc:224-250)."""
+        members = self.get_node_ids(group)
+        if len(members) <= 1:
+            return
+        with self._barrier_cv:
+            self._barrier_done = False
+        self.van.request_barrier(group, instance)
+        with self._barrier_cv:
+            self._barrier_cv.wait_for(lambda: self._barrier_done)
+
+    def manage(self, msg: Message) -> None:
+        """Handle barrier responses (reference: postoffice.cc:270-283)."""
+        if msg.meta.control.cmd in (Command.BARRIER, Command.INSTANCE_BARRIER):
+            if not msg.meta.request:
+                with self._barrier_cv:
+                    self._barrier_done = True
+                    self._barrier_cv.notify_all()
+
+    # -- key ranges ----------------------------------------------------------
+
+    def get_server_key_ranges(self) -> List[Range]:
+        """Uniform partition of key space over server groups (reference:
+        postoffice.cc:257-268)."""
+        with self._server_key_ranges_mu:
+            if not self._server_key_ranges:
+                log.check(self.num_servers > 0, "no servers configured")
+                span = MAX_KEY // self.num_servers
+                for i in range(self.num_servers):
+                    begin = span * i
+                    end = span * (i + 1) if i + 1 < self.num_servers else MAX_KEY
+                    self._server_key_ranges.append(Range(begin, end))
+            return self._server_key_ranges
+
+    # -- customers -----------------------------------------------------------
+
+    def add_customer(self, customer) -> None:
+        with self._customers_cv:
+            key = (customer.app_id, customer.customer_id)
+            log.check(key not in self._customers, f"customer {key} exists")
+            self._customers[key] = customer
+            self._customers_cv.notify_all()
+
+    def get_customer(self, app_id: int, customer_id: int, timeout: float = 0.0):
+        key = (app_id, customer_id)
+        with self._customers_cv:
+            if timeout > 0:
+                self._customers_cv.wait_for(
+                    lambda: key in self._customers, timeout
+                )
+            return self._customers.get(key)
+
+    def remove_customer(self, customer) -> None:
+        with self._customers_cv:
+            self._customers.pop((customer.app_id, customer.customer_id), None)
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def update_heartbeat(self, node_id: int, t: float) -> None:
+        with self._heartbeat_mu:
+            self._heartbeats[node_id] = t
+
+    def get_dead_nodes(self, timeout_s: int = 60) -> List[int]:
+        """Nodes silent for > timeout_s (reference: postoffice.cc:285-304)."""
+        if timeout_s == 0:
+            return []
+        dead: List[int] = []
+        now = time.time()
+        expected = self.get_node_ids(
+            WORKER_GROUP + SERVER_GROUP if self.is_scheduler else SCHEDULER_GROUP
+        )
+        with self._heartbeat_mu:
+            for node_id in expected:
+                last = self._heartbeats.get(node_id, self._start_time)
+                if last + timeout_s < now:
+                    dead.append(node_id)
+        return dead

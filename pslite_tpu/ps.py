@@ -1,0 +1,124 @@
+"""Top-level lifecycle API: start_ps / finalize / postoffice accessors.
+
+Capability parity with the reference's ``include/ps/ps.h``: role parsing
+(worker / server / scheduler / **joint**), instance-group fan-out with one
+thread per instance (``_StartPS``/``_StartPSGroup``, ps.h:38-138), the
+finalize barrier, and exit callbacks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import environment
+from .base import EMPTY_ID
+from .message import Role
+from .postoffice import Postoffice
+from .utils import logging as log
+
+_mu = threading.Lock()
+_instances: Dict[Tuple[Role, int], Postoffice] = {}
+
+
+def _parse_role(role) -> Role:
+    if isinstance(role, Role):
+        return role
+    log.check(role is not None, "role not given and DMLC_ROLE unset")
+    return Role[str(role).upper()]
+
+
+def _role_list(role: Role, group_size: int):
+    roles = [Role.SERVER, Role.WORKER] if role == Role.JOINT else [role]
+    for r in roles:
+        for idx in range(group_size if r != Role.SCHEDULER else 1):
+            yield r, idx
+
+
+def start_ps(
+    customer_id: int = 0,
+    role=None,
+    rank: Optional[int] = None,
+    do_barrier: bool = True,
+    env: Optional[environment.Environment] = None,
+) -> None:
+    """Create and start every Postoffice instance this process hosts.
+
+    With JOINT roles and/or ``DMLC_GROUP_SIZE`` > 1 several instances start
+    concurrently (each blocks in the startup barrier until the full cluster
+    has registered), so instances are started on threads and joined.
+    """
+    env = env or environment.get()
+    if role is None:
+        role = env.find("DMLC_ROLE")
+    role = _parse_role(role)
+    if rank is not None:
+        env.set("DMLC_RANK", str(rank))
+    group_size = max(env.find_int("DMLC_GROUP_SIZE", 1), 1)
+
+    created = []
+    with _mu:
+        for r, idx in _role_list(role, group_size):
+            key = (r, idx)
+            if key not in _instances:
+                _instances[key] = Postoffice(r, instance_idx=idx, env=env)
+            created.append(_instances[key])
+
+    errors = []
+
+    def _start(po: Postoffice) -> None:
+        try:
+            po.start(customer_id, do_barrier=do_barrier)
+        except Exception as exc:  # surfaced after join
+            errors.append((po, exc))
+
+    threads = [
+        threading.Thread(target=_start, args=(po,), name=f"start-{po.role_str()}-{po.instance_idx}")
+        for po in created
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0][1]
+
+
+def finalize(customer_id: int = 0, do_barrier: bool = True) -> None:
+    """Finalize every instance this process hosts (reference: ps.h:183-192)."""
+    with _mu:
+        pos = list(_instances.values())
+    threads = [
+        threading.Thread(
+            target=po.finalize, args=(customer_id, do_barrier),
+            name=f"finalize-{po.role_str()}-{po.instance_idx}",
+        )
+        for po in pos
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if customer_id == 0:
+        with _mu:
+            _instances.clear()
+
+
+def postoffice(role=None, instance_idx: int = 0) -> Postoffice:
+    """Accessor for a started Postoffice instance.
+
+    Without ``role``, prefers WORKER, then SERVER, then SCHEDULER — the
+    common case for app code running on a joint node.
+    """
+    with _mu:
+        if role is not None:
+            return _instances[(_parse_role(role), instance_idx)]
+        for r in (Role.WORKER, Role.SERVER, Role.SCHEDULER):
+            if (r, instance_idx) in _instances:
+                return _instances[(r, instance_idx)]
+    raise KeyError("no Postoffice started in this process")
+
+
+def num_instances() -> int:
+    with _mu:
+        return len(_instances)

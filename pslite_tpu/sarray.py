@@ -1,0 +1,138 @@
+"""SArray — the zero-copy shared-buffer abstraction of the data plane.
+
+Capability parity with the reference's ``include/ps/sarray.h`` (378 L):
+ref-counted zero-copy arrays with pointer-copy assignment, reinterpreting
+casts between element types (``sarray.h:81-91``), zero-copy ``segment()``
+slices (``:294-305``), and device placement tags carried through casts and
+slices (``:14-20, 319-323``).
+
+On TPU the host-side representation is a numpy view (numpy's ``base``
+ref-counting gives the zero-copy sharing semantics for free); device-side
+buffers are ``jax.Array`` shards referenced by handle.  The device tags tell
+the van where the bytes live / must land — the ICI van uses them to route
+HBM-resident payloads without a host round-trip.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+import numpy as np
+
+
+class DeviceType(enum.IntEnum):
+    """Where a buffer lives (reference: sarray.h device tags UNK/CPU/GPU)."""
+
+    UNK = 0
+    CPU = 1
+    TPU = 2  # the reference's GPU slot; here: HBM on a TPU chip
+
+
+class SArray:
+    """A typed view over shared bytes, with src/dst device placement tags.
+
+    Copying an SArray never copies data — only the view.  ``segment`` and
+    ``astype_view`` return new SArrays aliasing the same buffer, preserving
+    device tags (reference: sarray.h:294-305, 319-323).
+    """
+
+    __slots__ = (
+        "data",
+        "src_device",
+        "src_device_id",
+        "dst_device",
+        "dst_device_id",
+        "device_array",
+    )
+
+    def __init__(
+        self,
+        data: Any = None,
+        dtype: Any = None,
+        src_device: DeviceType = DeviceType.UNK,
+        src_device_id: int = -1,
+        dst_device: DeviceType = DeviceType.UNK,
+        dst_device_id: int = -1,
+    ):
+        if data is None:
+            self.data = np.empty(0, dtype=dtype or np.uint8)
+        elif isinstance(data, SArray):
+            self.data = data.data
+            src_device = data.src_device
+            src_device_id = data.src_device_id
+            dst_device = data.dst_device
+            dst_device_id = data.dst_device_id
+        elif isinstance(data, np.ndarray):
+            self.data = data if dtype is None else data.view(dtype)
+        elif isinstance(data, (bytes, bytearray, memoryview)):
+            self.data = np.frombuffer(data, dtype=dtype or np.uint8)
+        else:
+            self.data = np.asarray(data, dtype=dtype)
+        self.src_device = src_device
+        self.src_device_id = src_device_id
+        self.dst_device = dst_device
+        self.dst_device_id = dst_device_id
+        # Optional handle to an on-device jax.Array this view mirrors.
+        self.device_array = None
+
+    # -- zero-copy transforms ------------------------------------------------
+
+    def astype_view(self, dtype) -> "SArray":
+        """Reinterpreting cast (no copy) — reference sarray.h:81-91."""
+        out = SArray(self.data.view(dtype))
+        out._copy_tags(self)
+        return out
+
+    def segment(self, begin: int, end: int) -> "SArray":
+        """Zero-copy slice [begin, end) — reference sarray.h:294-305."""
+        out = SArray(self.data[begin:end])
+        out._copy_tags(self)
+        return out
+
+    def _copy_tags(self, other: "SArray") -> None:
+        self.src_device = other.src_device
+        self.src_device_id = other.src_device_id
+        self.dst_device = other.dst_device
+        self.dst_device_id = other.dst_device_id
+        self.device_array = other.device_array
+
+    # -- properties ----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def size(self) -> int:
+        return int(self.data.size)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.nbytes)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def tobytes(self) -> bytes:
+        return self.data.tobytes()
+
+    def numpy(self) -> np.ndarray:
+        return self.data
+
+    def shares_memory(self, other: "SArray") -> bool:
+        return np.shares_memory(self.data, other.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __repr__(self) -> str:
+        return (
+            f"SArray(dtype={self.data.dtype}, size={self.data.size}, "
+            f"src={self.src_device.name}:{self.src_device_id}, "
+            f"dst={self.dst_device.name}:{self.dst_device_id})"
+        )
+
+
+def as_sarray(x: Any, dtype=None) -> SArray:
+    return x if isinstance(x, SArray) else SArray(x, dtype=dtype)

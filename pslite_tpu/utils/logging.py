@@ -1,0 +1,73 @@
+"""Mini logging layer: CHECK macros and PS_VERBOSE-gated vlog.
+
+Equivalent of the reference's dmlc mini-glog (``include/dmlc/logging.h``) and
+``PS_VLOG`` (``include/ps/internal/postoffice.h:315``): verbosity 1 logs
+connection-level events, 2 logs every message.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_logger = logging.getLogger("pslite_tpu")
+if not _logger.handlers:
+    _handler = logging.StreamHandler(sys.stderr)
+    _handler.setFormatter(
+        logging.Formatter("[%(asctime)s %(levelname).1s pslite_tpu] %(message)s")
+    )
+    _logger.addHandler(_handler)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+
+class CheckError(AssertionError):
+    """Raised by check() — the CHECK()-failure equivalent."""
+
+
+def check(cond: bool, msg: str = "") -> None:
+    if not cond:
+        raise CheckError(msg or "check failed")
+
+
+def check_eq(a, b, msg: str = "") -> None:
+    if a != b:
+        raise CheckError(f"check failed: {a!r} != {b!r} {msg}")
+
+
+_verbosity_override = 0
+
+
+def set_verbosity(level: int) -> None:
+    """Raise process-wide verbosity (used by Postoffice instances whose
+    PS_VERBOSE arrives via an injected Environment rather than os.environ)."""
+    global _verbosity_override
+    _verbosity_override = max(_verbosity_override, level)
+
+
+def verbosity() -> int:
+    try:
+        env_level = int(os.environ.get("PS_VERBOSE", "0"))
+    except ValueError:
+        env_level = 0
+    return max(env_level, _verbosity_override)
+
+
+def vlog(level: int, msg: str) -> None:
+    """Log ``msg`` when PS_VERBOSE >= level (1=connection, 2=per-message)."""
+    if verbosity() >= level:
+        _logger.info(msg)
+
+
+def info(msg: str) -> None:
+    _logger.info(msg)
+
+
+def warning(msg: str) -> None:
+    _logger.warning(msg)
+
+
+def fatal(msg: str) -> None:
+    _logger.error(msg)
+    raise CheckError(msg)

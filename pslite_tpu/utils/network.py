@@ -1,0 +1,44 @@
+"""Interface/IP discovery and ephemeral-port picking.
+
+Equivalent of the reference's ``src/network_utils.h`` (``GetIP``,
+``GetAvailableInterfaceAndIP``, ``GetAvailablePort``).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+
+def get_ip(interface: Optional[str] = None) -> str:
+    """Best-effort local IP discovery.
+
+    Without netlink access we use the UDP-connect trick; for an explicit
+    interface name we fall back to hostname resolution.  Matches the
+    reference's behavior of preferring a non-loopback address.
+    """
+    if interface == "lo":
+        return "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
+def get_available_port(host: str = "") -> int:
+    """Bind port 0 and return the kernel-assigned ephemeral port."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()

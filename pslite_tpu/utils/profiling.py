@@ -1,0 +1,41 @@
+"""Per-message event tracing.
+
+Equivalent of the reference's ``USE_PROFILING`` van tracing
+(``src/van.cc:29-77, 440-457``): when ``ENABLE_PROFILING`` is set, every
+push/pull send/recv appends ``key,event,timestamp_us`` to a role-tagged file
+(``PROFILE_PATH`` or ``pslite_profile_van_<role>_<ts>``).  For device-side
+timelines use ``jax.profiler`` traces; this file-based log covers the
+control/DCN plane the same way the reference covers its NICs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class Profiler:
+    def __init__(self, env, role: str):
+        self._enabled = bool(env.find_int("ENABLE_PROFILING", 0))
+        self._fh = None
+        self._mu = threading.Lock()
+        if self._enabled:
+            path = env.find("PROFILE_PATH")
+            if not path:
+                path = f"pslite_profile_van_{role}_{int(time.time())}"
+            self._fh = open(path, "a")
+
+    def record(self, key: int, event: str, push: bool) -> None:
+        if not self._enabled or self._fh is None:
+            return
+        ts_us = int(time.time() * 1e6)
+        kind = "push" if push else "pull"
+        with self._mu:
+            self._fh.write(f"{key},{event}_{kind},{ts_us}\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            with self._mu:
+                self._fh.close()
+                self._fh = None

@@ -1,0 +1,60 @@
+"""Thread-safe queue used by vans and customers.
+
+Equivalent of the reference's ``ThreadsafeQueue``
+(``include/ps/internal/threadsafe_queue.h:18-118``): a mutex+condvar MPMC
+queue, with an optional busy-poll mode (``DMLC_LOCKLESS_QUEUE`` /
+``DMLC_POLLING_IN_NANOSECOND``) that trades CPU for latency on the hot
+receive path.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ThreadsafeQueue(Generic[T]):
+    def __init__(self, busy_poll_ns: int = 0):
+        self._q: Deque[T] = collections.deque()
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        # Busy-poll window before falling back to a blocking wait.
+        self._busy_poll_s = busy_poll_ns / 1e9
+
+    def push(self, item: T) -> None:
+        with self._cv:
+            self._q.append(item)
+            self._cv.notify()
+
+    def wait_and_pop(self, timeout: Optional[float] = None) -> Optional[T]:
+        """Pop the next item, blocking.  Returns None on timeout."""
+        if self._busy_poll_s > 0:
+            deadline = time.monotonic() + self._busy_poll_s
+            while time.monotonic() < deadline:
+                with self._mu:
+                    if self._q:
+                        return self._q.popleft()
+        with self._cv:
+            if timeout is None:
+                while not self._q:
+                    self._cv.wait()
+            else:
+                deadline = time.monotonic() + timeout
+                while not self._q:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        if not self._q:
+                            return None
+            return self._q.popleft()
+
+    def try_pop(self) -> Optional[T]:
+        with self._mu:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._q)

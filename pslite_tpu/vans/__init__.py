@@ -1,0 +1,39 @@
+"""Van transport family.
+
+Equivalent of the reference's pluggable Van layer (``src/van.cc:43-104``
+factory): ``tcp`` (zmq-van analog, DCN/control-plane workhorse), ``loopback``
+(in-process fake for unit tests — the tier the reference fork dropped),
+``ici`` (flagship TPU data plane over XLA collectives), ``shm`` (same-host
+IPC fast path), ``multi`` (multi-rail composite).
+"""
+
+from __future__ import annotations
+
+
+def create(van_type: str, postoffice):
+    try:
+        if van_type in ("tcp", "zmq", "0", ""):
+            from .tcp_van import TcpVan
+
+            return TcpVan(postoffice)
+        if van_type == "loopback":
+            from .loopback_van import LoopbackVan
+
+            return LoopbackVan(postoffice)
+        if van_type == "ici":
+            from .ici_van import IciVan
+
+            return IciVan(postoffice)
+        if van_type == "shm":
+            from .shm_van import ShmVan
+
+            return ShmVan(postoffice)
+        if van_type in ("multi", "multivan"):
+            from .multi_van import MultiVan
+
+            return MultiVan(postoffice)
+    except ImportError as exc:
+        raise ValueError(
+            f"van type {van_type!r} is not available in this build: {exc}"
+        ) from exc
+    raise ValueError(f"unknown van type: {van_type!r}")

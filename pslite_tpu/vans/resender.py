@@ -1,0 +1,114 @@
+"""Resender — optional ACK/dedup/retransmit reliability layer.
+
+Capability parity with the reference's ``src/resender.h``: every sent message
+is buffered under a signature; the receiver acks everything and drops
+duplicates; a monitor thread retransmits entries older than
+``PS_RESEND_TIMEOUT`` ms, up to 10 retries.  Enabled with ``PS_RESEND=1``;
+exercised together with the ``PS_DROP_MSG`` fault injector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Set, Tuple
+
+from ..message import Command, Control, Message
+from ..utils import logging as log
+
+
+def _signature(msg: Message) -> int:
+    m = msg.meta
+    # Unlike the reference (which truncates ids to 8 bits — resender.h:98-100,
+    # a known quirk), hash the full ids so large clusters stay collision-free.
+    return hash(
+        (m.app_id, m.customer_id, m.sender, m.recver, m.timestamp, m.request,
+         m.push, m.simple_app, m.key, m.control.cmd)
+    ) & ((1 << 64) - 1)
+
+
+class Resender:
+    def __init__(self, van, timeout_ms: int, max_retries: int = 10):
+        self._van = van
+        self._timeout_s = timeout_ms / 1000.0
+        self._max_retries = max_retries
+        self._mu = threading.Lock()
+        self._send_buff: Dict[int, Tuple[Message, float, int]] = {}
+        self._acked: Set[int] = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._monitoring, name="resender", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def drain(self, max_wait_s: float = 5.0) -> bool:
+        """Keep retransmitting until every buffered message is acked (or the
+        deadline passes).  Called before shutdown so peers whose barrier
+        replies were dropped still get them; without this a lossy link can
+        strand a peer in finalize forever."""
+        deadline = time.monotonic() + max_wait_s
+        while time.monotonic() < deadline:
+            with self._mu:
+                if not self._send_buff:
+                    return True
+            time.sleep(self._timeout_s / 4)
+        with self._mu:
+            return not self._send_buff
+
+    def add_outgoing(self, msg: Message) -> None:
+        if msg.meta.control.cmd in (Command.ACK, Command.TERMINATE):
+            return
+        sig = _signature(msg)
+        msg.meta.control.msg_sig = sig
+        with self._mu:
+            self._send_buff[sig] = (msg, time.monotonic(), 0)
+
+    def add_incoming(self, msg: Message) -> bool:
+        """Returns True if the message was consumed (ACK) or is a duplicate."""
+        cmd = msg.meta.control.cmd
+        if cmd == Command.TERMINATE:
+            return False
+        if cmd == Command.ACK:
+            with self._mu:
+                self._send_buff.pop(msg.meta.control.msg_sig, None)
+            return True
+        sig = msg.meta.control.msg_sig or _signature(msg)
+        ack = Message()
+        ack.meta.recver = msg.meta.sender
+        ack.meta.control = Control(cmd=Command.ACK, msg_sig=sig)
+        self._van.send(ack)
+        with self._mu:
+            duplicated = sig in self._acked
+            if not duplicated:
+                self._acked.add(sig)
+        if duplicated:
+            log.vlog(2, f"Duplicated message dropped: {msg.debug_string()}")
+        return duplicated
+
+    def _monitoring(self) -> None:
+        while not self._stop.wait(self._timeout_s / 2):
+            now = time.monotonic()
+            resend = []
+            with self._mu:
+                for sig, (msg, sent_at, retries) in list(self._send_buff.items()):
+                    if now - sent_at <= self._timeout_s:
+                        continue
+                    if retries >= self._max_retries:
+                        log.warning(
+                            f"Failed to deliver after {retries} retries: "
+                            f"{msg.debug_string()}"
+                        )
+                        del self._send_buff[sig]
+                        continue
+                    self._send_buff[sig] = (msg, now, retries + 1)
+                    resend.append(msg)
+            for msg in resend:
+                log.vlog(1, f"Resend {msg.debug_string()}")
+                try:
+                    self._van.send_msg_locked(msg)
+                except Exception as exc:
+                    log.warning(f"resend failed: {exc!r}")

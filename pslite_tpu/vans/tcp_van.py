@@ -1,0 +1,203 @@
+"""TCP van — socket transport for the DCN/control plane.
+
+Equivalent of the reference's ZMQVan (``src/zmq_van.h``): a listener accepts
+inbound connections (each pumped by a reader thread into one receive queue —
+the ROUTER side), and sends go over per-peer outbound sockets (the DEALER
+side).  Frames use the shared wire format (``wire.py``); data segments are
+sent zero-copy as memoryviews and received with ``recv_into`` directly into
+their final numpy buffers.
+
+When the native C++ core (``cpp/pslite_core.cc``) is built, the framing and
+socket loops can be offloaded to it via ``pslite_tpu.vans.native``.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import wire
+from ..message import Message, Node
+from ..utils import logging as log
+from ..utils.queues import ThreadsafeQueue
+from .van import Van
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            return None
+        got += r
+    return memoryview(buf)
+
+
+class TcpVan(Van):
+    def __init__(self, postoffice):
+        super().__init__(postoffice)
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._reader_threads: list = []
+        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue(
+            busy_poll_ns=self.env.find_int("DMLC_POLLING_IN_NANOSECOND", 0)
+            if self.env.find_int("DMLC_LOCKLESS_QUEUE", 0)
+            else 0
+        )
+        self._send_socks: Dict[int, socket.socket] = {}
+        self._send_addrs: Dict[int, Tuple[str, int]] = {}
+        self._socks_mu = threading.Lock()
+        self._closing = False
+
+    # -- transport interface -------------------------------------------------
+
+    def bind_transport(self, node: Node, max_retry: int) -> int:
+        port = node.port
+        for attempt in range(max_retry + 1):
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("", port))
+                break
+            except OSError:
+                s.close()
+                if attempt == max_retry:
+                    raise
+                port = 10000 + random.randint(0, 40000)
+        s.listen(128)
+        port = s.getsockname()[1]
+        self._listener = s
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tcp-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return port
+
+    def connect_transport(self, node: Node) -> None:
+        if node.id < 0:
+            return
+        with self._socks_mu:
+            prev_addr = self._send_addrs.get(node.id)
+            if prev_addr == (node.hostname, node.port) and node.id in self._send_socks:
+                return
+        # Peers start concurrently; retry until the remote listener is up
+        # (zmq's async connect gives the reference this for free).
+        deadline = 60.0
+        delay = 0.05
+        while True:
+            try:
+                sock = socket.create_connection(
+                    (node.hostname, node.port), timeout=30
+                )
+                break
+            except OSError:
+                if deadline <= 0 or self._closing:
+                    raise
+                import time as _time
+
+                _time.sleep(delay)
+                deadline -= delay
+                delay = min(delay * 2, 1.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._socks_mu:
+            old = self._send_socks.pop(node.id, None)
+            self._send_socks[node.id] = sock
+            self._send_addrs[node.id] = (node.hostname, node.port)
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+
+    def send_msg(self, msg: Message) -> int:
+        recver = msg.meta.recver
+        with self._socks_mu:
+            sock = self._send_socks.get(recver)
+        log.check(sock is not None, f"tcp: not connected to node {recver}")
+        chunks = wire.pack_frame(msg)
+        total = 0
+        for c in chunks:
+            sock.sendall(c)
+            total += len(c) if isinstance(c, bytes) else c.nbytes
+        return total
+
+    def recv_msg(self) -> Optional[Message]:
+        return self._queue.wait_and_pop()
+
+    def stop_transport(self) -> None:
+        self._closing = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._socks_mu:
+            socks = list(self._send_socks.values())
+            self._send_socks.clear()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._queue.push(None)
+
+    # -- internals -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._reader_loop, args=(conn,), name="tcp-reader",
+                daemon=True,
+            )
+            t.start()
+            self._reader_threads.append(t)
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closing:
+                hdr = _recv_exact(conn, wire.FRAME_HEADER_SIZE)
+                if hdr is None:
+                    break
+                meta_len, n_data = wire.unpack_frame_header(bytes(hdr))
+                lens_buf = _recv_exact(conn, 8 * n_data)
+                if lens_buf is None:
+                    break
+                lens = struct.unpack(f"<{n_data}Q", bytes(lens_buf))
+                meta_buf = _recv_exact(conn, meta_len)
+                if meta_buf is None:
+                    break
+                meta = wire.unpack_meta(bytes(meta_buf))
+                bufs = []
+                ok = True
+                for ln in lens:
+                    b = _recv_exact(conn, int(ln))
+                    if b is None:
+                        ok = False
+                        break
+                    bufs.append(b)
+                if not ok:
+                    break
+                self._queue.push(wire.rebuild_message(meta, bufs))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

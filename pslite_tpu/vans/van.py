@@ -1,0 +1,513 @@
+"""Van base class — the control plane shared by every transport.
+
+Capability parity with the reference's ``include/ps/internal/van.h`` +
+``src/van.cc``: scheduler bootstrap (ADD_NODE handshake, rank assignment with
+preferred ranks / ordered hosts / mixed mode), group and instance barriers,
+heartbeats with dead-node detection, node recovery, drop-injection fault
+testing (``PS_DROP_MSG``), the optional Resender reliability layer, byte
+counters, and the receiving loop that dispatches data messages to Customers.
+
+Transport subclasses implement ``bind_transport / connect_transport /
+send_msg / recv_msg / stop_transport``.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import environment
+from ..base import (
+    ALL_GROUP,
+    EMPTY_ID,
+    SCHEDULER_ID,
+    server_rank_to_id,
+    worker_rank_to_id,
+)
+from ..message import Command, Control, Message, Meta, Node, Role
+from ..utils import logging as log
+from ..utils.network import get_ip
+from ..utils.profiling import Profiler
+from .resender import Resender
+
+
+class Van:
+    def __init__(self, postoffice):
+        self.po = postoffice
+        self.env: environment.Environment = postoffice.env
+        self.my_node: Node = Node()
+        self.scheduler: Node = Node()
+        self.ready = threading.Event()
+        self.send_bytes = 0
+        self.recv_bytes = 0
+        self._start_mu = threading.Lock()
+        self._send_mu = threading.Lock()
+        self._init_stage = 0
+        self._recv_thread: Optional[threading.Thread] = None
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._drop_rate = 0
+        self.resender: Optional[Resender] = None
+        self.profiler = Profiler(self.env, postoffice.role_str())
+        # Scheduler-side registration state.
+        self._registrations: List[Node] = []
+        self._registered_addrs: Dict[str, int] = {}  # addr -> assigned id
+        self._num_registered = 0
+        self._barrier_senders: Dict[Tuple[int, bool], Set[int]] = {}
+        self._connected_nodes: Dict[str, int] = {}
+        self._timestamp = 0
+        self._timestamp_mu = threading.Lock()
+
+    # -- transport interface -------------------------------------------------
+
+    def bind_transport(self, node: Node, max_retry: int) -> int:
+        """Bind the receive endpoint; returns the bound port."""
+        raise NotImplementedError
+
+    def connect_transport(self, node: Node) -> None:
+        raise NotImplementedError
+
+    def send_msg(self, msg: Message) -> int:
+        """Send one message; returns bytes sent."""
+        raise NotImplementedError
+
+    def recv_msg(self) -> Optional[Message]:
+        """Blocking receive; None means the transport is shutting down."""
+        raise NotImplementedError
+
+    def stop_transport(self) -> None:
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, customer_id: int) -> None:
+        with self._start_mu:
+            if self._init_stage == 0:
+                self._init_nodes()
+                port = self.bind_transport(self.my_node, max_retry=40)
+                if port:
+                    self.my_node.ports = [port]
+                log.vlog(1, f"Bind to {self.my_node.short_debug()}")
+                self.connect(self.scheduler)
+                self._recv_thread = threading.Thread(
+                    target=self._receiving, name="van-recv", daemon=True
+                )
+                self._recv_thread.start()
+                self._init_stage = 1
+        if not self.po.is_scheduler:
+            node = copy.deepcopy(self.my_node)
+            node.customer_id = customer_id
+            node.aux_id = self.po.preferred_rank
+            msg = Message()
+            msg.meta.recver = SCHEDULER_ID
+            msg.meta.request = True
+            msg.meta.control = Control(cmd=Command.ADD_NODE, node=[node])
+            msg.meta.timestamp = self.next_timestamp()
+            self.send(msg)
+        self.ready.wait()
+        with self._start_mu:
+            if self._init_stage == 1:
+                self._drop_rate = self.env.find_int("PS_DROP_MSG", 0)
+                if self.env.find_int("PS_RESEND", 0):
+                    timeout_ms = self.env.find_int("PS_RESEND_TIMEOUT", 1000)
+                    self.resender = Resender(self, timeout_ms)
+                interval = self.env.find_int("PS_HEARTBEAT_INTERVAL", 0)
+                if interval > 0 and not self.po.is_scheduler:
+                    self._heartbeat_thread = threading.Thread(
+                        target=self._heartbeat_loop, args=(interval,),
+                        name="van-heartbeat", daemon=True,
+                    )
+                    self._heartbeat_thread.start()
+                self._init_stage = 2
+
+    def _init_nodes(self) -> None:
+        uri = self.env.find("DMLC_PS_ROOT_URI")
+        log.check(uri is not None, "DMLC_PS_ROOT_URI not set")
+        self.scheduler = Node(
+            role=Role.SCHEDULER,
+            id=SCHEDULER_ID,
+            hostname=uri,
+            ports=[self.env.find_int("DMLC_PS_ROOT_PORT", 0)],
+        )
+        if self.po.is_scheduler:
+            self.my_node = copy.deepcopy(self.scheduler)
+        else:
+            role = Role.WORKER if self.po.is_worker else Role.SERVER
+            host = self.env.find("DMLC_NODE_HOST")
+            if not host:
+                host = get_ip(self.env.find("DMLC_INTERFACE"))
+            self.my_node = Node(
+                role=role,
+                id=EMPTY_ID,
+                hostname=host,
+                ports=[self.env.find_int("DMLC_PORT", 0)],
+            )
+
+    def connect(self, node: Node) -> None:
+        addr = node.addr_key()
+        if node.id != EMPTY_ID and self._connected_nodes.get(addr) == node.id:
+            return
+        self.connect_transport(node)
+        if node.id != EMPTY_ID:
+            self._connected_nodes[addr] = node.id
+
+    def stop(self) -> None:
+        if self.resender is not None:
+            # Flush unacked messages (e.g. barrier replies a lossy link
+            # dropped) before tearing the transport down.
+            self.resender.drain()
+        exit_msg = Message()
+        exit_msg.meta.recver = self.my_node.id
+        exit_msg.meta.sender = self.my_node.id
+        exit_msg.meta.control = Control(cmd=Command.TERMINATE)
+        try:
+            self.send(exit_msg)
+        except Exception:  # transport may already be down; receiver exits anyway
+            pass
+        self._stop_event.set()
+        # Closing the transport guarantees recv_msg unblocks even when the
+        # TERMINATE self-send could not be delivered.
+        self.stop_transport()
+        if self._recv_thread is not None:
+            self._recv_thread.join(timeout=10)
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=5)
+        if self.resender is not None:
+            self.resender.stop()
+        self.profiler.close()
+        self.ready.clear()
+        self._init_stage = 0
+
+    # -- send path -----------------------------------------------------------
+
+    def next_timestamp(self) -> int:
+        with self._timestamp_mu:
+            self._timestamp += 1
+            return self._timestamp
+
+    def send(self, msg: Message) -> int:
+        if msg.meta.sender == EMPTY_ID:
+            msg.meta.sender = self.my_node.id
+        if self.resender is not None:
+            self.resender.add_outgoing(msg)
+        with self._send_mu:
+            nbytes = self.send_msg(msg)
+        self.send_bytes += nbytes
+        if msg.meta.control.empty():
+            self.profiler.record(msg.meta.key, "send", msg.meta.push)
+        log.vlog(2, f"SEND {msg.debug_string()}")
+        return nbytes
+
+    def send_msg_locked(self, msg: Message) -> int:
+        """Raw retransmit path used by the Resender (no re-buffering)."""
+        with self._send_mu:
+            nbytes = self.send_msg(msg)
+        self.send_bytes += nbytes
+        return nbytes
+
+    # -- receive loop --------------------------------------------------------
+
+    def _receiving(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                msg = self.recv_msg()
+            except Exception as exc:  # transport torn down under us
+                if self._stop_event.is_set():
+                    break
+                log.warning(f"recv_msg failed: {exc!r}")
+                break
+            if msg is None:
+                break
+            self.recv_bytes += msg.meta.data_size
+            ctrl = msg.meta.control
+            if (
+                self._drop_rate > 0
+                and self.ready.is_set()
+                and ctrl.cmd != Command.TERMINATE
+                and random.randint(0, 99) < self._drop_rate
+            ):
+                log.vlog(1, f"Drop message {msg.debug_string()}")
+                continue
+            if self.resender is not None and self.resender.add_incoming(msg):
+                continue
+            log.vlog(2, f"RECV {msg.debug_string()}")
+            if ctrl.cmd == Command.TERMINATE:
+                break
+            try:
+                if ctrl.empty():
+                    self._process_data_msg(msg)
+                elif ctrl.cmd == Command.ADD_NODE:
+                    self._process_add_node(msg)
+                elif ctrl.cmd == Command.BARRIER:
+                    self._process_barrier(msg, instance=False)
+                elif ctrl.cmd == Command.INSTANCE_BARRIER:
+                    self._process_barrier(msg, instance=True)
+                elif ctrl.cmd == Command.HEARTBEAT:
+                    self._process_heartbeat(msg)
+                elif ctrl.cmd == Command.ACK:
+                    pass  # consumed by the resender when enabled
+                else:
+                    log.warning(
+                        f"unhandled control {ctrl.cmd}: {msg.debug_string()}"
+                    )
+            except Exception as exc:
+                # A bad message must not kill the receive pump.
+                log.warning(
+                    f"error processing {msg.debug_string()}: {exc!r}"
+                )
+
+    # -- data plane dispatch -------------------------------------------------
+
+    def _process_data_msg(self, msg: Message) -> None:
+        self.profiler.record(msg.meta.key, "recv", msg.meta.push)
+        app_id = msg.meta.app_id
+        # Workers demux by customer_id (several KVWorker customers share one
+        # app); servers demux by app_id (reference: van.cc:428-438).
+        customer_id = (
+            msg.meta.customer_id if self.my_node.role == Role.WORKER else app_id
+        )
+        # The reference waits 5 s for app readiness (van.cc:435-438); we allow
+        # more by default because single-CPU CI hosts serialize process
+        # startup, and a dropped message here would strand the sender.
+        timeout = self.env.find_float("PS_CUSTOMER_READY_TIMEOUT", 30.0)
+        customer = self.po.get_customer(app_id, customer_id, timeout=timeout)
+        log.check(
+            customer is not None,
+            f"no customer ({app_id}, {customer_id}) ready after {timeout}s",
+        )
+        customer.accept(msg)
+
+    # -- scheduler: registration & rank assignment ---------------------------
+
+    def _expected_instances(self) -> int:
+        return self.po.num_worker_instances + self.po.num_server_instances
+
+    def _process_add_node(self, msg: Message) -> None:
+        if msg.meta.request:
+            log.check(self.po.is_scheduler, "ADD_NODE request at non-scheduler")
+            self._process_add_node_at_scheduler(msg)
+        else:
+            self._process_roster(msg)
+
+    def _process_add_node_at_scheduler(self, msg: Message) -> None:
+        nodes = msg.meta.control.node
+        if self.ready.is_set():
+            self._handle_late_registration(nodes)
+            return
+        for node in nodes:
+            addr = node.addr_key()
+            if addr in self._registered_addrs:
+                continue  # duplicate customer registration on one endpoint
+            self._registered_addrs[addr] = EMPTY_ID
+            self._registrations.append(node)
+        if len(self._registrations) < self._expected_instances():
+            return
+        self._assign_ranks(self._registrations)
+        for node in self._registrations:
+            self.connect(node)
+            self._registered_addrs[node.addr_key()] = node.id
+            self.po.update_heartbeat(node.id, time.time())
+        roster = [copy.deepcopy(self.scheduler)] + [
+            copy.deepcopy(n) for n in self._registrations
+        ]
+        for node in self._registrations:
+            reply = Message()
+            reply.meta.recver = node.id
+            reply.meta.control = Control(cmd=Command.ADD_NODE, node=roster)
+            reply.meta.timestamp = self.next_timestamp()
+            self.send(reply)
+        log.vlog(
+            1,
+            f"the scheduler is connected to {self.po.num_worker_instances} "
+            f"workers and {self.po.num_server_instances} servers",
+        )
+        self.ready.set()
+
+    def _assign_ranks(self, nodes: List[Node]) -> None:
+        """Assign node ids — reference: van.cc:112-265.
+
+        Order of precedence: explicit preferred ranks (every node supplied
+        ``aux_id``), then BYTEPS_ORDERED_HOSTS explicit host order, then
+        mixed-mode (non-colocated servers first), then sort by ip:port.
+        """
+        servers = [n for n in nodes if n.role == Role.SERVER]
+        workers = [n for n in nodes if n.role == Role.WORKER]
+        use_preferred = all(n.aux_id != EMPTY_ID for n in nodes) and nodes
+        if use_preferred:
+            for n in servers:
+                n.id = server_rank_to_id(n.aux_id)
+            for n in workers:
+                n.id = worker_rank_to_id(n.aux_id)
+            return
+        ordered_hosts = self.env.find("BYTEPS_ORDERED_HOSTS")
+        if ordered_hosts:
+            order = {h: i for i, h in enumerate(ordered_hosts.split(","))}
+            keyfn = lambda n: (order.get(n.hostname, len(order)), n.addr_key())
+        elif self.env.find_int("BYTEPS_ENABLE_MIXED_MODE", 0):
+            worker_hosts = {n.hostname for n in workers}
+            # Non-colocated servers get the lowest ranks (reference:
+            # van.cc:126-150 — they take more traffic in mixed mode).
+            keyfn = lambda n: (n.hostname in worker_hosts, n.addr_key())
+        else:
+            keyfn = lambda n: n.addr_key()
+        for rank, n in enumerate(sorted(servers, key=keyfn)):
+            n.id = server_rank_to_id(rank)
+        for rank, n in enumerate(sorted(workers, key=keyfn)):
+            n.id = worker_rank_to_id(rank)
+
+    def _handle_late_registration(self, nodes: List[Node]) -> None:
+        """Post-bootstrap ADD_NODE: new customer on a known node, or recovery
+        of a dead one (reference: van.cc:266-332)."""
+        for node in nodes:
+            addr = node.addr_key()
+            known_id = self._registered_addrs.get(addr, EMPTY_ID)
+            if known_id != EMPTY_ID:
+                # Existing endpoint registering another customer: resend roster.
+                roster = [copy.deepcopy(self.scheduler)] + [
+                    copy.deepcopy(n) for n in self._registrations
+                ]
+                reply = Message()
+                reply.meta.recver = known_id
+                reply.meta.control = Control(cmd=Command.ADD_NODE, node=roster)
+                self.send(reply)
+                continue
+            timeout = self.env.find_int("PS_HEARTBEAT_TIMEOUT", 0)
+            dead = [
+                d
+                for d in self.po.get_dead_nodes(timeout)
+                if (d % 2 == 0) == (node.role == Role.SERVER)
+            ]
+            if not dead:
+                log.warning(f"unexpected late ADD_NODE from {node.short_debug()}")
+                continue
+            node.id = dead[0]
+            node.is_recovery = True
+            log.vlog(1, f"recovering node {node.short_debug()}")
+            self.connect(node)
+            self._registered_addrs[addr] = node.id
+            self.po.update_heartbeat(node.id, time.time())
+            self._registrations = [
+                n for n in self._registrations if n.id != node.id
+            ] + [node]
+            # Full roster to the recovered node; just the recovery node to
+            # everyone else (reference: van.cc:266-285).
+            roster = [copy.deepcopy(self.scheduler)] + [
+                copy.deepcopy(n) for n in self._registrations
+            ]
+            for peer in self._registrations:
+                reply = Message()
+                reply.meta.recver = peer.id
+                payload = roster if peer.id == node.id else [copy.deepcopy(node)]
+                reply.meta.control = Control(cmd=Command.ADD_NODE, node=payload)
+                self.send(reply)
+
+    def _process_roster(self, msg: Message) -> None:
+        """Non-scheduler handling of the scheduler's ADD_NODE broadcast."""
+        my_addr = self.my_node.addr_key()
+        for node in msg.meta.control.node:
+            if (
+                self.my_node.id == EMPTY_ID
+                and node.addr_key() == my_addr
+                and node.role == self.my_node.role
+            ):
+                self.my_node.id = node.id
+                self.my_node.is_recovery = node.is_recovery
+                self.po.on_id_assigned(node)
+            if node.id == self.my_node.id or node.role == self.my_node.role:
+                # Never connect worker<->worker or server<->server
+                # (reference: README.md:20).
+                if node.id != self.my_node.id:
+                    continue
+            if node.role == Role.SCHEDULER and not self.po.is_scheduler:
+                continue  # already connected during start()
+            if node.id != self.my_node.id:
+                self.connect(node)
+        log.check(self.my_node.id != EMPTY_ID, "scheduler did not assign my id")
+        self.ready.set()
+
+    # -- barriers ------------------------------------------------------------
+
+    def request_barrier(self, group: int, instance: bool) -> None:
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.control = Control(
+            cmd=Command.INSTANCE_BARRIER if instance else Command.BARRIER,
+            barrier_group=group,
+        )
+        msg.meta.timestamp = self.next_timestamp()
+        self.send(msg)
+
+    def _barrier_expected(self, group: int, instance: bool) -> int:
+        from ..base import group_members
+
+        sched, srv, wrk = group_members(group)
+        count = 1 if sched else 0
+        if instance:
+            count += self.po.num_server_instances if srv else 0
+            count += self.po.num_worker_instances if wrk else 0
+        else:
+            count += self.po.num_servers if srv else 0
+            count += self.po.num_workers if wrk else 0
+        return count
+
+    def _process_barrier(self, msg: Message, instance: bool) -> None:
+        if msg.meta.request:
+            group = msg.meta.control.barrier_group
+            key = (group, instance)
+            senders = self._barrier_senders.setdefault(key, set())
+            senders.add(msg.meta.sender)
+            # Instance barriers count every instance; group barriers count
+            # distinct group ranks (reference: van.cc:351-426).
+            if instance:
+                progress = len(senders)
+            else:
+                progress = len({self.po.id_to_group_rank(s) for s in senders})
+            if progress >= self._barrier_expected(group, instance):
+                members = sorted(senders)
+                self._barrier_senders[key] = set()
+                for member in members:
+                    reply = Message()
+                    reply.meta.recver = member
+                    reply.meta.request = False
+                    reply.meta.app_id = msg.meta.app_id
+                    reply.meta.customer_id = msg.meta.customer_id
+                    reply.meta.control = Control(
+                        cmd=msg.meta.control.cmd, barrier_group=group
+                    )
+                    reply.meta.timestamp = self.next_timestamp()
+                    self.send(reply)
+        else:
+            self.po.manage(msg)
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def _heartbeat_loop(self, interval_s: float) -> None:
+        while not self._stop_event.wait(interval_s):
+            if not self.ready.is_set():
+                continue
+            msg = Message()
+            msg.meta.recver = SCHEDULER_ID
+            msg.meta.request = True
+            msg.meta.control = Control(
+                cmd=Command.HEARTBEAT, node=[copy.deepcopy(self.my_node)]
+            )
+            msg.meta.timestamp = self.next_timestamp()
+            try:
+                self.send(msg)
+            except Exception as exc:
+                log.warning(f"heartbeat send failed: {exc!r}")
+
+    def _process_heartbeat(self, msg: Message) -> None:
+        now = time.time()
+        self.po.update_heartbeat(msg.meta.sender, now)
+        if msg.meta.request and self.po.is_scheduler:
+            reply = Message()
+            reply.meta.recver = msg.meta.sender
+            reply.meta.request = False
+            reply.meta.control = Control(cmd=Command.HEARTBEAT)
+            reply.meta.timestamp = self.next_timestamp()
+            self.send(reply)

@@ -1,0 +1,256 @@
+"""Wire format: Meta <-> bytes, and message framing for byte-stream vans.
+
+Equivalent of the reference's hand-rolled POD wire format
+(``src/meta.h``, ``PackMeta/UnpackMeta/GetPackMetaLen`` in
+``src/van.cc:689-831``) — a compact little-endian layout, no protobuf.
+The layout here is our own (versioned, explicit field order); when the native
+C++ core is built it implements this exact format so Python and C++ peers
+interoperate.
+
+Frame layout used by stream transports (tcp van)::
+
+    u32 magic | u32 meta_len | u32 n_data | u64 data_len[n_data] | meta | data...
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from .message import (
+    Command,
+    Control,
+    Message,
+    Meta,
+    Node,
+    Role,
+    code_dtype,
+)
+from .sarray import SArray
+
+MAGIC = 0x50535450  # "PSTP"
+WIRE_VERSION = 1
+
+_META_FIXED = struct.Struct(
+    "<B"  # version
+    "iiiii i"  # head app_id customer_id timestamp sender recver
+    "B"  # flags: request|push|pull|simple_app
+    "Q Q q q i q"  # key addr val_len option sid data_size
+    "b i b i"  # src_dev_type src_dev_id dst_dev_type dst_dev_id
+    "B i Q"  # control_cmd barrier_group msg_sig
+    "H H I"  # num_nodes num_data_types body_len
+)
+
+_NODE_FIXED = struct.Struct("<B i i B i H H H H")  # role id customer_id
+# is_recovery aux_id hostname_len num_ports num_devs endpoint_len
+
+_F_REQUEST, _F_PUSH, _F_PULL, _F_SIMPLE = 1, 2, 4, 8
+
+
+def _pack_node(n: Node) -> bytes:
+    host = n.hostname.encode()
+    ndev = len(n.dev_types)
+    out = [
+        _NODE_FIXED.pack(
+            int(n.role),
+            n.id,
+            n.customer_id,
+            int(n.is_recovery),
+            n.aux_id,
+            len(host),
+            len(n.ports),
+            ndev,
+            len(n.endpoint_name),
+        ),
+        host,
+        struct.pack(f"<{len(n.ports)}i", *n.ports),
+        struct.pack(f"<{ndev}i", *n.dev_types),
+        struct.pack(f"<{ndev}i", *n.dev_ids),
+        bytes(n.endpoint_name),
+    ]
+    return b"".join(out)
+
+
+def _unpack_node(buf: memoryview, off: int) -> Tuple[Node, int]:
+    (role, nid, cust, is_rec, aux, hlen, nports, ndev, elen) = _NODE_FIXED.unpack_from(
+        buf, off
+    )
+    off += _NODE_FIXED.size
+    host = bytes(buf[off : off + hlen]).decode()
+    off += hlen
+    ports = list(struct.unpack_from(f"<{nports}i", buf, off))
+    off += 4 * nports
+    dev_types = list(struct.unpack_from(f"<{ndev}i", buf, off))
+    off += 4 * ndev
+    dev_ids = list(struct.unpack_from(f"<{ndev}i", buf, off))
+    off += 4 * ndev
+    endpoint = bytes(buf[off : off + elen])
+    off += elen
+    node = Node(
+        role=Role(role),
+        id=nid,
+        customer_id=cust,
+        hostname=host,
+        ports=ports,
+        dev_types=dev_types,
+        dev_ids=dev_ids,
+        is_recovery=bool(is_rec),
+        endpoint_name=endpoint,
+        aux_id=aux,
+    )
+    return node, off
+
+
+def pack_meta(meta: Meta) -> bytes:
+    flags = (
+        (_F_REQUEST if meta.request else 0)
+        | (_F_PUSH if meta.push else 0)
+        | (_F_PULL if meta.pull else 0)
+        | (_F_SIMPLE if meta.simple_app else 0)
+    )
+    ctrl = meta.control
+    fixed = _META_FIXED.pack(
+        WIRE_VERSION,
+        meta.head,
+        meta.app_id,
+        meta.customer_id,
+        meta.timestamp,
+        meta.sender,
+        meta.recver,
+        flags,
+        meta.key % (1 << 64),
+        meta.addr % (1 << 64),
+        meta.val_len,
+        meta.option,
+        meta.sid,
+        meta.data_size,
+        meta.src_dev_type,
+        meta.src_dev_id,
+        meta.dst_dev_type,
+        meta.dst_dev_id,
+        int(ctrl.cmd),
+        ctrl.barrier_group,
+        ctrl.msg_sig % (1 << 64),
+        len(ctrl.node),
+        len(meta.data_type),
+        len(meta.body),
+    )
+    parts = [fixed]
+    parts.append(bytes(bytearray(min(c, 255) for c in meta.data_type)))
+    parts.append(bytes(meta.body))
+    for n in ctrl.node:
+        parts.append(_pack_node(n))
+    return b"".join(parts)
+
+
+def unpack_meta(buf: bytes) -> Meta:
+    view = memoryview(buf)
+    fields = _META_FIXED.unpack_from(view, 0)
+    (
+        version,
+        head,
+        app_id,
+        customer_id,
+        timestamp,
+        sender,
+        recver,
+        flags,
+        key,
+        addr,
+        val_len,
+        option,
+        sid,
+        data_size,
+        src_dt,
+        src_di,
+        dst_dt,
+        dst_di,
+        ctrl_cmd,
+        barrier_group,
+        msg_sig,
+        num_nodes,
+        num_dtypes,
+        body_len,
+    ) = fields
+    if version != WIRE_VERSION:
+        raise ValueError(f"wire version mismatch: {version} != {WIRE_VERSION}")
+    off = _META_FIXED.size
+    data_type = list(view[off : off + num_dtypes])
+    off += num_dtypes
+    body = bytes(view[off : off + body_len])
+    off += body_len
+    nodes = []
+    for _ in range(num_nodes):
+        node, off = _unpack_node(view, off)
+        nodes.append(node)
+    meta = Meta(
+        head=head,
+        app_id=app_id,
+        customer_id=customer_id,
+        timestamp=timestamp,
+        sender=sender,
+        recver=recver,
+        request=bool(flags & _F_REQUEST),
+        push=bool(flags & _F_PUSH),
+        pull=bool(flags & _F_PULL),
+        simple_app=bool(flags & _F_SIMPLE),
+        body=body,
+        data_type=data_type,
+        control=Control(
+            cmd=Command(ctrl_cmd), node=nodes, barrier_group=barrier_group,
+            msg_sig=msg_sig,
+        ),
+        key=key,
+        addr=addr,
+        val_len=val_len,
+        option=option,
+        sid=sid,
+        data_size=data_size,
+        src_dev_type=src_dt,
+        src_dev_id=src_di,
+        dst_dev_type=dst_dt,
+        dst_dev_id=dst_di,
+    )
+    return meta
+
+
+# -- stream framing ----------------------------------------------------------
+
+_FRAME_HDR = struct.Struct("<III")  # magic, meta_len, n_data
+
+
+def pack_frame(msg: Message) -> List[bytes]:
+    """Serialize a message into an iovec-style list of byte chunks.
+
+    Data segments are passed through zero-copy (memoryviews over the numpy
+    buffers) so large tensors are never copied on the send path.
+    """
+    meta_buf = pack_meta(msg.meta)
+    lens = struct.pack(f"<{len(msg.data)}Q", *[d.nbytes for d in msg.data])
+    hdr = _FRAME_HDR.pack(MAGIC, len(meta_buf), len(msg.data))
+    chunks: List[bytes] = [hdr, lens, meta_buf]
+    for d in msg.data:
+        chunks.append(memoryview(np.ascontiguousarray(d.data)).cast("B"))
+    return chunks
+
+
+def unpack_frame_header(hdr: bytes) -> Tuple[int, int]:
+    magic, meta_len, n_data = _FRAME_HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic: {magic:#x}")
+    return meta_len, n_data
+
+
+FRAME_HEADER_SIZE = _FRAME_HDR.size
+
+
+def rebuild_message(meta: Meta, data_bufs: List[bytes]) -> Message:
+    """Reassemble a Message from unpacked meta + raw data segments."""
+    msg = Message(meta=meta)
+    for i, raw in enumerate(data_bufs):
+        code = meta.data_type[i] if i < len(meta.data_type) else 2
+        arr = np.frombuffer(raw, dtype=code_dtype(code))
+        msg.data.append(SArray(arr))
+    return msg

@@ -1,0 +1,27 @@
+"""Test bootstrap: force the CPU backend with 8 virtual devices.
+
+Sharding/collective tests run on a virtual 8-device CPU mesh; real-TPU
+benchmarking happens in bench.py (which does NOT import this).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _loopback_isolation(request):
+    """Give each test its own loopback namespace and clean registry."""
+    os.environ["PS_LOOPBACK_NS"] = request.node.nodeid
+    yield
+    from pslite_tpu.vans import loopback_van
+
+    loopback_van.reset_registry()
+    os.environ.pop("PS_LOOPBACK_NS", None)

@@ -1,0 +1,112 @@
+"""In-process cluster harness for unit tests.
+
+Runs a whole PS cluster (scheduler + servers + workers, optionally with
+instance groups) inside one process over the loopback van — the functional
+test tier the reference fork dropped (SURVEY §4).  Every node gets its own
+Environment override map, so one OS process hosts many logical nodes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from pslite_tpu.base import ALL_GROUP
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import Role
+from pslite_tpu.postoffice import Postoffice
+
+_cluster_seq = itertools.count(1)
+
+
+class LoopbackCluster:
+    def __init__(
+        self,
+        num_workers: int = 1,
+        num_servers: int = 1,
+        group_size: int = 1,
+        env_extra: Optional[Dict[str, str]] = None,
+        van_type: str = "loopback",
+    ):
+        if van_type == "tcp":
+            from pslite_tpu.utils.network import get_available_port
+
+            host, port = "127.0.0.1", get_available_port()
+        else:
+            host, port = "lo", 40000 + next(_cluster_seq)
+        self.base_env = {
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_NUM_SERVER": str(num_servers),
+            "DMLC_GROUP_SIZE": str(group_size),
+            "DMLC_PS_ROOT_URI": host,
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NODE_HOST": host,
+            "PS_VAN_TYPE": van_type,
+        }
+        if env_extra:
+            self.base_env.update(env_extra)
+        self.scheduler = self._make(Role.SCHEDULER, 0)
+        self.servers: List[Postoffice] = [
+            self._make(Role.SERVER, idx)
+            for _ in range(num_servers)
+            for idx in range(group_size)
+        ]
+        self.workers: List[Postoffice] = [
+            self._make(Role.WORKER, idx)
+            for _ in range(num_workers)
+            for idx in range(group_size)
+        ]
+
+    def _make(self, role: Role, instance_idx: int) -> Postoffice:
+        env = Environment(dict(self.base_env))
+        return Postoffice(role, instance_idx=instance_idx, env=env)
+
+    def all_nodes(self) -> List[Postoffice]:
+        return [self.scheduler] + self.servers + self.workers
+
+    def start(self, customer_id: int = 0, do_barrier: bool = True) -> None:
+        errors = []
+
+        def _start(po):
+            try:
+                po.start(customer_id, do_barrier=do_barrier)
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=_start, args=(po,), daemon=True)
+            for po in self.all_nodes()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        if errors:
+            raise errors[0]
+        for t in threads:
+            assert not t.is_alive(), "cluster start timed out"
+
+    def finalize(self, customer_id: int = 0, do_barrier: bool = True) -> None:
+        threads = [
+            threading.Thread(
+                target=po.finalize, args=(customer_id, do_barrier), daemon=True
+            )
+            for po in self.all_nodes()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+    def barrier_all(self) -> None:
+        threads = [
+            threading.Thread(
+                target=po.barrier, args=(0, ALL_GROUP, True), daemon=True
+            )
+            for po in self.all_nodes()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)

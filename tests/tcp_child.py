@@ -1,0 +1,51 @@
+"""Child process for the multi-process TCP cluster test.
+
+Mirrors the reference's tests/local.sh + test_benchmark flow: the role comes
+from DMLC_ROLE; workers push then pull and verify multi-worker aggregation.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import pslite_tpu as ps
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.message import Role
+
+
+def main() -> int:
+    role = os.environ["DMLC_ROLE"]
+    ps.start_ps()
+    server = None
+    if role == "server":
+        server = KVServer(0)
+        server.set_request_handle(KVServerDefaultHandle())
+    if role == "worker":
+        po = ps.postoffice(Role.WORKER)
+        worker = KVWorker(0, 0)
+        ranges = po.get_server_key_ranges()
+        keys = np.array(
+            sorted([ranges[0].begin + 1, ranges[1].begin + 2]), dtype=np.uint64
+        )
+        vals = np.full(2 * 256, 1.5, dtype=np.float32)
+        worker.wait(worker.push(keys, vals))
+        # All workers must have pushed before pulling.
+        po.barrier(0, ps.WORKER_GROUP)
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        expected = 2 * 1.5  # two workers pushed
+        if not np.allclose(out, expected):
+            print(f"WORKER_FAIL: got {out[:4]} expected {expected}")
+            return 1
+        print("WORKER_OK")
+    ps.finalize()
+    if server is not None:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Instance groups (DMLC_GROUP_SIZE) — reference: ps.h:59-138.
+
+Each worker/server group hosts multiple instances; worker instance *i* only
+exchanges data with server instance *i* of each server group.
+"""
+
+import numpy as np
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.base import server_rank_to_id, worker_rank_to_id
+
+from helpers import LoopbackCluster
+
+
+def test_group_size_two_bootstrap_and_push():
+    cluster = LoopbackCluster(num_workers=1, num_servers=1, group_size=2)
+    cluster.start()
+    servers = []
+    try:
+        ids = sorted(po.van.my_node.id for po in cluster.servers)
+        assert ids == [server_rank_to_id(0), server_rank_to_id(1)]
+        ids = sorted(po.van.my_node.id for po in cluster.workers)
+        assert ids == [worker_rank_to_id(0), worker_rank_to_id(1)]
+
+        handles = {}
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            h = KVServerDefaultHandle()
+            srv.set_request_handle(h)
+            handles[po.instance_idx] = h
+            servers.append(srv)
+
+        # Worker instance 0 pushes; only server instance 0 must see it.
+        w0 = next(po for po in cluster.workers if po.instance_idx == 0)
+        worker = KVWorker(0, 0, postoffice=w0)
+        keys = np.array([5], dtype=np.uint64)
+        vals = np.arange(8, dtype=np.float32)
+        worker.wait(worker.push(keys, vals))
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+        assert 5 in handles[0].store
+        assert 5 not in handles[1].store
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()

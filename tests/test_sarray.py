@@ -1,0 +1,45 @@
+"""SArray zero-copy semantics (reference: include/ps/sarray.h)."""
+
+import numpy as np
+
+from pslite_tpu.range import Range, find_range
+from pslite_tpu.sarray import DeviceType, SArray
+
+
+def test_zero_copy_assignment():
+    a = SArray(np.arange(10, dtype=np.float32))
+    b = SArray(a)
+    assert a.shares_memory(b)
+    b.data[0] = 99.0
+    assert a.data[0] == 99.0
+
+
+def test_segment_is_view():
+    a = SArray(np.arange(10, dtype=np.float32), src_device=DeviceType.TPU,
+               src_device_id=3)
+    seg = a.segment(2, 5)
+    assert seg.size == 3
+    assert seg.shares_memory(a)
+    assert seg.src_device == DeviceType.TPU and seg.src_device_id == 3
+    seg.data[0] = -1.0
+    assert a.data[2] == -1.0
+
+
+def test_reinterpret_cast():
+    a = SArray(np.arange(4, dtype=np.uint64))
+    b = a.astype_view(np.uint8)
+    assert b.nbytes == a.nbytes
+    assert b.size == 32
+    assert b.shares_memory(a)
+
+
+def test_from_bytes():
+    a = SArray(b"\x01\x00\x00\x00", dtype=np.int32)
+    assert a.size == 1 and int(a[0]) == 1
+
+
+def test_find_range():
+    keys = np.array([2, 4, 8, 16, 32], dtype=np.uint64)
+    r = find_range(keys, 4, 17)
+    assert (r.begin, r.end) == (1, 4)
+    assert Range(3, 7).size() == 4

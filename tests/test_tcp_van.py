@@ -1,0 +1,81 @@
+"""TCP van tests: in-process cluster over real sockets, plus a true
+multi-process cluster (the reference's tests/local.sh pattern)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.utils.network import get_available_port
+
+from helpers import LoopbackCluster
+
+
+def test_tcp_cluster_in_process():
+    cluster = LoopbackCluster(num_workers=2, num_servers=2, van_type="tcp")
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        w0 = KVWorker(0, 0, postoffice=cluster.workers[0])
+        w1 = KVWorker(0, 0, postoffice=cluster.workers[1])
+
+        ranges = cluster.workers[0].get_server_key_ranges()
+        keys = np.array(
+            sorted([ranges[0].begin + 3, ranges[1].begin + 7]), dtype=np.uint64
+        )
+        k = 1024
+        vals = np.linspace(0, 1, 2 * k).astype(np.float32)
+        w0.wait(w0.push(keys, vals))
+        w1.wait(w1.push(keys, vals))
+        out = np.zeros_like(vals)
+        w1.wait(w1.pull(keys, out))
+        np.testing.assert_allclose(out, 2 * vals, rtol=1e-6)
+    finally:
+        for srv in servers:
+            srv.stop()
+        cluster.finalize()
+
+
+def test_tcp_cluster_multiprocess():
+    """1 scheduler + 2 servers + 2 workers as separate OS processes."""
+    port = get_available_port()
+    child = os.path.join(os.path.dirname(__file__), "tcp_child.py")
+    base_env = dict(
+        os.environ,
+        DMLC_NUM_WORKER="2",
+        DMLC_NUM_SERVER="2",
+        DMLC_PS_ROOT_URI="127.0.0.1",
+        DMLC_PS_ROOT_PORT=str(port),
+        DMLC_NODE_HOST="127.0.0.1",
+        PS_VAN_TYPE="tcp",
+    )
+    procs = []
+    for role in ["scheduler", "server", "server", "worker", "worker"]:
+        env = dict(base_env, DMLC_ROLE=role)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, child],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out.decode())
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+    worker_outs = [o for o in outputs if "WORKER_OK" in o]
+    assert len(worker_outs) == 2, f"expected 2 worker OKs, got: {outputs}"

@@ -1,0 +1,94 @@
+"""Wire-format round-trip tests (Meta pack/unpack + frames)."""
+
+import numpy as np
+
+from pslite_tpu import wire
+from pslite_tpu.message import Command, Control, Message, Meta, Node, Role
+from pslite_tpu.sarray import SArray
+
+
+def _sample_meta() -> Meta:
+    node_a = Node(
+        role=Role.WORKER,
+        id=9,
+        customer_id=2,
+        hostname="10.0.0.1",
+        ports=[5001, 5002],
+        dev_types=[2, 2],
+        dev_ids=[0, 1],
+        is_recovery=True,
+        endpoint_name=b"\x01\x02ep",
+        aux_id=3,
+    )
+    node_b = Node(role=Role.SERVER, id=8, hostname="10.0.0.2", ports=[6000])
+    return Meta(
+        head=7,
+        app_id=11,
+        customer_id=1,
+        timestamp=42,
+        sender=9,
+        recver=8,
+        request=True,
+        push=True,
+        pull=False,
+        simple_app=False,
+        body=b"hello-body",
+        data_type=[8, 10, 5],
+        control=Control(
+            cmd=Command.ADD_NODE,
+            node=[node_a, node_b],
+            barrier_group=7,
+            msg_sig=0xDEADBEEF,
+        ),
+        key=123456789,
+        addr=0xABCDEF,
+        val_len=4096,
+        option=-5,
+        sid=77,
+        data_size=8192,
+        src_dev_type=2,
+        src_dev_id=0,
+        dst_dev_type=1,
+        dst_dev_id=-1,
+    )
+
+
+def test_meta_roundtrip():
+    meta = _sample_meta()
+    buf = wire.pack_meta(meta)
+    out = wire.unpack_meta(buf)
+    assert out == meta
+
+
+def test_empty_meta_roundtrip():
+    meta = Meta()
+    out = wire.unpack_meta(wire.pack_meta(meta))
+    assert out == meta
+
+
+def test_frame_roundtrip():
+    msg = Message(meta=Meta(app_id=3, timestamp=5, request=True, push=True))
+    keys = np.array([1, 2, 3], dtype=np.uint64)
+    vals = np.arange(12, dtype=np.float32)
+    msg.add_data(SArray(keys))
+    msg.add_data(SArray(vals))
+    chunks = wire.pack_frame(msg)
+    blob = b"".join(bytes(c) for c in chunks)
+
+    meta_len, n_data = wire.unpack_frame_header(blob[: wire.FRAME_HEADER_SIZE])
+    assert n_data == 2
+    import struct
+
+    off = wire.FRAME_HEADER_SIZE
+    lens = struct.unpack_from("<2Q", blob, off)
+    off += 16
+    meta = wire.unpack_meta(blob[off : off + meta_len])
+    off += meta_len
+    bufs = []
+    for ln in lens:
+        bufs.append(blob[off : off + ln])
+        off += ln
+    out = wire.rebuild_message(meta, bufs)
+    np.testing.assert_array_equal(out.data[0].numpy().view(np.uint64), keys)
+    np.testing.assert_array_equal(out.data[1].numpy().view(np.float32), vals)
+    assert out.meta.data_size == keys.nbytes + vals.nbytes
