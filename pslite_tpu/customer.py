@@ -93,14 +93,22 @@ class Customer:
             self._tracker[timestamp][1] += num
             self._cv.notify_all()
 
+    _MAX_HOOK_ENTRIES = 256
+
     def add_wait_hook(self, timestamp: int, hook: Callable[[], None]) -> None:
-        """Attach a device-completion hook run by wait_request (ICI path)."""
+        """Attach a device-completion hook run by wait_request (ICI path).
+
+        Hooks must be idempotent (e.g. ``Future.result``): they run on
+        *every* wait of the timestamp so concurrent waiters all observe
+        completion.  Entries are evicted FIFO beyond a bounded window."""
         with self._mu:
             self._hooks.setdefault(timestamp, []).append(hook)
+            while len(self._hooks) > self._MAX_HOOK_ENTRIES:
+                self._hooks.pop(next(iter(self._hooks)))
 
     def _take_hooks(self, timestamp: int) -> List[Callable[[], None]]:
         with self._mu:
-            return self._hooks.pop(timestamp, [])
+            return list(self._hooks.get(timestamp, ()))
 
     # -- receive pump --------------------------------------------------------
 

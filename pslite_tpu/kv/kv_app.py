@@ -118,12 +118,17 @@ class KVWorker:
         self._recv_kvs: Dict[int, List[KVPairs]] = {}
         self._pull_dst: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = {}
         self._slicer = default_slicer
-        # Zero-copy transports (ici/shm) deliver pulls in place; message
-        # transports reassemble on completion (kv_app.h is_worker_zpull_).
-        self._zero_copy_pull = self.po.van.__class__.__name__ in (
-            "IciVan",
-            "ShmVan",
-        )
+        # Zero-copy transports (shm) deliver pulls in place, so completion
+        # skips reassembly (kv_app.h is_worker_zpull_).  The ICI van's
+        # engine path never reaches _finish; its message *fallback* path
+        # behaves like a normal transport and must reassemble.
+        self._zero_copy_pull = self.po.van.__class__.__name__ == "ShmVan"
+        # Dense buckets / sparse tables routed through the collective engine
+        # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
+        # compared on lookup).
+        self._dense_routes: Dict[Tuple[int, int, int], str] = {}
+        self._device_results: Dict[int, object] = {}
+        self._engine_pool = None  # lazy completion executor (engine path)
 
     @property
     def engine(self):
@@ -133,6 +138,102 @@ class KVWorker:
     def set_slicer(self, slicer) -> None:
         """Custom slicer hook (kv_app.h:256-265)."""
         self._slicer = slicer
+
+    # -- ICI collective fast path -------------------------------------------
+
+    def register_dense(self, name: str, keys, val_len: int, dtype=None,
+                       init=None):
+        """Register a dense bucket on the collective engine; subsequent
+        push/pull on exactly these keys ride jitted ICI collectives.  The
+        analog of the reference's first-touch rendezvous + registration
+        (rdma_van.h:520-548)."""
+        log.check(self.engine is not None,
+                  "register_dense requires the ici van")
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        bucket = self.engine.register_dense(name, keys, val_len, dtype=dtype,
+                                            init=init)
+        self._dense_routes[
+            (len(keys), int(keys[0]), int(keys[-1]))
+        ] = name
+        return bucket
+
+    def _engine_route(self, keys: np.ndarray, cmd: int = 0,
+                      lens=None) -> Optional[str]:
+        """Bucket name iff these exact keys are registered and the request
+        carries nothing the collective path cannot express (custom cmd,
+        variable lens fall back to the message path)."""
+        if self.engine is None or len(keys) == 0:
+            return None
+        if cmd != 0 or lens is not None:
+            return None
+        name = self._dense_routes.get((len(keys), int(keys[0]), int(keys[-1])))
+        if name is None:
+            return None
+        if not np.array_equal(self.engine.bucket(name).keys, keys):
+            return None  # same signature, different key set
+        return name
+
+    _MAX_DEVICE_RESULTS = 8
+
+    def _engine_dispatch(self, result, out=None, callback=None,
+                         keep_result: bool = False) -> int:
+        """Timestamp + async completion for a collective op.
+
+        Completion (device done -> host copy -> callback) runs on a
+        dedicated thread so callbacks fire without wait(), matching the
+        message path; wait(ts) joins the same future (idempotent hook).
+        """
+        import concurrent.futures
+
+        ts = self._customer.new_request(SERVER_GROUP, num_responses=0)
+        with self._mu:
+            if self._engine_pool is None:
+                self._engine_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-engine-complete"
+                )
+            if keep_result:
+                self._device_results[ts] = result
+                while len(self._device_results) > self._MAX_DEVICE_RESULTS:
+                    self._device_results.pop(next(iter(self._device_results)))
+        fut = self._engine_pool.submit(
+            self._engine_complete, result, out, callback
+        )
+        self._customer.add_wait_hook(ts, fut.result)
+        return ts
+
+    @staticmethod
+    def _engine_complete(result, out, callback):
+        result.block_until_ready()
+        if out is not None:
+            np.copyto(
+                out.reshape(-1),
+                np.asarray(result).reshape(-1)[: out.size].astype(out.dtype),
+            )
+        if callback is not None:
+            callback()
+
+    def get_pulled(self, ts: int):
+        """Device-resident pull result for a recent engine-path timestamp
+        (bounded window of the last few results)."""
+        with self._mu:
+            return self._device_results.get(ts)
+
+    def push_sparse(self, name: str, indices, grads,
+                    callback=None) -> int:
+        """Sparse push: [W, n] rows + [W, n, d] grads scatter-added into the
+        sharded table (aggregation server handle)."""
+        eng = getattr(self.po.van, "sparse_engine", None)
+        log.check(eng is not None, "push_sparse requires the ici van")
+        store = eng.push(name, indices, grads)
+        return self._engine_dispatch(store, callback=callback)
+
+    def pull_sparse(self, name: str, indices, out=None,
+                    callback=None) -> int:
+        eng = getattr(self.po.van, "sparse_engine", None)
+        log.check(eng is not None, "pull_sparse requires the ici van")
+        result = eng.pull(name, indices)
+        return self._engine_dispatch(result, out=out, callback=callback,
+                                     keep_result=True)
 
     # -- public ops ----------------------------------------------------------
 
@@ -147,6 +248,11 @@ class KVWorker:
     ) -> int:
         """Zero-copy push; caller must not mutate buffers until wait(ts)
         (kv_app.h:210-231)."""
+        route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
+                                   lens)
+        if route is not None:
+            store = self.engine.push(route, vals)
+            return self._engine_dispatch(store, callback=callback)
         kvs = _as_kvs(keys, vals, lens, priority)
         ts = self._customer.new_request(SERVER_GROUP)
         if callback is not None:
@@ -166,6 +272,11 @@ class KVWorker:
     ) -> int:
         """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792)."""
         keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        route = self._engine_route(keys, cmd, lens)
+        if route is not None:
+            result = self.engine.pull(route)
+            return self._engine_dispatch(result, out=vals, callback=callback,
+                                         keep_result=True)
         ts = self._customer.new_request(SERVER_GROUP)
         with self._mu:
             if callback is not None:
@@ -187,6 +298,12 @@ class KVWorker:
         priority: int = 0,
     ) -> int:
         """Fused push+pull round trip (the benchmark hot path)."""
+        route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
+                                   lens)
+        if route is not None:
+            result = self.engine.push_pull(route, vals)
+            return self._engine_dispatch(result, out=outs, callback=callback,
+                                         keep_result=True)
         kvs = _as_kvs(keys, vals, lens, priority)
         ts = self._customer.new_request(SERVER_GROUP)
         with self._mu:

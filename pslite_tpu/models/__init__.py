@@ -1,0 +1,13 @@
+"""Model zoo / workload generators.
+
+The reference ships no models (SURVEY §0); its benchmark workloads are
+traffic shapes.  This package provides both: a flagship transformer LM
+(``transformer.py``) whose training step exercises the full PS data plane
+(pull = all_gather, push = reduce-scatter, server update between), plus the
+reference-benchmark workload generators (ResNet-50 gradient trace, sparse
+embedding) used by the BASELINE configs.
+"""
+
+from .transformer import ModelConfig, forward, init_params, loss_fn
+
+__all__ = ["ModelConfig", "forward", "init_params", "loss_fn"]

@@ -1,0 +1,103 @@
+"""PS-integrated SPMD training step for the flagship model.
+
+One jit-compiled program over a ``(dp, sp)`` mesh:
+
+1. **pull**: ``all_gather`` the flat parameter store (sharded over both
+   axes — every device is a PS server shard) and unravel into the params
+   pytree — the ``ZPull`` leg.
+2. forward/backward with **ring attention over sp** (long context) on the
+   local ``[B/dp, T/sp]`` token block — the worker compute.
+3. **push**: ``psum_scatter`` of the flat gradient over ``(dp, sp)`` — the
+   cross-worker aggregation ``KVServerDefaultHandle`` performs, executed as
+   a collective (the ``ZPush`` leg).
+4. **server update**: SGD applied to the local store shard.
+
+This is the reference's async PS loop (docs/overview.md:44-125) re-derived
+as a synchronous SPMD program — the "sync mode" SURVEY §7 requires, with
+the async per-message mode still available through KVServer handlers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+from .transformer import ModelConfig, init_params, loss_fn
+
+
+def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
+                       seed: int = 0):
+    """Returns (step_fn, flat_store, token_sharding, store_sharding).
+
+    ``step_fn(flat_store, inputs, targets) -> (flat_store, loss)`` is jitted
+    with donated store; inputs/targets are ``[B, T]`` int32 sharded
+    ``P('dp', 'sp')``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+    from ..parallel.ring_attention import ring_attention
+
+    axes = tuple(mesh.axis_names)  # e.g. ('dp', 'sp')
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    sp_axis = axes[-1]
+    sp = mesh.shape[sp_axis]
+
+    params0 = init_params(jax.random.PRNGKey(seed), cfg)
+    flat0, unravel = ravel_pytree(params0)
+    n_params = flat0.shape[0]
+    padded = -(-n_params // n_dev) * n_dev
+    flat0 = jnp.pad(flat0, (0, padded - n_params))
+
+    store_sharding = NamedSharding(mesh, P(axes))
+    token_sharding = NamedSharding(mesh, P(axes[0], sp_axis))
+    flat_store = jax.device_put(flat0, store_sharding)
+
+    def _local_step(store_l, inp_l, tgt_l):
+        # -- pull: params = all_gather(store) --------------------------------
+        flat = lax.all_gather(store_l, axes, tiled=True)[:n_params]
+        params = unravel(flat)
+
+        sp_idx = lax.axis_index(sp_axis)
+        t_local = inp_l.shape[1]
+        attn = lambda q, k, v: ring_attention(q, k, v, sp_axis, causal=True)
+
+        def _loss(p):
+            return loss_fn(p, inp_l, tgt_l, cfg, attn_fn=attn,
+                           pos_offset=sp_idx * t_local)
+
+        loss, grads = jax.value_and_grad(_loss)(params)
+        flat_g, _ = ravel_pytree(grads)
+        flat_g = jnp.pad(flat_g, (0, padded - n_params))
+
+        # -- push: reduce-scatter the summed gradient to server shards ------
+        agg = lax.psum_scatter(flat_g, axes, scatter_dimension=0, tiled=True)
+
+        # -- server update on the shard (mean of worker grads) --------------
+        new_store = store_l - lr * (agg / n_dev)
+        mean_loss = lax.psum(loss, axes) / n_dev
+        return new_store, mean_loss
+
+    fn = shard_map_compat(
+        _local_step,
+        mesh,
+        in_specs=(P(axes), P(axes[0], sp_axis), P(axes[0], sp_axis)),
+        out_specs=(P(axes), P()),
+    )
+    step = jax.jit(fn, donate_argnums=(0,))
+    return step, flat_store, token_sharding, store_sharding
+
+
+def toy_batch(cfg: ModelConfig, batch: int, seq: int, seed: int = 1):
+    """Deterministic toy LM data: predict (token + 1) mod vocab."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    inputs = rng.integers(0, cfg.vocab, size=(batch, seq)).astype(np.int32)
+    targets = (inputs + 1) % cfg.vocab
+    return inputs, targets

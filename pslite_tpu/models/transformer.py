@@ -1,0 +1,139 @@
+"""PSFormer — flagship transformer LM, written TPU-first.
+
+Pure-JAX (functional params pytree), bfloat16-friendly matmuls for the MXU,
+ring attention over a sequence-parallel mesh axis for long context, and a
+training step where the parameter server IS the optimizer loop:
+
+    pull   = all_gather of the sharded flat parameter store
+    push   = psum_scatter of the flat gradient (cross-worker aggregation)
+    update = server handle applied to the local store shard
+
+i.e. the BytePS gradient push/pull cycle (reference docs/overview.md:44-125)
+as one jit-compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    dim: int = 128
+    heads: int = 4
+    layers: int = 2
+    mlp_ratio: int = 4
+    dtype: str = "float32"  # params dtype; matmuls cast to bfloat16 on TPU
+
+
+def init_params(rng, cfg: ModelConfig):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(rng, 2 + cfg.layers)
+    D, H = cfg.dim, cfg.heads
+    scale = D ** -0.5
+
+    def dense(key, shape):
+        return (jax.random.normal(key, shape) * scale).astype(dt)
+
+    params = {
+        "embed": dense(keys[0], (cfg.vocab, D)),
+        "ln_f": jnp.ones((D,), dt),
+        "layers": [],
+    }
+    for i in range(cfg.layers):
+        k1, k2, k3, k4 = jax.random.split(keys[2 + i], 4)
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((D,), dt),
+                "ln2": jnp.ones((D,), dt),
+                "qkv": dense(k1, (D, 3 * D)),
+                "proj": dense(k2, (D, D)),
+                "mlp_in": dense(k3, (D, cfg.mlp_ratio * D)),
+                "mlp_out": dense(k4, (cfg.mlp_ratio * D, D)),
+            }
+        )
+    return params
+
+
+def _rmsnorm(x, scale):
+    import jax
+    import jax.numpy as jnp
+
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def forward(
+    params,
+    tokens,
+    cfg: ModelConfig,
+    attn_fn: Optional[Callable] = None,
+    pos_offset=0,
+):
+    """Token ids [B, T_local] -> logits [B, T_local, vocab].
+
+    ``attn_fn(q, k, v)`` defaults to the single-device causal reference;
+    under shard_map pass a ring_attention closure and the shard's global
+    ``pos_offset``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring_attention import reference_attention
+
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: reference_attention(q, k, v, causal=True)
+
+    D, H = cfg.dim, cfg.heads
+    hd = D // H
+    x = params["embed"][tokens]  # [B, T, D]
+    B, T, _ = x.shape
+    # Rotary-free learned-less sinusoidal positions (global under SP).
+    pos = pos_offset + jnp.arange(T)
+    freqs = jnp.exp(-jnp.arange(0, D, 2) / D * jnp.log(10000.0))
+    ang = pos[:, None] * freqs[None, :]
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    x = x + pe[None].astype(x.dtype)
+
+    compute_dt = jnp.bfloat16 if x.dtype != jnp.float64 else x.dtype
+
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = (h.astype(compute_dt) @ layer["qkv"].astype(compute_dt)).astype(
+            x.dtype
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, H, hd)
+        v = v.reshape(B, T, H, hd)
+        o = attn_fn(q, k, v).reshape(B, T, D)
+        x = x + (o.astype(compute_dt) @ layer["proj"].astype(compute_dt)
+                 ).astype(x.dtype)
+        h = _rmsnorm(x, layer["ln2"])
+        h = (h.astype(compute_dt) @ layer["mlp_in"].astype(compute_dt))
+        h = jax.nn.gelu(h.astype(x.dtype))
+        x = x + (h.astype(compute_dt) @ layer["mlp_out"].astype(compute_dt)
+                 ).astype(x.dtype)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x.astype(compute_dt) @ params["embed"].T.astype(compute_dt)
+              ).astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params, inputs, targets, cfg: ModelConfig, attn_fn=None,
+            pos_offset=0):
+    """Mean next-token cross-entropy over the local block."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = forward(params, inputs, cfg, attn_fn=attn_fn,
+                     pos_offset=pos_offset)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

@@ -1,0 +1,7 @@
+"""TPU parallel data plane: device meshes, collective KV engine, sparse
+tables, and sequence-parallel primitives."""
+
+from .mesh import default_mesh, make_mesh
+from .engine import CollectiveEngine, DenseBucket
+
+__all__ = ["CollectiveEngine", "DenseBucket", "default_mesh", "make_mesh"]

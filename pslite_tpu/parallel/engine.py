@@ -1,0 +1,303 @@
+"""CollectiveEngine — the ICI data plane for dense KV push/pull.
+
+This is the TPU-native replacement for the reference's RDMA/UCX hot path
+(SURVEY §2.4, §3.2-3.4), re-architected rather than translated:
+
+- Workers and server shards are the *same* devices of one SPMD mesh (the
+  colocated/JOINT deployment, reference ``ps.h:59-76``): the ``kv`` mesh
+  axis is simultaneously the worker fan-in axis and the server key-range
+  sharding axis.
+- ``push`` of a dense bucket is a jit-compiled ``psum_scatter`` (the
+  bandwidth-optimal half of an all-reduce): each device receives the
+  cross-worker **sum** of its own key range — the server-side aggregation of
+  ``KVServerDefaultHandle`` (kv_app.h:430-452) executed *inside* the
+  collective, on ICI, at line rate.
+- The server handler (sum / assign / SGD / custom jittable fn) is fused
+  between the reduce-scatter and the ``all_gather`` that implements
+  ``pull`` — one XLA program per (bucket shape, dtype, op), cached exactly
+  like the reference caches rendezvous addresses per (key, push, recver)
+  (rdma_van.h:250-325): first touch compiles, steady state replays.
+- Store shards are donated on every step, so the server state never
+  double-buffers in HBM.
+
+Zero-copy parity: ``RegisterRecvBuffer``'s "payload lands at this exact
+address" contract (test_benchmark.cc:169-181) maps to donated device buffers
+— the pulled array aliases the donated input's memory, no host round trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Dict, Optional, Union
+
+import numpy as np
+
+from ..utils import logging as log
+from .mesh import shard_map_compat as shard_map
+
+
+@dataclass
+class DenseBucket:
+    """A registered dense key bucket: the unit of collective push/pull.
+
+    Mirrors the reference benchmark's layout of ``NUM_KEY_PER_SERVER`` keys
+    of ``len`` bytes each (test_benchmark.cc:407-414): ``keys[i]`` owns
+    ``val_len`` consecutive values in the flat bucket vector.
+    """
+
+    name: str
+    keys: np.ndarray
+    val_len: int
+    dtype: object
+    total_len: int  # len(keys) * val_len
+    padded_len: int  # rounded up to a multiple of the mesh axis size
+
+
+ServerHandle = Union[str, Callable]
+
+
+class CollectiveEngine:
+    """Dense KV push/pull over one mesh axis.
+
+    ``grads`` arguments are globally shaped ``[W, total_len]`` (row w = the
+    gradient contributed by worker shard w), sharded ``P(axis, None)``; the
+    store is ``[padded_len]`` sharded ``P(axis)``.  All ops are async
+    (jax dispatch); ``block()`` or Customer wait-hooks give ZPush/Wait
+    semantics.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        axis_name: str = "kv",
+        server_handle: ServerHandle = "sum",
+    ):
+        import jax
+
+        from .mesh import default_mesh
+
+        self.mesh = mesh if mesh is not None else default_mesh(axis_name)
+        self.axis = axis_name
+        self.num_shards = self.mesh.shape[axis_name]
+        self._server_handle = server_handle
+        self._buckets: Dict[str, DenseBucket] = {}
+        self._stores: Dict[str, jax.Array] = {}
+        self._programs: Dict[tuple, Callable] = {}
+        self._mu = threading.Lock()
+
+    # -- registration --------------------------------------------------------
+
+    def register_dense(
+        self,
+        name: str,
+        keys,
+        val_len: int,
+        dtype=None,
+        init: Optional[np.ndarray] = None,
+    ) -> DenseBucket:
+        """Register a dense bucket and allocate its sharded store.
+
+        This is the moment the reference performs rendezvous + memory
+        registration (rdma_van.h:520-548); here it allocates the sharded
+        HBM store and (lazily) compiles the bucket's programs.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if dtype is None:
+            dtype = jnp.float32
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        total = len(keys) * val_len
+        padded = -(-total // self.num_shards) * self.num_shards
+        bucket = DenseBucket(
+            name=name,
+            keys=keys,
+            val_len=val_len,
+            dtype=dtype,
+            total_len=total,
+            padded_len=padded,
+        )
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        if init is not None:
+            flat = np.zeros(padded, dtype=np.asarray(init).dtype)
+            flat[:total] = np.asarray(init).reshape(-1)
+            store = jax.device_put(flat.astype(dtype), sharding)
+        else:
+            store = jax.device_put(
+                jnp.zeros(padded, dtype=dtype), sharding
+            )
+        with self._mu:
+            self._buckets[name] = bucket
+            self._stores[name] = store
+        return bucket
+
+    def bucket(self, name: str) -> DenseBucket:
+        return self._buckets[name]
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _handle_fn(self, handle: ServerHandle) -> Callable:
+        """Server-side update applied to (store_shard, aggregated_grads)."""
+        if callable(handle):
+            return handle
+        if handle == "sum":
+            return lambda store, agg: store + agg
+        if handle == "assign":
+            return lambda store, agg: agg
+        if handle.startswith("sgd"):
+            lr = float(handle.split(":", 1)[1]) if ":" in handle else 0.01
+            return lambda store, agg: store - lr * agg
+        raise ValueError(f"unknown server handle {handle!r}")
+
+    def _program(self, op: str, padded_len: int, dtype, handle_key) -> Callable:
+        """Jitted SPMD program for (op, shape, dtype, handle) — the
+        executable-cache analog of the reference's per-(key,push,recver)
+        rendezvous cache."""
+        key = (op, padded_len, str(dtype), handle_key)
+        with self._mu:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = self.axis
+        mesh = self.mesh
+        if op == "pull":
+            handle = None  # pull is read-only; no server update to fuse
+        else:
+            handle = self._handle_fn(
+                self._server_handle if handle_key == "_default" else handle_key
+            )
+        store_spec = P(axis)
+        grads_spec = P(axis, None)
+        repl_spec = P(None)
+
+        def _push_pull(store_l, grads_l):
+            # grads_l: [1, padded]; reduce-scatter across workers => my shard
+            agg = lax.psum_scatter(
+                grads_l[0], axis, scatter_dimension=0, tiled=True
+            )
+            new_store = handle(store_l, agg)
+            pulled = lax.all_gather(new_store, axis, tiled=True)
+            return new_store, pulled
+
+        def _push(store_l, grads_l):
+            agg = lax.psum_scatter(
+                grads_l[0], axis, scatter_dimension=0, tiled=True
+            )
+            return handle(store_l, agg)
+
+        def _pull(store_l):
+            return lax.all_gather(store_l, axis, tiled=True)
+
+        if op == "push_pull":
+            fn = shard_map(
+                _push_pull,
+                mesh=mesh,
+                in_specs=(store_spec, grads_spec),
+                out_specs=(store_spec, repl_spec),
+            )
+            jitted = jax.jit(fn, donate_argnums=(0,))
+        elif op == "push":
+            fn = shard_map(
+                _push,
+                mesh=mesh,
+                in_specs=(store_spec, grads_spec),
+                out_specs=store_spec,
+            )
+            jitted = jax.jit(fn, donate_argnums=(0,))
+        elif op == "pull":
+            fn = shard_map(
+                _pull, mesh=mesh, in_specs=(store_spec,), out_specs=repl_spec
+            )
+            jitted = jax.jit(fn)
+        else:
+            raise ValueError(op)
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    # -- data plane ops ------------------------------------------------------
+
+    def _prep_grads(self, bucket: DenseBucket, grads):
+        """Accept [W, total] (or [total] broadcast) host/device arrays and
+        deliver a [W, padded] device array sharded over the worker axis."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if isinstance(grads, jax.Array) and grads.ndim == 2:
+            if grads.shape[1] == bucket.padded_len:
+                if grads.sharding == sharding:
+                    return grads
+                return jax.device_put(grads, sharding)
+        arr = jnp.asarray(grads, dtype=bucket.dtype)
+        if arr.ndim == 1:
+            arr = jnp.broadcast_to(arr, (self.num_shards, arr.shape[0]))
+        log.check_eq(int(arr.shape[0]), self.num_shards, "bad worker dim")
+        if arr.shape[1] != bucket.padded_len:
+            log.check_eq(int(arr.shape[1]), bucket.total_len, "bad grad len")
+            pad = bucket.padded_len - bucket.total_len
+            arr = jnp.pad(arr, ((0, 0), (0, pad)))
+        return jax.device_put(arr, sharding)
+
+    def push_pull(self, name: str, grads, handle: Optional[ServerHandle] = None):
+        """Fused push+aggregate+update+pull; returns the replicated pulled
+        array (async).  The benchmark hot path (SURVEY §3.2)."""
+        bucket = self._buckets[name]
+        prog = self._program(
+            "push_pull", bucket.padded_len, bucket.dtype,
+            "_default" if handle is None else handle,
+        )
+        g = self._prep_grads(bucket, grads)
+        new_store, pulled = prog(self._stores[name], g)
+        self._stores[name] = new_store
+        return pulled[: bucket.total_len]
+
+    def push(self, name: str, grads, handle: Optional[ServerHandle] = None):
+        bucket = self._buckets[name]
+        prog = self._program(
+            "push", bucket.padded_len, bucket.dtype,
+            "_default" if handle is None else handle,
+        )
+        g = self._prep_grads(bucket, grads)
+        self._stores[name] = prog(self._stores[name], g)
+        return self._stores[name]
+
+    def pull(self, name: str):
+        bucket = self._buckets[name]
+        prog = self._program("pull", bucket.padded_len, bucket.dtype, "_pull")
+        return prog(self._stores[name])[: bucket.total_len]
+
+    def store_array(self, name: str):
+        """The sharded server-state array (for checkpointing)."""
+        return self._stores[name]
+
+    def set_store_array(self, name: str, value) -> None:
+        """Restore server state (checkpoint resume)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bucket = self._buckets[name]
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        arr = np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype))
+        flat = np.asarray(value).reshape(-1)
+        log.check(len(flat) in (bucket.total_len, bucket.padded_len),
+                  "bad restore length")
+        arr[: len(flat)] = flat
+        self._stores[name] = jax.device_put(arr, sharding)
+
+    def block(self, name: Optional[str] = None) -> None:
+        """Wait for outstanding device work (ZPush/Wait semantics)."""
+        if name is not None:
+            self._stores[name].block_until_ready()
+        else:
+            for store in list(self._stores.values()):
+                store.block_until_ready()

@@ -1,0 +1,93 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+The reference has no attention or sequence code (SURVEY §2.9); long-context
+support is new, TPU-first scope for this framework: sequence-sharded
+attention where K/V blocks rotate around the ring via ``ppermute`` while
+each shard accumulates blockwise softmax online (log-sum-exp carry), so a
+sequence of length ``T`` needs only ``T / num_shards`` resident K/V per
+device and communication rides neighbor ICI links.
+
+Layout: ``q, k, v`` are ``[B, T_local, H, D]`` per shard inside
+``shard_map`` over ``axis_name``; global sequence order is shard-major
+(shard s owns positions ``[s*T_local, (s+1)*T_local)``), which the causal
+mask uses to compare global positions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: float | None = None):
+    """Blockwise ring attention; call inside shard_map over ``axis_name``.
+
+    Returns the attention output ``[B, T_local, H, D]`` for this shard's
+    queries over the *global* key/value sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    S = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    q_pos = my * T + jnp.arange(T)  # global positions of my queries
+
+    # True -inf so the masked-row guards below can use isfinite().
+    neg_inf = -jnp.inf
+
+    def block(carry, i):
+        o, lse_m, lse_l, k_cur, v_cur = carry
+        # k_cur originated at shard (my - i) mod S.
+        src = (my - i) % S
+        k_pos = src * T + jnp.arange(T)
+        # scores: [B, H, T, Tk]
+        scores = jnp.einsum("bthd,bshd->bhts", q, k_cur) * scale
+        if causal:
+            mask = k_pos[None, :] > q_pos[:, None]  # [T, Tk]
+            scores = jnp.where(mask[None, None], neg_inf, scores)
+        m_new = jnp.maximum(lse_m, scores.max(axis=-1))
+        # Guard fully-masked rows: exp(neg_inf - neg_inf) -> use safe sub.
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        alpha = jnp.exp(lse_m - m_new)
+        alpha = jnp.where(jnp.isfinite(lse_m), alpha, 0.0)
+        lse_l = lse_l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhts,bshd->bthd", p, v_cur
+                                              ).transpose(0, 2, 1, 3)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m_new, lse_l, k_next, v_next), None
+
+    o0 = jnp.zeros((B, H, T, D), q.dtype)
+    m0 = jnp.full((B, H, T), neg_inf, q.dtype)
+    l0 = jnp.zeros((B, H, T), q.dtype)
+    (o, _, l, _, _), _ = lax.scan(
+        block, (o0, m0, l0, k, v), jnp.arange(S)
+    )
+    l = jnp.where(l == 0, 1.0, l)  # fully-masked rows output zeros
+    out = o / l[..., None]  # [B, H, T, D]
+    return out.transpose(0, 2, 1, 3)  # [B, T, H, D]
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: float | None = None):
+    """Single-device reference (same layout) for tests and the 1-chip path."""
+    import jax.numpy as jnp
+
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    if causal:
+        pos = jnp.arange(T)
+        mask = pos[None, :] > pos[:, None]
+        scores = jnp.where(mask[None, None], jnp.finfo(q.dtype).min, scores)
+    p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhts,bshd->bthd", p, v)  # [B, T, H, D]

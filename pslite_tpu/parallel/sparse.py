@@ -1,0 +1,195 @@
+"""Sparse KV tables — embedding-style push/pull over the mesh.
+
+The reference's sparse capability is KVPairs with arbitrary subsets of a
+huge key space, sliced to servers by key range and aggregated server-side
+(kv_app.h:430-452); its stress benchmark drives gather/scatter traffic
+(test_benchmark_stress.cc:249-431).  The TPU-native design shards the table
+rows over the ``kv`` mesh axis and turns push/pull into collectives with
+static shapes:
+
+- ``push``: all_gather the (indices, grads) of every worker shard, then each
+  table shard scatter-adds the rows it owns (``segment-sum`` aggregation —
+  the server handler as a reduction).
+- ``pull``: every shard materializes the owned rows for every worker's
+  index list (zeros elsewhere); a ``psum_scatter`` over the worker dimension
+  both sums the one-hot contributions and routes each worker exactly its
+  own batch — gather traffic rides the same bandwidth-optimal collective as
+  dense push.
+
+Row ownership is round-robin (``row % num_shards``) rather than contiguous
+range: skewed key distributions (the 1M-key embedding workload,
+BASELINE.md config 5) then load-balance across shards by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+import numpy as np
+
+from ..utils import logging as log
+from .mesh import shard_map_compat as shard_map
+
+
+@dataclass
+class SparseTable:
+    name: str
+    num_rows: int  # global rows
+    dim: int
+    rows_per_shard: int
+    dtype: object
+
+
+class SparseEngine:
+    """Sparse tables on the same mesh/axis as a CollectiveEngine."""
+
+    def __init__(self, mesh, axis_name: str = "kv"):
+        self.mesh = mesh
+        self.axis = axis_name
+        self.num_shards = mesh.shape[axis_name]
+        self._tables: Dict[str, SparseTable] = {}
+        self._stores: Dict[str, object] = {}
+        self._programs: Dict[tuple, Callable] = {}
+        self._mu = threading.Lock()
+
+    def register_sparse(self, name: str, num_rows: int, dim: int, dtype=None,
+                        init=None) -> SparseTable:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if dtype is None:
+            dtype = jnp.float32
+        rows_per_shard = -(-num_rows // self.num_shards)
+        table = SparseTable(name, num_rows, dim, rows_per_shard, dtype)
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if init is not None:
+            arr = np.zeros((rows_per_shard * self.num_shards, dim),
+                           dtype=np.dtype(dtype))
+            # Global row r lives on shard r % S at local row r // S: fill by
+            # interleaving so restore/init round-trips with pull.
+            arr[: num_rows] = np.asarray(init, dtype=np.dtype(dtype))
+            arr = arr.reshape(rows_per_shard, self.num_shards, dim).transpose(
+                1, 0, 2
+            ).reshape(-1, dim)
+            store = jax.device_put(arr, sharding)
+        else:
+            store = jax.device_put(
+                jnp.zeros((rows_per_shard * self.num_shards, dim), dtype=dtype),
+                sharding,
+            )
+        with self._mu:
+            self._tables[name] = table
+            self._stores[name] = store
+        return table
+
+    def _sparse_program(self, op: str, table: SparseTable, batch: int):
+        key = (op, table.name, batch)
+        with self._mu:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        import jax
+        from jax import lax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis
+        S = self.num_shards
+        R = table.rows_per_shard
+
+        def _push(store_l, idx_l, grads_l):
+            # store_l: [R, d]; idx_l: [1, n]; grads_l: [1, n, d]
+            all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
+            all_g = lax.all_gather(grads_l[0], axis, tiled=True)  # [W*n, d]
+            my = lax.axis_index(axis)
+            owned = (all_idx % S) == my
+            local_rows = jnp.where(owned, all_idx // S, R)  # R = dump slot
+            padded = jnp.zeros((R + 1, store_l.shape[1]), store_l.dtype)
+            padded = padded.at[local_rows].add(
+                jnp.where(owned[:, None], all_g, 0)
+            )
+            return store_l + padded[:R]
+
+        def _pull(store_l, idx_l):
+            # Route each worker its rows via psum_scatter over the worker dim.
+            all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
+            my = lax.axis_index(axis)
+            owned = (all_idx % S) == my
+            local_rows = jnp.where(owned, all_idx // S, 0)
+            vals = jnp.where(
+                owned[:, None], store_l[local_rows], 0
+            )  # [W*n, d]
+            vals = vals.reshape(S, -1, store_l.shape[1])  # [W, n, d]
+            mine = lax.psum_scatter(vals, axis, scatter_dimension=0,
+                                    tiled=True)  # [1, n, d]
+            return mine[0]  # [n, d] rows for my local indices
+
+        if op == "push":
+            fn = shard_map(
+                _push,
+                mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis, None), P(axis, None, None)),
+                out_specs=P(axis, None),
+            )
+            jitted = jax.jit(fn, donate_argnums=(0,))
+        elif op == "pull":
+            fn = shard_map(
+                _pull,
+                mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis, None)),
+                out_specs=P(axis, None),
+            )
+            jitted = jax.jit(fn)
+        else:
+            raise ValueError(op)
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _prep(self, table: SparseTable, indices, grads=None):
+        """[W, n] indices (+ [W, n, d] grads) sharded over the worker axis."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        idx = jnp.asarray(indices, dtype=jnp.int32)
+        log.check_eq(int(idx.shape[0]), self.num_shards, "bad worker dim")
+        idx_sh = jax.device_put(
+            idx, NamedSharding(self.mesh, P(self.axis, None))
+        )
+        if grads is None:
+            return idx_sh, None
+        g = jnp.asarray(grads, dtype=table.dtype)
+        g_sh = jax.device_put(
+            g, NamedSharding(self.mesh, P(self.axis, None, None))
+        )
+        return idx_sh, g_sh
+
+    def push(self, name: str, indices, grads):
+        """indices: [W, n] int rows per worker; grads: [W, n, d].
+        Duplicate rows (within or across workers) accumulate — the
+        aggregation contract of the default server handle."""
+        table = self._tables[name]
+        idx, g = self._prep(table, indices, grads)
+        prog = self._sparse_program("push", table, int(idx.shape[1]))
+        self._stores[name] = prog(self._stores[name], idx, g)
+        return self._stores[name]
+
+    def pull(self, name: str, indices):
+        """indices: [W, n] -> [W, n, d] rows, each worker shard receiving its
+        own batch."""
+        table = self._tables[name]
+        idx, _ = self._prep(table, indices)
+        prog = self._sparse_program("pull", table, int(idx.shape[1]))
+        out = prog(self._stores[name], idx)  # global [W*n, d]
+        return out.reshape(self.num_shards, -1, table.dim)
+
+    def store_array(self, name: str):
+        return self._stores[name]
+
+    def table(self, name: str) -> SparseTable:
+        return self._tables[name]

@@ -1,0 +1,58 @@
+"""IciVan — the flagship TPU transport: XLA collectives over the ICI mesh.
+
+The reference's BASELINE north star: an ``XlaVan/IciVan`` alongside
+zmq/rdma/fabric/ucx that maps ``KVWorker::ZPush/ZPull`` and KVServer
+aggregation onto reduce-scatter + all-gather over the device mesh, with the
+PS roles as logical shards of one SPMD program rather than RDMA endpoints.
+
+Split of planes (mirroring FabricVan nesting a ZMQVan for bootstrap,
+fabric_van.h:123-127):
+
+- **Control plane**: inherited message transport (loopback in-process; the
+  node still participates in scheduler bootstrap, barriers, heartbeats).
+- **Data plane**: a :class:`CollectiveEngine` + :class:`SparseEngine` on the
+  mesh.  ``KVWorker`` detects the engine and routes registered dense buckets
+  and sparse tables through jitted collectives; unregistered traffic falls
+  back to the message path, preserving the full KV contract (the "sync
+  collective vs async per-message" duality flagged in SURVEY §7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .loopback_van import LoopbackVan
+
+
+class IciVan(LoopbackVan):
+    def __init__(self, postoffice):
+        super().__init__(postoffice)
+        self.engine = None
+        self.sparse_engine = None
+        self._mesh = None
+
+    def set_mesh(self, mesh) -> None:
+        """Install a specific mesh before start() (tests, multi-host)."""
+        self._mesh = mesh
+
+    def start(self, customer_id: int) -> None:
+        super().start(customer_id)
+        # Only worker instances drive the SPMD data plane; scheduler/server
+        # instances keep the control-plane role (barriers, bookkeeping, and
+        # the async message fallback path).
+        if self.engine is None and self.po.is_worker:
+            from ..parallel.engine import CollectiveEngine
+            from ..parallel.sparse import SparseEngine
+
+            handle = self.env.find("PS_ICI_SERVER_HANDLE", "sum")
+            self.engine = CollectiveEngine(
+                mesh=self._mesh, server_handle=handle
+            )
+            self.sparse_engine = SparseEngine(
+                self.engine.mesh, self.engine.axis
+            )
+
+    def register_recv_buffer(self, sender_id: int, key: int, buffer) -> None:
+        # Donated HBM buffers make delivery-in-place the default on this
+        # van; nothing to pin (SURVEY §5 "RegisterRecvBuffer ⇒ donated HBM").
+        return None

@@ -6,12 +6,24 @@ benchmarking happens in bench.py (which does NOT import this).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set: the environment may preset JAX_PLATFORMS to the real TPU
+# (e.g. "axon"); unit tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize (TPU tunnel) may have already forced
+# jax_platforms programmatically at interpreter start; override before the
+# first backend use so tests stay on the 8-device virtual CPU mesh.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # jax-less host: non-jax tests still run
+    pass
 
 import pytest
 

@@ -1,0 +1,97 @@
+"""CollectiveEngine / SparseEngine numerics on an 8-device virtual CPU mesh.
+
+Validates that the ICI data plane reproduces the reference's server
+aggregation semantics (push => sum across workers, pull => broadcast;
+kv_app.h:430-452) as jitted reduce-scatter/all-gather collectives.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu.parallel import CollectiveEngine, default_mesh
+from pslite_tpu.parallel.sparse import SparseEngine
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    m = default_mesh()
+    assert m.shape["kv"] == 8, "conftest must provide 8 virtual devices"
+    return m
+
+
+def test_dense_push_pull_aggregates(mesh):
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(4, dtype=np.uint64)
+    val_len = 100  # total 400, not divisible by 8 -> exercises padding
+    eng.register_dense("b0", keys, val_len)
+    W = eng.num_shards
+    base = np.arange(4 * val_len, dtype=np.float32)
+    grads = np.stack([(w + 1) * base for w in range(W)])  # [W, total]
+    pulled = np.asarray(eng.push_pull("b0", grads))
+    expected = base * sum(range(1, W + 1))
+    np.testing.assert_allclose(pulled, expected, rtol=1e-5)
+
+
+def test_dense_push_accumulates_then_pull(mesh):
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(3, dtype=np.uint64)
+    eng.register_dense("b1", keys, 64)
+    ones = np.ones(3 * 64, dtype=np.float32)
+    eng.push("b1", ones)  # broadcast to all 8 workers -> sum = 8
+    eng.push("b1", ones)
+    out = np.asarray(eng.pull("b1"))
+    np.testing.assert_allclose(out, 16 * ones)
+
+
+def test_dense_sgd_handle(mesh):
+    eng = CollectiveEngine(mesh=mesh, server_handle="sgd:0.5")
+    keys = np.arange(2, dtype=np.uint64)
+    init = np.full(2 * 8, 10.0, dtype=np.float32)
+    eng.register_dense("b2", keys, 8, init=init)
+    grads = np.ones((8, 16), dtype=np.float32)  # sum = 8
+    pulled = np.asarray(eng.push_pull("b2", grads))
+    np.testing.assert_allclose(pulled, 10.0 - 0.5 * 8.0 * np.ones(16))
+
+
+def test_dense_init_roundtrip(mesh):
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(5, dtype=np.uint64)
+    init = np.random.default_rng(1).normal(size=5 * 32).astype(np.float32)
+    eng.register_dense("b3", keys, 32, init=init)
+    np.testing.assert_allclose(np.asarray(eng.pull("b3")), init, rtol=1e-6)
+
+
+def test_sparse_push_pull(mesh):
+    eng = SparseEngine(mesh)
+    rng = np.random.default_rng(7)
+    num_rows, dim, n = 37, 4, 6
+    eng.register_sparse("emb", num_rows, dim)
+    W = eng.num_shards
+    # Skewed indices with duplicates within and across workers.
+    idx = rng.integers(0, num_rows, size=(W, n)).astype(np.int32)
+    idx[:, 0] = 3  # hot row pushed by every worker
+    grads = rng.normal(size=(W, n, dim)).astype(np.float32)
+
+    eng.push("emb", idx, grads)
+
+    # Host reference: scatter-add.
+    ref = np.zeros((num_rows, dim), dtype=np.float32)
+    for w in range(W):
+        for i in range(n):
+            ref[idx[w, i]] += grads[w, i]
+
+    pulled = np.asarray(eng.pull("emb", idx))  # [W, n, dim]
+    for w in range(W):
+        np.testing.assert_allclose(pulled[w], ref[idx[w]], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_sparse_pull_zero_init(mesh):
+    eng = SparseEngine(mesh)
+    eng.register_sparse("z", 16, 2)
+    idx = np.zeros((8, 3), dtype=np.int32)
+    out = np.asarray(eng.pull("z", idx))
+    assert out.shape == (8, 3, 2)
+    np.testing.assert_array_equal(out, 0)

@@ -1,0 +1,120 @@
+"""ICI van end-to-end: the KV contract riding jitted collectives.
+
+The cluster control plane (scheduler bootstrap, barriers) runs in-process;
+dense registered buckets and sparse tables go through the CollectiveEngine;
+unregistered keys fall back to the async message path served by a KVServer —
+the sync/async duality SURVEY §7 requires.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+
+from helpers import LoopbackCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = LoopbackCluster(num_workers=1, num_servers=1, van_type="ici")
+    c.start()
+    yield c
+    c.finalize()
+
+
+def test_dense_bucket_push_pull(cluster):
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    assert worker.engine is not None
+    W = worker.engine.num_shards
+
+    keys = np.arange(8, dtype=np.uint64)
+    val_len = 50
+    worker.register_dense("grads", keys, val_len)
+
+    base = np.linspace(-1, 1, 8 * val_len).astype(np.float32)
+    grads = np.stack([(w + 1) * base for w in range(W)])
+
+    outs = np.zeros(8 * val_len, dtype=np.float32)
+    ts = worker.push_pull(keys, grads, outs)
+    worker.wait(ts)
+    np.testing.assert_allclose(outs, base * sum(range(1, W + 1)), rtol=1e-5)
+
+    # Device-resident result is also available (zero host copy).
+    dev = worker.get_pulled(ts)
+    assert dev is not None and dev.shape == (8 * val_len,)
+
+
+def test_dense_push_then_pull_separately(cluster):
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(4, dtype=np.uint64) + 100
+    worker.register_dense("acc", keys, 16)
+    ones = np.ones(4 * 16, dtype=np.float32)
+    worker.wait(worker.push(keys, ones))
+    out = np.zeros_like(ones)
+    worker.wait(worker.pull(keys, out))
+    W = worker.engine.num_shards
+    np.testing.assert_allclose(out, W * ones)
+
+
+def test_unregistered_keys_fall_back_to_messages(cluster):
+    srv = KVServer(0, postoffice=cluster.servers[0])
+    srv.set_request_handle(KVServerDefaultHandle())
+    try:
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([7777], dtype=np.uint64)
+        vals = np.full(32, 2.0, dtype=np.float32)
+        worker.wait(worker.push(keys, vals))
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+    finally:
+        srv.stop()
+
+
+def test_engine_callback_fires_without_wait(cluster):
+    """ps-lite's callback-driven pipelining: callbacks must fire on
+    completion even if the app never calls wait()."""
+    import threading
+
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(2, dtype=np.uint64) + 500
+    worker.register_dense("cb", keys, 8)
+    done = threading.Event()
+    worker.push(keys, np.ones(16, dtype=np.float32), callback=done.set)
+    assert done.wait(timeout=30), "engine-path callback never fired"
+
+
+def test_engine_route_rejects_different_keys(cluster):
+    """Same (count, first, last) signature but different keys must NOT hijack
+    the collective fast path."""
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.array([0, 5, 10], dtype=np.uint64)
+    worker.register_dense("sig", keys, 4)
+    other = np.array([0, 7, 10], dtype=np.uint64)
+    assert worker._engine_route(other) is None
+    assert worker._engine_route(keys) == "sig"
+    assert worker._engine_route(keys, cmd=3) is None
+
+
+def test_sparse_table_via_worker(cluster):
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    eng = cluster.workers[0].van.sparse_engine
+    assert eng is not None
+    eng.register_sparse("emb", num_rows=64, dim=8)
+    W = eng.num_shards
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 64, size=(W, 5)).astype(np.int32)
+    grads = rng.normal(size=(W, 5, 8)).astype(np.float32)
+    worker.wait(worker.push_sparse("emb", idx, grads))
+
+    out = np.zeros((W, 5, 8), dtype=np.float32)
+    worker.wait(worker.pull_sparse("emb", idx, out=out))
+
+    ref = np.zeros((64, 8), dtype=np.float32)
+    for w in range(W):
+        for i in range(5):
+            ref[idx[w, i]] += grads[w, i]
+    for w in range(W):
+        np.testing.assert_allclose(out[w], ref[idx[w]], rtol=1e-4, atol=1e-5)

@@ -1,0 +1,38 @@
+"""Flagship model: forward shapes, and the PS-integrated SPMD training step
+on a (dp=4, sp=2) virtual mesh — loss must decrease on learnable toy data."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from pslite_tpu.models.train import make_ps_train_step, toy_batch
+from pslite_tpu.models.transformer import ModelConfig, forward, init_params
+from pslite_tpu.parallel.mesh import make_mesh
+
+
+def test_forward_shapes_single_device():
+    cfg = ModelConfig(vocab=64, dim=32, heads=2, layers=1)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_ps_train_step_loss_decreases():
+    cfg = ModelConfig(vocab=32, dim=32, heads=2, layers=1)
+    mesh = make_mesh((4, 2), ("dp", "sp"))
+    step, store, tok_sharding, _ = make_ps_train_step(cfg, mesh, lr=0.5)
+
+    inputs, targets = toy_batch(cfg, batch=8, seq=16)
+    inputs = jax.device_put(inputs, tok_sharding)
+    targets = jax.device_put(targets, tok_sharding)
+
+    losses = []
+    for _ in range(10):
+        store, loss = step(store, inputs, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] * 0.9, losses

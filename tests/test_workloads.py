@@ -1,0 +1,47 @@
+"""Reference-benchmark workload generators (BASELINE configs 4 & 5)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu.models.embedding import replay as emb_replay, skewed_indices
+from pslite_tpu.models.resnet_trace import (
+    make_buckets,
+    replay as rn50_replay,
+    resnet50_param_sizes,
+    total_params,
+)
+from pslite_tpu.parallel import CollectiveEngine, default_mesh
+from pslite_tpu.parallel.sparse import SparseEngine
+
+
+def test_resnet50_trace_shape():
+    total = total_params()
+    # ResNet-50 has ~25.5M params; the trace must land close.
+    assert 25_000_000 < total < 26_000_000, total
+    buckets = make_buckets(4 << 20)
+    assert sum(n for _, n in buckets) == total
+    # Partitioning: no bucket exceeds BYTEPS_PARTITION_BYTES-equivalent.
+    assert all(n <= (4 << 20) // 4 for _, n in buckets)
+
+
+def test_resnet50_replay_small():
+    eng = CollectiveEngine(mesh=default_mesh())
+    step_bytes, dt = rn50_replay(eng, steps=1, bucket_bytes=64 << 20)
+    assert step_bytes == 2 * 4 * total_params()
+    assert dt > 0
+
+
+def test_embedding_skew_and_replay():
+    idx = skewed_indices(1000, 8, 256, seed=1)
+    assert idx.shape == (8, 256)
+    assert idx.min() >= 0 and idx.max() < 1000
+    # Zipf skew: the most common row should dominate.
+    _, counts = np.unique(idx, return_counts=True)
+    assert counts.max() > 10 * np.median(counts)
+
+    eng = SparseEngine(default_mesh())
+    step_bytes, dt = emb_replay(eng, num_rows=512, dim=8, batch=64, steps=2)
+    assert step_bytes == 2 * 4 * 8 * 64 * 8
+    assert dt > 0
