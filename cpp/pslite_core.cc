@@ -1,0 +1,427 @@
+// pslite_core — native transport core for pslite_tpu.
+//
+// TPU-native counterpart of the reference's C++ Van layer hot path
+// (src/zmq_van.h + src/van.cc framing): an epoll-driven TCP transport that
+// frames messages with the shared wire format
+//
+//   u32 magic | u32 meta_len | u32 n_data | u64 data_len[n_data] | meta | data…
+//
+// (see pslite_tpu/wire.py — the Python and C++ sides interoperate on the
+// byte level).  Socket IO, frame assembly, and the receive queue run on
+// native threads with no GIL involvement; Python drives it through the
+// C API below via ctypes.
+//
+// Build: make -C cpp   ->  cpp/libpslite_core.so
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <poll.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50535450;  // "PSTP", wire.py MAGIC
+constexpr size_t kHeaderSize = 12;       // magic + meta_len + n_data
+
+struct Frame {
+  uint8_t* buf = nullptr;  // lens + meta + data, one allocation
+  uint32_t meta_len = 0;
+  uint32_t n_data = 0;
+  // Offsets into buf:
+  //   [0, 8*n_data)                 data lens
+  //   [8*n_data, 8*n_data+meta_len) meta
+  //   then data segments back to back
+};
+
+// Per-connection frame reassembly state machine.
+struct Conn {
+  int fd = -1;
+  // Stage 0: header; stage 1: body (lens+meta+data).
+  int stage = 0;
+  size_t want = kHeaderSize;
+  size_t got = 0;
+  uint8_t header[kHeaderSize];
+  Frame frame;
+  size_t body_size = 0;
+
+  ~Conn() { free(frame.buf); }
+};
+
+class Core {
+ public:
+  Core() : epfd_(epoll_create1(0)) {}
+
+  ~Core() { StopAndJoin(); }
+
+  int Bind(int port, int backlog) {
+    // Non-blocking listener: AcceptAll drains until EAGAIN and must not
+    // wedge the io thread.
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -errno;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    if (listen(fd, backlog) < 0) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    listen_fd_ = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    io_thread_ = std::thread([this] { IoLoop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  int Connect(int node_id, const char* host, int port) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res) {
+      return -EHOSTUNREACH;
+    }
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      return -errno;
+    }
+    // Bounded connect (30 s): a black-holed peer must not stall the caller
+    // for the kernel's full SYN-retry period.
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+    freeaddrinfo(res);
+    if (rc < 0 && errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      rc = poll(&pfd, 1, 30000);
+      if (rc <= 0) {
+        close(fd);
+        return rc == 0 ? -ETIMEDOUT : -errno;
+      }
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) {
+        close(fd);
+        return -err;
+      }
+    } else if (rc < 0) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    fcntl(fd, F_SETFL, flags);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(send_mu_);
+    auto it = send_fds_.find(node_id);
+    if (it != send_fds_.end()) close(it->second);
+    send_fds_[node_id] = fd;
+    return 0;
+  }
+
+  long long Send(int node_id, const uint8_t* meta, uint32_t meta_len,
+                 uint32_t n_data, const uint8_t* const* data,
+                 const uint64_t* lens) {
+    int fd;
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      auto it = send_fds_.find(node_id);
+      if (it == send_fds_.end()) return -ENOTCONN;
+      fd = it->second;
+    }
+    uint8_t header[kHeaderSize];
+    memcpy(header, &kMagic, 4);
+    memcpy(header + 4, &meta_len, 4);
+    memcpy(header + 8, &n_data, 4);
+
+    std::vector<iovec> iov;
+    iov.reserve(3 + n_data);
+    iov.push_back({header, kHeaderSize});
+    iov.push_back({const_cast<uint64_t*>(lens), 8ull * n_data});
+    iov.push_back({const_cast<uint8_t*>(meta), meta_len});
+    long long total = kHeaderSize + 8ull * n_data + meta_len;
+    for (uint32_t i = 0; i < n_data; ++i) {
+      iov.push_back({const_cast<uint8_t*>(data[i]),
+                     static_cast<size_t>(lens[i])});
+      total += lens[i];
+    }
+    // Serialize writers per peer socket (frames must not interleave).
+    std::lock_guard<std::mutex> lk(per_fd_send_mu_[fd % kSendLocks]);
+    size_t idx = 0;
+    size_t off = 0;
+    long long sent_total = 0;
+    while (idx < iov.size()) {
+      iovec cur[64];
+      int cnt = 0;
+      for (size_t i = idx; i < iov.size() && cnt < 64; ++i, ++cnt) {
+        cur[cnt] = iov[i];
+        if (i == idx && off) {
+          cur[cnt].iov_base = static_cast<uint8_t*>(cur[cnt].iov_base) + off;
+          cur[cnt].iov_len -= off;
+        }
+      }
+      ssize_t n = writev(fd, cur, cnt);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return -errno;
+      }
+      sent_total += n;
+      size_t left = static_cast<size_t>(n);
+      // Consume fully-written entries; zero-length iovecs (empty payload
+      // segments, e.g. a pull request's vals) must advance even when no
+      // bytes remain, or the loop would respin writev forever.
+      while (idx < iov.size()) {
+        size_t avail = iov[idx].iov_len - off;
+        if (avail <= left) {
+          left -= avail;
+          ++idx;
+          off = 0;
+        } else {
+          off += left;
+          break;
+        }
+      }
+    }
+    (void)total;
+    return sent_total;
+  }
+
+  // Returns 1 with a frame, 0 on timeout, -1 when stopped.
+  int Recv(Frame* out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(queue_mu_);
+    auto ready = [this] { return stopped_ || !queue_.empty(); };
+    if (timeout_ms < 0) {
+      queue_cv_.wait(lk, ready);
+    } else if (!queue_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   ready)) {
+      return 0;
+    }
+    if (!queue_.empty()) {
+      *out = queue_.front();
+      queue_.pop_front();
+      return 1;
+    }
+    return stopped_ ? -1 : 0;
+  }
+
+  void Stop() {
+    stopped_ = true;
+    if (listen_fd_ >= 0) {
+      shutdown(listen_fd_, SHUT_RDWR);
+      close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    queue_cv_.notify_all();
+  }
+
+  void StopAndJoin() {
+    Stop();
+    if (io_thread_.joinable()) io_thread_.join();
+    std::lock_guard<std::mutex> lk(send_mu_);
+    for (auto& kv : send_fds_) close(kv.second);
+    send_fds_.clear();
+    for (auto& kv : conns_) delete kv.second;
+    conns_.clear();
+    std::lock_guard<std::mutex> qlk(queue_mu_);
+    for (auto& f : queue_) free(f.buf);
+    queue_.clear();
+  }
+
+ private:
+  static constexpr int kSendLocks = 64;
+
+  void IoLoop() {
+    epoll_event events[64];
+    while (!stopped_) {
+      int n = epoll_wait(epfd_, events, 64, 100);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == listen_fd_) {
+          AcceptAll();
+        } else {
+          auto it = conns_.find(fd);
+          if (it != conns_.end() && !ReadConn(it->second)) {
+            epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+            close(fd);
+            delete it->second;
+            conns_.erase(it);
+          }
+        }
+      }
+    }
+  }
+
+  void AcceptAll() {
+    while (true) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* conn = new Conn();
+      conn->fd = fd;
+      conns_[fd] = conn;
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  // Pump all available bytes through the frame state machine.  Returns
+  // false when the peer closed or errored.
+  bool ReadConn(Conn* c) {
+    while (true) {
+      uint8_t* dst;
+      if (c->stage == 0) {
+        dst = c->header + c->got;
+      } else {
+        dst = c->frame.buf + c->got;
+      }
+      ssize_t n = read(c->fd, dst, c->want - c->got);
+      if (n == 0) return false;
+      if (n < 0) {
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      }
+      c->got += static_cast<size_t>(n);
+      if (c->got < c->want) continue;
+      if (c->stage == 0) {
+        uint32_t magic, meta_len, n_data;
+        memcpy(&magic, c->header, 4);
+        memcpy(&meta_len, c->header + 4, 4);
+        memcpy(&n_data, c->header + 8, 4);
+        if (magic != kMagic) return false;
+        c->frame.meta_len = meta_len;
+        c->frame.n_data = n_data;
+        // Read lens first to learn the body size.
+        c->body_size = 8ull * n_data + meta_len;
+        c->frame.buf = static_cast<uint8_t*>(malloc(c->body_size));
+        c->stage = 1;
+        c->want = 8ull * n_data;  // lens arrive first
+        if (n_data == 0) c->want = 0;
+        c->got = 0;
+        if (c->want == 0) {
+          c->stage = 2;
+          c->want = meta_len;
+        }
+      } else if (c->stage == 1) {
+        // Lens complete: total body = lens + meta + sum(data).
+        uint64_t total = 0;
+        const uint64_t* lens = reinterpret_cast<uint64_t*>(c->frame.buf);
+        for (uint32_t i = 0; i < c->frame.n_data; ++i) total += lens[i];
+        size_t full = 8ull * c->frame.n_data + c->frame.meta_len + total;
+        c->frame.buf = static_cast<uint8_t*>(realloc(c->frame.buf, full));
+        c->body_size = full;
+        c->stage = 2;
+        c->want = full;
+        // got already == 8*n_data
+      } else {
+        // Frame complete.
+        {
+          std::lock_guard<std::mutex> lk(queue_mu_);
+          queue_.push_back(c->frame);
+        }
+        queue_cv_.notify_one();
+        c->frame = Frame();
+        c->stage = 0;
+        c->want = kHeaderSize;
+        c->got = 0;
+      }
+    }
+  }
+
+  int epfd_;
+  int listen_fd_ = -1;
+  std::thread io_thread_;
+  std::atomic<bool> stopped_{false};
+  std::unordered_map<int, Conn*> conns_;  // io thread only
+  std::unordered_map<int, int> send_fds_;
+  std::mutex send_mu_;
+  std::mutex per_fd_send_mu_[kSendLocks];
+  std::deque<Frame> queue_;
+  std::mutex queue_mu_;
+  std::condition_variable queue_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct psl_frame_view {
+  uint8_t* buf;
+  uint32_t meta_len;
+  uint32_t n_data;
+};
+
+void* psl_create() { return new Core(); }
+
+int psl_bind(void* h, int port, int backlog) {
+  return static_cast<Core*>(h)->Bind(port, backlog);
+}
+
+int psl_connect(void* h, int node_id, const char* host, int port) {
+  return static_cast<Core*>(h)->Connect(node_id, host, port);
+}
+
+long long psl_send(void* h, int node_id, const uint8_t* meta,
+                   uint32_t meta_len, uint32_t n_data,
+                   const uint8_t* const* data, const uint64_t* lens) {
+  return static_cast<Core*>(h)->Send(node_id, meta, meta_len, n_data, data,
+                                     lens);
+}
+
+int psl_recv(void* h, psl_frame_view* out, int timeout_ms) {
+  Frame f;
+  int rc = static_cast<Core*>(h)->Recv(&f, timeout_ms);
+  if (rc == 1) {
+    out->buf = f.buf;
+    out->meta_len = f.meta_len;
+    out->n_data = f.n_data;
+  }
+  return rc;
+}
+
+void psl_frame_free(uint8_t* buf) { free(buf); }
+
+void psl_stop(void* h) { static_cast<Core*>(h)->Stop(); }
+
+void psl_destroy(void* h) { delete static_cast<Core*>(h); }
+
+}  // extern "C"

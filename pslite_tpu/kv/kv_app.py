@@ -118,11 +118,12 @@ class KVWorker:
         self._recv_kvs: Dict[int, List[KVPairs]] = {}
         self._pull_dst: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = {}
         self._slicer = default_slicer
-        # Zero-copy transports (shm) deliver pulls in place, so completion
-        # skips reassembly (kv_app.h is_worker_zpull_).  The ICI van's
-        # engine path never reaches _finish; its message *fallback* path
-        # behaves like a normal transport and must reassemble.
-        self._zero_copy_pull = self.po.van.__class__.__name__ == "ShmVan"
+        # Message-path pulls always reassemble into the caller's buffer in
+        # _finish (the shm van already saved the socket copy by aliasing
+        # /dev/shm; the ICI engine path never reaches _finish at all).
+        # True delivery-in-place (kv_app.h is_worker_zpull_) exists on the
+        # engine path via device-resident results (get_pulled).
+        self._zero_copy_pull = False
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).

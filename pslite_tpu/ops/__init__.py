@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the PS hot paths.
+
+The reference's hot loops are NIC-side (RDMA write batching); on TPU the
+equivalents are HBM-side: fused optimizer application on server shards
+(one HBM pass instead of several) and blockwise int8 quantization for
+bandwidth-compressed push/pull over DCN-class links.
+"""
+
+from .fused_update import adam_update, sgd_update
+from .quantize import dequantize_int8, quantize_int8
+
+__all__ = ["adam_update", "sgd_update", "quantize_int8", "dequantize_int8"]

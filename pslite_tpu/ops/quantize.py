@@ -1,0 +1,96 @@
+"""Blockwise int8 quantization kernels (Pallas, TPU).
+
+Gradient compression for the DCN/TCP vans: the reference moves raw fp32
+bytes; quantized push quarters wire bytes on bandwidth-limited links (the
+EQuARX-style trade, PAPERS.md).  Symmetric per-row scaling: the flat vector
+is laid out as rows of 128 lanes; each row gets ``scale = max|row| / 127``.
+Tiles are ``(32, 128)`` (the int8 minimum), so rows are padded to a
+multiple of 32.  Scales come back lane-replicated ``[rows, 128]``; send
+``scales[:, 0]`` on the wire and re-broadcast on receive.
+
+Kernels fall back to the Pallas interpreter off-TPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+QUANT_BLOCK = 128  # elements per scale (one lane row)
+_TILE_ROWS = 32    # int8 min sublane tile
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@jax.jit
+def quantize_int8(x):
+    """flat fp32 -> (int8 ``[rows, 128]``, fp32 scales ``[rows, 128]``).
+
+    Keep the original length for :func:`dequantize_int8`.
+    """
+    from jax.experimental import pallas as pl
+
+    x = x.astype(jnp.float32).reshape(-1)
+    pad = (-x.shape[0]) % (QUANT_BLOCK * _TILE_ROWS)
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    rows = x.shape[0] // QUANT_BLOCK
+    x2 = x.reshape(rows, QUANT_BLOCK)
+    grid = rows // _TILE_ROWS
+
+    def kernel(x_ref, q_ref, s_ref):
+        blk = x_ref[:, :]
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(blk), axis=1, keepdims=True) / 127.0, 1e-12
+        )
+        q_ref[:, :] = jnp.clip(
+            jnp.round(blk / scale), -127, 127
+        ).astype(jnp.int8)
+        s_ref[:, :] = jnp.broadcast_to(scale, blk.shape)
+
+    spec = pl.BlockSpec((_TILE_ROWS, QUANT_BLOCK), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, QUANT_BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((rows, QUANT_BLOCK), jnp.float32),
+        ),
+        grid=(grid,),
+        in_specs=[spec],
+        out_specs=(spec, spec),
+        interpret=_use_interpret(),
+    )(x2)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def dequantize_int8(q, scales, n: int):
+    """Inverse of :func:`quantize_int8`; ``n`` is the original length.
+
+    ``scales`` may be lane-replicated ``[rows, 128]`` or compact
+    ``[rows]``/``[rows, 1]`` (wire form) — re-broadcast as needed.
+    """
+    from jax.experimental import pallas as pl
+
+    rows = q.shape[0]
+    if scales.ndim == 1:
+        scales = scales[:, None]
+    if scales.shape[1] != QUANT_BLOCK:
+        scales = jnp.broadcast_to(scales[:, :1], (rows, QUANT_BLOCK))
+
+    def kernel(q_ref, s_ref, x_ref):
+        x_ref[:, :] = q_ref[:, :].astype(jnp.float32) * s_ref[:, :]
+
+    spec = pl.BlockSpec((_TILE_ROWS, QUANT_BLOCK), lambda i: (i, 0))
+    x = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, QUANT_BLOCK), jnp.float32),
+        grid=(rows // _TILE_ROWS,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=_use_interpret(),
+    )(q, jnp.asarray(scales, jnp.float32))
+    return x.reshape(-1)[:n]

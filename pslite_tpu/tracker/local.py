@@ -1,0 +1,144 @@
+"""Local multi-process launcher.
+
+Equivalent of the reference's ``tracker/dmlc_local.py``: spawns 1 scheduler
++ S servers + W workers as OS processes wired by DMLC_* env vars, with the
+``keepalive`` elastic-restart loop — a process exiting with code 254 is
+re-execed (dmlc_local.py:16-25), which together with scheduler-side
+recovery (van.cc:266-332) gives restart-based fault tolerance.
+
+Usage::
+
+    python -m pslite_tpu.tracker.local -n 2 -s 2 [--van tcp] -- \
+        python my_app.py args...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+RESTART_EXIT_CODE = 254
+
+
+def build_env(
+    role: str,
+    num_workers: int,
+    num_servers: int,
+    root_uri: str,
+    root_port: int,
+    van: str = "tcp",
+    group_size: int = 1,
+    extra: Dict[str, str] | None = None,
+) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update(
+        DMLC_ROLE=role,
+        DMLC_NUM_WORKER=str(num_workers),
+        DMLC_NUM_SERVER=str(num_servers),
+        DMLC_PS_ROOT_URI=root_uri,
+        DMLC_PS_ROOT_PORT=str(root_port),
+        DMLC_GROUP_SIZE=str(group_size),
+        PS_VAN_TYPE=van,
+    )
+    if extra:
+        env.update(extra)
+    return env
+
+
+class LocalLauncher:
+    def __init__(self, num_workers: int, num_servers: int, cmd: List[str],
+                 van: str = "tcp", root_port: int = 0, group_size: int = 1,
+                 keepalive: bool = True):
+        from ..utils.network import get_available_port
+
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        self.cmd = cmd
+        self.van = van
+        self.group_size = group_size
+        self.keepalive = keepalive
+        self.root_port = root_port or get_available_port()
+        self.root_uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        self._procs: List[tuple] = []  # (role, Popen)
+
+    def _spawn(self, role: str) -> None:
+        env = build_env(
+            role, self.num_workers, self.num_servers, self.root_uri,
+            self.root_port, self.van, self.group_size,
+        )
+        env.setdefault("DMLC_NODE_HOST", self.root_uri)
+        proc = subprocess.Popen(self.cmd, env=env)
+        self._procs.append((role, proc))
+
+    def run(self) -> int:
+        roles = (
+            ["scheduler"]
+            + ["server"] * self.num_servers
+            + ["worker"] * self.num_workers
+        )
+        for role in roles:
+            self._spawn(role)
+        # Supervise: restart on RESTART_EXIT_CODE (keepalive), propagate the
+        # first real failure, succeed when all workers finish.
+        rc = 0
+        while self._procs:
+            time.sleep(0.2)
+            for i, (role, proc) in enumerate(list(self._procs)):
+                code = proc.poll()
+                if code is None:
+                    continue
+                self._procs.pop(i)
+                if code == RESTART_EXIT_CODE and self.keepalive:
+                    print(f"[tracker] restarting {role} (exit 254)",
+                          file=sys.stderr)
+                    self._spawn(role)
+                elif code != 0:
+                    rc = code
+                    self.terminate()
+                break
+        return rc
+
+    def terminate(self) -> None:
+        for _, proc in self._procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for _, proc in self._procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, required=True)
+    ap.add_argument("--van", default="tcp")
+    ap.add_argument("--group-size", type=int, default=1)
+    ap.add_argument("--root-port", type=int, default=0)
+    ap.add_argument("--no-keepalive", action="store_true")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="program to launch (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        ap.error("no command given")
+    launcher = LocalLauncher(
+        args.num_workers, args.num_servers, cmd, van=args.van,
+        root_port=args.root_port, group_size=args.group_size,
+        keepalive=not args.no_keepalive,
+    )
+    try:
+        return launcher.run()
+    except KeyboardInterrupt:
+        launcher.terminate()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""MultiVan — multi-rail composite transport.
+
+Equivalent of the reference's MultiVan (``src/multi_van.h``): N inner TCP
+rails (one per port / NIC / device channel, ``DMLC_NUM_PORTS``), a shared
+receive queue fed by per-rail pump threads, control traffic pinned to rail
+0, and data traffic routed by the message's device id (falling back to
+round-robin) — the multi-NIC pattern that maps to multiple ICI/DCN rails
+on TPU pods.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional
+
+from ..message import Message, Node
+from ..utils.queues import ThreadsafeQueue
+from .tcp_van import TcpVan
+from .van import Van
+
+
+class _Rail(TcpVan):
+    """A TcpVan used purely as a transport (its control plane is unused)."""
+
+
+class MultiVan(Van):
+    def __init__(self, postoffice):
+        super().__init__(postoffice)
+        self.num_rails = max(postoffice.env.find_int("DMLC_NUM_PORTS", 2), 1)
+        self._rails: List[_Rail] = [
+            _Rail(postoffice) for _ in range(self.num_rails)
+        ]
+        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
+        self._pumps: List[threading.Thread] = []
+        self._rr = itertools.count()
+
+    def bind_transport(self, node: Node, max_retry: int) -> int:
+        ports = []
+        for i, rail in enumerate(self._rails):
+            # Rail 0 owns the advertised port (the scheduler's root port);
+            # extra rails take ephemeral ports.
+            want = node.port if i == 0 else 0
+            sub = Node(role=node.role, hostname=node.hostname, ports=[want])
+            ports.append(rail.bind_transport(sub, max_retry))
+        node.ports = ports
+        for i, rail in enumerate(self._rails):
+            t = threading.Thread(
+                target=self._pump, args=(rail,), name=f"multivan-pump-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._pumps.append(t)
+        return ports[0]
+
+    def connect_transport(self, node: Node) -> None:
+        for i, rail in enumerate(self._rails):
+            sub = Node(
+                role=node.role,
+                id=node.id,
+                hostname=node.hostname,
+                ports=[node.ports[i % len(node.ports)]],
+            )
+            rail.connect_transport(sub)
+
+    def _pick_rail(self, msg: Message) -> _Rail:
+        if not msg.meta.control.empty():
+            return self._rails[0]  # control plane rides rail 0
+        dev = msg.meta.src_dev_id
+        if dev is not None and dev >= 0:
+            return self._rails[dev % self.num_rails]
+        return self._rails[next(self._rr) % self.num_rails]
+
+    def send_msg(self, msg: Message) -> int:
+        return self._pick_rail(msg).send_msg(msg)
+
+    def recv_msg(self) -> Optional[Message]:
+        return self._queue.wait_and_pop()
+
+    def _pump(self, rail: _Rail) -> None:
+        while True:
+            msg = rail.recv_msg()
+            if msg is None:
+                break
+            self._queue.push(msg)
+
+    def stop_transport(self) -> None:
+        for rail in self._rails:
+            rail.stop_transport()
+        for t in self._pumps:
+            t.join(timeout=5)
+        self._queue.push(None)

@@ -1,0 +1,147 @@
+"""ctypes bindings to the native C++ transport core (cpp/pslite_core.cc).
+
+Loads ``cpp/libpslite_core.so`` when present (``make -C cpp``); the TCP van
+then runs its socket IO, frame assembly, and receive queue on native
+threads, GIL-free — the counterpart of the reference keeping its Van layer
+in C++.  ``PS_NATIVE=0`` forces the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "cpp",
+                 "libpslite_core.so"),
+    "libpslite_core.so",
+]
+
+_lib = None
+
+
+class _FrameView(ctypes.Structure):
+    _fields_ = [
+        ("buf", ctypes.POINTER(ctypes.c_uint8)),
+        ("meta_len", ctypes.c_uint32),
+        ("n_data", ctypes.c_uint32),
+    ]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None when unavailable/disabled."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if os.environ.get("PS_NATIVE", "1") in ("0", "false"):
+        return None
+    for path in _LIB_PATHS:
+        try:
+            lib = ctypes.CDLL(os.path.abspath(path)
+                              if os.path.sep in path else path)
+        except OSError:
+            continue
+        lib.psl_create.restype = ctypes.c_void_p
+        lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.psl_connect.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+        ]
+        lib.psl_send.restype = ctypes.c_longlong
+        lib.psl_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.psl_recv.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(_FrameView), ctypes.c_int
+        ]
+        lib.psl_frame_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.psl_stop.argtypes = [ctypes.c_void_p]
+        lib.psl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+    return None
+
+
+class NativeTransport:
+    """Thin OO wrapper over the C API."""
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._h = self._lib.psl_create()
+
+    def bind(self, port: int, backlog: int = 128) -> int:
+        rc = self._lib.psl_bind(self._h, port, backlog)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return rc
+
+    def connect(self, node_id: int, host: str, port: int) -> None:
+        rc = self._lib.psl_connect(self._h, node_id, host.encode(), port)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def send(self, node_id: int, meta: bytes, data: List[memoryview]) -> int:
+        n = len(data)
+        bufs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keepalive = []
+        for i, d in enumerate(data):
+            mv = memoryview(d).cast("B")
+            if mv.readonly:
+                mv = memoryview(bytearray(mv))
+            c = (ctypes.c_uint8 * len(mv)).from_buffer(mv)
+            keepalive.append((mv, c))
+            bufs[i] = ctypes.addressof(c)
+            lens[i] = len(mv)
+        meta_buf = (ctypes.c_uint8 * len(meta)).from_buffer_copy(meta)
+        rc = self._lib.psl_send(
+            self._h, node_id, meta_buf, len(meta), n, bufs, lens
+        )
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return int(rc)
+
+    def recv(self, timeout_ms: int = -1) -> Optional[Tuple[bytes, List[bytes]]]:
+        """(meta_bytes, data_segments) — None when stopped; raises
+        TimeoutError on timeout."""
+        view = _FrameView()
+        rc = self._lib.psl_recv(self._h, ctypes.byref(view), timeout_ms)
+        if rc == -1:
+            return None
+        if rc == 0:
+            raise TimeoutError
+        try:
+            n_data = view.n_data
+            lens_bytes = ctypes.string_at(view.buf, 8 * n_data)
+            import struct
+
+            lens = struct.unpack(f"<{n_data}Q", lens_bytes)
+            off = 8 * n_data
+            meta = ctypes.string_at(
+                ctypes.addressof(view.buf.contents) + off, view.meta_len
+            )
+            off += view.meta_len
+            segs = []
+            base = ctypes.addressof(view.buf.contents)
+            for ln in lens:
+                # Writable copies: receivers may mutate payloads in place
+                # (e.g. a server handle averaging pushed gradients), which
+                # the pure-Python path permits too.
+                segs.append(bytearray(ctypes.string_at(base + off, ln)))
+                off += ln
+            return meta, segs
+        finally:
+            self._lib.psl_frame_free(view.buf)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.psl_stop(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.psl_destroy(self._h)
+            self._h = None

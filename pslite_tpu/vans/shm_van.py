@@ -1,0 +1,162 @@
+"""ShmVan — same-host IPC fast path: meta over TCP, data via /dev/shm.
+
+Equivalent of the reference's IPCTransport inside the RDMA van
+(rdma_transport.h:469-633, ``BYTEPS_ENABLE_IPC=1``): when peers share a
+host, payloads move through named shared-memory segments (one per
+(sender, recver, key, direction) — the ``BytePS_ShM_<key>`` pattern) and
+only the small meta message crosses the socket.  The receiver maps the
+segment and aliases it zero-copy into the delivered SArray.
+
+As in the reference, a segment is reused across iterations of the same key,
+which assumes at most one outstanding message per (key, direction) — the
+same contract the reference's registered buffers impose
+(kv_app.h:210-217).
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .. import wire
+from ..message import Message
+from ..sarray import SArray
+from ..utils import logging as log
+from .tcp_van import TcpVan
+
+_BODY_MARKER = b"SHM1"
+_SHM_DIR = "/dev/shm"
+
+
+class _Segment:
+    def __init__(self, name: str, size: int, create: bool):
+        self.name = name
+        self.path = os.path.join(_SHM_DIR, name)
+        self.created = create
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(self.path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            else:
+                size = os.fstat(fd).st_size
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.size = size
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self.mm.close()
+        except BufferError:
+            pass  # numpy views still alive; the mapping dies with them
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class ShmVan(TcpVan):
+    """TCP control/meta plane + shared-memory data plane for same-host
+    peers; remote peers transparently use plain TCP frames."""
+
+    def __init__(self, postoffice):
+        super().__init__(postoffice)
+        self._segments: Dict[str, _Segment] = {}
+        self._seg_mu = __import__("threading").Lock()
+        self._ns = self.env.find("PS_SHM_NS", str(os.getpid()))
+        self._peer_hosts: Dict[int, str] = {}
+        self._min_bytes = self.env.find_int("PS_SHM_MIN_BYTES", 4096)
+
+    def connect_transport(self, node) -> None:
+        super().connect_transport(node)
+        if node.id >= 0:
+            self._peer_hosts[node.id] = node.hostname
+
+    def _same_host(self, recver: int) -> bool:
+        host = self._peer_hosts.get(recver)
+        return host is not None and host == self.my_node.hostname
+
+    def _segment(self, name: str, size: int, create: bool) -> _Segment:
+        with self._seg_mu:
+            seg = self._segments.get(name)
+            if seg is not None and seg.size >= size:
+                return seg
+            if seg is not None:
+                seg.close(unlink=seg.created)
+            seg = _Segment(name, size, create)
+            self._segments[name] = seg
+            return seg
+
+    def send_msg(self, msg: Message) -> int:
+        m = msg.meta
+        total = sum(d.nbytes for d in msg.data)
+        if (
+            not msg.data
+            or not m.control.empty()
+            or total < self._min_bytes
+            or not self._same_host(m.recver)
+        ):
+            return super().send_msg(msg)
+
+        # Segment identity mirrors the reference's per-key shm naming
+        # (rdma_utils.h:63-65); reused across iterations.
+        name = (
+            f"psl_{self._ns}_{m.sender}_{m.recver}_{m.key}"
+            f"_{int(m.push)}{int(m.request)}"
+        )
+        seg = self._segment(name, total, create=True)
+        off = 0
+        for d in msg.data:
+            raw = memoryview(np.ascontiguousarray(d.data)).cast("B")
+            seg.mm[off : off + raw.nbytes] = raw
+            off += raw.nbytes
+
+        import copy
+
+        meta_only = Message()
+        meta_only.meta = copy.copy(m)  # don't mutate the caller's message
+        meta_only.meta.body = _BODY_MARKER + json.dumps(
+            {
+                "seg": name,
+                "lens": [d.nbytes for d in msg.data],
+                "codes": list(m.data_type),
+            }
+        ).encode()
+        # Keep data_size for byte accounting but strip payload from the frame.
+        sent = super().send_msg(meta_only)
+        return sent + total
+
+    def recv_msg(self):
+        msg = super().recv_msg()
+        if msg is None:
+            return None
+        body = msg.meta.body
+        if body.startswith(_BODY_MARKER):
+            info = json.loads(body[len(_BODY_MARKER):].decode())
+            seg = self._segment(info["seg"], sum(info["lens"]), create=False)
+            view = memoryview(seg.mm)
+            off = 0
+            msg.data = []
+            msg.meta.data_type = list(info["codes"])
+            for ln, code in zip(info["lens"], info["codes"]):
+                arr = np.frombuffer(
+                    view[off : off + ln], dtype=wire.code_dtype(code)
+                )
+                msg.data.append(SArray(arr))
+                off += ln
+            msg.meta.body = b""
+        return msg
+
+    def stop_transport(self) -> None:
+        super().stop_transport()
+        with self._seg_mu:
+            for seg in self._segments.values():
+                seg.close(unlink=seg.created)
+            self._segments.clear()

@@ -17,6 +17,7 @@ import random
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -43,6 +44,13 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
 class TcpVan(Van):
     def __init__(self, postoffice):
         super().__init__(postoffice)
+        # Native C++ core (epoll io threads, GIL-free framing) when built.
+        self._native = None
+        if self.env.find("PS_NATIVE", "1") not in ("0", "false"):
+            from . import native as _native_mod
+
+            if _native_mod.load() is not None:
+                self._native = _native_mod.NativeTransport()
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reader_threads: list = []
@@ -59,6 +67,15 @@ class TcpVan(Van):
     # -- transport interface -------------------------------------------------
 
     def bind_transport(self, node: Node, max_retry: int) -> int:
+        if self._native is not None:
+            port = node.port
+            for attempt in range(max_retry + 1):
+                try:
+                    return self._native.bind(port)
+                except OSError:
+                    if attempt == max_retry:
+                        raise
+                    port = 10000 + random.randint(0, 40000)
         port = node.port
         for attempt in range(max_retry + 1):
             try:
@@ -80,31 +97,39 @@ class TcpVan(Van):
         self._accept_thread.start()
         return port
 
+    def _retry_connect(self, connect_once):
+        """Peers start concurrently; retry until the remote listener is up
+        (zmq's async connect gives the reference this for free).  Each
+        attempt is itself bounded to 30 s (python: socket timeout; native:
+        poll-bounded connect in pslite_core.cc)."""
+        deadline, delay = 60.0, 0.05
+        while True:
+            try:
+                return connect_once()
+            except OSError:
+                if deadline <= 0 or self._closing:
+                    raise
+                time.sleep(delay)
+                deadline -= delay
+                delay = min(delay * 2, 1.0)
+
     def connect_transport(self, node: Node) -> None:
         if node.id < 0:
+            return
+        if self._native is not None:
+            self._retry_connect(
+                lambda: self._native.connect(node.id, node.hostname, node.port)
+            )
             return
         with self._socks_mu:
             prev_addr = self._send_addrs.get(node.id)
             if prev_addr == (node.hostname, node.port) and node.id in self._send_socks:
                 return
-        # Peers start concurrently; retry until the remote listener is up
-        # (zmq's async connect gives the reference this for free).
-        deadline = 60.0
-        delay = 0.05
-        while True:
-            try:
-                sock = socket.create_connection(
-                    (node.hostname, node.port), timeout=30
-                )
-                break
-            except OSError:
-                if deadline <= 0 or self._closing:
-                    raise
-                import time as _time
-
-                _time.sleep(delay)
-                deadline -= delay
-                delay = min(delay * 2, 1.0)
+        sock = self._retry_connect(
+            lambda: socket.create_connection(
+                (node.hostname, node.port), timeout=30
+            )
+        )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._socks_mu:
             old = self._send_socks.pop(node.id, None)
@@ -118,6 +143,13 @@ class TcpVan(Van):
 
     def send_msg(self, msg: Message) -> int:
         recver = msg.meta.recver
+        if self._native is not None:
+            meta_buf = wire.pack_meta(msg.meta)
+            data = [
+                memoryview(np.ascontiguousarray(d.data)).cast("B")
+                for d in msg.data
+            ]
+            return self._native.send(recver, meta_buf, data)
         with self._socks_mu:
             sock = self._send_socks.get(recver)
         log.check(sock is not None, f"tcp: not connected to node {recver}")
@@ -129,10 +161,24 @@ class TcpVan(Van):
         return total
 
     def recv_msg(self) -> Optional[Message]:
+        if self._native is not None:
+            res = self._native.recv(-1)
+            if res is None:
+                return None
+            meta_buf, segs = res
+            return wire.rebuild_message(wire.unpack_meta(meta_buf), segs)
         return self._queue.wait_and_pop()
 
     def stop_transport(self) -> None:
         self._closing = True
+        if self._native is not None:
+            self._native.stop()
+
+    def post_stop(self) -> None:
+        # Safe only after the receive thread joined: frees the native core
+        # (io thread, epoll fd, every socket).
+        if self._native is not None:
+            self._native.destroy()
         if self._listener is not None:
             try:
                 self._listener.close()

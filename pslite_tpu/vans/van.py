@@ -81,6 +81,10 @@ class Van:
     def stop_transport(self) -> None:
         raise NotImplementedError
 
+    def post_stop(self) -> None:
+        """Final teardown after the receive thread has joined (resources a
+        blocked recv_msg might still be using)."""
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, customer_id: int) -> None:
@@ -88,7 +92,9 @@ class Van:
             if self._init_stage == 0:
                 self._init_nodes()
                 port = self.bind_transport(self.my_node, max_retry=40)
-                if port:
+                # Transports that bind multiple rails populate node.ports
+                # themselves (MultiVan); single-rail transports report one.
+                if port and len(self.my_node.ports) <= 1:
                     self.my_node.ports = [port]
                 log.vlog(1, f"Bind to {self.my_node.short_debug()}")
                 self.connect(self.scheduler)
@@ -177,6 +183,7 @@ class Van:
             self._heartbeat_thread.join(timeout=5)
         if self.resender is not None:
             self.resender.stop()
+        self.post_stop()
         self.profiler.close()
         self.ready.clear()
         self._init_stage = 0

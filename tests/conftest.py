@@ -27,6 +27,18 @@ except ImportError:  # jax-less host: non-jax tests still run
 
 import pytest
 
+# Best-effort build of the native transport core so the suite exercises the
+# C++ path; tests still pass on the pure-Python fallback if g++ is missing.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if not os.path.exists(os.path.join(_repo, "cpp", "libpslite_core.so")):
+    import subprocess
+
+    subprocess.run(
+        ["make", "-C", os.path.join(_repo, "cpp")],
+        capture_output=True,
+        check=False,
+    )
+
 
 @pytest.fixture(autouse=True)
 def _loopback_isolation(request):

@@ -1,0 +1,115 @@
+"""Checkpoint/resume of server state, and the local launcher (keepalive)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu.checkpoint import (
+    load_kv_store,
+    load_train_state,
+    restore_engine,
+    save_engine,
+    save_kv_store,
+    save_train_state,
+)
+from pslite_tpu.parallel import CollectiveEngine, default_mesh
+from pslite_tpu.parallel.sparse import SparseEngine
+
+
+def test_engine_checkpoint_roundtrip(tmp_path):
+    mesh = default_mesh()
+    eng = CollectiveEngine(mesh=mesh)
+    sp = SparseEngine(mesh)
+    keys = np.arange(3, dtype=np.uint64)
+    eng.register_dense("d", keys, 16)
+    eng.push("d", np.ones(48, np.float32))
+    sp.register_sparse("t", 20, 4)
+    sp.push("t", np.zeros((8, 2), np.int32), np.ones((8, 2, 4), np.float32))
+
+    path = str(tmp_path / "ckpt")
+    save_engine(eng, path, sparse_engine=sp)
+
+    eng2 = CollectiveEngine(mesh=mesh)
+    sp2 = SparseEngine(mesh)
+    eng2.register_dense("d", keys, 16)
+    sp2.register_sparse("t", 20, 4)
+    restore_engine(eng2, path, sparse_engine=sp2)
+
+    np.testing.assert_allclose(
+        np.asarray(eng2.pull("d")), np.asarray(eng.pull("d"))
+    )
+    idx = np.zeros((8, 2), np.int32)
+    np.testing.assert_allclose(
+        np.asarray(sp2.pull("t", idx)), np.asarray(sp.pull("t", idx))
+    )
+
+
+def test_kv_store_roundtrip(tmp_path):
+    store = {5: np.arange(4, dtype=np.float32), 9: np.ones(2, np.float32)}
+    path = str(tmp_path / "kv")
+    save_kv_store(store, path)
+    out = load_kv_store(path)
+    assert set(out) == {5, 9}
+    np.testing.assert_array_equal(out[5], store[5])
+
+
+def test_train_state_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    store = jnp.arange(10, dtype=jnp.float32)
+    path = str(tmp_path / "train")
+    save_train_state(store, 42, path)
+    restored, step = load_train_state(path)
+    assert step == 42
+    np.testing.assert_array_equal(restored, np.arange(10, dtype=np.float32))
+
+
+def test_local_launcher_runs_cluster(tmp_path):
+    """Launch a real 1s+2w cluster through the tracker CLI."""
+    child = os.path.join(os.path.dirname(__file__), "tcp_child.py")
+    env = dict(os.environ, DMLC_NUM_WORKER="2", DMLC_NUM_SERVER="2")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "2", "-s", "2", "--", sys.executable, child,
+        ],
+        capture_output=True,
+        timeout=180,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(child))),
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+
+
+def test_local_launcher_keepalive_restart(tmp_path):
+    """A child exiting 254 must be restarted (elastic keepalive)."""
+    marker = tmp_path / "restarted"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "m = sys.argv[1]\n"
+        "if os.environ['DMLC_ROLE'] == 'scheduler':\n"
+        "    if not os.path.exists(m):\n"
+        "        open(m, 'w').close()\n"
+        "        sys.exit(254)\n"
+        "    print('RESTARTED_OK')\n"
+        "sys.exit(0)\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "0", "-s", "0", "--", sys.executable, str(script),
+            str(marker),
+        ],
+        capture_output=True,
+        timeout=120,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert b"RESTARTED_OK" in proc.stdout
+    assert b"restarting scheduler" in proc.stderr

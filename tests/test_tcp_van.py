@@ -42,6 +42,33 @@ def test_tcp_cluster_in_process():
         cluster.finalize()
 
 
+def test_tcp_cluster_pure_python_fallback():
+    """PS_NATIVE=0 must keep the socket path working (hosts without the
+    built C++ core)."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="tcp",
+        env_extra={"PS_NATIVE": "0"},
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([1], dtype=np.uint64)
+        vals = np.arange(128, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+        assert cluster.workers[0].van._native is None
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
 def test_tcp_cluster_multiprocess():
     """1 scheduler + 2 servers + 2 workers as separate OS processes."""
     port = get_available_port()
