@@ -1,0 +1,32 @@
+# Top-level build/test entry points (reference: Makefile + make/ps.mk).
+#
+#   make native         build the C++ transport core
+#   make native ASAN=1  ... with AddressSanitizer
+#   make test           run the full suite (virtual 8-device CPU mesh)
+#   make bench          run the headline benchmark on the local accelerator
+#   make lint           byte-compile every Python module
+
+ASAN ?= 0
+ifeq ($(ASAN), 1)
+CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=address"
+endif
+
+.PHONY: all native test bench lint clean
+
+all: native
+
+native:
+	$(MAKE) -C cpp $(CPPFLAGS_EXTRA)
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench: native
+	python bench.py
+
+lint:
+	python -m compileall -q pslite_tpu tests bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C cpp clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

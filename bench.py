@@ -21,49 +21,67 @@ import json
 import time
 
 
-def main() -> None:
+def _measure(eng, name: str, num_keys: int, val_len: int, iters: int) -> float:
+    """Goodput (GB/s) of iterated push_pull on one registered bucket."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from pslite_tpu.parallel.engine import CollectiveEngine
-
-    eng = CollectiveEngine()
-    num_keys = 40  # NUM_KEY_PER_SERVER default (test_benchmark.cc:407-414)
-    val_len = (1 << 20) // 4  # 1 MB per key, fp32
     keys = np.arange(num_keys, dtype=np.uint64)
-    eng.register_dense("bench", keys, val_len)
-    bucket = eng.bucket("bench")
-
+    eng.register_dense(name, keys, val_len)
+    bucket = eng.bucket(name)
     sharding = NamedSharding(eng.mesh, P(eng.axis, None))
     grads = jax.device_put(
         jnp.ones((eng.num_shards, bucket.padded_len), jnp.float32), sharding
     )
-
     # Warmup: compile + first-touch (the rendezvous equivalent).
     for _ in range(3):
-        out = eng.push_pull("bench", grads)
+        out = eng.push_pull(name, grads)
     out.block_until_ready()
-
-    iters = 30
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = eng.push_pull("bench", grads)
+        out = eng.push_pull(name, grads)
     out.block_until_ready()
     elapsed = time.perf_counter() - t0
-
     payload = num_keys * val_len * 4  # bytes per direction
-    total_bytes = 2 * payload * iters  # push + pull
-    goodput_gbps = total_bytes / elapsed / 1e9
+    return 2 * payload * iters / elapsed / 1e9  # push + pull
+
+
+def main() -> None:
+    import os
+
+    from pslite_tpu.parallel.engine import CollectiveEngine
+
+    eng = CollectiveEngine()
+    # Reference sweep 1KB..64MB per key (test.sh / README.md:123-135);
+    # headline config: 40 keys x 1MB (test_benchmark.cc:407-414).
+    # PS_BENCH_QUICK=1 shrinks everything (CI smoke on CPU).
+    quick = bool(int(os.environ.get("PS_BENCH_QUICK", "0")))
+    sizes = (1 << 10, 64 << 10) if quick else (
+        1 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20
+    )
+    sweep = {}
+    for size in sizes:
+        label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
+        iters = 2 if quick else max(4, min(60, (256 << 20) // max(size, 1 << 20)))
+        sweep[label] = round(
+            _measure(eng, f"sweep_{size}", 1, size // 4, iters), 2
+        )
+    if quick:
+        headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
+    else:
+        headline = _measure(eng, "bench", 40, (1 << 20) // 4, 30)
+
     baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget
     print(
         json.dumps(
             {
                 "metric": "dense push-pull goodput (40x1MB, fused RS+update+AG)",
-                "value": round(goodput_gbps, 2),
+                "value": round(headline, 2),
                 "unit": "GB/s/chip",
-                "vs_baseline": round(goodput_gbps / baseline, 3),
+                "vs_baseline": round(headline / baseline, 3),
+                "sweep_1key": sweep,
             }
         )
     )

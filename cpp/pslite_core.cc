@@ -254,8 +254,15 @@ class Core {
     std::lock_guard<std::mutex> lk(send_mu_);
     for (auto& kv : send_fds_) close(kv.second);
     send_fds_.clear();
-    for (auto& kv : conns_) delete kv.second;
+    for (auto& kv : conns_) {
+      close(kv.second->fd);
+      delete kv.second;
+    }
     conns_.clear();
+    if (epfd_ >= 0) {
+      close(epfd_);
+      epfd_ = -1;
+    }
     std::lock_guard<std::mutex> qlk(queue_mu_);
     for (auto& f : queue_) free(f.buf);
     queue_.clear();

@@ -134,6 +134,9 @@ class Meta:
     push: bool = False
     pull: bool = False
     simple_app: bool = False
+    # Transport-internal: payload rides out-of-band (shm segment descriptor
+    # in body) rather than in the frame's data section.
+    shm_data: bool = False
     body: bytes = b""
     data_type: List[int] = field(default_factory=list)
     control: Control = field(default_factory=Control)

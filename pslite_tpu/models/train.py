@@ -48,6 +48,20 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
     sp_axis = axes[-1]
     sp = mesh.shape[sp_axis]
 
+    # Non-divisible shardings would silently drop feature columns /
+    # experts inside shard_map; fail loudly up front instead.
+    if cfg.moe_experts:
+        if cfg.moe_experts % sp != 0:
+            raise ValueError(
+                f"moe_experts={cfg.moe_experts} must divide evenly over the "
+                f"{sp}-way model axis"
+            )
+    elif (cfg.mlp_ratio * cfg.dim) % sp != 0:
+        raise ValueError(
+            f"mlp hidden width {cfg.mlp_ratio * cfg.dim} must divide evenly "
+            f"over the {sp}-way model axis"
+        )
+
     params0 = init_params(jax.random.PRNGKey(seed), cfg)
     flat0, unravel = ravel_pytree(params0)
     n_params = flat0.shape[0]
@@ -58,6 +72,8 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
     token_sharding = NamedSharding(mesh, P(axes[0], sp_axis))
     flat_store = jax.device_put(flat0, store_sharding)
 
+    from .transformer import ParallelCtx
+
     def _local_step(store_l, inp_l, tgt_l):
         # -- pull: params = all_gather(store) --------------------------------
         flat = lax.all_gather(store_l, axes, tiled=True)[:n_params]
@@ -65,11 +81,20 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
 
         sp_idx = lax.axis_index(sp_axis)
         t_local = inp_l.shape[1]
-        attn = lambda q, k, v: ring_attention(q, k, v, sp_axis, causal=True)
+        # The model axis carries sequence parallelism (ring attention),
+        # tensor parallelism (sharded MLP matmuls + psum), and — for MoE
+        # configs — expert parallelism, all at once.
+        ctx = ParallelCtx(
+            attn_fn=lambda q, k, v: ring_attention(
+                q, k, v, sp_axis, causal=True
+            ),
+            pos_offset=sp_idx * t_local,
+            tp_axis=None if cfg.moe_experts else sp_axis,
+            ep_axis=sp_axis if cfg.moe_experts else None,
+        )
 
         def _loss(p):
-            return loss_fn(p, inp_l, tgt_l, cfg, attn_fn=attn,
-                           pos_offset=sp_idx * t_local)
+            return loss_fn(p, inp_l, tgt_l, cfg, ctx=ctx)
 
         loss, grads = jax.value_and_grad(_loss)(params)
         flat_g, _ = ravel_pytree(grads)

@@ -25,12 +25,31 @@ class ModelConfig:
     heads: int = 4
     layers: int = 2
     mlp_ratio: int = 4
+    moe_experts: int = 0  # >0: replace the MLP with a top-1 routed MoE
     dtype: str = "float32"  # params dtype; matmuls cast to bfloat16 on TPU
+
+
+@dataclass(frozen=True)
+class ParallelCtx:
+    """How a forward pass is sharded (inside shard_map).
+
+    ``attn_fn`` handles sequence parallelism (ring attention over sp);
+    ``tp_axis`` shards the MLP matmuls column/row-wise with a closing psum
+    (tensor parallelism); ``ep_axis`` shards MoE experts (expert
+    parallelism).  All None => single-device execution.
+    """
+
+    attn_fn: Optional[Callable] = None
+    pos_offset: int = 0
+    tp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None
 
 
 def init_params(rng, cfg: ModelConfig):
     import jax
     import jax.numpy as jnp
+
+    from ..parallel.moe import init_moe_params
 
     dt = jnp.dtype(cfg.dtype)
     keys = jax.random.split(rng, 2 + cfg.layers)
@@ -47,16 +66,20 @@ def init_params(rng, cfg: ModelConfig):
     }
     for i in range(cfg.layers):
         k1, k2, k3, k4 = jax.random.split(keys[2 + i], 4)
-        params["layers"].append(
-            {
-                "ln1": jnp.ones((D,), dt),
-                "ln2": jnp.ones((D,), dt),
-                "qkv": dense(k1, (D, 3 * D)),
-                "proj": dense(k2, (D, D)),
-                "mlp_in": dense(k3, (D, cfg.mlp_ratio * D)),
-                "mlp_out": dense(k4, (cfg.mlp_ratio * D, D)),
-            }
-        )
+        layer = {
+            "ln1": jnp.ones((D,), dt),
+            "ln2": jnp.ones((D,), dt),
+            "qkv": dense(k1, (D, 3 * D)),
+            "proj": dense(k2, (D, D)),
+        }
+        if cfg.moe_experts > 0:
+            layer["moe"] = init_moe_params(
+                k3, D, cfg.mlp_ratio * D, cfg.moe_experts, dt
+            )
+        else:
+            layer["mlp_in"] = dense(k3, (D, cfg.mlp_ratio * D))
+            layer["mlp_out"] = dense(k4, (cfg.mlp_ratio * D, D))
+        params["layers"].append(layer)
     return params
 
 
@@ -68,33 +91,97 @@ def _rmsnorm(x, scale):
     return x * jax.lax.rsqrt(var + 1e-6) * scale
 
 
+def _mlp(layer, h, compute_dt, ctx: "ParallelCtx", cfg: ModelConfig):
+    """Dense MLP.
+
+    With ``ctx.tp_axis`` set, the Megatron sequence<->tensor parallel
+    transition (activations are sequence-sharded on the same axis):
+    all_gather the token blocks, run the column/row-sharded matmul pair,
+    and reduce-scatter the partial sums back to sequence shards — the
+    closing collective both sums the feature-sharded partials and
+    re-shards the sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    x_dt = h.dtype
+    w_in, w_out = layer["mlp_in"], layer["mlp_out"]
+    if ctx.tp_axis is None:
+        h = h.astype(compute_dt) @ w_in.astype(compute_dt)
+        h = jax.nn.gelu(h.astype(x_dt))
+        return (h.astype(compute_dt) @ w_out.astype(compute_dt)).astype(x_dt)
+
+    axis = ctx.tp_axis
+    S = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    f_local = w_in.shape[1] // S
+    w_in = lax.dynamic_slice_in_dim(w_in, my * f_local, f_local, axis=1)
+    w_out = lax.dynamic_slice_in_dim(w_out, my * f_local, f_local, axis=0)
+
+    h_full = lax.all_gather(h, axis, axis=1, tiled=True)  # [B, T, D]
+    h1 = h_full.astype(compute_dt) @ w_in.astype(compute_dt)
+    h1 = jax.nn.gelu(h1.astype(x_dt))
+    part = (h1.astype(compute_dt) @ w_out.astype(compute_dt)).astype(x_dt)
+    # Sum feature partials across the axis AND return to sequence shards.
+    return lax.psum_scatter(part, axis, scatter_dimension=1, tiled=True)
+
+
+def _moe(layer, h, compute_dt, ctx: "ParallelCtx", cfg: ModelConfig):
+    """Routed MoE; with ``ctx.ep_axis`` set, experts shard blockwise over
+    the axis and tokens route via gather + psum_scatter."""
+    from jax import lax
+
+    from ..parallel.moe import moe_ffn
+
+    moe_p = layer["moe"]
+    if ctx.ep_axis is None:
+        return moe_ffn(moe_p, h, None, compute_dtype=compute_dt)
+    S = lax.psum(1, ctx.ep_axis)
+    my = lax.axis_index(ctx.ep_axis)
+    e_local = cfg.moe_experts // S
+    local = {
+        "gate": moe_p["gate"],  # gating over global expert ids, replicated
+        "w_in": lax.dynamic_slice_in_dim(
+            moe_p["w_in"], my * e_local, e_local, axis=0
+        ),
+        "w_out": lax.dynamic_slice_in_dim(
+            moe_p["w_out"], my * e_local, e_local, axis=0
+        ),
+    }
+    return moe_ffn(local, h, ctx.ep_axis, compute_dtype=compute_dt)
+
+
 def forward(
     params,
     tokens,
     cfg: ModelConfig,
     attn_fn: Optional[Callable] = None,
     pos_offset=0,
+    ctx: Optional["ParallelCtx"] = None,
 ):
     """Token ids [B, T_local] -> logits [B, T_local, vocab].
 
-    ``attn_fn(q, k, v)`` defaults to the single-device causal reference;
-    under shard_map pass a ring_attention closure and the shard's global
-    ``pos_offset``.
+    Single-device by default; pass a :class:`ParallelCtx` (or the legacy
+    ``attn_fn``/``pos_offset``) inside shard_map for sp/tp/ep execution.
     """
     import jax
     import jax.numpy as jnp
 
     from ..parallel.ring_attention import reference_attention
 
-    if attn_fn is None:
-        attn_fn = lambda q, k, v: reference_attention(q, k, v, causal=True)
+    if ctx is None:
+        ctx = ParallelCtx(attn_fn=attn_fn, pos_offset=pos_offset)
+    attn = ctx.attn_fn or (
+        lambda q, k, v: reference_attention(q, k, v, causal=True)
+    )
 
     D, H = cfg.dim, cfg.heads
     hd = D // H
     x = params["embed"][tokens]  # [B, T, D]
     B, T, _ = x.shape
-    # Rotary-free learned-less sinusoidal positions (global under SP).
-    pos = pos_offset + jnp.arange(T)
+    # Sinusoidal positions; global under sequence parallelism.
+    pos = ctx.pos_offset + jnp.arange(T)
     freqs = jnp.exp(-jnp.arange(0, D, 2) / D * jnp.log(10000.0))
     ang = pos[:, None] * freqs[None, :]
     pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
@@ -111,14 +198,14 @@ def forward(
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
-        o = attn_fn(q, k, v).reshape(B, T, D)
+        o = attn(q, k, v).reshape(B, T, D)
         x = x + (o.astype(compute_dt) @ layer["proj"].astype(compute_dt)
                  ).astype(x.dtype)
         h = _rmsnorm(x, layer["ln2"])
-        h = (h.astype(compute_dt) @ layer["mlp_in"].astype(compute_dt))
-        h = jax.nn.gelu(h.astype(x.dtype))
-        x = x + (h.astype(compute_dt) @ layer["mlp_out"].astype(compute_dt)
-                 ).astype(x.dtype)
+        if "moe" in layer:
+            x = x + _moe(layer, h, compute_dt, ctx, cfg)
+        else:
+            x = x + _mlp(layer, h, compute_dt, ctx, cfg)
 
     x = _rmsnorm(x, params["ln_f"])
     logits = (x.astype(compute_dt) @ params["embed"].T.astype(compute_dt)
@@ -127,13 +214,13 @@ def forward(
 
 
 def loss_fn(params, inputs, targets, cfg: ModelConfig, attn_fn=None,
-            pos_offset=0):
+            pos_offset=0, ctx=None):
     """Mean next-token cross-entropy over the local block."""
     import jax
     import jax.numpy as jnp
 
     logits = forward(params, inputs, cfg, attn_fn=attn_fn,
-                     pos_offset=pos_offset)
+                     pos_offset=pos_offset, ctx=ctx)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()

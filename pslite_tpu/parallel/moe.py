@@ -1,0 +1,106 @@
+"""Expert-parallel MoE layer (EP) over a mesh axis.
+
+The reference's stress benchmark drives gather / scatter / data-scatter
+traffic — "exactly MoE-style all-to-all building blocks" (SURVEY §2.9,
+test_benchmark_stress.cc:249-431).  This layer realizes that traffic
+pattern as a real expert-parallel feed-forward:
+
+- experts are sharded over the ``ep`` axis (each device owns E/S experts);
+- token activations and their top-1 expert assignments are **gathered**
+  across the axis;
+- each shard computes its own experts for every token routed to them
+  (one-hot masked, batched einsum -> MXU-friendly static shapes, no
+  capacity overflow);
+- a ``psum_scatter`` over the gathered dimension **scatters** each shard's
+  contributions back to the token's owner — the same bandwidth-optimal
+  collective pair as dense push/pull.
+"""
+
+from __future__ import annotations
+
+
+def init_moe_params(rng, dim: int, hidden: int, num_experts: int, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale = dim ** -0.5
+    return {
+        "gate": (jax.random.normal(k1, (dim, num_experts)) * scale).astype(dtype),
+        "w_in": (jax.random.normal(k2, (num_experts, dim, hidden)) * scale
+                 ).astype(dtype),
+        "w_out": (jax.random.normal(k3, (num_experts, hidden, dim)) * scale
+                  ).astype(dtype),
+    }
+
+
+def moe_ffn(params, x, axis_name: str | None, compute_dtype=None):
+    """Top-1 routed expert FFN.
+
+    ``x``: [B, T, D].  With ``axis_name`` set (inside shard_map), experts
+    are taken to be sharded over that axis: ``params['w_in']`` etc. hold
+    only the local experts ``[E_local, ...]`` and tokens route across
+    devices via all_gather + psum_scatter.  With ``axis_name=None`` the
+    full expert set runs locally (single-device path).
+
+    The selected expert's output is scaled by its softmax gate
+    probability — that scaling is the router's only gradient path (a bare
+    argmax one-hot would freeze routing at init).  ``compute_dtype``
+    (e.g. bfloat16) applies to the expert einsums, matching the dense
+    MLP's MXU dtype policy.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, T, D = x.shape
+    e_local = params["w_in"].shape[0]
+    cdt = compute_dtype or x.dtype
+
+    def experts_apply(xs, weights):
+        # xs: [N, D]; weights: [N, E_local] (gate-prob-scaled one-hot)
+        h = jnp.einsum(
+            "nd,edh->neh", xs.astype(cdt), params["w_in"].astype(cdt)
+        ).astype(x.dtype)
+        h = jax.nn.gelu(h)
+        y = jnp.einsum(
+            "neh,ehd->ned", h.astype(cdt), params["w_out"].astype(cdt)
+        ).astype(x.dtype)
+        return jnp.einsum("ned,ne->nd", y, weights)
+
+    logits = x @ params["gate"]  # gate columns hold GLOBAL expert ids
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(logits, axis=-1)  # [B, T]
+    top_p = jnp.take_along_axis(probs, top[..., None], axis=-1)[..., 0]
+
+    if axis_name is None:
+        flat = x.reshape(-1, D)
+        weights = (
+            jax.nn.one_hot(top.reshape(-1), e_local, dtype=x.dtype)
+            * top_p.reshape(-1)[:, None]
+        )
+        return experts_apply(flat, weights).reshape(B, T, D)
+
+    S = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+
+    # Gather every shard's tokens + routes (the "gather" traffic leg).
+    xs = lax.all_gather(x.reshape(-1, D), axis_name, tiled=True)  # [S*N, D]
+    tops = lax.all_gather(top.reshape(-1), axis_name, tiled=True)  # [S*N]
+    top_ps = lax.all_gather(top_p.reshape(-1), axis_name, tiled=True)
+
+    # Experts are sharded blockwise: shard s owns [s*E_local, (s+1)*E_local).
+    local_id = tops - my * e_local
+    mine = (local_id >= 0) & (local_id < e_local)
+    weights = (
+        jax.nn.one_hot(jnp.where(mine, local_id, 0), e_local, dtype=x.dtype)
+        * (mine.astype(x.dtype) * top_ps)[:, None]
+    )
+    contrib = experts_apply(xs, weights)  # [S*N, D], zeros for foreign tokens
+
+    # Route contributions back to token owners (the "scatter" leg).
+    contrib = contrib.reshape(S, -1, D)
+    mine_back = lax.psum_scatter(
+        contrib, axis_name, scatter_dimension=0, tiled=True
+    )  # [1, N, D]
+    return mine_back.reshape(B, T, D)

@@ -56,6 +56,7 @@ class Postoffice:
         self._preferred_group_rank = self.env.find_int("DMLC_RANK", EMPTY_ID)
 
         self._customers: Dict[tuple, object] = {}
+        self._pending_msgs: Dict[tuple, list] = {}
         self._customers_cv = threading.Condition()
         self._barrier_mu = threading.Lock()
         self._barrier_cv = threading.Condition(self._barrier_mu)
@@ -221,12 +222,38 @@ class Postoffice:
 
     # -- customers -----------------------------------------------------------
 
+    _MAX_PENDING_PER_APP = 10000
+
     def add_customer(self, customer) -> None:
+        # Registration and the flush of parked messages happen atomically
+        # under the same lock that buffer_pending serializes on, so a
+        # concurrently arriving message can never be delivered ahead of the
+        # parked ones (accept() only enqueues; it takes no locks of ours).
         with self._customers_cv:
             key = (customer.app_id, customer.customer_id)
             log.check(key not in self._customers, f"customer {key} exists")
+            for msg in self._pending_msgs.pop(key, []):
+                customer.accept(msg)
             self._customers[key] = customer
             self._customers_cv.notify_all()
+
+    def buffer_pending(self, app_id: int, customer_id: int, msg) -> None:
+        """Park a message that arrived before its app registered (the van
+        never blocks its receive loop waiting for readiness)."""
+        key = (app_id, customer_id)
+        with self._customers_cv:
+            customer = self._customers.get(key)
+            if customer is None:
+                queue = self._pending_msgs.setdefault(key, [])
+                if len(queue) >= self._MAX_PENDING_PER_APP:
+                    log.warning(
+                        f"dropping message for unregistered app {key} "
+                        f"(pending buffer full)"
+                    )
+                    return
+                queue.append(msg)
+                return
+            customer.accept(msg)
 
     def get_customer(self, app_id: int, customer_id: int, timeout: float = 0.0):
         key = (app_id, customer_id)

@@ -52,7 +52,14 @@ def build_env(
 class LocalLauncher:
     def __init__(self, num_workers: int, num_servers: int, cmd: List[str],
                  van: str = "tcp", root_port: int = 0, group_size: int = 1,
-                 keepalive: bool = True):
+                 keepalive: bool = True, joint: bool = False):
+        if joint and num_workers != num_servers:
+            raise ValueError(
+                "joint mode hosts one worker+server pair per process; "
+                f"num_workers ({num_workers}) must equal num_servers "
+                f"({num_servers})"
+            )
+        self.joint = joint
         from ..utils.network import get_available_port
 
         self.num_workers = num_workers
@@ -75,11 +82,16 @@ class LocalLauncher:
         self._procs.append((role, proc))
 
     def run(self) -> int:
-        roles = (
-            ["scheduler"]
-            + ["server"] * self.num_servers
-            + ["worker"] * self.num_workers
-        )
+        if self.joint:
+            # JOINT deployment (reference ps.h:59-76): each process hosts a
+            # worker AND a server; requires num_workers == num_servers.
+            roles = ["scheduler"] + ["joint"] * self.num_workers
+        else:
+            roles = (
+                ["scheduler"]
+                + ["server"] * self.num_servers
+                + ["worker"] * self.num_workers
+            )
         for role in roles:
             self._spawn(role)
         # Supervise: restart on RESTART_EXIT_CODE (keepalive), propagate the
@@ -121,6 +133,8 @@ def main(argv=None) -> int:
     ap.add_argument("--van", default="tcp")
     ap.add_argument("--group-size", type=int, default=1)
     ap.add_argument("--root-port", type=int, default=0)
+    ap.add_argument("--joint", action="store_true",
+                    help="one process per rank hosting worker+server")
     ap.add_argument("--no-keepalive", action="store_true")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="program to launch (prefix with --)")
@@ -128,11 +142,14 @@ def main(argv=None) -> int:
     cmd = [c for c in args.cmd if c != "--"]
     if not cmd:
         ap.error("no command given")
-    launcher = LocalLauncher(
-        args.num_workers, args.num_servers, cmd, van=args.van,
-        root_port=args.root_port, group_size=args.group_size,
-        keepalive=not args.no_keepalive,
-    )
+    try:
+        launcher = LocalLauncher(
+            args.num_workers, args.num_servers, cmd, van=args.van,
+            root_port=args.root_port, group_size=args.group_size,
+            keepalive=not args.no_keepalive, joint=args.joint,
+        )
+    except ValueError as exc:
+        ap.error(str(exc))
     try:
         return launcher.run()
     except KeyboardInterrupt:

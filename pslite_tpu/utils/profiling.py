@@ -39,3 +39,29 @@ class Profiler:
             with self._mu:
                 self._fh.close()
                 self._fh = None
+
+
+class device_trace:
+    """Device-side timeline capture (jax.profiler / XPlane).
+
+    The TPU counterpart of the van's per-message event log: wrap the hot
+    region and open the trace in TensorBoard/XProf::
+
+        with device_trace("/tmp/ps_trace"):
+            engine.push_pull("grads", g)
+            engine.block()
+    """
+
+    def __init__(self, log_dir: str):
+        self._log_dir = log_dir
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+
+        self._ctx = jax.profiler.trace(self._log_dir)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)

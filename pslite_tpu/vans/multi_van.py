@@ -86,7 +86,11 @@ class MultiVan(Van):
 
     def stop_transport(self) -> None:
         for rail in self._rails:
-            rail.stop_transport()
+            rail.stop_transport()  # unblocks each pump's recv_msg
         for t in self._pumps:
             t.join(timeout=5)
         self._queue.push(None)
+
+    def post_stop(self) -> None:
+        for rail in self._rails:
+            rail.post_stop()  # frees native cores after pumps exited

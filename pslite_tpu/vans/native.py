@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 from typing import List, Optional, Tuple
 
 _LIB_PATHS = [
@@ -117,8 +118,6 @@ class NativeTransport:
         try:
             n_data = view.n_data
             lens_bytes = ctypes.string_at(view.buf, 8 * n_data)
-            import struct
-
             lens = struct.unpack(f"<{n_data}Q", lens_bytes)
             off = 8 * n_data
             meta = ctypes.string_at(

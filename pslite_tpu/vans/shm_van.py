@@ -15,11 +15,12 @@ same contract the reference's registered buffers impose
 
 from __future__ import annotations
 
+import copy
 import json
 import mmap
 import os
-import struct
-from typing import Dict, List, Tuple
+import threading
+from typing import Dict
 
 import numpy as np
 
@@ -29,7 +30,6 @@ from ..sarray import SArray
 from ..utils import logging as log
 from .tcp_van import TcpVan
 
-_BODY_MARKER = b"SHM1"
 _SHM_DIR = "/dev/shm"
 
 
@@ -69,7 +69,7 @@ class ShmVan(TcpVan):
     def __init__(self, postoffice):
         super().__init__(postoffice)
         self._segments: Dict[str, _Segment] = {}
-        self._seg_mu = __import__("threading").Lock()
+        self._seg_mu = threading.Lock()
         self._ns = self.env.find("PS_SHM_NS", str(os.getpid()))
         self._peer_hosts: Dict[int, str] = {}
         self._min_bytes = self.env.find_int("PS_SHM_MIN_BYTES", 4096)
@@ -118,11 +118,12 @@ class ShmVan(TcpVan):
             seg.mm[off : off + raw.nbytes] = raw
             off += raw.nbytes
 
-        import copy
-
         meta_only = Message()
         meta_only.meta = copy.copy(m)  # don't mutate the caller's message
-        meta_only.meta.body = _BODY_MARKER + json.dumps(
+        # The descriptor rides in body, gated by the wire-level shm_data
+        # flag (never by sniffing user bodies).
+        meta_only.meta.shm_data = True
+        meta_only.meta.body = json.dumps(
             {
                 "seg": name,
                 "lens": [d.nbytes for d in msg.data],
@@ -137,9 +138,9 @@ class ShmVan(TcpVan):
         msg = super().recv_msg()
         if msg is None:
             return None
-        body = msg.meta.body
-        if body.startswith(_BODY_MARKER):
-            info = json.loads(body[len(_BODY_MARKER):].decode())
+        if msg.meta.shm_data:
+            info = json.loads(msg.meta.body.decode())
+            msg.meta.shm_data = False
             seg = self._segment(info["seg"], sum(info["lens"]), create=False)
             view = memoryview(seg.mm)
             off = 0

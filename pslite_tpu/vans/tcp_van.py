@@ -170,15 +170,11 @@ class TcpVan(Van):
         return self._queue.wait_and_pop()
 
     def stop_transport(self) -> None:
+        """Unblock recv_msg and tear the sockets down (the recv thread is
+        joined right after this returns, so it must wake here)."""
         self._closing = True
         if self._native is not None:
-            self._native.stop()
-
-    def post_stop(self) -> None:
-        # Safe only after the receive thread joined: frees the native core
-        # (io thread, epoll fd, every socket).
-        if self._native is not None:
-            self._native.destroy()
+            self._native.stop()  # psl_recv returns -1 -> recv_msg None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -196,7 +192,13 @@ class TcpVan(Van):
                 s.close()
             except OSError:
                 pass
-        self._queue.push(None)
+        self._queue.push(None)  # wakes the pure-Python recv path
+
+    def post_stop(self) -> None:
+        # Safe only after the receive thread joined: frees the native core
+        # (io thread, epoll fd, every socket).
+        if self._native is not None:
+            self._native.destroy()
 
     # -- internals -----------------------------------------------------------
 

@@ -60,6 +60,13 @@ class Van:
         self._connected_nodes: Dict[str, int] = {}
         self._timestamp = 0
         self._timestamp_mu = threading.Lock()
+        # Per-peer data-message sequence ids + optional in-order delivery
+        # (the UCX van's sid/reorder machinery, ucx_van.h:1032-1039,
+        # 1217-1257; enable with PS_FORCE_REQ_ORDER=1).
+        self._force_order = bool(self.env.find_int("PS_FORCE_REQ_ORDER", 0))
+        self._send_sids: Dict[int, int] = {}
+        self._recv_expected: Dict[int, int] = {}
+        self._recv_buffered: Dict[int, Dict[int, Message]] = {}
 
     # -- transport interface -------------------------------------------------
 
@@ -198,6 +205,11 @@ class Van:
     def send(self, msg: Message) -> int:
         if msg.meta.sender == EMPTY_ID:
             msg.meta.sender = self.my_node.id
+        if msg.meta.control.empty():
+            with self._timestamp_mu:
+                sid = self._send_sids.get(msg.meta.recver, 0)
+                self._send_sids[msg.meta.recver] = sid + 1
+            msg.meta.sid = sid
         if self.resender is not None:
             self.resender.add_outgoing(msg)
         with self._send_mu:
@@ -245,7 +257,11 @@ class Van:
                 break
             try:
                 if ctrl.empty():
-                    self._process_data_msg(msg)
+                    if self._force_order:
+                        for ready in self._release_in_order(msg):
+                            self._process_data_msg(ready)
+                    else:
+                        self._process_data_msg(msg)
                 elif ctrl.cmd == Command.ADD_NODE:
                     self._process_add_node(msg)
                 elif ctrl.cmd == Command.BARRIER:
@@ -268,6 +284,36 @@ class Van:
 
     # -- data plane dispatch -------------------------------------------------
 
+    def _reset_peer_sids(self, node_id: int) -> None:
+        """Forget sequence state for a (re)joining peer (recovery path)."""
+        with self._timestamp_mu:
+            self._send_sids.pop(node_id, None)
+        self._recv_expected.pop(node_id, None)
+        self._recv_buffered.pop(node_id, None)
+
+    def _release_in_order(self, msg: Message) -> List[Message]:
+        """Deliver per-sender data messages strictly by sequence id.
+
+        Messages from peers that predate sid assignment (sid == EMPTY_ID)
+        pass through untouched.
+        """
+        sid = msg.meta.sid
+        if sid == EMPTY_ID:
+            return [msg]
+        sender = msg.meta.sender
+        expected = self._recv_expected.get(sender, 0)
+        buffered = self._recv_buffered.setdefault(sender, {})
+        if sid != expected:
+            buffered[sid] = msg
+            return []
+        ready = [msg]
+        expected += 1
+        while expected in buffered:
+            ready.append(buffered.pop(expected))
+            expected += 1
+        self._recv_expected[sender] = expected
+        return ready
+
     def _process_data_msg(self, msg: Message) -> None:
         self.profiler.record(msg.meta.key, "recv", msg.meta.push)
         app_id = msg.meta.app_id
@@ -276,16 +322,16 @@ class Van:
         customer_id = (
             msg.meta.customer_id if self.my_node.role == Role.WORKER else app_id
         )
-        # The reference waits 5 s for app readiness (van.cc:435-438); we allow
-        # more by default because single-CPU CI hosts serialize process
-        # startup, and a dropped message here would strand the sender.
-        timeout = self.env.find_float("PS_CUSTOMER_READY_TIMEOUT", 30.0)
-        customer = self.po.get_customer(app_id, customer_id, timeout=timeout)
-        log.check(
-            customer is not None,
-            f"no customer ({app_id}, {customer_id}) ready after {timeout}s",
-        )
-        customer.accept(msg)
+        # The reference blocks the receive loop up to 5 s waiting for app
+        # readiness (van.cc:435-438).  Blocking here is a priority
+        # inversion: a barrier response queued behind this message may be
+        # exactly what unblocks the main thread that would register the
+        # app.  Instead, park early arrivals and flush on registration.
+        customer = self.po.get_customer(app_id, customer_id)
+        if customer is not None:
+            customer.accept(msg)
+        else:
+            self.po.buffer_pending(app_id, customer_id, msg)
 
     # -- scheduler: registration & rank assignment ---------------------------
 
@@ -393,6 +439,7 @@ class Van:
             node.id = dead[0]
             node.is_recovery = True
             log.vlog(1, f"recovering node {node.short_debug()}")
+            self._reset_peer_sids(node.id)
             self.connect(node)
             self._registered_addrs[addr] = node.id
             self.po.update_heartbeat(node.id, time.time())
@@ -431,6 +478,11 @@ class Van:
             if node.role == Role.SCHEDULER and not self.po.is_scheduler:
                 continue  # already connected during start()
             if node.id != self.my_node.id:
+                if node.is_recovery:
+                    # A restarted peer begins its sid sequence at 0 again;
+                    # stale per-peer ordering state would stall force-order
+                    # delivery forever.
+                    self._reset_peer_sids(node.id)
                 self.connect(node)
         log.check(self.my_node.id != EMPTY_ID, "scheduler did not assign my id")
         self.ready.set()
@@ -473,6 +525,12 @@ class Van:
                 progress = len(senders)
             else:
                 progress = len({self.po.id_to_group_rank(s) for s in senders})
+            log.vlog(
+                1,
+                f"barrier(group={group}, instance={instance}): "
+                f"{progress}/{self._barrier_expected(group, instance)} "
+                f"senders={sorted(senders)}",
+            )
             if progress >= self._barrier_expected(group, instance):
                 members = sorted(senders)
                 self._barrier_senders[key] = set()

@@ -46,7 +46,7 @@ _META_FIXED = struct.Struct(
 _NODE_FIXED = struct.Struct("<B i i B i H H H H")  # role id customer_id
 # is_recovery aux_id hostname_len num_ports num_devs endpoint_len
 
-_F_REQUEST, _F_PUSH, _F_PULL, _F_SIMPLE = 1, 2, 4, 8
+_F_REQUEST, _F_PUSH, _F_PULL, _F_SIMPLE, _F_SHM = 1, 2, 4, 8, 16
 
 
 def _pack_node(n: Node) -> bytes:
@@ -109,6 +109,7 @@ def pack_meta(meta: Meta) -> bytes:
         | (_F_PUSH if meta.push else 0)
         | (_F_PULL if meta.pull else 0)
         | (_F_SIMPLE if meta.simple_app else 0)
+        | (_F_SHM if meta.shm_data else 0)
     )
     ctrl = meta.control
     fixed = _META_FIXED.pack(
@@ -196,6 +197,7 @@ def unpack_meta(buf: bytes) -> Meta:
         push=bool(flags & _F_PUSH),
         pull=bool(flags & _F_PULL),
         simple_app=bool(flags & _F_SIMPLE),
+        shm_data=bool(flags & _F_SHM),
         body=body,
         data_type=data_type,
         control=Control(

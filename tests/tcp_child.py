@@ -4,10 +4,14 @@ Mirrors the reference's tests/local.sh + test_benchmark flow: the role comes
 from DMLC_ROLE; workers push then pull and verify multi-worker aggregation.
 """
 
+import faulthandler
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# A hung child must fail loudly with stacks, not strand the launcher.
+faulthandler.dump_traceback_later(120, exit=True)
 
 import numpy as np
 
@@ -20,23 +24,25 @@ def main() -> int:
     role = os.environ["DMLC_ROLE"]
     ps.start_ps()
     server = None
-    if role == "server":
+    if role in ("server", "joint"):
         server = KVServer(0)
         server.set_request_handle(KVServerDefaultHandle())
-    if role == "worker":
+    if role in ("worker", "joint"):
         po = ps.postoffice(Role.WORKER)
         worker = KVWorker(0, 0)
         ranges = po.get_server_key_ranges()
         keys = np.array(
-            sorted([ranges[0].begin + 1, ranges[1].begin + 2]), dtype=np.uint64
+            sorted(r.begin + i + 1 for i, r in enumerate(ranges)),
+            dtype=np.uint64,
         )
-        vals = np.full(2 * 256, 1.5, dtype=np.float32)
+        vals = np.full(len(keys) * 256, 1.5, dtype=np.float32)
         worker.wait(worker.push(keys, vals))
         # All workers must have pushed before pulling.
         po.barrier(0, ps.WORKER_GROUP)
         out = np.zeros_like(vals)
         worker.wait(worker.pull(keys, out))
-        expected = 2 * 1.5  # two workers pushed
+        num_workers = int(os.environ["DMLC_NUM_WORKER"])
+        expected = num_workers * 1.5
         if not np.allclose(out, expected):
             print(f"WORKER_FAIL: got {out[:4]} expected {expected}")
             return 1

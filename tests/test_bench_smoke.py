@@ -1,0 +1,39 @@
+"""bench.py must stay runnable: exercise its measurement helper on the CPU
+mesh and check the JSON contract fields."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_measure_helper_runs():
+    import bench
+    from pslite_tpu.parallel.engine import CollectiveEngine
+
+    eng = CollectiveEngine()
+    goodput = bench._measure(eng, "smoke", num_keys=2, val_len=1024, iters=2)
+    assert goodput > 0
+
+
+def test_bench_cli_contract():
+    import os
+
+    env = dict(os.environ, PS_BENCH_QUICK="1")
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        timeout=560,
+        cwd="/root/repo",
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr.decode()[-1500:]
+    lines = [l for l in out.stdout.decode().splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline"):
+        assert field in rec
+    assert rec["value"] > 0

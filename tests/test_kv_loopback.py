@@ -179,6 +179,38 @@ def test_variable_length_values():
         cluster.finalize()
 
 
+def test_early_push_buffered_until_server_app_ready():
+    """A push that lands before the server app registers must neither block
+    the receive loop nor be dropped — it is parked and flushed on
+    registration (the reference instead stalls its recv loop up to 5s,
+    van.cc:435-438, which inverts priority with barrier responses)."""
+    import time
+
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    servers = []
+    try:
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([3], dtype=np.uint64)
+        vals = np.ones(16, dtype=np.float32)
+        ts = worker.push(keys, vals)  # server app does not exist yet
+        time.sleep(0.3)
+        # Control traffic must still flow while the push is parked.
+        cluster.barrier_all()
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        worker.wait(ts)  # flushed on registration, then answered
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
 def test_simple_app():
     cluster = LoopbackCluster(num_workers=1, num_servers=1)
     cluster.start()

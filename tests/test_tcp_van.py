@@ -81,6 +81,7 @@ def test_tcp_cluster_multiprocess():
         DMLC_PS_ROOT_PORT=str(port),
         DMLC_NODE_HOST="127.0.0.1",
         PS_VAN_TYPE="tcp",
+        PS_VERBOSE="1",  # a hung child's dump then shows barrier progress
     )
     procs = []
     for role in ["scheduler", "server", "server", "worker", "worker"]:
@@ -96,7 +97,9 @@ def test_tcp_cluster_multiprocess():
     outputs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=180)
+            # Generous: this 1-CPU host serializes 5 interpreter startups,
+            # and cold-cache runs add jit compilation elsewhere in the suite.
+            out, _ = p.communicate(timeout=300)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
