@@ -70,14 +70,19 @@ def main() -> None:
         )
     if quick:
         headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
+        headline_cfg = "4x64KB quick"
     else:
         headline = _measure(eng, "bench", 40, (1 << 20) // 4, 30)
+        headline_cfg = "40x1MB"
 
     baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget
     print(
         json.dumps(
             {
-                "metric": "dense push-pull goodput (40x1MB, fused RS+update+AG)",
+                "metric": (
+                    f"dense push-pull goodput ({headline_cfg}, "
+                    "fused RS+update+AG)"
+                ),
                 "value": round(headline, 2),
                 "unit": "GB/s/chip",
                 "vs_baseline": round(headline / baseline, 3),

@@ -1,0 +1,162 @@
+"""KV benchmark CLI — the reference's workhorse benchmark re-created.
+
+Parity with ``tests/test_benchmark.cc``: modes PUSH_THEN_PULL / PUSH_PULL /
+PUSH_ONLY / PULL_ONLY (:25-30), ``len repeat mode`` arguments, NUM_KEY_PER_SERVER
+keys per server (:407-414), goodput printed every LOG_DURATION rounds with
+the same metric definitions (:388-396):
+
+    goodput_gbps = 8 * len * total_key_num * iters / elapsed_ns
+    latency_ns_per_key = elapsed / iters / total_key_num / 1000
+
+The server uses an assign-and-echo handle (the reference's EmptyHandler
+allocates per-key buffers on first push and echoes them on pull,
+:131-203), with val/len consistency checks baked in.  Runs over any van;
+launch e.g.::
+
+    python -m pslite_tpu.tracker.local -n 1 -s 1 --van shm -- \
+        python -m pslite_tpu.benchmark --len 1024000 --repeat 10 --mode push_pull
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+MODES = ("push_then_pull", "push_pull", "push_only", "pull_only")
+
+
+class BenchmarkHandle:
+    """Assign on push (allocating on first touch), echo on pull."""
+
+    def __init__(self):
+        self.store = {}
+
+    def __call__(self, meta, data, server):
+        from .kv.kv_app import KVPairs
+        from .utils import logging as log
+
+        if meta.push:
+            n = len(data.keys)
+            log.check(n > 0 and len(data.vals) % n == 0,
+                      "inconsistent val/len in push")
+            k = len(data.vals) // n
+            for i, key in enumerate(data.keys):
+                self.store[int(key)] = np.array(
+                    data.vals[i * k : (i + 1) * k]
+                )
+            server.response(meta)
+        else:
+            vals = [self.store[int(key)] for key in data.keys]
+            server.response(
+                meta,
+                KVPairs(keys=data.keys, vals=np.concatenate(vals)),
+            )
+
+
+def run_worker(args) -> None:
+    from . import postoffice
+    from .kv.kv_app import KVWorker
+    from .message import Role
+
+    po = postoffice(Role.WORKER)
+    worker = KVWorker(0, 0)
+    ranges = po.get_server_key_ranges()
+    keys_per_server = args.num_keys
+    val_len = args.len // 4  # fp32 elements per key
+    keys = np.sort(
+        np.concatenate(
+            [
+                np.arange(keys_per_server, dtype=np.uint64) + r.begin
+                for r in ranges
+            ]
+        )
+    )
+    total_keys = len(keys)
+    vals = np.random.default_rng(po.my_rank()).normal(
+        size=total_keys * val_len
+    ).astype(np.float32)
+    outs = np.zeros_like(vals)
+
+    def timed(fn, iters):
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            fn()
+        return time.perf_counter_ns() - t0
+
+    def report(tag, elapsed_ns, iters, bytes_per_iter):
+        goodput = 8.0 * bytes_per_iter * iters / max(elapsed_ns, 1)
+        lat = elapsed_ns / max(iters, 1) / total_keys / 1000.0
+        print(
+            f"{tag}: {goodput:.3f} Gbps, avg latency {lat:.3f} us/key",
+            flush=True,
+        )
+
+    # Warm up (registration / first-touch, as the reference's first rounds).
+    worker.wait(worker.push(keys, vals))
+    worker.wait(worker.pull(keys, outs))
+
+    payload = total_keys * val_len * 4
+    log_every = int(os.environ.get("LOG_DURATION", "10"))
+    done = 0
+    while done < args.repeat:
+        iters = min(log_every, args.repeat - done)
+        if args.mode == "push_then_pull":
+            e1 = timed(lambda: worker.wait(worker.push(keys, vals)), iters)
+            report("push", e1, iters, payload)
+            e2 = timed(lambda: worker.wait(worker.pull(keys, outs)), iters)
+            report("pull", e2, iters, payload)
+        elif args.mode == "push_pull":
+            e = timed(
+                lambda: worker.wait(worker.push_pull(keys, vals, outs)),
+                iters,
+            )
+            report("push_pull", e, iters, 2 * payload)
+        elif args.mode == "push_only":
+            e = timed(lambda: worker.wait(worker.push(keys, vals)), iters)
+            report("push", e, iters, payload)
+        else:  # pull_only
+            e = timed(lambda: worker.wait(worker.pull(keys, outs)), iters)
+            report("pull", e, iters, payload)
+        done += iters
+
+    # Correctness: the last pull must echo the last push (assign handle).
+    if args.mode in ("push_then_pull", "push_pull"):
+        worker.wait(worker.push(keys, vals))
+        worker.wait(worker.pull(keys, outs))
+        np.testing.assert_allclose(outs, vals, rtol=1e-6)
+        print("CHECK_OK", flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--len", type=int, default=1024000,
+                    help="bytes per key (default 1024000)")
+    ap.add_argument("--repeat", type=int, default=10)
+    ap.add_argument("--mode", choices=MODES, default="push_pull")
+    ap.add_argument("--num-keys", type=int,
+                    default=int(os.environ.get("NUM_KEY_PER_SERVER", "40")))
+    args = ap.parse_args(argv)
+
+    from . import KVServer, finalize, start_ps
+
+    role = os.environ["DMLC_ROLE"]
+    start_ps()
+    server = None
+    if role in ("server", "joint"):
+        server = KVServer(0)
+        server.set_request_handle(BenchmarkHandle())
+    if role in ("worker", "joint"):
+        run_worker(args)
+    finalize()
+    if server is not None:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

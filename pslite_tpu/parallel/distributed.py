@@ -1,0 +1,67 @@
+"""Multi-host bootstrap: jax.distributed from the PS environment.
+
+The reference scales multi-host through its scheduler rendezvous; on TPU
+pods the equivalent is ``jax.distributed.initialize`` building one global
+mesh across hosts, with XLA collectives riding ICI within a slice and DCN
+across slices.  This module derives the coordinator/process topology from
+the same DMLC_* variables the PS control plane uses, so one launcher
+config drives both planes:
+
+- coordinator = ``DMLC_PS_ROOT_URI : DMLC_PS_ROOT_PORT + 1`` (the port
+  next to the scheduler),
+- num_processes = worker count (each host is one worker / one JOINT
+  process),
+- process_id = ``DMLC_RANK``.
+
+Single-process use (tests, one chip) never needs this.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import environment
+from ..utils import logging as log
+
+
+def distributed_options(env=None) -> Dict[str, object]:
+    """Pure computation of jax.distributed.initialize kwargs from env."""
+    env = env or environment.get()
+    uri = env.find("DMLC_PS_ROOT_URI")
+    log.check(uri is not None, "DMLC_PS_ROOT_URI not set")
+    port = env.find_int("DMLC_PS_ROOT_PORT", 0) + 1
+    num = env.find_int("DMLC_NUM_WORKER", 0)
+    log.check(num > 0, "DMLC_NUM_WORKER not set")
+    rank = env.find_int("DMLC_RANK", -1)
+    log.check(0 <= rank < num,
+              "DMLC_RANK must be set per host for multi-host meshes")
+    return {
+        "coordinator_address": f"{uri}:{port}",
+        "num_processes": num,
+        "process_id": rank,
+    }
+
+
+def init_distributed(env=None) -> Optional[Dict[str, object]]:
+    """Initialize jax.distributed from the PS env (no-op for 1 process).
+
+    Returns the options used, or None when single-process.
+    """
+    env = env or environment.get()
+    if env.find_int("DMLC_NUM_WORKER", 1) <= 1:
+        return None
+    opts = distributed_options(env)
+    import jax
+
+    jax.distributed.initialize(**opts)
+    return opts
+
+
+def global_mesh(axis_name: str = "kv"):
+    """1-D mesh over every device of every process (call after
+    init_distributed on multi-host)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis_name,))

@@ -245,6 +245,12 @@ class Postoffice:
             customer = self._customers.get(key)
             if customer is None:
                 queue = self._pending_msgs.setdefault(key, [])
+                if not queue:
+                    # Loud on first park so a never-registering app shows
+                    # up in logs instead of presenting as a silent hang.
+                    log.warning(
+                        f"parking message for not-yet-registered app {key}"
+                    )
                 if len(queue) >= self._MAX_PENDING_PER_APP:
                     log.warning(
                         f"dropping message for unregistered app {key} "

@@ -1,0 +1,132 @@
+"""Stress traffic generator — gather / scatter / datascatter / dense.
+
+Parity with ``tests/test_benchmark_stress.cc`` (:249-431), which documents
+four traffic patterns over BytePS sessions ("exactly MoE-style all-to-all
+building blocks", SURVEY §2.9).  Here each pattern is a jitted collective
+over the mesh, optionally driven by several host threads
+(``BENCHMARK_NTHREAD``) to stress the dispatch path:
+
+- ``dense``        reduce: push_pull (psum_scatter + all_gather)
+- ``gather``       every shard materializes all shards' blocks (all_gather)
+- ``scatter``      cross-worker reduction to owner shards (psum_scatter)
+- ``datascatter``  sparse rows routed to owner shards (SparseEngine)
+
+Usage (single process drives the whole mesh)::
+
+    python -m pslite_tpu.stress --len 30720000 --repeat 5 --threads 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+
+import numpy as np
+
+PATTERNS = ("dense", "gather", "scatter", "datascatter")
+
+
+def run_pattern(engine, sparse_engine, pattern: str, size_bytes: int,
+                iters: int) -> float:
+    """Returns application goodput in Gbps for the pattern."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    W = engine.num_shards
+    n = max(size_bytes // 4, W)
+    name = f"stress_{pattern}_{size_bytes}"
+
+    if pattern == "datascatter":
+        dim = 128
+        rows = max(n // dim, W)
+        table = f"{name}_tbl"
+        if table not in sparse_engine._tables:
+            sparse_engine.register_sparse(table, rows, dim)
+        batch = max(rows // W, 1)
+        idx = np.random.default_rng(0).integers(
+            0, rows, size=(W, batch)
+        ).astype(np.int32)
+        grads = np.ones((W, batch, dim), np.float32)
+        sparse_engine.push(table, idx, grads)  # warm
+        sparse_engine.store_array(table).block_until_ready()
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            sparse_engine.push(table, idx, grads)
+        sparse_engine.store_array(table).block_until_ready()
+        elapsed = time.perf_counter_ns() - t0
+        moved = 4 * W * batch * dim * iters
+        return 8.0 * moved / max(elapsed, 1)
+
+    if name not in engine._buckets:
+        engine.register_dense(name, np.arange(1, dtype=np.uint64), n)
+    bucket = engine.bucket(name)
+    sharding = NamedSharding(engine.mesh, P(engine.axis, None))
+    grads = jax.device_put(
+        jnp.ones((W, bucket.padded_len), jnp.float32), sharding
+    )
+
+    ops = {
+        "dense": lambda: engine.push_pull(name, grads),
+        "gather": lambda: engine.pull(name),
+        "scatter": lambda: engine.push(name, grads),
+    }
+    op = ops[pattern]
+    out = op()  # warm / compile
+    out.block_until_ready()
+    t0 = time.perf_counter_ns()
+    for _ in range(iters):
+        out = op()
+    out.block_until_ready()
+    elapsed = time.perf_counter_ns() - t0
+    per_iter = n * 4 * (2 if pattern == "dense" else 1)
+    return 8.0 * per_iter * iters / max(elapsed, 1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--len", type=int, default=30_720_000,
+                    help="bytes per tensor (stress default 30720000)")
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--threads", type=int,
+                    default=int(os.environ.get("BENCHMARK_NTHREAD", "1")))
+    ap.add_argument("--patterns", nargs="*", default=list(PATTERNS))
+    args = ap.parse_args(argv)
+
+    from .parallel.engine import CollectiveEngine
+    from .parallel.sparse import SparseEngine
+
+    engine = CollectiveEngine()
+    sparse = SparseEngine(engine.mesh, engine.axis)
+
+    results = {}
+
+    def drive(pattern):
+        results[pattern] = run_pattern(
+            engine, sparse, pattern, args.len, args.repeat
+        )
+
+    for pattern in args.patterns:
+        if args.threads > 1 and pattern != "datascatter":
+            # Concurrent host threads sharing one engine stress the
+            # dispatch path (BENCHMARK_NTHREAD, test_benchmark.cc:535-549).
+            threads = [
+                threading.Thread(target=drive, args=(pattern,))
+                for _ in range(args.threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            drive(pattern)
+        print(f"{pattern}: {results[pattern]:.3f} Gbps", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

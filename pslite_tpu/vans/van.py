@@ -291,6 +291,8 @@ class Van:
         self._recv_expected.pop(node_id, None)
         self._recv_buffered.pop(node_id, None)
 
+    _MAX_REORDER_BUFFER = 1024
+
     def _release_in_order(self, msg: Message) -> List[Message]:
         """Deliver per-sender data messages strictly by sequence id.
 
@@ -303,11 +305,23 @@ class Van:
         sender = msg.meta.sender
         expected = self._recv_expected.get(sender, 0)
         buffered = self._recv_buffered.setdefault(sender, {})
-        if sid != expected:
+        if sid == expected:
+            ready = [msg]
+            expected += 1
+        else:
             buffered[sid] = msg
-            return []
-        ready = [msg]
-        expected += 1
+            if len(buffered) <= self._MAX_REORDER_BUFFER:
+                return []
+            # Gap recovery: a message lost beyond the resender's retry
+            # budget would otherwise stall this peer forever (and grow the
+            # buffer without bound).  Skip to the earliest buffered sid,
+            # surrendering strict ordering across the gap.
+            expected = min(buffered)
+            log.warning(
+                f"force-order gap from node {sender}: skipping to sid "
+                f"{expected}"
+            )
+            ready = []
         while expected in buffered:
             ready.append(buffered.pop(expected))
             expected += 1

@@ -1,0 +1,67 @@
+"""Benchmark CLI (test_benchmark.cc parity) + distributed options."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_benchmark_cli_over_launcher():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "1", "-s", "2", "--",
+            sys.executable, "-m", "pslite_tpu.benchmark",
+            "--len", "16384", "--repeat", "4", "--mode", "push_then_pull",
+        ],
+        capture_output=True,
+        timeout=240,
+        cwd="/root/repo",
+    )
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    assert "push:" in out and "pull:" in out and "Gbps" in out
+    assert "CHECK_OK" in out
+
+
+def test_distributed_options_from_env():
+    from pslite_tpu.environment import Environment
+    from pslite_tpu.parallel.distributed import (
+        distributed_options,
+        init_distributed,
+    )
+
+    env = Environment({
+        "DMLC_PS_ROOT_URI": "10.0.0.1",
+        "DMLC_PS_ROOT_PORT": "9090",
+        "DMLC_NUM_WORKER": "4",
+        "DMLC_RANK": "2",
+    })
+    opts = distributed_options(env)
+    assert opts == {
+        "coordinator_address": "10.0.0.1:9091",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    # Single-process: no-op.
+    assert init_distributed(Environment({"DMLC_NUM_WORKER": "1"})) is None
+
+    from pslite_tpu.utils.logging import CheckError
+
+    with pytest.raises(CheckError):
+        distributed_options(Environment({
+            "DMLC_PS_ROOT_URI": "h", "DMLC_NUM_WORKER": "4",
+        }))  # missing DMLC_RANK
+
+def test_stress_patterns_on_cpu_mesh():
+    jax = pytest.importorskip("jax")
+    from pslite_tpu.parallel.engine import CollectiveEngine
+    from pslite_tpu.parallel.sparse import SparseEngine
+    from pslite_tpu.stress import PATTERNS, run_pattern
+
+    eng = CollectiveEngine()
+    sp = SparseEngine(eng.mesh, eng.axis)
+    for pattern in PATTERNS:
+        gbps = run_pattern(eng, sp, pattern, size_bytes=64 * 1024, iters=2)
+        assert gbps > 0, pattern

@@ -1,0 +1,44 @@
+"""Per-message event tracing (ENABLE_PROFILING), van byte counters."""
+
+import numpy as np
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+
+from helpers import LoopbackCluster
+
+
+def test_profiler_event_log_and_byte_counters(tmp_path):
+    path = tmp_path / "trace.csv"
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"ENABLE_PROFILING": "1", "PROFILE_PATH": str(path)},
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([9], dtype=np.uint64)
+        vals = np.ones(32, dtype=np.float32)
+        worker.wait(worker.push(keys, vals))
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+
+        van = cluster.workers[0].van
+        assert van.send_bytes > 0
+        assert van.recv_bytes > 0
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+    lines = path.read_text().strip().splitlines()
+    # key,event_kind,timestamp_us — the reference's (key, event, µs) format.
+    assert any(line.startswith("9,send_push,") for line in lines), lines
+    assert any(line.startswith("9,recv_pull,") for line in lines), lines
+    for line in lines:
+        key, event, ts = line.split(",")
+        assert event.split("_")[0] in ("send", "recv")
+        assert int(ts) > 0
