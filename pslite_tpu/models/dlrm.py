@@ -1,0 +1,138 @@
+"""DLRM-style recommender: sparse embedding + dense MLP through the PS.
+
+The reference's sparse workload (1M-key skewed embedding push/pull,
+BASELINE config 5) in model form: categorical features look up rows of a
+mesh-sharded embedding table (SparseEngine — expert/table parallelism),
+dense features feed an MLP whose parameters live in a dense PS store.
+One training step does BOTH PS cycles:
+
+- dense params: pull = all_gather, push = psum_scatter (dp axis)
+- embedding rows: pull = sparse gather routing, push = scatter-add of the
+  per-row gradients into the owning shards
+
+i.e. the hybrid dense+sparse traffic pattern BytePS serves in production.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DLRMConfig:
+    num_rows: int = 1024  # embedding table rows (1M in the benchmark)
+    emb_dim: int = 16
+    num_cat: int = 4  # categorical features per example
+    num_dense: int = 8  # dense features per example
+    hidden: int = 64
+    dtype: str = "float32"
+
+
+def init_mlp(rng, cfg: DLRMConfig):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(cfg.dtype)
+    d_in = cfg.num_dense + cfg.num_cat * cfg.emb_dim
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "w1": (jax.random.normal(k1, (d_in, cfg.hidden)) * d_in ** -0.5
+               ).astype(dt),
+        "b1": jnp.zeros((cfg.hidden,), dt),
+        "w2": (jax.random.normal(k2, (cfg.hidden, 1)) * cfg.hidden ** -0.5
+               ).astype(dt),
+        "b2": jnp.zeros((1,), dt),
+    }
+
+
+def predict(mlp, emb_rows, dense_feats, cfg: DLRMConfig):
+    """emb_rows [B, num_cat, emb_dim]; dense [B, num_dense] -> logits [B]."""
+    import jax
+    import jax.numpy as jnp
+
+    B = dense_feats.shape[0]
+    x = jnp.concatenate(
+        [dense_feats, emb_rows.reshape(B, -1)], axis=-1
+    )
+    h = jax.nn.relu(x @ mlp["w1"] + mlp["b1"])
+    return (h @ mlp["w2"] + mlp["b2"])[:, 0]
+
+
+def make_train_step(cfg: DLRMConfig, engine, sparse_engine, lr: float = 0.1,
+                    seed: int = 0):
+    """Returns ``step(idx, dense, labels) -> loss`` driving both PS planes.
+
+    ``idx``: [W, B, num_cat] rows per worker shard; ``dense``:
+    [W, B, num_dense]; ``labels``: [W, B] in {0,1}.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.flatten_util import ravel_pytree
+
+    W = engine.num_shards
+    mlp0 = init_mlp(jax.random.PRNGKey(seed), cfg)
+    flat0, unravel = ravel_pytree(mlp0)
+
+    engine.register_dense("dlrm_mlp", np.arange(1, dtype=np.uint64),
+                          flat0.shape[0], init=np.asarray(flat0))
+    sparse_engine.register_sparse("dlrm_emb", cfg.num_rows, cfg.emb_dim)
+
+    @jax.jit
+    def _grads(flat_mlp, emb_rows, dense, labels):
+        def loss_of(flat, rows):
+            mlp = unravel(flat)
+            logits = predict(mlp, rows, dense.reshape(-1, cfg.num_dense),
+                             cfg)
+            lbl = labels.reshape(-1).astype(logits.dtype)
+            # Sigmoid cross-entropy (CTR-style binary objective).
+            return jnp.mean(
+                jnp.maximum(logits, 0) - logits * lbl
+                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            )
+
+        (loss, (g_flat, g_rows)) = jax.value_and_grad(
+            lambda f, r: loss_of(f, r), argnums=(0, 1)
+        )(flat_mlp, emb_rows)
+        return loss, g_flat, g_rows
+
+    def step(idx, dense, labels):
+        B = idx.shape[1]
+        # -- sparse pull: rows for every worker's batch ---------------------
+        flat_idx = idx.reshape(W, B * cfg.num_cat)
+        rows = sparse_engine.pull("dlrm_emb", flat_idx)  # [W, B*num_cat, d]
+        rows = rows.reshape(W * B, cfg.num_cat, cfg.emb_dim)
+        # -- dense pull -----------------------------------------------------
+        flat_mlp = engine.pull("dlrm_mlp")
+        # -- local compute (host-driven across the worker dim) --------------
+        loss, g_flat, g_rows = _grads(
+            flat_mlp,
+            rows,
+            jnp.asarray(dense),
+            jnp.asarray(labels),
+        )
+        # -- dense push: aggregated MLP gradient, SGD on shards -------------
+        # g_flat already averages over every worker's examples; the push
+        # broadcast + psum multiplies by W, so pre-divide.  Pin the
+        # accumulate semantics regardless of the engine's default handle.
+        engine.push("dlrm_mlp", -lr * g_flat / W, handle="sum")
+        # -- sparse push: per-row gradients scatter-add into the table ------
+        g_rows = g_rows.reshape(W, B * cfg.num_cat, cfg.emb_dim)
+        sparse_engine.push("dlrm_emb", flat_idx, -lr * g_rows)
+        return loss
+
+    return step
+
+
+def toy_batch(cfg: DLRMConfig, workers: int, batch: int, seed: int = 0):
+    """Learnable toy CTR data: label correlates with one hot row's use."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    idx = rng.zipf(1.5, size=(workers, batch, cfg.num_cat)).astype(np.int64)
+    idx = (idx - 1) % cfg.num_rows
+    dense = rng.normal(size=(workers, batch, cfg.num_dense)).astype(
+        np.float32
+    )
+    labels = ((idx[..., 0] % 2) ^ (dense[..., 0] > 0)).astype(np.int32)
+    return idx.astype(np.int32), dense, labels

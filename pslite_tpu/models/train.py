@@ -34,17 +34,14 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
     ``P('dp', 'sp')``.
     """
     import jax
-    import jax.numpy as jnp
-    import numpy as np
     from jax import lax
-    from jax.flatten_util import ravel_pytree
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import shard_map_compat
     from ..parallel.ring_attention import ring_attention
+    from .ps_step import make_flat_ps_step
+    from .transformer import ParallelCtx
 
     axes = tuple(mesh.axis_names)  # e.g. ('dp', 'sp')
-    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
     sp_axis = axes[-1]
     sp = mesh.shape[sp_axis]
 
@@ -63,27 +60,13 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
         )
 
     params0 = init_params(jax.random.PRNGKey(seed), cfg)
-    flat0, unravel = ravel_pytree(params0)
-    n_params = flat0.shape[0]
-    padded = -(-n_params // n_dev) * n_dev
-    flat0 = jnp.pad(flat0, (0, padded - n_params))
 
-    store_sharding = NamedSharding(mesh, P(axes))
-    token_sharding = NamedSharding(mesh, P(axes[0], sp_axis))
-    flat_store = jax.device_put(flat0, store_sharding)
-
-    from .transformer import ParallelCtx
-
-    def _local_step(store_l, inp_l, tgt_l):
-        # -- pull: params = all_gather(store) --------------------------------
-        flat = lax.all_gather(store_l, axes, tiled=True)[:n_params]
-        params = unravel(flat)
-
+    def _local_loss(params, inp_l, tgt_l):
         sp_idx = lax.axis_index(sp_axis)
         t_local = inp_l.shape[1]
         # The model axis carries sequence parallelism (ring attention),
-        # tensor parallelism (sharded MLP matmuls + psum), and — for MoE
-        # configs — expert parallelism, all at once.
+        # tensor parallelism (sharded MLP matmuls), and — for MoE configs —
+        # expert parallelism, all at once.
         ctx = ParallelCtx(
             attn_fn=lambda q, k, v: ring_attention(
                 q, k, v, sp_axis, causal=True
@@ -92,29 +75,14 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
             tp_axis=None if cfg.moe_experts else sp_axis,
             ep_axis=sp_axis if cfg.moe_experts else None,
         )
+        return loss_fn(params, inp_l, tgt_l, cfg, ctx=ctx)
 
-        def _loss(p):
-            return loss_fn(p, inp_l, tgt_l, cfg, ctx=ctx)
-
-        loss, grads = jax.value_and_grad(_loss)(params)
-        flat_g, _ = ravel_pytree(grads)
-        flat_g = jnp.pad(flat_g, (0, padded - n_params))
-
-        # -- push: reduce-scatter the summed gradient to server shards ------
-        agg = lax.psum_scatter(flat_g, axes, scatter_dimension=0, tiled=True)
-
-        # -- server update on the shard (mean of worker grads) --------------
-        new_store = store_l - lr * (agg / n_dev)
-        mean_loss = lax.psum(loss, axes) / n_dev
-        return new_store, mean_loss
-
-    fn = shard_map_compat(
-        _local_step,
-        mesh,
-        in_specs=(P(axes), P(axes[0], sp_axis), P(axes[0], sp_axis)),
-        out_specs=(P(axes), P()),
+    token_spec = P(axes[0], sp_axis)
+    step, flat_store, (token_sharding, _), store_sharding, _ = (
+        make_flat_ps_step(
+            mesh, params0, _local_loss, [token_spec, token_spec], lr=lr
+        )
     )
-    step = jax.jit(fn, donate_argnums=(0,))
     return step, flat_store, token_sharding, store_sharding
 
 

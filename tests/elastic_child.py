@@ -1,0 +1,66 @@
+"""Child for the elastic end-to-end test.
+
+Worker rank 1 crashes (exit 254) after its first push; the launcher's
+keepalive restarts it; the scheduler's recovery path hands it the dead id;
+it pushes again and the cluster finalizes cleanly.  Worker rank 0 polls the
+store until it reflects all three pushes.
+"""
+
+import faulthandler
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+faulthandler.dump_traceback_later(180, exit=True)
+
+import numpy as np
+
+import pslite_tpu as ps
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.message import Role
+
+
+def main() -> int:
+    role = os.environ["DMLC_ROLE"]
+    marker = sys.argv[1]
+    if role == "worker" and os.path.exists(marker):
+        # Recovery run: give the scheduler time to see the old id as dead.
+        time.sleep(float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "2")) + 1.5)
+    ps.start_ps()
+    server = None
+    if role == "server":
+        server = KVServer(0)
+        server.set_request_handle(KVServerDefaultHandle())
+    if role == "worker":
+        po = ps.postoffice(Role.WORKER)
+        worker = KVWorker(0, 0)
+        keys = np.array([42], dtype=np.uint64)
+        worker.wait(worker.push(keys, np.ones(8, dtype=np.float32)))
+        if po.my_rank() == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(254)  # crash AFTER push, BEFORE finalize
+        if po.is_recovery:
+            print("RECOVERED_OK", flush=True)
+        if po.my_rank() == 0:
+            out = np.zeros(8, dtype=np.float32)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                worker.wait(worker.pull(keys, out))
+                if out[0] >= 3.0:  # rank0 once + rank1 twice
+                    print("POLL_OK", flush=True)
+                    break
+                time.sleep(0.5)
+            else:
+                print(f"POLL_FAIL out={out[0]}", flush=True)
+                return 1
+    ps.finalize()
+    if server is not None:
+        server.stop()
+    print(f"{role} ELASTIC_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Elastic end-to-end: crash -> keepalive restart -> dead-id recovery ->
+cluster continues and finalizes cleanly.
+
+Exercises the full reliability chain in one scenario: heartbeats
+(PS_HEARTBEAT_*), scheduler dead-node detection, recovery id inheritance,
+launcher keepalive (exit 254), and continued KV traffic afterwards —
+the reference's recovery story (van.cc:266-332 + dmlc_local.py keepalive)
+driven through real OS processes.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_worker_crash_recovery_end_to_end(tmp_path):
+    marker = tmp_path / "crashed"
+    child = os.path.join(os.path.dirname(__file__), "elastic_child.py")
+    env = dict(
+        os.environ,
+        PS_HEARTBEAT_INTERVAL="1",
+        PS_HEARTBEAT_TIMEOUT="2",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "2", "-s", "1", "--",
+            sys.executable, child, str(marker),
+        ],
+        capture_output=True,
+        timeout=300,
+        env=env,
+        cwd="/root/repo",
+    )
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out[-3000:]
+    assert marker.exists(), "the crash never happened"
+    assert "restarting worker (exit 254)" in out
+    assert "RECOVERED_OK" in out
+    assert "POLL_OK" in out
+    assert out.count("ELASTIC_DONE") == 4  # scheduler, server, 2 workers
