@@ -19,6 +19,51 @@ import numpy as np
 from .utils import logging as log
 
 
+def have_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def save_engine_orbax(engine, path: str, sparse_engine=None) -> None:
+    """Orbax-backed snapshot of the engine stores (sharded arrays are
+    handed to orbax as-is, so multi-host saves write per-shard)."""
+    import orbax.checkpoint as ocp
+
+    state = {"dense": {}, "sparse": {}}
+    for name in engine._buckets:
+        state["dense"][name] = engine.store_array(name)
+    if sparse_engine is not None:
+        for name in sparse_engine._tables:
+            state["sparse"][name] = sparse_engine.store_array(name)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), state, force=True)
+        ckptr.wait_until_finished()
+
+
+def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
+    """Restore an orbax snapshot; buckets/tables must be pre-registered so
+    the target shardings exist (same contract as restore_engine)."""
+    import orbax.checkpoint as ocp
+
+    target = {"dense": {}, "sparse": {}}
+    for name in engine._buckets:
+        target["dense"][name] = engine.store_array(name)
+    if sparse_engine is not None:
+        for name in sparse_engine._tables:
+            target["sparse"][name] = sparse_engine.store_array(name)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.abspath(path), target)
+    for name, arr in state["dense"].items():
+        engine._stores[name] = arr
+    if sparse_engine is not None:
+        for name, arr in state["sparse"].items():
+            sparse_engine._stores[name] = arr
+
+
 def save_engine(engine, path: str, sparse_engine=None) -> None:
     """Snapshot every dense bucket (and sparse table) to ``path``."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

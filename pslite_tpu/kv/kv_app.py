@@ -66,6 +66,11 @@ class KVMeta:
     option: int = 0
 
 
+# meta.option marker: vals travel as int8 blocks + fp32 scales (gradient
+# compression for DCN-class links; ops/quantize.py scheme).
+OPT_COMPRESS_INT8 = 1
+
+
 def default_slicer(
     kvs: KVPairs, ranges: List[Range]
 ) -> List[Optional[KVPairs]]:
@@ -246,20 +251,37 @@ class KVWorker:
         cmd: int = 0,
         callback: Optional[Callable[[], None]] = None,
         priority: int = 0,
+        compress: Optional[str] = None,
     ) -> int:
         """Zero-copy push; caller must not mutate buffers until wait(ts)
-        (kv_app.h:210-231)."""
+        (kv_app.h:210-231).
+
+        ``compress='int8'`` quarters wire bytes on the message path
+        (blockwise symmetric quantization, decompressed server-side before
+        the handler).  Ignored on the collective path — ICI needs no wire
+        compression — and incompatible with ``lens``.
+        """
+        if compress is not None:
+            log.check(compress == "int8", f"unknown compression {compress!r}")
+            log.check(lens is None, "compress requires fixed-length values")
         route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
                                    lens)
         if route is not None:
             store = self.engine.push(route, vals)
             return self._engine_dispatch(store, callback=callback)
         kvs = _as_kvs(keys, vals, lens, priority)
+        if compress is not None:
+            log.check(
+                kvs.vals.dtype == np.float32,
+                f"compress='int8' requires float32 values, got "
+                f"{kvs.vals.dtype}",
+            )
         ts = self._customer.new_request(SERVER_GROUP)
         if callback is not None:
             with self._mu:
                 self._callbacks[ts] = callback
-        self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs)
+        self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs,
+                   compress=compress)
         return ts
 
     def pull(
@@ -337,6 +359,7 @@ class KVWorker:
         kvs: KVPairs,
         val_dtype=None,
         val_nbytes: int = 0,
+        compress: Optional[str] = None,
     ) -> None:
         ranges = self.po.get_server_key_ranges()
         sliced = self._slicer(kvs, ranges)
@@ -368,9 +391,20 @@ class KVWorker:
                 m.val_len = part.vals.nbytes
             m.addr = id(part.vals)  # address token for same-process fast paths
             msg.add_data(SArray(part.keys))
-            msg.add_data(SArray(part.vals))
-            if part.lens is not None:
-                msg.add_data(SArray(np.asarray(part.lens, dtype=np.int32)))
+            if compress == "int8" and push:  # dtype validated in push()
+                from ..ops.quantize import np_quantize_int8
+
+                q, scales, _n = np_quantize_int8(part.vals)
+                m.option = OPT_COMPRESS_INT8
+                m.val_len = part.vals.nbytes  # original size for decompress
+                msg.add_data(SArray(q.reshape(-1)))
+                msg.add_data(SArray(scales))
+            else:
+                msg.add_data(SArray(part.vals))
+                if part.lens is not None:
+                    msg.add_data(
+                        SArray(np.asarray(part.lens, dtype=np.int32))
+                    )
             self.po.van.send(msg)
 
     def _process(self, msg: Message) -> None:
@@ -494,9 +528,18 @@ class KVServer:
         kvs = KVPairs()
         if len(msg.data) >= 2:
             kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
-            kvs.vals = msg.data[1].numpy()
-            if len(msg.data) > 2:
-                kvs.lens = msg.data[2].astype_view(np.int32).numpy()
+            if meta.option == OPT_COMPRESS_INT8 and meta.push:
+                from ..ops.quantize import QUANT_BLOCK, np_dequantize_int8
+
+                q = msg.data[1].astype_view(np.int8).numpy().reshape(
+                    -1, QUANT_BLOCK
+                )
+                scales = msg.data[2].astype_view(np.float32).numpy()
+                kvs.vals = np_dequantize_int8(q, scales, meta.val_len // 4)
+            else:
+                kvs.vals = msg.data[1].numpy()
+                if len(msg.data) > 2:
+                    kvs.lens = msg.data[2].astype_view(np.int32).numpy()
         if meta.push and len(kvs.keys):
             reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
             if reg is not None:

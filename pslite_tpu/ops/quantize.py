@@ -26,6 +26,35 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def np_quantize_int8(x):
+    """Host-side (numpy) variant for the DCN/TCP message path: flat fp32 ->
+    (int8 [rows,128], fp32 scales [rows]).  Same layout/semantics as the
+    Pallas kernel, minus lane replication."""
+    import numpy as _np
+
+    x = _np.asarray(x, dtype=_np.float32).reshape(-1)
+    n = x.shape[0]
+    pad = (-n) % QUANT_BLOCK
+    if pad:
+        x = _np.pad(x, (0, pad))
+    rows = x.shape[0] // QUANT_BLOCK
+    x2 = x.reshape(rows, QUANT_BLOCK)
+    scales = _np.maximum(
+        _np.abs(x2).max(axis=1) / 127.0, 1e-12
+    ).astype(_np.float32)
+    q = _np.clip(
+        _np.rint(x2 / scales[:, None]), -127, 127
+    ).astype(_np.int8)
+    return q, scales, n
+
+
+def np_dequantize_int8(q, scales, n: int):
+    import numpy as _np
+
+    x = q.astype(_np.float32) * _np.asarray(scales, _np.float32)[:, None]
+    return x.reshape(-1)[:n]
+
+
 @jax.jit
 def quantize_int8(x):
     """flat fp32 -> (int8 ``[rows, 128]``, fp32 scales ``[rows, 128]``).

@@ -113,3 +113,38 @@ def test_local_launcher_keepalive_restart(tmp_path):
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
     assert b"RESTARTED_OK" in proc.stdout
     assert b"restarting scheduler" in proc.stderr
+
+def test_engine_checkpoint_orbax_roundtrip(tmp_path):
+    from pslite_tpu.checkpoint import (
+        have_orbax,
+        restore_engine_orbax,
+        save_engine_orbax,
+    )
+
+    if not have_orbax():
+        pytest.skip("orbax not installed")
+    mesh = default_mesh()
+    eng = CollectiveEngine(mesh=mesh)
+    sp = SparseEngine(mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("od", keys, 16)
+    eng.push("od", np.full(32, 2.0, np.float32))
+    sp.register_sparse("ot", 16, 4)
+    sp.push("ot", np.ones((8, 2), np.int32),
+            np.ones((8, 2, 4), np.float32))
+
+    path = str(tmp_path / "orbax_ckpt")
+    save_engine_orbax(eng, path, sparse_engine=sp)
+
+    eng2 = CollectiveEngine(mesh=mesh)
+    sp2 = SparseEngine(mesh)
+    eng2.register_dense("od", keys, 16)
+    sp2.register_sparse("ot", 16, 4)
+    restore_engine_orbax(eng2, path, sparse_engine=sp2)
+    np.testing.assert_allclose(
+        np.asarray(eng2.pull("od")), np.asarray(eng.pull("od"))
+    )
+    idx = np.ones((8, 2), np.int32)
+    np.testing.assert_allclose(
+        np.asarray(sp2.pull("ot", idx)), np.asarray(sp.pull("ot", idx))
+    )

@@ -241,3 +241,34 @@ def test_simple_app():
         for app in apps:
             app.stop()
         cluster.finalize()
+
+
+def test_compressed_push():
+    """int8 gradient compression on the message path: values land within
+    quantization error, wire bytes shrink ~4x."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([5], dtype=np.uint64)
+        n = 64 * 1024
+        vals = np.random.default_rng(0).normal(size=n).astype(np.float32)
+
+        before = cluster.workers[0].van.send_bytes
+        worker.wait(worker.push(keys, vals, compress="int8"))
+        wire_bytes = cluster.workers[0].van.send_bytes - before
+        assert wire_bytes < vals.nbytes / 3  # ~4x smaller + overhead
+
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        step = np.abs(vals).reshape(-1, 128).max(axis=1) / 127.0
+        tol = np.repeat(step, 128) * 0.51 + 1e-6
+        assert np.all(np.abs(out - vals) <= tol)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
