@@ -72,7 +72,12 @@ def main() -> None:
         headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
         headline_cfg = "4x64KB quick"
     else:
-        headline = _measure(eng, "bench", 40, (1 << 20) // 4, 30)
+        # Median of 3 rounds: single-run numbers on a shared chip vary
+        # ~20%; the driver records whatever one invocation prints.
+        runs = sorted(
+            _measure(eng, "bench", 40, (1 << 20) // 4, 30) for _ in range(3)
+        )
+        headline = runs[1]
         headline_cfg = "40x1MB"
 
     baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget

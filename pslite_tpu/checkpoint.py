@@ -57,11 +57,21 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
             target["sparse"][name] = sparse_engine.store_array(name)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(os.path.abspath(path), target)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # Route through the same setters/shardings restore_engine uses so both
+    # paths share the locking and placement guarantees.
     for name, arr in state["dense"].items():
-        engine._stores[name] = arr
+        engine.set_store_array(name, np.asarray(arr))
     if sparse_engine is not None:
+        sharding = NamedSharding(
+            sparse_engine.mesh, P(sparse_engine.axis, None)
+        )
         for name, arr in state["sparse"].items():
-            sparse_engine._stores[name] = arr
+            sparse_engine._stores[name] = jax.device_put(
+                np.asarray(arr), sharding
+            )
 
 
 def save_engine(engine, path: str, sparse_engine=None) -> None:

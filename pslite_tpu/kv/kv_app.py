@@ -396,7 +396,8 @@ class KVWorker:
 
                 q, scales, _n = np_quantize_int8(part.vals)
                 m.option = OPT_COMPRESS_INT8
-                m.val_len = part.vals.nbytes  # original size for decompress
+                # m.val_len already holds the uncompressed byte count (set
+                # above); the server derives n = val_len // 4 from it.
                 msg.add_data(SArray(q.reshape(-1)))
                 msg.add_data(SArray(scales))
             else:

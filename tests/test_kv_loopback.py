@@ -272,3 +272,39 @@ def test_compressed_push():
         for s in servers:
             s.stop()
         cluster.finalize()
+
+
+def test_registered_recv_buffer_identity():
+    """The reference benchmark proves zero-copy delivery by checking pushes
+    land in the pre-registered buffer (test_benchmark.cc:169-181); the
+    app-level contract here: the handler's vals alias the registered
+    buffer's memory."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    servers = []
+    try:
+        seen = {}
+
+        def handle(meta, data, server):
+            if meta.push:
+                seen["vals"] = data.vals
+            server.response(meta)
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(handle)
+        servers.append(srv)
+
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        worker_id = cluster.workers[0].van.my_node.id
+        registered = np.zeros(64, dtype=np.float32)
+        srv.register_recv_buffer(worker_id, 7, registered)
+
+        vals = np.arange(64, dtype=np.float32)
+        worker.wait(worker.push(np.array([7], np.uint64), vals))
+        assert "vals" in seen
+        assert np.shares_memory(seen["vals"], registered)
+        np.testing.assert_allclose(registered, vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
