@@ -57,21 +57,14 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
             target["sparse"][name] = sparse_engine.store_array(name)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(os.path.abspath(path), target)
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    # Route through the same setters/shardings restore_engine uses so both
-    # paths share the locking and placement guarantees.
+    # The restore target was the live stores, so orbax hands back arrays
+    # already in the target shardings; the setters assign them directly
+    # (no host round-trip — multi-host arrays aren't host-fetchable).
     for name, arr in state["dense"].items():
-        engine.set_store_array(name, np.asarray(arr))
+        engine.set_store_array(name, arr)
     if sparse_engine is not None:
-        sharding = NamedSharding(
-            sparse_engine.mesh, P(sparse_engine.axis, None)
-        )
         for name, arr in state["sparse"].items():
-            sparse_engine._stores[name] = jax.device_put(
-                np.asarray(arr), sharding
-            )
+            sparse_engine.set_store_array(name, arr)
 
 
 def save_engine(engine, path: str, sparse_engine=None) -> None:
@@ -112,22 +105,13 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
         path = path + ".npz"
     data = np.load(path)
     meta = json.loads(bytes(data["__meta__"]).decode())
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     for name in meta["dense"]:
         log.check(name in engine._buckets,
                   f"bucket {name!r} not registered before restore")
         engine.set_store_array(name, data[f"dense/{name}"])
     if sparse_engine is not None:
         for name in meta["sparse"]:
-            log.check(name in sparse_engine._tables,
-                      f"table {name!r} not registered before restore")
-            sharding = NamedSharding(sparse_engine.mesh,
-                                     P(sparse_engine.axis, None))
-            sparse_engine._stores[name] = jax.device_put(
-                data[f"sparse/{name}"], sharding
-            )
+            sparse_engine.set_store_array(name, data[f"sparse/{name}"])
 
 
 def save_kv_store(store: Dict[int, np.ndarray], path: str) -> None:

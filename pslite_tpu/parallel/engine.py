@@ -281,18 +281,39 @@ class CollectiveEngine:
         return self._stores[name]
 
     def set_store_array(self, name: str, value) -> None:
-        """Restore server state (checkpoint resume)."""
+        """Restore server state (checkpoint resume).
+
+        Accepts a host array (placed onto the bucket's sharding) or a
+        ``jax.Array`` already laid out for this store (multi-host orbax
+        restores pass these through untouched — fetching them to host
+        would fail across non-addressable devices).
+        """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         bucket = self._buckets[name]
         sharding = NamedSharding(self.mesh, P(self.axis))
+        if isinstance(value, jax.Array):
+            equivalent = value.sharding == sharding or (
+                hasattr(value.sharding, "is_equivalent_to")
+                and value.sharding.is_equivalent_to(sharding, value.ndim)
+            )
+            if equivalent:
+                log.check_eq(tuple(value.shape), (bucket.padded_len,),
+                             "bad restore shape")
+                log.check_eq(value.dtype, np.dtype(bucket.dtype),
+                             "bad restore dtype")
+                with self._mu:
+                    self._stores[name] = value
+                return
         arr = np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype))
         flat = np.asarray(value).reshape(-1)
         log.check(len(flat) in (bucket.total_len, bucket.padded_len),
                   "bad restore length")
         arr[: len(flat)] = flat
-        self._stores[name] = jax.device_put(arr, sharding)
+        placed = jax.device_put(arr, sharding)
+        with self._mu:
+            self._stores[name] = placed
 
     def block(self, name: Optional[str] = None) -> None:
         """Wait for outstanding device work (ZPush/Wait semantics)."""

@@ -191,5 +191,33 @@ class SparseEngine:
     def store_array(self, name: str):
         return self._stores[name]
 
+    def set_store_array(self, name: str, value) -> None:
+        """Restore a table (checkpoint resume).  Host arrays must already be
+        in the shard-interleaved layout ``store_array`` exposes; sharded
+        ``jax.Array``s (multi-host restores) are assigned directly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        log.check(name in self._tables, f"table {name!r} not registered")
+        table = self._tables[name]
+        expected = (table.rows_per_shard * self.num_shards, table.dim)
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if isinstance(value, jax.Array):
+            equivalent = value.sharding == sharding or (
+                hasattr(value.sharding, "is_equivalent_to")
+                and value.sharding.is_equivalent_to(sharding, value.ndim)
+            )
+            if equivalent:
+                log.check_eq(tuple(value.shape), expected,
+                             "bad restore shape")
+                with self._mu:
+                    self._stores[name] = value
+                return
+        host = np.asarray(value)
+        log.check_eq(tuple(host.shape), expected, "bad restore shape")
+        placed = jax.device_put(host, sharding)
+        with self._mu:
+            self._stores[name] = placed
+
     def table(self, name: str) -> SparseTable:
         return self._tables[name]

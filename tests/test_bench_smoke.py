@@ -22,7 +22,16 @@ def test_measure_helper_runs():
 def test_bench_cli_contract():
     import os
 
-    env = dict(os.environ, PS_BENCH_QUICK="1")
+    # Force the child onto CPU: the axon sitecustomize would otherwise put
+    # bench.py on the real TPU tunnel, coupling the unit suite to tunnel
+    # health (JAX_PLATFORMS alone is overridden programmatically, so also
+    # disable the axon registration).
+    env = dict(
+        os.environ,
+        PS_BENCH_QUICK="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,

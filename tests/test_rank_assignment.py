@@ -1,0 +1,113 @@
+"""Scheduler rank-assignment policies (reference: van.cc:112-265):
+preferred ranks (aux_id), BYTEPS_ORDERED_HOSTS, and mixed mode."""
+
+import itertools
+import threading
+
+from pslite_tpu.base import server_rank_to_id, worker_rank_to_id
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import Role
+from pslite_tpu.postoffice import Postoffice
+
+_seq = itertools.count(60000)
+
+
+def _cluster(num_workers, num_servers, per_node_env, base_extra=None):
+    """Build scheduler+servers+workers with per-node env overrides.
+    Policy vars the scheduler reads (BYTEPS_*) go in ``base_extra``."""
+    port = next(_seq)
+    base = {
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "DMLC_PS_ROOT_URI": "lo",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NODE_HOST": "lo",
+        "PS_VAN_TYPE": "loopback",
+    }
+    if base_extra:
+        base.update(base_extra)
+    nodes = []
+    nodes.append(Postoffice(Role.SCHEDULER,
+                            env=Environment(dict(base))))
+    for i in range(num_servers):
+        env = dict(base, **per_node_env("server", i))
+        nodes.append(Postoffice(Role.SERVER, env=Environment(env)))
+    for i in range(num_workers):
+        env = dict(base, **per_node_env("worker", i))
+        nodes.append(Postoffice(Role.WORKER, env=Environment(env)))
+    threads = [threading.Thread(target=p.start, daemon=True) for p in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "cluster start timed out"
+    return nodes
+
+
+def _finalize(nodes):
+    threads = [
+        threading.Thread(target=p.finalize, daemon=True) for p in nodes
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+
+def test_preferred_ranks_honored():
+    """Every node supplies DMLC_RANK -> ids follow the preferences,
+    regardless of registration order."""
+    prefs = {"server": {0: 1, 1: 0}, "worker": {0: 1, 1: 0}}
+
+    nodes = _cluster(
+        2, 2, lambda role, i: {"DMLC_RANK": str(prefs[role][i])}
+    )
+    try:
+        servers = [n for n in nodes if n.is_server]
+        workers = [n for n in nodes if n.is_worker]
+        # Construction order i was given preferred rank prefs[role][i].
+        assert servers[0].van.my_node.id == server_rank_to_id(1)
+        assert servers[1].van.my_node.id == server_rank_to_id(0)
+        assert workers[0].van.my_node.id == worker_rank_to_id(1)
+        assert workers[1].van.my_node.id == worker_rank_to_id(0)
+    finally:
+        _finalize(nodes)
+
+
+def test_mixed_mode_prefers_non_colocated_servers():
+    """BYTEPS_ENABLE_MIXED_MODE: servers NOT sharing a host with workers
+    get the lowest server ranks (van.cc:126-150)."""
+    # Two servers on distinct hosts; the worker shares "hostB".
+    hosts = {"server": {0: "hostB", 1: "hostA"}, "worker": {0: "hostB"}}
+
+    def env(role, i):
+        return {"DMLC_NODE_HOST": hosts[role][i]}
+
+    nodes = _cluster(1, 2, env,
+                     base_extra={"BYTEPS_ENABLE_MIXED_MODE": "1"})
+    try:
+        servers = {n.van.my_node.hostname: n.van.my_node.id
+                   for n in nodes if n.is_server}
+        # hostA (not colocated with the worker) takes rank 0.
+        assert servers["hostA"] == server_rank_to_id(0)
+        assert servers["hostB"] == server_rank_to_id(1)
+    finally:
+        _finalize(nodes)
+
+
+def test_ordered_hosts_policy():
+    """BYTEPS_ORDERED_HOSTS pins rank order to the listed host order."""
+    hosts = {"worker": {0: "h2", 1: "h1"}, "server": {0: "h1"}}
+
+    def env(role, i):
+        return {"DMLC_NODE_HOST": hosts[role][i]}
+
+    nodes = _cluster(2, 1, env,
+                     base_extra={"BYTEPS_ORDERED_HOSTS": "h1,h2"})
+    try:
+        workers = {n.van.my_node.hostname: n.van.my_node.id
+                   for n in nodes if n.is_worker}
+        assert workers["h1"] == worker_rank_to_id(0)
+        assert workers["h2"] == worker_rank_to_id(1)
+    finally:
+        _finalize(nodes)
