@@ -1,0 +1,85 @@
+"""Train the flagship transformer through the PS data plane.
+
+Single process drives the whole device mesh (every device is worker AND
+server shard — the JOINT deployment).  On a TPU slice this runs over ICI;
+on a CPU dev box, force a virtual mesh (BOTH vars — an axon sitecustomize
+may override JAX_PLATFORMS programmatically)::
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_flagship.py --steps 20
+
+Add ``--moe`` for the expert-parallel variant.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--moe", action="store_true")
+    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--checkpoint", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from pslite_tpu.checkpoint import save_train_state
+    from pslite_tpu.models.train import make_ps_train_step, toy_batch
+    from pslite_tpu.models.transformer import ModelConfig
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    sp = 2 if n % 2 == 0 else 1
+    mesh = make_mesh((n // sp, sp), ("dp", "sp"))
+    print(f"devices={n} mesh=(dp={n // sp}, sp={sp}) "
+          f"backend={jax.default_backend()}")
+
+    cfg = ModelConfig(
+        vocab=256, dim=args.dim, heads=4, layers=args.layers,
+        moe_experts=4 * sp if args.moe else 0,
+    )
+    step, store, tok_sharding, _ = make_ps_train_step(cfg, mesh, lr=args.lr)
+
+    # Batch shards over dp and sequence over sp: round both up so the
+    # example runs on any slice size.
+    dp = n // sp
+    batch = -(-args.batch // dp) * dp
+    seq = -(-args.seq // sp) * sp
+    inputs, targets = toy_batch(cfg, batch=batch, seq=seq)
+    inputs = jax.device_put(inputs, tok_sharding)
+    targets = jax.device_put(targets, tok_sharding)
+
+    # Warm up (jit compile) before timing, like pslite_tpu/benchmark.py.
+    store, loss = step(store, inputs, targets)
+    print(f"step {0:4d}  loss {float(loss):.4f}  (compile)")
+    t0 = time.perf_counter()
+    for i in range(1, args.steps):
+        store, loss = step(store, inputs, targets)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    store.block_until_ready()
+    dt = time.perf_counter() - t0
+    toks = batch * seq * max(args.steps - 1, 1)
+    print(f"{toks / max(dt, 1e-9):,.0f} tokens/s (steady state)")
+
+    if args.checkpoint:
+        save_train_state(store, args.steps, args.checkpoint)
+        path = args.checkpoint
+        if not path.endswith(".npz"):
+            path += ".npz"
+        print(f"saved {path}")
+
+
+if __name__ == "__main__":
+    main()
