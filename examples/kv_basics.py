@@ -17,7 +17,13 @@ import pslite_tpu as ps
 
 
 def main() -> None:
-    role = os.environ["DMLC_ROLE"]  # set by the launcher
+    role = os.environ.get("DMLC_ROLE")
+    if role is None:
+        sys.exit(
+            "DMLC_ROLE not set — run this under the launcher:\n"
+            "  python -m pslite_tpu.tracker.local -n 2 -s 2 -- "
+            "python examples/kv_basics.py"
+        )
     ps.start_ps()
 
     server = None

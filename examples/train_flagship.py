@@ -56,6 +56,9 @@ def main() -> None:
     dp = n // sp
     batch = -(-args.batch // dp) * dp
     seq = -(-args.seq // sp) * sp
+    if (batch, seq) != (args.batch, args.seq):
+        print(f"note: batch/seq padded to mesh factors: "
+              f"batch {args.batch}->{batch}, seq {args.seq}->{seq}")
     inputs, targets = toy_batch(cfg, batch=batch, seq=seq)
     inputs = jax.device_put(inputs, tok_sharding)
     targets = jax.device_put(targets, tok_sharding)
@@ -63,6 +66,7 @@ def main() -> None:
     # Warm up (jit compile) before timing, like pslite_tpu/benchmark.py.
     store, loss = step(store, inputs, targets)
     print(f"step {0:4d}  loss {float(loss):.4f}  (compile)")
+    timed_steps = args.steps - 1
     t0 = time.perf_counter()
     for i in range(1, args.steps):
         store, loss = step(store, inputs, targets)
@@ -70,15 +74,16 @@ def main() -> None:
             print(f"step {i:4d}  loss {float(loss):.4f}")
     store.block_until_ready()
     dt = time.perf_counter() - t0
-    toks = batch * seq * max(args.steps - 1, 1)
-    print(f"{toks / max(dt, 1e-9):,.0f} tokens/s (steady state)")
+    if timed_steps > 0:
+        toks = batch * seq * timed_steps
+        print(f"{toks / dt:,.0f} tokens/s (steady state, "
+              f"{timed_steps} timed steps)")
+    else:
+        print("(need --steps >= 2 for a steady-state throughput number)")
 
     if args.checkpoint:
-        save_train_state(store, args.steps, args.checkpoint)
-        path = args.checkpoint
-        if not path.endswith(".npz"):
-            path += ".npz"
-        print(f"saved {path}")
+        written = save_train_state(store, args.steps, args.checkpoint)
+        print(f"saved {written}")
 
 
 if __name__ == "__main__":

@@ -127,10 +127,13 @@ def load_kv_store(path: str) -> Dict[int, np.ndarray]:
     return {int(k): data[k] for k in data.files}
 
 
-def save_train_state(flat_store, step: int, path: str) -> None:
-    """Snapshot the flagship training loop's sharded parameter store."""
+def save_train_state(flat_store, step: int, path: str) -> str:
+    """Snapshot the flagship training loop's sharded parameter store.
+
+    Returns the path actually written (np.savez appends ``.npz``)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     np.savez(path, store=np.asarray(flat_store), step=np.int64(step))
+    return path if path.endswith(".npz") else path + ".npz"
 
 
 def load_train_state(path: str, sharding=None):

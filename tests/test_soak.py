@@ -1,0 +1,89 @@
+"""Randomized soak: the KV contract against a host reference model.
+
+Property-style net over the whole message path: random sorted key sets,
+random push/pull interleavings from two workers, random payload sizes —
+every pull must match a plain dict+numpy model of the
+KVServerDefaultHandle semantics.  Catches slicer/reassembly/ordering
+regressions no single-scenario test pins down.
+"""
+
+import numpy as np
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+
+from helpers import LoopbackCluster
+
+
+def test_randomized_push_pull_soak():
+    rng = np.random.default_rng(1234)
+    cluster = LoopbackCluster(num_workers=2, num_servers=3)
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        workers = [
+            KVWorker(0, 0, postoffice=po) for po in cluster.workers
+        ]
+        ranges = cluster.workers[0].get_server_key_ranges()
+
+        # A pool of keys spread across all three server ranges.
+        pool = np.sort(
+            np.unique(
+                np.concatenate(
+                    [
+                        r.begin + rng.integers(0, 1 << 30, size=6).astype(
+                            np.uint64
+                        )
+                        for r in ranges
+                    ]
+                )
+            )
+        )
+        k = 8  # values per key
+        model = {}  # host reference: key -> np.ndarray
+
+        for round_idx in range(30):
+            w = workers[round_idx % 2]
+            # Random subset of the pool, sorted (the KV contract).
+            take = rng.random(len(pool)) < 0.5
+            if not take.any():
+                continue
+            keys = pool[take]
+            if rng.random() < 0.6 or not model:
+                vals = rng.normal(size=len(keys) * k).astype(np.float32)
+                w.wait(w.push(keys, vals))
+                for i, key in enumerate(keys):
+                    seg = vals[i * k : (i + 1) * k]
+                    key = int(key)
+                    model[key] = model.get(key, 0) + seg
+            else:
+                known = np.array(
+                    [key for key in keys if int(key) in model],
+                    dtype=np.uint64,
+                )
+                if len(known) == 0:
+                    continue
+                out = np.zeros(len(known) * k, dtype=np.float32)
+                w.wait(w.pull(known, out))
+                expected = np.concatenate(
+                    [model[int(key)] for key in known]
+                )
+                np.testing.assert_allclose(
+                    out, expected, rtol=1e-5, atol=1e-6,
+                    err_msg=f"round {round_idx}",
+                )
+
+        # Final full verification from both workers.
+        known = np.array(sorted(model), dtype=np.uint64)
+        expected = np.concatenate([model[int(key)] for key in known])
+        for w in workers:
+            out = np.zeros(len(known) * k, dtype=np.float32)
+            w.wait(w.pull(known, out))
+            np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
