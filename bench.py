@@ -6,11 +6,21 @@ Mirrors the reference's ``tests/test_benchmark`` PUSH_PULL mode
 (40 keys x 1 MB, repeat-timed).  Runs on whatever accelerator JAX exposes
 (the real TPU chip under the driver; do NOT set JAX_PLATFORMS=cpu here).
 
-``vs_baseline``: the reference publishes no absolute numbers
-(BASELINE.json "published": {}); the driver-defined pass bar is >= 70% of
-ICI line rate.  We normalize against 0.7 x 100 GB/s = 70 GB/s per chip —
-a v5e-class per-chip ICI budget — so vs_baseline >= 1.0 means the bar is
-met on the measured path.
+Honesty notes (single chip):
+- On a 1-device mesh ``psum_scatter``/``all_gather`` degenerate to local
+  HBM ops — the headline is an HBM/dispatch benchmark, NOT an ICI
+  benchmark.  We therefore report the detected chip model, an estimated
+  HBM-bandwidth utilization, and keep ``vs_baseline`` (normalized against
+  0.7 x 100 GB/s = 70 GB/s/chip, the driver's >=70%-of-ICI-line-rate bar)
+  clearly labeled as an ICI-budget ratio the single-chip path never
+  traverses.
+- The reference publishes no absolute numbers (BASELINE.json
+  "published": {}).
+
+Resilience: the TPU tunnel can flap (round 1 recorded rc=1 with no
+number).  Backend init is probed in a subprocess with a timeout and
+retried with backoff; on final failure ONE parseable JSON line with an
+``error`` field is printed (value 0) instead of a traceback.
 
 Prints ONE JSON line.
 """
@@ -18,11 +28,79 @@ Prints ONE JSON line.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
 
+# Rough per-chip HBM bandwidth (GB/s) by device_kind substring, for the
+# utilization estimate.  Public figures; best-effort match.
+_HBM_GBPS = (
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
 
-def _measure(eng, name: str, num_keys: int, val_len: int, iters: int) -> float:
-    """Goodput (GB/s) of iterated push_pull on one registered bucket."""
+# The probe honors an explicitly-set JAX_PLATFORMS (the axon sitecustomize
+# force-overrides the env var programmatically, so it must be re-applied
+# via jax.config after import — e.g. the PS_BENCH_QUICK CPU smoke).
+_PROBE_SRC = (
+    "import json, os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "jax.config.update('jax_platforms', p) if p else None; "
+    "d = jax.devices()[0]; "
+    "print(json.dumps({'platform': d.platform, "
+    "'device_kind': d.device_kind, 'n': jax.device_count()}))"
+)
+
+
+def _probe_backend(attempts: int = 3, timeout_s: int = 180) -> dict:
+    """Initialize the JAX backend in a THROWAWAY subprocess with a hard
+    timeout — ``jax.devices()`` hangs forever when the axon tunnel is
+    down, and a hung in-process init cannot be recovered.  Retries with
+    backoff because the tunnel flaps transiently."""
+    delays = (20, 60)
+    last = ""
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return json.loads(out.stdout.strip().splitlines()[-1])
+            last = (out.stderr or out.stdout or "").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {timeout_s}s (tunnel down?)"
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            last = repr(exc)
+        if i < attempts - 1:
+            time.sleep(delays[min(i, len(delays) - 1)])
+    return {"error": last or "backend probe failed"}
+
+
+def _hbm_estimate(device_kind: str) -> float | None:
+    kind = (device_kind or "").lower()
+    for sub, gbps in _HBM_GBPS:
+        if sub in kind:
+            return gbps
+    return None
+
+
+def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
+             host_grads: bool = False) -> float:
+    """Goodput (GB/s) of iterated push_pull on one registered bucket.
+
+    ``host_grads=True`` measures the message-origin path real users hit:
+    the host->HBM ``device_put`` of a (persistent) host numpy buffer runs
+    inside the timed loop (round-1 bench only ever timed pre-sharded
+    device arrays).  Allocation of fresh host arrays is NOT included."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,57 +110,137 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int) -> float:
     eng.register_dense(name, keys, val_len)
     bucket = eng.bucket(name)
     sharding = NamedSharding(eng.mesh, P(eng.axis, None))
-    grads = jax.device_put(
-        jnp.ones((eng.num_shards, bucket.padded_len), jnp.float32), sharding
-    )
+    if host_grads:
+        inp = np.ones((eng.num_shards, bucket.padded_len), np.float32)
+    else:
+        inp = jax.device_put(
+            jnp.ones((eng.num_shards, bucket.padded_len), jnp.float32),
+            sharding,
+        )
     # Warmup: compile + first-touch (the rendezvous equivalent).
     for _ in range(3):
-        out = eng.push_pull(name, grads)
+        out = eng.push_pull(name, inp)
     out.block_until_ready()
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = eng.push_pull(name, grads)
+        out = eng.push_pull(name, inp)
     out.block_until_ready()
     elapsed = time.perf_counter() - t0
     payload = num_keys * val_len * 4  # bytes per direction
     return 2 * payload * iters / elapsed / 1e9  # push + pull
 
 
+_emit_mu = threading.Lock()
+_emitted = False
+
+
+def _emit(obj: dict) -> None:
+    """Print the ONE result line (idempotent: watchdog vs main race)."""
+    global _emitted
+    with _emit_mu:
+        if _emitted:
+            return
+        _emitted = True
+        print(json.dumps(obj), flush=True)
+
+
+def _error_line(msg: str, extra: dict | None = None) -> dict:
+    line = {
+        "metric": "dense push-pull goodput (40x1MB, fused RS+update+AG)",
+        "value": 0.0,
+        "unit": "GB/s/chip",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }
+    if extra:
+        line.update(extra)
+    return line
+
+
 def main() -> None:
-    import os
-
-    from pslite_tpu.parallel.engine import CollectiveEngine
-
-    eng = CollectiveEngine()
-    # Reference sweep 1KB..64MB per key (test.sh / README.md:123-135);
-    # headline config: 40 keys x 1MB (test_benchmark.cc:407-414).
-    # PS_BENCH_QUICK=1 shrinks everything (CI smoke on CPU).
     quick = bool(int(os.environ.get("PS_BENCH_QUICK", "0")))
-    sizes = (1 << 10, 64 << 10) if quick else (
-        1 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20
-    )
-    sweep = {}
-    for size in sizes:
-        label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
-        iters = 2 if quick else max(4, min(60, (256 << 20) // max(size, 1 << 20)))
-        sweep[label] = round(
-            _measure(eng, f"sweep_{size}", 1, size // 4, iters), 2
-        )
-    if quick:
-        headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
-        headline_cfg = "4x64KB quick"
-    else:
-        # Median of 3 rounds: single-run numbers on a shared chip vary
-        # ~20%; the driver records whatever one invocation prints.
-        runs = sorted(
-            _measure(eng, "bench", 40, (1 << 20) // 4, 30) for _ in range(3)
-        )
-        headline = runs[1]
-        headline_cfg = "40x1MB"
+    probe = _probe_backend(attempts=1 if quick else 3,
+                           timeout_s=60 if quick else 180)
+    if "error" in probe:
+        _emit(_error_line(f"JAX backend unavailable: {probe['error']}"))
+        return
 
-    baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget
-    print(
-        json.dumps(
+    # The probe only covers its own subprocess; the tunnel can still flap
+    # before the in-process backend init below, which would hang forever
+    # (un-catchable).  A watchdog guarantees one parseable line regardless.
+    deadline = int(os.environ.get("PS_BENCH_TIMEOUT_S", "900"))
+
+    def _watchdog_fire():
+        _emit(_error_line(
+            f"bench exceeded {deadline}s (backend hang after successful "
+            f"probe — tunnel flapped mid-run?)",
+            {"platform": probe.get("platform"),
+             "device_kind": probe.get("device_kind")},
+        ))
+        os._exit(0)
+
+    watchdog = threading.Timer(deadline, _watchdog_fire)
+    watchdog.daemon = True
+    watchdog.start()
+
+    try:
+        explicit = os.environ.get("JAX_PLATFORMS")
+        if explicit:
+            # Re-apply an explicit platform choice over the sitecustomize's
+            # programmatic override (same counter-measure as the probe).
+            import jax
+
+            jax.config.update("jax_platforms", explicit)
+
+        from pslite_tpu.parallel.engine import CollectiveEngine
+
+        eng = CollectiveEngine()
+        # Reference sweep 1KB..64MB per key (test.sh / README.md:123-135);
+        # headline config: 40 keys x 1MB (test_benchmark.cc:407-414).
+        # PS_BENCH_QUICK=1 shrinks everything (CI smoke on CPU).
+        sizes = (1 << 10, 64 << 10) if quick else (
+            1 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20
+        )
+        sweep = {}
+        for size in sizes:
+            label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
+            iters = 2 if quick else max(
+                4, min(60, (256 << 20) // max(size, 1 << 20))
+            )
+            sweep[label] = round(
+                _measure(eng, f"sweep_{size}", 1, size // 4, iters), 2
+            )
+        if quick:
+            headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
+            headline_cfg = "4x64KB quick"
+            host_path = _measure(
+                eng, "bench_host", 4, (64 << 10) // 4, 2, host_grads=True
+            )
+        else:
+            # Median of 3 rounds: single-run numbers on a shared chip vary
+            # ~20%; the driver records whatever one invocation prints.
+            iters = 30
+            runs = sorted(
+                _measure(eng, "bench", 40, (1 << 20) // 4, iters)
+                for _ in range(3)
+            )
+            headline = runs[1]
+            headline_cfg = "40x1MB"
+            host_path = _measure(
+                eng, "bench_host", 40, (1 << 20) // 4, 8, host_grads=True
+            )
+
+        single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
+        hbm_est = _hbm_estimate(probe.get("device_kind", ""))
+        hbm_util = None
+        if hbm_est:
+            # Lower-bound HBM traffic of the fused step: read grads, read
+            # store, write store, write pulled = 4 x payload per iter.
+            # headline GB/s = 2 x payload / s, so traffic >= 2 x headline.
+            hbm_util = round(2 * headline / hbm_est, 3)
+
+        baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget
+        _emit(
             {
                 "metric": (
                     f"dense push-pull goodput ({headline_cfg}, "
@@ -91,10 +249,28 @@ def main() -> None:
                 "value": round(headline, 2),
                 "unit": "GB/s/chip",
                 "vs_baseline": round(headline / baseline, 3),
+                "platform": probe.get("platform"),
+                "device_kind": probe.get("device_kind"),
+                "n_devices": probe.get("n"),
                 "sweep_1key": sweep,
+                "host_origin_goodput": round(host_path, 2),
+                "hbm_util_est": hbm_util,
+                "note": (
+                    "single-chip: collectives degenerate to HBM-local ops; "
+                    "vs_baseline is an ICI-budget ratio the 1-device path "
+                    "does not traverse — hbm_util_est is the honest "
+                    "single-chip measure"
+                ) if single_chip else "multi-chip ICI path",
             }
         )
-    )
+    except Exception as exc:  # noqa: BLE001 - one parseable line, always
+        _emit(_error_line(
+            f"{type(exc).__name__}: {exc}",
+            {"platform": probe.get("platform"),
+             "device_kind": probe.get("device_kind")},
+        ))
+    finally:
+        watchdog.cancel()
 
 
 if __name__ == "__main__":

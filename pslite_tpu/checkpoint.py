@@ -51,15 +51,16 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
 
     target = {"dense": {}, "sparse": {}}
     for name in engine._buckets:
-        target["dense"][name] = engine.store_array(name)
+        target["dense"][name] = engine.store_spec(name)
     if sparse_engine is not None:
         for name in sparse_engine._tables:
-            target["sparse"][name] = sparse_engine.store_array(name)
+            target["sparse"][name] = sparse_engine.store_spec(name)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(os.path.abspath(path), target)
-    # The restore target was the live stores, so orbax hands back arrays
-    # already in the target shardings; the setters assign them directly
-    # (no host round-trip — multi-host arrays aren't host-fetchable).
+    # The targets are ShapeDtypeStructs carrying the live stores'
+    # shardings (no allocation), so orbax hands back arrays already in
+    # the target shardings; the setters assign them directly (no host
+    # round-trip — multi-host arrays aren't host-fetchable).
     for name, arr in state["dense"].items():
         engine.set_store_array(name, arr)
     if sparse_engine is not None:

@@ -188,6 +188,11 @@ class KVWorker:
         Completion (device done -> host copy -> callback) runs on a
         dedicated thread so callbacks fire without wait(), matching the
         message path; wait(ts) joins the same future (idempotent hook).
+
+        ``result`` must be a NON-donated array: pushes hand back a tiny
+        completion token (the store itself is donated by the next push of
+        the same bucket, so blocking on it would crash back-to-back
+        pushes); pulls hand back the gathered output.
         """
         import concurrent.futures
 
@@ -230,8 +235,8 @@ class KVWorker:
         sharded table (aggregation server handle)."""
         eng = getattr(self.po.van, "sparse_engine", None)
         log.check(eng is not None, "push_sparse requires the ici van")
-        store = eng.push(name, indices, grads)
-        return self._engine_dispatch(store, callback=callback)
+        token = eng.push(name, indices, grads)
+        return self._engine_dispatch(token, callback=callback)
 
     def pull_sparse(self, name: str, indices, out=None,
                     callback=None) -> int:
@@ -267,8 +272,8 @@ class KVWorker:
         route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
                                    lens)
         if route is not None:
-            store = self.engine.push(route, vals)
-            return self._engine_dispatch(store, callback=callback)
+            token = self.engine.push(route, vals)
+            return self._engine_dispatch(token, callback=callback)
         kvs = _as_kvs(keys, vals, lens, priority)
         if compress is not None:
             log.check(

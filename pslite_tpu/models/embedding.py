@@ -38,7 +38,7 @@ def replay(sparse_engine, num_rows: int = 1 << 20, dim: int = 64,
         sparse_engine.push(name, idx, grads)
         out = sparse_engine.pull(name, idx)
     out.block_until_ready()
-    sparse_engine.store_array(name).block_until_ready()
+    sparse_engine.block(name)
     dt = (time.perf_counter() - t0) / max(steps, 1)
     step_bytes = 2 * 4 * W * batch * dim  # push + pull payload
     return step_bytes, dt

@@ -86,6 +86,12 @@ class CollectiveEngine:
         self._stores: Dict[str, jax.Array] = {}
         self._programs: Dict[tuple, Callable] = {}
         self._mu = threading.Lock()
+        # Per-bucket write locks: the jitted programs donate the store
+        # buffer, so the load-run-store sequence must be atomic per bucket
+        # (two concurrent pushes of one bucket would otherwise hand the
+        # same donated buffer to two programs).  Per-bucket rather than
+        # engine-wide so different buckets still dispatch concurrently.
+        self._bucket_mu: Dict[str, threading.Lock] = {}
 
     # -- registration --------------------------------------------------------
 
@@ -132,6 +138,7 @@ class CollectiveEngine:
         with self._mu:
             self._buckets[name] = bucket
             self._stores[name] = store
+            self._bucket_mu.setdefault(name, threading.Lock())
         return bucket
 
     def bucket(self, name: str) -> DenseBucket:
@@ -191,7 +198,10 @@ class CollectiveEngine:
             agg = lax.psum_scatter(
                 grads_l[0], axis, scatter_dimension=0, tiled=True
             )
-            return handle(store_l, agg)
+            new = handle(store_l, agg)
+            # Tiny non-donated completion token: callers block on this
+            # instead of the store (which the next push donates).
+            return new, new[:1]
 
         def _pull(store_l):
             return lax.all_gather(store_l, axis, tiled=True)
@@ -209,7 +219,7 @@ class CollectiveEngine:
                 _push,
                 mesh=mesh,
                 in_specs=(store_spec, grads_spec),
-                out_specs=store_spec,
+                out_specs=(store_spec, store_spec),
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
         elif op == "pull":
@@ -257,8 +267,9 @@ class CollectiveEngine:
             "_default" if handle is None else handle,
         )
         g = self._prep_grads(bucket, grads)
-        new_store, pulled = prog(self._stores[name], g)
-        self._stores[name] = new_store
+        with self._bucket_mu[name]:
+            new_store, pulled = prog(self._stores[name], g)
+            self._stores[name] = new_store
         return pulled[: bucket.total_len]
 
     def push(self, name: str, grads, handle: Optional[ServerHandle] = None):
@@ -268,17 +279,46 @@ class CollectiveEngine:
             "_default" if handle is None else handle,
         )
         g = self._prep_grads(bucket, grads)
-        self._stores[name] = prog(self._stores[name], g)
-        return self._stores[name]
+        with self._bucket_mu[name]:
+            new_store, token = prog(self._stores[name], g)
+            self._stores[name] = new_store
+        # The token is a tiny non-donated output that becomes ready when
+        # the push completes — block on it freely (the store itself is
+        # donated by the next push, so it must not escape).
+        return token
 
     def pull(self, name: str):
         bucket = self._buckets[name]
         prog = self._program("pull", bucket.padded_len, bucket.dtype, "_pull")
-        return prog(self._stores[name])[: bucket.total_len]
+        # Bucket lock: a concurrent push donates the store buffer; reading
+        # it unlocked could hand an already-donated array to the pull
+        # program.  Dispatch is async, so this only serializes enqueue.
+        with self._bucket_mu[name]:
+            pulled = prog(self._stores[name])
+        return pulled[: bucket.total_len]
 
     def store_array(self, name: str):
-        """The sharded server-state array (for checkpointing)."""
-        return self._stores[name]
+        """A consistent snapshot of the sharded server state (for
+        checkpointing).
+
+        Copied under the bucket lock: the live buffer may be donated by
+        the next push the moment the lock is released, so handing out the
+        live reference would hand out a to-be-deleted array."""
+        import jax.numpy as jnp
+
+        with self._bucket_mu[name]:
+            return jnp.copy(self._stores[name])
+
+    def store_spec(self, name: str):
+        """Shape/dtype/sharding of a store without copying it (restore
+        targets)."""
+        import jax
+
+        with self._bucket_mu[name]:
+            arr = self._stores[name]
+            return jax.ShapeDtypeStruct(
+                arr.shape, arr.dtype, sharding=arr.sharding
+            )
 
     def set_store_array(self, name: str, value) -> None:
         """Restore server state (checkpoint resume).
@@ -303,7 +343,7 @@ class CollectiveEngine:
                              "bad restore shape")
                 log.check_eq(value.dtype, np.dtype(bucket.dtype),
                              "bad restore dtype")
-                with self._mu:
+                with self._bucket_mu[name]:
                     self._stores[name] = value
                 return
         arr = np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype))
@@ -312,13 +352,18 @@ class CollectiveEngine:
                   "bad restore length")
         arr[: len(flat)] = flat
         placed = jax.device_put(arr, sharding)
-        with self._mu:
+        with self._bucket_mu[name]:
             self._stores[name] = placed
 
     def block(self, name: Optional[str] = None) -> None:
         """Wait for outstanding device work (ZPush/Wait semantics)."""
         if name is not None:
-            self._stores[name].block_until_ready()
+            names = [name]
         else:
-            for store in list(self._stores.values()):
-                store.block_until_ready()
+            with self._mu:
+                names = list(self._stores)
+        for n in names:
+            # Held across the wait so no concurrent push can donate the
+            # array between the read and block_until_ready.
+            with self._bucket_mu[n]:
+                self._stores[n].block_until_ready()

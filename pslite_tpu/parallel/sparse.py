@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -53,6 +53,10 @@ class SparseEngine:
         self._stores: Dict[str, object] = {}
         self._programs: Dict[tuple, Callable] = {}
         self._mu = threading.Lock()
+        # Per-table write locks: push donates the store buffer, so the
+        # load-run-store sequence must be atomic per table (same contract
+        # as CollectiveEngine._bucket_mu).
+        self._table_mu: Dict[str, threading.Lock] = {}
 
     def register_sparse(self, name: str, num_rows: int, dim: int, dtype=None,
                         init=None) -> SparseTable:
@@ -83,6 +87,7 @@ class SparseEngine:
         with self._mu:
             self._tables[name] = table
             self._stores[name] = store
+            self._table_mu.setdefault(name, threading.Lock())
         return table
 
     def _sparse_program(self, op: str, table: SparseTable, batch: int):
@@ -112,7 +117,10 @@ class SparseEngine:
             padded = padded.at[local_rows].add(
                 jnp.where(owned[:, None], all_g, 0)
             )
-            return store_l + padded[:R]
+            new = store_l + padded[:R]
+            # Tiny non-donated completion token: callers block on this
+            # instead of the store (which the next push donates).
+            return new, new[:1, :1]
 
         def _pull(store_l, idx_l):
             # Route each worker its rows via psum_scatter over the worker dim.
@@ -133,7 +141,7 @@ class SparseEngine:
                 _push,
                 mesh=self.mesh,
                 in_specs=(P(axis, None), P(axis, None), P(axis, None, None)),
-                out_specs=P(axis, None),
+                out_specs=(P(axis, None), P(axis, None)),
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
         elif op == "pull":
@@ -176,8 +184,13 @@ class SparseEngine:
         table = self._tables[name]
         idx, g = self._prep(table, indices, grads)
         prog = self._sparse_program("push", table, int(idx.shape[1]))
-        self._stores[name] = prog(self._stores[name], idx, g)
-        return self._stores[name]
+        with self._table_mu[name]:
+            new_store, token = prog(self._stores[name], idx, g)
+            self._stores[name] = new_store
+        # The token is a tiny non-donated output that becomes ready when
+        # the push completes — block on it freely (the store itself is
+        # donated by the next push, so it must not escape).
+        return token
 
     def pull(self, name: str, indices):
         """indices: [W, n] -> [W, n, d] rows, each worker shard receiving its
@@ -185,11 +198,40 @@ class SparseEngine:
         table = self._tables[name]
         idx, _ = self._prep(table, indices)
         prog = self._sparse_program("pull", table, int(idx.shape[1]))
-        out = prog(self._stores[name], idx)  # global [W*n, d]
+        with self._table_mu[name]:
+            out = prog(self._stores[name], idx)  # global [W*n, d]
         return out.reshape(self.num_shards, -1, table.dim)
 
     def store_array(self, name: str):
-        return self._stores[name]
+        """A consistent snapshot of the sharded table (for checkpointing);
+        copied under the table lock — see CollectiveEngine.store_array.
+        For a plain device-drain use :meth:`block` (no copy)."""
+        import jax.numpy as jnp
+
+        with self._table_mu[name]:
+            return jnp.copy(self._stores[name])
+
+    def store_spec(self, name: str):
+        """Shape/dtype/sharding of a table without copying it (restore
+        targets)."""
+        import jax
+
+        with self._table_mu[name]:
+            arr = self._stores[name]
+            return jax.ShapeDtypeStruct(
+                arr.shape, arr.dtype, sharding=arr.sharding
+            )
+
+    def block(self, name: Optional[str] = None) -> None:
+        """Wait for outstanding device work without copying the table."""
+        if name is not None:
+            names = [name]
+        else:
+            with self._mu:
+                names = list(self._stores)
+        for n in names:
+            with self._table_mu[n]:
+                self._stores[n].block_until_ready()
 
     def set_store_array(self, name: str, value) -> None:
         """Restore a table (checkpoint resume).  Host arrays must already be
@@ -210,13 +252,13 @@ class SparseEngine:
             if equivalent:
                 log.check_eq(tuple(value.shape), expected,
                              "bad restore shape")
-                with self._mu:
+                with self._table_mu[name]:
                     self._stores[name] = value
                 return
         host = np.asarray(value)
         log.check_eq(tuple(host.shape), expected, "bad restore shape")
         placed = jax.device_put(host, sharding)
-        with self._mu:
+        with self._table_mu[name]:
             self._stores[name] = placed
 
     def table(self, name: str) -> SparseTable:

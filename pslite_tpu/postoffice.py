@@ -251,12 +251,17 @@ class Postoffice:
                     log.warning(
                         f"parking message for not-yet-registered app {key}"
                     )
-                if len(queue) >= self._MAX_PENDING_PER_APP:
-                    log.warning(
-                        f"dropping message for unregistered app {key} "
-                        f"(pending buffer full)"
-                    )
-                    return
+                # Overflow is fatal, mirroring the reference's CHECK-fail
+                # after its 5 s customer-readiness wait (van.cc:428-438):
+                # silently dropping a KV message strands the sender's
+                # wait_request forever — fail loud instead.
+                log.check(
+                    len(queue) < self._MAX_PENDING_PER_APP,
+                    f"pending buffer overflow for app {key}: "
+                    f"{len(queue)} messages parked but the app never "
+                    f"registered a customer — misconfigured app_id or the "
+                    f"app failed to start",
+                )
                 queue.append(msg)
                 return
             customer.accept(msg)

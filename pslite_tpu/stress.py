@@ -51,11 +51,11 @@ def run_pattern(engine, sparse_engine, pattern: str, size_bytes: int,
         ).astype(np.int32)
         grads = np.ones((W, batch, dim), np.float32)
         sparse_engine.push(table, idx, grads)  # warm
-        sparse_engine.store_array(table).block_until_ready()
+        sparse_engine.block(table)
         t0 = time.perf_counter_ns()
         for _ in range(iters):
             sparse_engine.push(table, idx, grads)
-        sparse_engine.store_array(table).block_until_ready()
+        sparse_engine.block(table)
         elapsed = time.perf_counter_ns() - t0
         moved = 4 * W * batch * dim * iters
         return 8.0 * moved / max(elapsed, 1)

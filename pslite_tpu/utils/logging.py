@@ -71,3 +71,8 @@ def warning(msg: str) -> None:
 def fatal(msg: str) -> None:
     _logger.error(msg)
     raise CheckError(msg)
+
+
+def fatal_log(msg: str) -> None:
+    """Log at error level without raising (for use in except blocks)."""
+    _logger.error(msg)

@@ -1,0 +1,47 @@
+"""Force the JAX CPU backend with N virtual devices — reliably.
+
+The axon sitecustomize (TPU tunnel) force-sets ``jax_platforms``
+programmatically at interpreter start, so ``JAX_PLATFORMS=cpu`` in the
+environment alone is not enough once jax has been imported: the config
+must be updated before first backend use.  This is the single shared
+implementation behind tests/conftest.py, ``__graft_entry__.dryrun_multichip``
+and any CPU-mesh tooling; keep the counter-measures here in sync with the
+sitecustomize's behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_cpu(n_devices: int = 8) -> None:
+    """Pin the CPU backend with ``n_devices`` virtual devices.
+
+    Must run before jax initializes a backend; raises RuntimeError if a
+    non-CPU backend (or too few devices) already initialized.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable tunnel registration
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        # Replace a stale count rather than trusting it.
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} virtual CPU devices but the backend already "
+            f"initialized with {len(devices)} {devices[0].platform!r} "
+            f"device(s); call pin_cpu in a fresh process before any jax "
+            f"backend use"
+        )

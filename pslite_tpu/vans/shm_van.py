@@ -15,6 +15,7 @@ same contract the reference's registered buffers impose
 
 from __future__ import annotations
 
+import base64
 import copy
 import json
 import mmap
@@ -123,13 +124,17 @@ class ShmVan(TcpVan):
         # The descriptor rides in body, gated by the wire-level shm_data
         # flag (never by sniffing user bodies).
         meta_only.meta.shm_data = True
-        meta_only.meta.body = json.dumps(
-            {
-                "seg": name,
-                "lens": [d.nbytes for d in msg.data],
-                "codes": list(m.data_type),
-            }
-        ).encode()
+        desc = {
+            "seg": name,
+            "lens": [d.nbytes for d in msg.data],
+            "codes": list(m.data_type),
+        }
+        if m.body:
+            # Preserve a user body riding alongside data segments — the
+            # descriptor must not destroy it (Meta.body and data are
+            # independent channels in the reference's message model).
+            desc["body"] = base64.b64encode(bytes(m.body)).decode("ascii")
+        meta_only.meta.body = json.dumps(desc).encode()
         # Keep data_size for byte accounting but strip payload from the frame.
         sent = super().send_msg(meta_only)
         return sent + total
@@ -152,7 +157,9 @@ class ShmVan(TcpVan):
                 )
                 msg.data.append(SArray(arr))
                 off += ln
-            msg.meta.body = b""
+            msg.meta.body = (
+                base64.b64decode(info["body"]) if "body" in info else b""
+            )
         return msg
 
     def stop_transport(self) -> None:

@@ -14,7 +14,9 @@ send_msg / recv_msg / stop_transport``.
 from __future__ import annotations
 
 import copy
+import os
 import random
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -276,6 +278,24 @@ class Van:
                     log.warning(
                         f"unhandled control {ctrl.cmd}: {msg.debug_string()}"
                     )
+            except log.CheckError as exc:
+                # Invariant violations (CHECK failures) are fatal, like the
+                # reference's CHECK → abort: the whole process dies so the
+                # launcher (keepalive/elastic) can tear down and restart,
+                # and local callers blocked in wait_request don't hang on a
+                # zombie.  PS_CHECK_FATAL=0 downgrades to killing just this
+                # node (pump + heartbeat) — used by in-process test
+                # clusters where many logical nodes share the interpreter.
+                log.fatal_log(
+                    f"CHECK failed: {exc} (while processing "
+                    f"{msg.debug_string()}); node going dark "
+                    f"(pump + heartbeat terminating)"
+                )
+                self._stop_event.set()
+                if self.env.find("PS_CHECK_FATAL", "1") != "0":
+                    sys.stderr.flush()
+                    os._exit(134)  # SIGABRT-style exit, reference CHECK
+                raise
             except Exception as exc:
                 # A bad message must not kill the receive pump.
                 log.warning(
@@ -534,11 +554,18 @@ class Van:
             senders = self._barrier_senders.setdefault(key, set())
             senders.add(msg.meta.sender)
             # Instance barriers count every instance; group barriers count
-            # distinct group ranks (reference: van.cc:351-426).
+            # distinct group members (reference: van.cc:351-426).  The
+            # dedup key must keep role parity: server id 8 and worker id 9
+            # both map to group rank 0, and collapsing them deadlocks any
+            # mixed-role group barrier.
             if instance:
                 progress = len(senders)
             else:
-                progress = len({self.po.id_to_group_rank(s) for s in senders})
+                # (parity, group_rank) is unique per member: scheduler is
+                # the only id mapping to group rank -1.
+                progress = len({
+                    (s % 2, self.po.id_to_group_rank(s)) for s in senders
+                })
             log.vlog(
                 1,
                 f"barrier(group={group}, instance={instance}): "

@@ -5,23 +5,16 @@ benchmarking happens in bench.py (which does NOT import this).
 """
 
 import os
+import sys
 
-# Hard-set: the environment may preset JAX_PLATFORMS to the real TPU
-# (e.g. "axon"); unit tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon sitecustomize (TPU tunnel) may have already forced
-# jax_platforms programmatically at interpreter start; override before the
-# first backend use so tests stay on the 8-device virtual CPU mesh.
+# Unit tests always run on the virtual 8-device CPU mesh, whatever the
+# environment (axon sitecustomize) tries to force.
 try:
-    import jax
+    from pslite_tpu.utils.platform_pin import pin_cpu
 
-    jax.config.update("jax_platforms", "cpu")
+    pin_cpu(8)
 except ImportError:  # jax-less host: non-jax tests still run
     pass
 
@@ -38,6 +31,12 @@ if not os.path.exists(os.path.join(_repo, "cpp", "libpslite_core.so")):
         capture_output=True,
         check=False,
     )
+
+
+# In-process test clusters host many logical nodes in one interpreter; a
+# CHECK failure in one node's pump must not os._exit the whole pytest run.
+# Multi-process tests that assert the abort behavior override this.
+os.environ.setdefault("PS_CHECK_FATAL", "0")
 
 
 @pytest.fixture(autouse=True)

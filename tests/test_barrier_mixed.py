@@ -1,0 +1,67 @@
+"""Mixed-role group barriers (regression for the role-collapse deadlock).
+
+Group (non-instance) barriers dedup senders by group rank; server id 8 and
+worker id 9 both map to group rank 0, so a dedup key without role parity
+makes any mixed-role barrier (SERVER_WORKER_GROUP, non-instance ALL_GROUP)
+unsatisfiable — every participant hangs.  Reference behavior: the
+scheduler counts barrier requests per distinct group member
+(van.cc:382-426).
+"""
+
+import threading
+
+from pslite_tpu.base import ALL_GROUP, SERVER_WORKER_GROUP
+
+from helpers import LoopbackCluster
+
+
+def _barrier_all(nodes, group):
+    done = []
+
+    def run(po):
+        po.barrier(0, group, instance=False)
+        done.append(po.van.my_node.id)
+
+    threads = [
+        threading.Thread(target=run, args=(po,), daemon=True) for po in nodes
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    alive = [t for t in threads if t.is_alive()]
+    assert not alive, (
+        f"group barrier(group={group}) deadlocked: "
+        f"{len(done)}/{len(nodes)} participants returned"
+    )
+
+
+def test_server_worker_group_barrier():
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    try:
+        _barrier_all(cluster.servers + cluster.workers, SERVER_WORKER_GROUP)
+    finally:
+        cluster.finalize()
+
+
+def test_all_group_non_instance_barrier():
+    cluster = LoopbackCluster(num_workers=2, num_servers=1)
+    cluster.start()
+    try:
+        _barrier_all(cluster.all_nodes(), ALL_GROUP)
+    finally:
+        cluster.finalize()
+
+
+def test_mixed_barrier_repeats():
+    """Barrier state must reset between rounds for mixed groups too."""
+    cluster = LoopbackCluster(num_workers=2, num_servers=2)
+    cluster.start()
+    try:
+        for _ in range(3):
+            _barrier_all(
+                cluster.servers + cluster.workers, SERVER_WORKER_GROUP
+            )
+    finally:
+        cluster.finalize()

@@ -1,0 +1,52 @@
+"""CHECK failures in the receive pump abort the process (reference
+semantics: dmlc CHECK -> abort, so launchers can restart the node).
+
+PS_CHECK_FATAL=0 (set by conftest for in-process clusters) downgrades the
+abort to killing the node; this test runs a subprocess with the default
+fatal behavior and asserts the exit code.
+"""
+
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["PS_REPO"])
+sys.path.insert(0, os.path.join(os.environ["PS_REPO"], "tests"))
+from pslite_tpu.postoffice import Postoffice
+Postoffice._MAX_PENDING_PER_APP = 0  # overflow on the first parked message
+from helpers import LoopbackCluster
+from pslite_tpu.message import Message
+
+cluster = LoopbackCluster(num_workers=1, num_servers=1)
+cluster.start()
+msg = Message()
+msg.meta.app_id = 99  # never registered -> parks -> overflow -> CHECK
+msg.meta.customer_id = 99
+msg.meta.request = True
+msg.meta.recver = cluster.servers[0].van.my_node.id
+cluster.workers[0].van.send(msg)
+time.sleep(10)
+print("STILL_ALIVE", flush=True)
+"""
+
+
+def test_pump_check_failure_aborts_process():
+    env = dict(os.environ)
+    env["PS_CHECK_FATAL"] = "1"
+    env["PS_REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 134, (
+        f"expected abort (134), got rc={out.returncode}\n"
+        f"stdout: {out.stdout}\nstderr: {out.stderr}"
+    )
+    assert "STILL_ALIVE" not in out.stdout
+    # The abort line must carry the failed invariant's message.
+    assert "pending buffer overflow" in (out.stdout + out.stderr)

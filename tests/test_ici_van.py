@@ -58,6 +58,26 @@ def test_dense_push_then_pull_separately(cluster):
     np.testing.assert_allclose(out, W * ones)
 
 
+def test_back_to_back_pushes_same_bucket(cluster):
+    """Regression: the store a push returns is donated by the NEXT push of
+    the same bucket; wait(ts1) after issuing push ts2 must not block on the
+    escaped (deleted) reference."""
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(4, dtype=np.uint64) + 300
+    worker.register_dense("b2b", keys, 16)
+    ones = np.ones(4 * 16, dtype=np.float32)
+    ts1 = worker.push(keys, ones)
+    ts2 = worker.push(keys, ones)
+    ts3 = worker.push(keys, ones)
+    worker.wait(ts1)
+    worker.wait(ts2)
+    worker.wait(ts3)
+    out = np.zeros_like(ones)
+    worker.wait(worker.pull(keys, out))
+    W = worker.engine.num_shards
+    np.testing.assert_allclose(out, 3 * W * ones)
+
+
 def test_unregistered_keys_fall_back_to_messages(cluster):
     srv = KVServer(0, postoffice=cluster.servers[0])
     srv.set_request_handle(KVServerDefaultHandle())

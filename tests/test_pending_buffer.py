@@ -1,0 +1,28 @@
+"""Pending-message buffer overflow must be fatal, not a silent drop.
+
+A dropped KV request/response permanently strands the sender's
+wait_request; the reference CHECK-fails when an app never becomes ready
+(van.cc:428-438) rather than limping on.
+"""
+
+import pytest
+
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import Message, Role
+from pslite_tpu.postoffice import Postoffice
+from pslite_tpu.utils import logging as log
+
+
+def test_pending_overflow_raises_check_error(monkeypatch):
+    env = Environment({
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "lo",
+        "DMLC_PS_ROOT_PORT": "1",
+    })
+    po = Postoffice(Role.SERVER, env=env)
+    monkeypatch.setattr(Postoffice, "_MAX_PENDING_PER_APP", 4)
+    for _ in range(4):
+        po.buffer_pending(0, 0, Message())
+    with pytest.raises(log.CheckError, match="pending buffer overflow"):
+        po.buffer_pending(0, 0, Message())

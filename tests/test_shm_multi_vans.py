@@ -5,9 +5,13 @@ worker+server moving data through shared memory) and
 tests/run_benchmark.sh's MultiVan mode.
 """
 
+import threading
+
 import numpy as np
 
 from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.customer import Customer
+from pslite_tpu.message import Message
 
 from helpers import LoopbackCluster
 
@@ -51,6 +55,42 @@ def test_shm_van_small_messages_use_tcp():
     )
     cluster.start()
     _push_pull_roundtrip(cluster, payload_floats=16)
+
+
+def test_shm_preserves_user_body_with_data():
+    """A user body riding alongside data segments must survive the shm
+    fast path (the descriptor is carried separately, not by clobbering
+    meta.body)."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=1, van_type="shm")
+    cluster.start()
+    try:
+        received = []
+        got_msg = threading.Event()
+
+        def handle(msg):
+            received.append(msg)
+            got_msg.set()
+
+        Customer(7, 7, handle, cluster.servers[0])
+        payload = np.arange(64 * 1024, dtype=np.float32)
+        msg = Message()
+        msg.meta.app_id = 7
+        msg.meta.customer_id = 7
+        msg.meta.recver = cluster.servers[0].van.my_node.id
+        msg.meta.request = True
+        msg.meta.push = True
+        msg.meta.key = 42
+        msg.meta.body = b"user-body"
+        msg.add_data(payload)
+        cluster.workers[0].van.send(msg)
+        assert got_msg.wait(15), "message never delivered"
+        got = received[0]
+        assert got.meta.body == b"user-body"
+        np.testing.assert_array_equal(
+            np.asarray(got.data[0].data, dtype=np.float32), payload
+        )
+    finally:
+        cluster.finalize()
 
 
 def test_multi_van_push_pull():
