@@ -216,9 +216,24 @@ class KVWorker:
     def _engine_complete(result, out, callback):
         result.block_until_ready()
         if out is not None:
+            if getattr(result, "is_fully_addressable", True) or getattr(
+                result, "is_fully_replicated", False
+            ):
+                host = np.asarray(result)
+            else:
+                # Multi-process mesh, worker-sharded result (sparse pull):
+                # this process's rows are its addressable shards, in
+                # global row order.
+                shards = sorted(
+                    result.addressable_shards,
+                    key=lambda s: tuple(sl.start or 0 for sl in s.index),
+                )
+                host = np.concatenate(
+                    [np.asarray(s.data) for s in shards], axis=0
+                )
             np.copyto(
                 out.reshape(-1),
-                np.asarray(result).reshape(-1)[: out.size].astype(out.dtype),
+                host.reshape(-1)[: out.size].astype(out.dtype),
             )
         if callback is not None:
             callback()

@@ -78,9 +78,17 @@ class CollectiveEngine:
 
         from .mesh import default_mesh
 
+        from .placement import local_shard_count, mesh_is_multiprocess
+
         self.mesh = mesh if mesh is not None else default_mesh(axis_name)
         self.axis = axis_name
         self.num_shards = self.mesh.shape[axis_name]
+        # Fixed at construction; cached off the hot path.
+        self._multiprocess = mesh_is_multiprocess(self.mesh)
+        self._local_shard_count = (
+            local_shard_count(self.mesh) if self._multiprocess
+            else self.num_shards
+        )
         self._server_handle = server_handle
         self._buckets: Dict[str, DenseBucket] = {}
         self._stores: Dict[str, jax.Array] = {}
@@ -128,9 +136,11 @@ class CollectiveEngine:
         )
         sharding = NamedSharding(self.mesh, P(self.axis))
         if init is not None:
-            flat = np.zeros(padded, dtype=np.asarray(init).dtype)
+            flat = np.zeros(padded, dtype=np.dtype(dtype))
             flat[:total] = np.asarray(init).reshape(-1)
-            store = jax.device_put(flat.astype(dtype), sharding)
+            store = self._place(flat, sharding)
+        elif self._is_multiprocess():
+            store = self._place(np.zeros(padded, np.dtype(dtype)), sharding)
         else:
             store = jax.device_put(
                 jnp.zeros(padded, dtype=dtype), sharding
@@ -235,9 +245,29 @@ class CollectiveEngine:
 
     # -- data plane ops ------------------------------------------------------
 
+    def _is_multiprocess(self) -> bool:
+        return self._multiprocess
+
+    def _place(self, host_arr, sharding):
+        from .placement import place_host_array
+
+        return place_host_array(
+            self.mesh, host_arr, sharding, self._multiprocess
+        )
+
+    def _local_shards(self) -> int:
+        """Worker rows owned by THIS process on a multi-process mesh."""
+        return self._local_shard_count
+
     def _prep_grads(self, bucket: DenseBucket, grads):
         """Accept [W, total] (or [total] broadcast) host/device arrays and
-        deliver a [W, padded] device array sharded over the worker axis."""
+        deliver a [W, padded] device array sharded over the worker axis.
+
+        On a multi-process mesh a host array is this PROCESS's
+        contribution: [total] broadcasts to the process's local worker
+        rows, [local, total] maps row-for-row; the global array is
+        assembled with make_array_from_process_local_data (device_put
+        cannot target non-addressable devices)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -248,6 +278,23 @@ class CollectiveEngine:
                 if grads.sharding == sharding:
                     return grads
                 return jax.device_put(grads, sharding)
+        if self._is_multiprocess():
+            arr = np.asarray(grads, dtype=np.dtype(bucket.dtype))
+            local = self._local_shards()
+            if arr.ndim == 1:
+                arr = np.broadcast_to(arr, (local, arr.shape[0]))
+            log.check_eq(int(arr.shape[0]), local,
+                         "bad local worker dim (rows = this process's "
+                         "devices on a multi-process mesh)")
+            if arr.shape[1] != bucket.padded_len:
+                log.check_eq(int(arr.shape[1]), bucket.total_len,
+                             "bad grad len")
+                pad = bucket.padded_len - bucket.total_len
+                arr = np.pad(arr, ((0, 0), (0, pad)))
+            return jax.make_array_from_process_local_data(
+                sharding, np.ascontiguousarray(arr),
+                (self.num_shards, bucket.padded_len),
+            )
         arr = jnp.asarray(grads, dtype=bucket.dtype)
         if arr.ndim == 1:
             arr = jnp.broadcast_to(arr, (self.num_shards, arr.shape[0]))
@@ -351,7 +398,7 @@ class CollectiveEngine:
         log.check(len(flat) in (bucket.total_len, bucket.padded_len),
                   "bad restore length")
         arr[: len(flat)] = flat
-        placed = jax.device_put(arr, sharding)
+        placed = self._place(arr, sharding)
         with self._bucket_mu[name]:
             self._stores[name] = placed
 

@@ -1,0 +1,35 @@
+"""Host->device placement helpers shared by the dense and sparse engines.
+
+On a multi-process mesh (jax.distributed), ``device_put`` cannot target
+non-addressable devices; globally-known host data goes through the
+callback form, and per-process contributions through
+``make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+
+def mesh_is_multiprocess(mesh) -> bool:
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def local_shard_count(mesh) -> int:
+    """Mesh positions owned by THIS process."""
+    import jax
+
+    me = jax.process_index()
+    return sum(1 for d in mesh.devices.flat if d.process_index == me)
+
+
+def place_host_array(mesh, host_arr, sharding, multiprocess=None):
+    """Place a (globally known) host array onto a sharding, working on
+    single- AND multi-process meshes."""
+    import jax
+
+    if multiprocess is None:
+        multiprocess = mesh_is_multiprocess(mesh)
+    if not multiprocess:
+        return jax.device_put(host_arr, sharding)
+    return jax.make_array_from_callback(
+        host_arr.shape, sharding, lambda idx: host_arr[idx]
+    )

@@ -46,9 +46,16 @@ class SparseEngine:
     """Sparse tables on the same mesh/axis as a CollectiveEngine."""
 
     def __init__(self, mesh, axis_name: str = "kv"):
+        from .placement import local_shard_count, mesh_is_multiprocess
+
         self.mesh = mesh
         self.axis = axis_name
         self.num_shards = mesh.shape[axis_name]
+        self._multiprocess = mesh_is_multiprocess(mesh)
+        self._local_shard_count = (
+            local_shard_count(mesh) if self._multiprocess
+            else self.num_shards
+        )
         self._tables: Dict[str, SparseTable] = {}
         self._stores: Dict[str, object] = {}
         self._programs: Dict[tuple, Callable] = {}
@@ -78,7 +85,13 @@ class SparseEngine:
             arr = arr.reshape(rows_per_shard, self.num_shards, dim).transpose(
                 1, 0, 2
             ).reshape(-1, dim)
-            store = jax.device_put(arr, sharding)
+            store = self._place(arr, sharding)
+        elif self._is_multiprocess():
+            store = self._place(
+                np.zeros((rows_per_shard * self.num_shards, dim),
+                         np.dtype(dtype)),
+                sharding,
+            )
         else:
             store = jax.device_put(
                 jnp.zeros((rows_per_shard * self.num_shards, dim), dtype=dtype),
@@ -158,23 +171,55 @@ class SparseEngine:
             self._programs[key] = jitted
         return jitted
 
+    def _is_multiprocess(self) -> bool:
+        return self._multiprocess
+
+    def _local_shards(self) -> int:
+        return self._local_shard_count
+
+    def _place(self, host_arr, sharding):
+        from .placement import place_host_array
+
+        return place_host_array(
+            self.mesh, host_arr, sharding, self._multiprocess
+        )
+
     def _prep(self, table: SparseTable, indices, grads=None):
-        """[W, n] indices (+ [W, n, d] grads) sharded over the worker axis."""
+        """[W, n] indices (+ [W, n, d] grads) sharded over the worker axis.
+
+        On a multi-process mesh the host inputs carry only THIS process's
+        worker rows ([local, n] / [local, n, d])."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        idx_sharding = NamedSharding(self.mesh, P(self.axis, None))
+        g_sharding = NamedSharding(self.mesh, P(self.axis, None, None))
+        if self._is_multiprocess():
+            idx = np.ascontiguousarray(np.asarray(indices, dtype=np.int32))
+            local = self._local_shards()
+            log.check_eq(int(idx.shape[0]), local,
+                         "bad local worker dim (rows = this process's "
+                         "devices on a multi-process mesh)")
+            idx_sh = jax.make_array_from_process_local_data(
+                idx_sharding, idx, (self.num_shards,) + idx.shape[1:]
+            )
+            if grads is None:
+                return idx_sh, None
+            g = np.ascontiguousarray(
+                np.asarray(grads, dtype=np.dtype(table.dtype))
+            )
+            g_sh = jax.make_array_from_process_local_data(
+                g_sharding, g, (self.num_shards,) + g.shape[1:]
+            )
+            return idx_sh, g_sh
         idx = jnp.asarray(indices, dtype=jnp.int32)
         log.check_eq(int(idx.shape[0]), self.num_shards, "bad worker dim")
-        idx_sh = jax.device_put(
-            idx, NamedSharding(self.mesh, P(self.axis, None))
-        )
+        idx_sh = jax.device_put(idx, idx_sharding)
         if grads is None:
             return idx_sh, None
         g = jnp.asarray(grads, dtype=table.dtype)
-        g_sh = jax.device_put(
-            g, NamedSharding(self.mesh, P(self.axis, None, None))
-        )
+        g_sh = jax.device_put(g, g_sharding)
         return idx_sh, g_sh
 
     def push(self, name: str, indices, grads):
@@ -257,7 +302,7 @@ class SparseEngine:
                 return
         host = np.asarray(value)
         log.check_eq(tuple(host.shape), expected, "bad restore shape")
-        placed = jax.device_put(host, sharding)
+        placed = self._place(host, sharding)
         with self._table_mu[name]:
             self._stores[name] = placed
 

@@ -24,6 +24,10 @@ def create(van_type: str, postoffice):
             from .ici_van import IciVan
 
             return IciVan(postoffice)
+        if van_type in ("ici_tcp", "ici+tcp", "xla"):
+            from .ici_van import IciTcpVan
+
+            return IciTcpVan(postoffice)
         if van_type == "shm":
             from .shm_van import ShmVan
 

@@ -8,32 +8,66 @@ PS roles as logical shards of one SPMD program rather than RDMA endpoints.
 Split of planes (mirroring FabricVan nesting a ZMQVan for bootstrap,
 fabric_van.h:123-127):
 
-- **Control plane**: inherited message transport (loopback in-process; the
-  node still participates in scheduler bootstrap, barriers, heartbeats).
-- **Data plane**: a :class:`CollectiveEngine` + :class:`SparseEngine` on the
-  mesh.  ``KVWorker`` detects the engine and routes registered dense buckets
-  and sparse tables through jitted collectives; unregistered traffic falls
-  back to the message path, preserving the full KV contract (the "sync
-  collective vs async per-message" duality flagged in SURVEY §7).
+- **Control plane**: a pluggable message transport.  :class:`IciVan`
+  nests the in-process loopback (single-process clusters, tests);
+  :class:`IciTcpVan` nests the real socket van, so separate OS processes
+  bootstrap through the scheduler exactly like the reference's
+  fabric/ucx vans ride their nested ZMQ control plane.
+- **Data plane**: a :class:`CollectiveEngine` + :class:`SparseEngine` on
+  the mesh.  ``KVWorker`` detects the engine and routes registered dense
+  buckets and sparse tables through jitted collectives; unregistered
+  traffic falls back to the message path, preserving the full KV contract
+  (the "sync collective vs async per-message" duality of SURVEY §7).
+
+Multi-process meshes (``PS_ICI_MULTIHOST=1``): each worker process joins
+``jax.distributed`` (coordinator derived from the same DMLC_* variables
+the control plane uses — parallel/distributed.py) and the engines are
+built over the GLOBAL mesh spanning every process's devices, so a dense
+push is one cross-process reduce-scatter riding ICI/DCN.  Worker
+processes must then drive registered buckets in SPMD lockstep (same
+ops, same order), which is the same contract XLA imposes on any
+multi-host program; per-message asynchrony stays on the control plane.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
+from ..utils import logging as log
 from .loopback_van import LoopbackVan
+from .tcp_van import TcpVan
 
 
-class IciVan(LoopbackVan):
+class _IciDataPlane:
+    """Engine management shared by every ICI van flavor (mixin)."""
+
     def __init__(self, postoffice):
         super().__init__(postoffice)
         self.engine = None
         self.sparse_engine = None
         self._mesh = None
+        self._distributed_opts = None
 
     def set_mesh(self, mesh) -> None:
         """Install a specific mesh before start() (tests, multi-host)."""
         self._mesh = mesh
+
+    def _multihost(self) -> bool:
+        return self.env.find_int("PS_ICI_MULTIHOST", 0) == 1
+
+    def _make_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        if self._multihost():
+            # Join the global jax.distributed runtime before first backend
+            # use; every worker process contributes its local devices to
+            # one global mesh (the DCN/ICI-spanning deployment).
+            from ..parallel import distributed
+
+            self._distributed_opts = distributed.init_distributed(self.env)
+            log.info(
+                f"ici multihost: jax.distributed {self._distributed_opts}"
+            )
+            return distributed.global_mesh()
+        return None  # CollectiveEngine defaults to the local-device mesh
 
     def start(self, customer_id: int) -> None:
         super().start(customer_id)
@@ -46,13 +80,37 @@ class IciVan(LoopbackVan):
 
             handle = self.env.find("PS_ICI_SERVER_HANDLE", "sum")
             self.engine = CollectiveEngine(
-                mesh=self._mesh, server_handle=handle
+                mesh=self._make_mesh(), server_handle=handle
             )
             self.sparse_engine = SparseEngine(
                 self.engine.mesh, self.engine.axis
             )
 
+    def stop_transport(self) -> None:
+        super().stop_transport()
+        if self._distributed_opts is not None:
+            self._distributed_opts = None
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception as exc:  # best-effort: interpreter teardown
+                log.vlog(1, f"jax.distributed.shutdown: {exc!r}")
+
     def register_recv_buffer(self, sender_id: int, key: int, buffer) -> None:
         # Donated HBM buffers make delivery-in-place the default on this
         # van; nothing to pin (SURVEY §5 "RegisterRecvBuffer ⇒ donated HBM").
         return None
+
+
+class IciVan(_IciDataPlane, LoopbackVan):
+    """Collective data plane over the in-process loopback control plane."""
+
+
+class IciTcpVan(_IciDataPlane, TcpVan):
+    """Collective data plane over the real socket control plane — the
+    fabric_van pattern (fabric_van.h:123-127): scheduler bootstrap, rank
+    assignment, barriers, heartbeats, and the message fallback path all
+    ride TCP between OS processes, while registered dense/sparse traffic
+    rides jitted XLA collectives over the (optionally multi-process)
+    device mesh."""

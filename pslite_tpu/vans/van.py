@@ -292,7 +292,7 @@ class Van:
                     f"(pump + heartbeat terminating)"
                 )
                 self._stop_event.set()
-                if self.env.find("PS_CHECK_FATAL", "1") != "0":
+                if self.env.find_bool("PS_CHECK_FATAL", True):
                     sys.stderr.flush()
                     os._exit(134)  # SIGABRT-style exit, reference CHECK
                 raise

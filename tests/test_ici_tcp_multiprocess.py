@@ -1,0 +1,63 @@
+"""IciTcpVan: collective data plane over the TCP control plane, across
+real OS processes — the fabric_van pattern (fabric_van.h:123-127) with
+jax.distributed supplying the cross-process device mesh.
+
+2 worker processes x 4 virtual CPU devices each = one global 8-device
+mesh; a dense push_pull must aggregate across both processes and match
+the host model (the PS aggregation contract of kv_app.h:430-452).
+"""
+
+import os
+import subprocess
+import sys
+
+from pslite_tpu.utils.network import get_available_port
+
+
+def test_ici_tcp_two_process_push_pull():
+    port = get_available_port()
+    child = os.path.join(os.path.dirname(__file__), "ici_tcp_child.py")
+    base_env = dict(
+        os.environ,
+        DMLC_NUM_WORKER="2",
+        DMLC_NUM_SERVER="1",
+        DMLC_PS_ROOT_URI="127.0.0.1",
+        DMLC_PS_ROOT_PORT=str(port),
+        DMLC_NODE_HOST="127.0.0.1",
+        PS_VAN_TYPE="ici_tcp",
+        PS_ICI_MULTIHOST="1",
+        PS_VERBOSE="1",
+    )
+    # The children pin their own platform; scrub any inherited forcing.
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        base_env.pop(var, None)
+    roles = [("scheduler", None), ("server", None), ("worker", 0),
+             ("worker", 1)]
+    procs = []
+    for role, rank in roles:
+        env = dict(base_env, DMLC_ROLE=role)
+        if rank is not None:
+            env["DMLC_RANK"] = str(rank)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, child],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    outputs = []
+    for p in procs:
+        try:
+            # 1-CPU host: 4 interpreter startups serialize, plus the
+            # cross-process shard_map compile; be generous.
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out.decode())
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+    worker_outs = [o for o in outputs if "WORKER_OK 24.0" in o]
+    assert len(worker_outs) == 2, f"expected 2 worker OKs, got: {outputs}"
