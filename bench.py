@@ -94,7 +94,7 @@ def _hbm_estimate(device_kind: str) -> float | None:
 
 
 def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
-             host_grads: bool = False) -> float:
+             host_grads: bool = False, handle=None) -> float:
     """Goodput (GB/s) of iterated push_pull on one registered bucket.
 
     ``host_grads=True`` measures the message-origin path real users hit:
@@ -119,11 +119,11 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
         )
     # Warmup: compile + first-touch (the rendezvous equivalent).
     for _ in range(3):
-        out = eng.push_pull(name, inp)
+        out = eng.push_pull(name, inp, handle=handle)
     out.block_until_ready()
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = eng.push_pull(name, inp)
+        out = eng.push_pull(name, inp, handle=handle)
     out.block_until_ready()
     elapsed = time.perf_counter() - t0
     payload = num_keys * val_len * 4  # bytes per direction
@@ -216,6 +216,7 @@ def main() -> None:
             host_path = _measure(
                 eng, "bench_host", 4, (64 << 10) // 4, 2, host_grads=True
             )
+            fused = None
         else:
             # Median of 3 rounds: single-run numbers on a shared chip vary
             # ~20%; the driver records whatever one invocation prints.
@@ -228,6 +229,13 @@ def main() -> None:
             headline_cfg = "40x1MB"
             host_path = _measure(
                 eng, "bench_host", 40, (1 << 20) // 4, 8, host_grads=True
+            )
+            # Fused Pallas optimizer pass (sgd+momentum) between the
+            # reduce-scatter and all-gather: the server aggregation hot
+            # loop (kv_app.h:430-452) as one HBM pass.
+            fused = _measure(
+                eng, "bench_fused", 40, (1 << 20) // 4, 8,
+                handle="sgd_momentum:0.01,0.9",
             )
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
@@ -254,6 +262,9 @@ def main() -> None:
                 "n_devices": probe.get("n"),
                 "sweep_1key": sweep,
                 "host_origin_goodput": round(host_path, 2),
+                "fused_sgdm_goodput": (
+                    round(fused, 2) if fused is not None else None
+                ),
                 "hbm_util_est": hbm_util,
                 "note": (
                     "single-chip: collectives degenerate to HBM-local ops; "

@@ -72,7 +72,7 @@ def save_engine(engine, path: str, sparse_engine=None) -> None:
     """Snapshot every dense bucket (and sparse table) to ``path``."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays: Dict[str, np.ndarray] = {}
-    meta = {"dense": {}, "sparse": {}}
+    meta = {"dense": {}, "sparse": {}, "opt": {}}
     for name, bucket in engine._buckets.items():
         arrays[f"dense/{name}"] = np.asarray(engine.store_array(name))
         meta["dense"][name] = {
@@ -80,6 +80,12 @@ def save_engine(engine, path: str, sparse_engine=None) -> None:
             "val_len": bucket.val_len,
             "total_len": bucket.total_len,
         }
+        opt = engine.opt_state(name)
+        if opt is not None:
+            kind, states = opt
+            meta["opt"][name] = {"kind": kind, "n": len(states)}
+            for i, s in enumerate(states):
+                arrays[f"opt/{name}/{i}"] = np.asarray(s)
     if sparse_engine is not None:
         for name, table in sparse_engine._tables.items():
             arrays[f"sparse/{name}"] = np.asarray(
@@ -110,6 +116,11 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
         log.check(name in engine._buckets,
                   f"bucket {name!r} not registered before restore")
         engine.set_store_array(name, data[f"dense/{name}"])
+    for name, info in meta.get("opt", {}).items():
+        engine.set_opt_state(
+            name, info["kind"],
+            [data[f"opt/{name}/{i}"] for i in range(info["n"])],
+        )
     if sparse_engine is not None:
         for name in meta["sparse"]:
             sparse_engine.set_store_array(name, data[f"sparse/{name}"])

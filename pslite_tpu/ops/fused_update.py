@@ -7,9 +7,13 @@ step (SGD+momentum / Adam) in **one** tiled pass over the shard with
 in-place aliasing — guaranteeing the single-pass fusion rather than hoping
 XLA finds it.
 
-All kernels run over lane-aligned flat blocks, work inside ``shard_map``
-(pure per-shard compute), and fall back to the Pallas interpreter off-TPU
-so unit tests run on the virtual CPU mesh.
+Layout: flat vectors are zero-padded and reshaped to ``(rows, 128)`` with
+``rows`` a multiple of the 8-sublane tile, and the kernels use 2-D
+``(block_rows, 128)`` BlockSpecs — rank-1 blocks and sub-(8,128) tiles
+pass the interpreter but fail Mosaic lowering on real TPU hardware.
+Kernels run inside ``shard_map`` (pure per-shard compute) and fall back
+to the Pallas interpreter off-TPU so unit tests run on the virtual CPU
+mesh.
 """
 
 from __future__ import annotations
@@ -19,18 +23,28 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_BLOCK = 8 * 128 * 8  # fp32 tile-aligned flat block
+_LANES = 128
+_SUBLANES = 8
+_MAX_BLOCK_ROWS = 512  # (512, 128) fp32 block = 256 KiB per operand
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pad_to_block(x, block):
-    pad = (-x.shape[0]) % block
+def _tile_geometry(n: int):
+    """(padded_len, rows, block_rows, grid) for a flat length n."""
+    rows0 = -(-n // _LANES)
+    block_rows = min(_MAX_BLOCK_ROWS, -(-rows0 // _SUBLANES) * _SUBLANES)
+    rows = -(-rows0 // block_rows) * block_rows
+    return rows * _LANES, rows, block_rows, rows // block_rows
+
+
+def _to_tiles(x, padded_len: int):
+    pad = padded_len - x.shape[0]
     if pad:
         x = jnp.pad(x, (0, pad))
-    return x, pad
+    return x.reshape(-1, _LANES)
 
 
 @functools.partial(jax.jit, static_argnames=("lr", "momentum"))
@@ -42,33 +56,30 @@ def sgd_update(store, mom, agg, lr: float = 0.01, momentum: float = 0.9):
     from jax.experimental import pallas as pl
 
     n = store.shape[0]
-    block = min(_BLOCK, max(8 * 128, n))
-    store_p, pad = _pad_to_block(store, block)
-    mom_p, _ = _pad_to_block(mom, block)
-    agg_p, _ = _pad_to_block(agg, block)
-    grid = store_p.shape[0] // block
+    padded, rows, block_rows, grid = _tile_geometry(n)
+    store_t = _to_tiles(store, padded)
+    mom_t = _to_tiles(mom, padded)
+    agg_t = _to_tiles(agg, padded)
 
     def kernel(store_ref, mom_ref, agg_ref, out_store_ref, out_mom_ref):
-        m = momentum * mom_ref[:] + agg_ref[:]
-        out_mom_ref[:] = m
-        out_store_ref[:] = store_ref[:] - lr * m
+        m = momentum * mom_ref[:, :] + agg_ref[:, :]
+        out_mom_ref[:, :] = m
+        out_store_ref[:, :] = store_ref[:, :] - lr * m
 
-    spec = pl.BlockSpec((block,), lambda i: (i,))
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
     new_store, new_mom = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct(store_p.shape, store_p.dtype),
-            jax.ShapeDtypeStruct(mom_p.shape, mom_p.dtype),
+            jax.ShapeDtypeStruct(store_t.shape, store_t.dtype),
+            jax.ShapeDtypeStruct(mom_t.shape, mom_t.dtype),
         ),
         grid=(grid,),
         in_specs=[spec, spec, spec],
         out_specs=(spec, spec),
         input_output_aliases={0: 0, 1: 1},
         interpret=_use_interpret(),
-    )(store_p, mom_p, agg_p)
-    if pad:
-        new_store, new_mom = new_store[:n], new_mom[:n]
-    return new_store, new_mom
+    )(store_t, mom_t, agg_t)
+    return new_store.reshape(-1)[:n], new_mom.reshape(-1)[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("lr", "beta1", "beta2", "eps"))
@@ -85,12 +96,11 @@ def adam_update(store, m, v, agg, step, lr: float = 1e-3,
     from jax.experimental.pallas import tpu as pltpu
 
     n = store.shape[0]
-    block = min(_BLOCK, max(8 * 128, n))
-    store_p, pad = _pad_to_block(store, block)
-    m_p, _ = _pad_to_block(m, block)
-    v_p, _ = _pad_to_block(v, block)
-    agg_p, _ = _pad_to_block(agg, block)
-    grid = store_p.shape[0] // block
+    padded, rows, block_rows, grid = _tile_geometry(n)
+    store_t = _to_tiles(store, padded)
+    m_t = _to_tiles(m, padded)
+    v_t = _to_tiles(v, padded)
+    agg_t = _to_tiles(agg, padded)
 
     t = jnp.asarray(step, jnp.float32)
     alpha_t = lr * jnp.sqrt(1.0 - beta2 ** t) / (1.0 - beta1 ** t)
@@ -98,17 +108,17 @@ def adam_update(store, m, v, agg, step, lr: float = 1e-3,
 
     def kernel(scalar_ref, store_ref, m_ref, v_ref, agg_ref,
                out_store_ref, out_m_ref, out_v_ref):
-        g = agg_ref[:]
-        m_new = beta1 * m_ref[:] + (1 - beta1) * g
-        v_new = beta2 * v_ref[:] + (1 - beta2) * g * g
-        out_m_ref[:] = m_new
-        out_v_ref[:] = v_new
-        out_store_ref[:] = store_ref[:] - scalar_ref[0] * m_new / (
+        g = agg_ref[:, :]
+        m_new = beta1 * m_ref[:, :] + (1 - beta1) * g
+        v_new = beta2 * v_ref[:, :] + (1 - beta2) * g * g
+        out_m_ref[:, :] = m_new
+        out_v_ref[:, :] = v_new
+        out_store_ref[:, :] = store_ref[:, :] - scalar_ref[0] * m_new / (
             jnp.sqrt(v_new) + eps
         )
 
     # Index maps receive the prefetched scalar ref as a trailing argument.
-    spec = pl.BlockSpec((block,), lambda i, s: (i,))
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i, s: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(grid,),
@@ -118,14 +128,16 @@ def adam_update(store, m, v, agg, step, lr: float = 1e-3,
     new_store, new_m, new_v = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct(store_p.shape, store_p.dtype),
-            jax.ShapeDtypeStruct(m_p.shape, m_p.dtype),
-            jax.ShapeDtypeStruct(v_p.shape, v_p.dtype),
+            jax.ShapeDtypeStruct(store_t.shape, store_t.dtype),
+            jax.ShapeDtypeStruct(m_t.shape, m_t.dtype),
+            jax.ShapeDtypeStruct(v_t.shape, v_t.dtype),
         ),
         grid_spec=grid_spec,
         input_output_aliases={1: 0, 2: 1, 3: 2},
         interpret=_use_interpret(),
-    )(scalars, store_p, m_p, v_p, agg_p)
-    if pad:
-        new_store, new_m, new_v = new_store[:n], new_m[:n], new_v[:n]
-    return new_store, new_m, new_v
+    )(scalars, store_t, m_t, v_t, agg_t)
+    return (
+        new_store.reshape(-1)[:n],
+        new_m.reshape(-1)[:n],
+        new_v.reshape(-1)[:n],
+    )

@@ -1,0 +1,96 @@
+"""Cross-slice (DCN) tier: hierarchical dense push/pull.
+
+A TPU pod slice talks ICI internally; slices talk to each other over DCN.
+The reference's analogous structures are BytePS's hierarchical reduction
+and the MultiVan rail composition (multi_van.h:173-197: route each
+message across N inner vans).  Here the two tiers compose the two
+existing data planes:
+
+1. **ICI tier** — intra-slice aggregation: one fused
+   ``psum_scatter + all_gather`` (an all-reduce) on the slice's
+   :class:`CollectiveEngine`, producing the slice-local gradient sum.
+2. **DCN tier** — inter-slice exchange: each slice's leader pushes the
+   slice-sum through the ordinary KV message path (:class:`KVWorker`
+   over a socket van).  The default slicer shards the keys across the
+   global servers, so DCN traffic is key-range partitioned across
+   server rails exactly like MultiVan routes across its inner vans; the
+   server handler applies the update (sum / optimizer — the same
+   pluggable handle contract, kv_app.h:430-452).
+3. **Redistribute** — the pulled global aggregate is placed replicated
+   onto the slice mesh for consumption by the slice's devices.
+
+The leader barriers on the worker group between push and pull so every
+slice's contribution lands before any slice reads the aggregate (the
+synchronous-SGD pattern of the reference's docs/overview.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..base import WORKER_GROUP
+from ..utils import logging as log
+
+
+class DcnKVWorker:
+    """Hierarchical dense push/pull: slice mesh (ICI) + KV messages (DCN).
+
+    ``kv_worker`` is this slice leader's :class:`KVWorker` on a socket
+    van connecting the slices; ``slice_engine`` is the slice's
+    :class:`CollectiveEngine`.  One instance per slice leader process.
+    """
+
+    def __init__(self, kv_worker, slice_engine, barrier=True):
+        self.kv = kv_worker
+        self.engine = slice_engine
+        self._barrier = barrier
+        self._keys: dict = {}
+
+    def register_dense(self, name: str, keys, val_len: int,
+                       dtype=None) -> None:
+        """Register the bucket on both tiers (engine scratch + KV keys)."""
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        self.engine.register_dense(name, keys, val_len, dtype=dtype)
+        self._keys[name] = keys
+
+    def push_pull(self, name: str, grads, out: Optional[np.ndarray] = None):
+        """grads: this slice's worker rows ([W_slice, total] or [total]).
+
+        Returns the global (all-slice) aggregate as a host array, also
+        written to ``out`` when given.  Synchronous across slices.
+        """
+        log.check(name in self._keys, f"bucket {name!r} not registered")
+        bucket = self.engine.bucket(name)
+        # ICI tier: slice-local all-reduce.  handle="assign" makes the
+        # engine store pure scratch (store := slice sum), so the global
+        # accumulation semantics live only at the DCN servers.
+        slice_sum = np.asarray(
+            self.engine.push_pull(name, grads, handle="assign")
+        )
+        # DCN tier: key-range-sharded push to the global servers, then a
+        # barrier so every slice's push is applied before any pull.
+        keys = self._keys[name]
+        ts = self.kv.push(keys, slice_sum)
+        self.kv.wait(ts)
+        if self._barrier:
+            self.kv.po.barrier(self.kv._customer.customer_id, WORKER_GROUP)
+        if out is None:
+            out = np.empty(bucket.total_len, dtype=np.dtype(bucket.dtype))
+        self.kv.wait(self.kv.pull(keys, out))
+        if self._barrier:
+            # Post-pull barrier: without it a fast slice's NEXT-round push
+            # could land at the sum-accumulating servers before a slow
+            # slice finishes reading THIS round's aggregate.
+            self.kv.po.barrier(self.kv._customer.customer_id, WORKER_GROUP)
+        return out
+
+    def to_device(self, name: str, host_aggregate):
+        """Place the pulled aggregate replicated onto the slice mesh (the
+        intra-slice redistribution step)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.engine.mesh, P(None))
+        return jax.device_put(np.asarray(host_aggregate), sharding)

@@ -28,6 +28,7 @@ address" contract (test_benchmark.cc:169-181) maps to donated device buffers
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Callable, Dict, Optional, Union
@@ -73,6 +74,7 @@ class CollectiveEngine:
         mesh=None,
         axis_name: str = "kv",
         server_handle: ServerHandle = "sum",
+        profiler=None,
     ):
         import jax
 
@@ -92,6 +94,10 @@ class CollectiveEngine:
         self._server_handle = server_handle
         self._buckets: Dict[str, DenseBucket] = {}
         self._stores: Dict[str, jax.Array] = {}
+        # Optimizer state for stateful server handles (sgd_momentum: mom;
+        # adam: m, v, step), sharded like the store and donated each step.
+        self._opt_states: Dict[str, tuple] = {}
+        self._opt_kinds: Dict[str, str] = {}
         self._programs: Dict[tuple, Callable] = {}
         self._mu = threading.Lock()
         # Per-bucket write locks: the jitted programs donate the store
@@ -100,6 +106,13 @@ class CollectiveEngine:
         # same donated buffer to two programs).  Per-bucket rather than
         # engine-wide so different buckets still dispatch concurrently.
         self._bucket_mu: Dict[str, threading.Lock] = {}
+        # Observability (reference: van.cc:29-77 event log + van.h:183-184
+        # byte counters): application-payload bytes moved through the
+        # collective data plane, surfaced next to Van.send_bytes/recv_bytes.
+        self.profiler = profiler
+        self.push_bytes = 0
+        self.pull_bytes = 0
+        self._counter_mu = threading.Lock()
 
     # -- registration --------------------------------------------------------
 
@@ -164,10 +177,72 @@ class CollectiveEngine:
             return lambda store, agg: store + agg
         if handle == "assign":
             return lambda store, agg: agg
+        if handle.startswith("sgd_momentum") or handle.startswith("adam"):
+            raise ValueError(
+                f"{handle!r} is stateful — resolved via _stateful_handle"
+            )
         if handle.startswith("sgd"):
             lr = float(handle.split(":", 1)[1]) if ":" in handle else 0.01
             return lambda store, agg: store - lr * agg
         raise ValueError(f"unknown server handle {handle!r}")
+
+    @staticmethod
+    def _handle_params(handle: str, defaults):
+        parts = handle.split(":", 1)
+        vals = list(defaults)
+        if len(parts) == 2 and parts[1]:
+            toks = parts[1].split(",")
+            log.check(
+                len(toks) <= len(vals),
+                f"handle {handle!r} has {len(toks)} parameters but at "
+                f"most {len(vals)} are supported",
+            )
+            for i, tok in enumerate(toks):
+                vals[i] = float(tok)
+        return vals
+
+    def _stateful_handle(self, handle: str):
+        """(n_state, fn) for the fused-kernel server handles.
+
+        ``fn(store_l, state_l, agg) -> (new_store_l, new_state_l)`` runs
+        per shard inside shard_map, applying the whole optimizer step as
+        one Pallas pass over the shard (the aggregation hot loop of
+        kv_app.h:430-452 fused with the reduce-scatter's output).
+        """
+        from ..ops import fused_update
+
+        if handle.startswith("sgd_momentum"):
+            lr, momentum = self._handle_params(handle, (0.01, 0.9))
+
+            def fn(store_l, state_l, agg):
+                new_store, new_mom = fused_update.sgd_update(
+                    store_l, state_l[0], agg, lr=lr, momentum=momentum
+                )
+                return new_store, (new_mom,)
+
+            return 1, fn
+        if handle.startswith("adam"):
+            lr, b1, b2, eps = self._handle_params(
+                handle, (1e-3, 0.9, 0.999, 1e-8)
+            )
+
+            def fn(store_l, state_l, agg):
+                m_l, v_l, step_l = state_l
+                step = step_l[0] + 1.0
+                new_store, new_m, new_v = fused_update.adam_update(
+                    store_l, m_l, v_l, agg, step, lr=lr,
+                    beta1=b1, beta2=b2, eps=eps,
+                )
+                return new_store, (new_m, new_v, step_l + 1.0)
+
+            return 3, fn
+        raise ValueError(f"not a stateful handle: {handle!r}")
+
+    @staticmethod
+    def _is_stateful(handle) -> bool:
+        return isinstance(handle, str) and (
+            handle.startswith("sgd_momentum") or handle.startswith("adam")
+        )
 
     def _program(self, op: str, padded_len: int, dtype, handle_key) -> Callable:
         """Jitted SPMD program for (op, shape, dtype, handle) — the
@@ -185,6 +260,8 @@ class CollectiveEngine:
 
         axis = self.axis
         mesh = self.mesh
+        if op in ("push_st", "push_pull_st"):
+            return self._stateful_program(op, key, handle_key)
         if op == "pull":
             handle = None  # pull is read-only; no server update to fuse
         else:
@@ -242,6 +319,102 @@ class CollectiveEngine:
         with self._mu:
             self._programs[key] = jitted
         return jitted
+
+    def _stateful_program(self, op: str, key, handle_key: str) -> Callable:
+        """Program for the fused-kernel handles: the Pallas optimizer pass
+        runs between the reduce-scatter and the all-gather, with store AND
+        optimizer state donated (one HBM pass per step, no double
+        buffering)."""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        n_state, sfn = self._stateful_handle(handle_key)
+        axis = self.axis
+        store_spec = P(axis)
+        grads_spec = P(axis, None)
+        repl_spec = P(None)
+
+        def _push(store_l, *rest):
+            state_l, grads_l = rest[:-1], rest[-1]
+            agg = lax.psum_scatter(
+                grads_l[0], axis, scatter_dimension=0, tiled=True
+            )
+            new_store, new_state = sfn(store_l, tuple(state_l), agg)
+            return (new_store, *new_state, new_store[:1])  # token last
+
+        def _push_pull(store_l, *rest):
+            state_l, grads_l = rest[:-1], rest[-1]
+            agg = lax.psum_scatter(
+                grads_l[0], axis, scatter_dimension=0, tiled=True
+            )
+            new_store, new_state = sfn(store_l, tuple(state_l), agg)
+            pulled = lax.all_gather(new_store, axis, tiled=True)
+            return (new_store, *new_state, pulled)
+
+        body = _push if op == "push_st" else _push_pull
+        tail_spec = store_spec if op == "push_st" else repl_spec
+        fn = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(store_spec, *([store_spec] * n_state), grads_spec),
+            out_specs=(store_spec, *([store_spec] * n_state), tail_spec),
+        )
+        jitted = jax.jit(fn, donate_argnums=tuple(range(1 + n_state)))
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _ensure_opt_state(self, name: str, handle: str, bucket) -> None:
+        """Allocate (or validate) the bucket's optimizer state.  Call with
+        the bucket lock held."""
+        kind = handle.split(":", 1)[0]
+        have = self._opt_kinds.get(name)
+        if have == kind:
+            return
+        log.check(have is None,
+                  f"bucket {name!r} already has {have!r} state; cannot "
+                  f"switch to {kind!r}")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        dt = np.dtype(bucket.dtype)
+        if kind == "sgd_momentum":
+            state = (self._place(np.zeros(bucket.padded_len, dt), sharding),)
+        else:  # adam
+            state = (
+                self._place(np.zeros(bucket.padded_len, dt), sharding),
+                self._place(np.zeros(bucket.padded_len, dt), sharding),
+                self._place(np.zeros(self.num_shards, np.float32), sharding),
+            )
+        self._opt_states[name] = state
+        self._opt_kinds[name] = kind
+
+    def opt_state(self, name: str):
+        """Snapshot of the bucket's optimizer state (checkpointing).
+        Returns (kind, arrays) or None when the bucket has none."""
+        import jax.numpy as jnp
+
+        with self._bucket_mu[name]:
+            if name not in self._opt_states:
+                return None
+            return self._opt_kinds[name], tuple(
+                jnp.copy(s) for s in self._opt_states[name]
+            )
+
+    def set_opt_state(self, name: str, kind: str, values) -> None:
+        """Restore optimizer state (checkpoint resume)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        log.check(name in self._buckets, f"bucket {name!r} not registered")
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        placed = tuple(
+            self._place(np.ascontiguousarray(np.asarray(v)), sharding)
+            for v in values
+        )
+        with self._bucket_mu[name]:
+            self._opt_states[name] = placed
+            self._opt_kinds[name] = kind
 
     # -- data plane ops ------------------------------------------------------
 
@@ -305,36 +478,97 @@ class CollectiveEngine:
             arr = jnp.pad(arr, ((0, 0), (0, pad)))
         return jax.device_put(arr, sharding)
 
+    def _observe(self, name: str, op: str, bucket: DenseBucket,
+                 t0: float) -> None:
+        """Account one data-plane op: byte counters always, the
+        (bucket, op, bytes, µs) event when profiling is on.
+
+        The µs field is DISPATCH latency (op entry to async enqueue), not
+        device execution time — collectives are dispatched asynchronously;
+        use ``utils.profiling.device_trace`` (XPlane) for transfer-level
+        timing, as documented in record_engine's consumer docs."""
+        payload = bucket.total_len * np.dtype(bucket.dtype).itemsize
+        with self._counter_mu:
+            if op in ("push", "push_pull"):
+                self.push_bytes += payload
+            if op in ("pull", "push_pull"):
+                self.pull_bytes += payload
+        if self.profiler is not None and getattr(
+            self.profiler, "enabled", False
+        ):
+            dur_us = int((time.perf_counter() - t0) * 1e6)
+            nbytes = payload * (2 if op == "push_pull" else 1)
+            self.profiler.record_engine(name, op, nbytes, dur_us)
+
+    def _resolve_handle(self, handle: Optional[ServerHandle]):
+        resolved = self._server_handle if handle is None else handle
+        if self._is_stateful(resolved):
+            return resolved, resolved  # stateful handles key by full string
+        return resolved, ("_default" if handle is None else handle)
+
     def push_pull(self, name: str, grads, handle: Optional[ServerHandle] = None):
         """Fused push+aggregate+update+pull; returns the replicated pulled
         array (async).  The benchmark hot path (SURVEY §3.2)."""
+        t0 = time.perf_counter()
         bucket = self._buckets[name]
-        prog = self._program(
-            "push_pull", bucket.padded_len, bucket.dtype,
-            "_default" if handle is None else handle,
-        )
+        resolved, handle_key = self._resolve_handle(handle)
         g = self._prep_grads(bucket, grads)
+        if self._is_stateful(resolved):
+            prog = self._program(
+                "push_pull_st", bucket.padded_len, bucket.dtype, handle_key
+            )
+            with self._bucket_mu[name]:
+                self._ensure_opt_state(name, resolved, bucket)
+                outs = prog(
+                    self._stores[name], *self._opt_states[name], g
+                )
+                self._stores[name] = outs[0]
+                self._opt_states[name] = tuple(outs[1:-1])
+                pulled = outs[-1]
+            self._observe(name, "push_pull", bucket, t0)
+            return pulled[: bucket.total_len]
+        prog = self._program(
+            "push_pull", bucket.padded_len, bucket.dtype, handle_key
+        )
         with self._bucket_mu[name]:
             new_store, pulled = prog(self._stores[name], g)
             self._stores[name] = new_store
+        self._observe(name, "push_pull", bucket, t0)
         return pulled[: bucket.total_len]
 
     def push(self, name: str, grads, handle: Optional[ServerHandle] = None):
+        t0 = time.perf_counter()
         bucket = self._buckets[name]
-        prog = self._program(
-            "push", bucket.padded_len, bucket.dtype,
-            "_default" if handle is None else handle,
-        )
+        resolved, handle_key = self._resolve_handle(handle)
         g = self._prep_grads(bucket, grads)
+        if self._is_stateful(resolved):
+            prog = self._program(
+                "push_st", bucket.padded_len, bucket.dtype, handle_key
+            )
+            with self._bucket_mu[name]:
+                self._ensure_opt_state(name, resolved, bucket)
+                outs = prog(
+                    self._stores[name], *self._opt_states[name], g
+                )
+                self._stores[name] = outs[0]
+                self._opt_states[name] = tuple(outs[1:-1])
+                token = outs[-1]
+            self._observe(name, "push", bucket, t0)
+            return token
+        prog = self._program(
+            "push", bucket.padded_len, bucket.dtype, handle_key
+        )
         with self._bucket_mu[name]:
             new_store, token = prog(self._stores[name], g)
             self._stores[name] = new_store
+        self._observe(name, "push", bucket, t0)
         # The token is a tiny non-donated output that becomes ready when
         # the push completes — block on it freely (the store itself is
         # donated by the next push, so it must not escape).
         return token
 
     def pull(self, name: str):
+        t0 = time.perf_counter()
         bucket = self._buckets[name]
         prog = self._program("pull", bucket.padded_len, bucket.dtype, "_pull")
         # Bucket lock: a concurrent push donates the store buffer; reading
@@ -342,6 +576,7 @@ class CollectiveEngine:
         # program.  Dispatch is async, so this only serializes enqueue.
         with self._bucket_mu[name]:
             pulled = prog(self._stores[name])
+        self._observe(name, "pull", bucket, t0)
         return pulled[: bucket.total_len]
 
     def store_array(self, name: str):

@@ -24,6 +24,7 @@ BASELINE.md config 5) then load-balance across shards by construction.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
@@ -45,7 +46,7 @@ class SparseTable:
 class SparseEngine:
     """Sparse tables on the same mesh/axis as a CollectiveEngine."""
 
-    def __init__(self, mesh, axis_name: str = "kv"):
+    def __init__(self, mesh, axis_name: str = "kv", profiler=None):
         from .placement import local_shard_count, mesh_is_multiprocess
 
         self.mesh = mesh
@@ -56,6 +57,11 @@ class SparseEngine:
             local_shard_count(mesh) if self._multiprocess
             else self.num_shards
         )
+        # Observability mirroring CollectiveEngine (van.cc:29-77 analog).
+        self.profiler = profiler
+        self.push_bytes = 0
+        self.pull_bytes = 0
+        self._counter_mu = threading.Lock()
         self._tables: Dict[str, SparseTable] = {}
         self._stores: Dict[str, object] = {}
         self._programs: Dict[tuple, Callable] = {}
@@ -222,16 +228,36 @@ class SparseEngine:
         g_sh = jax.device_put(g, g_sharding)
         return idx_sh, g_sh
 
+    def _observe(self, name: str, op: str, table: SparseTable,
+                 batch: int, t0: float) -> None:
+        payload = (
+            self.num_shards * batch * table.dim
+            * np.dtype(table.dtype).itemsize
+        )
+        with self._counter_mu:
+            if op == "push":
+                self.push_bytes += payload
+            else:
+                self.pull_bytes += payload
+        if self.profiler is not None and getattr(
+            self.profiler, "enabled", False
+        ):
+            dur_us = int((time.perf_counter() - t0) * 1e6)
+            self.profiler.record_engine(name, f"sparse_{op}", payload,
+                                        dur_us)
+
     def push(self, name: str, indices, grads):
         """indices: [W, n] int rows per worker; grads: [W, n, d].
         Duplicate rows (within or across workers) accumulate — the
         aggregation contract of the default server handle."""
+        t0 = time.perf_counter()
         table = self._tables[name]
         idx, g = self._prep(table, indices, grads)
         prog = self._sparse_program("push", table, int(idx.shape[1]))
         with self._table_mu[name]:
             new_store, token = prog(self._stores[name], idx, g)
             self._stores[name] = new_store
+        self._observe(name, "push", table, int(idx.shape[1]), t0)
         # The token is a tiny non-donated output that becomes ready when
         # the push completes — block on it freely (the store itself is
         # donated by the next push, so it must not escape).
@@ -240,11 +266,13 @@ class SparseEngine:
     def pull(self, name: str, indices):
         """indices: [W, n] -> [W, n, d] rows, each worker shard receiving its
         own batch."""
+        t0 = time.perf_counter()
         table = self._tables[name]
         idx, _ = self._prep(table, indices)
         prog = self._sparse_program("pull", table, int(idx.shape[1]))
         with self._table_mu[name]:
             out = prog(self._stores[name], idx)  # global [W*n, d]
+        self._observe(name, "pull", table, int(idx.shape[1]), t0)
         return out.reshape(self.num_shards, -1, table.dim)
 
     def store_array(self, name: str):

@@ -26,6 +26,10 @@ class Profiler:
                 path = f"pslite_profile_van_{role}_{int(time.time())}"
             self._fh = open(path, "a")
 
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
     def record(self, key: int, event: str, push: bool) -> None:
         if not self._enabled or self._fh is None:
             return
@@ -33,6 +37,19 @@ class Profiler:
         kind = "push" if push else "pull"
         with self._mu:
             self._fh.write(f"{key},{event}_{kind},{ts_us}\n")
+
+    def record_engine(self, bucket: str, op: str, nbytes: int,
+                      dur_us: int) -> None:
+        """Collective data-plane event: ``bucket,<op>_engine,ts,bytes,µs``
+        — the engine-path extension of the reference's (key, event, µs)
+        log, so ENABLE_PROFILING covers the flagship transport too."""
+        if not self._enabled or self._fh is None:
+            return
+        ts_us = int(time.time() * 1e6)
+        with self._mu:
+            self._fh.write(
+                f"{bucket},{op}_engine,{ts_us},{nbytes},{dur_us}\n"
+            )
 
     def close(self) -> None:
         if self._fh is not None:

@@ -79,11 +79,15 @@ class _IciDataPlane:
             from ..parallel.sparse import SparseEngine
 
             handle = self.env.find("PS_ICI_SERVER_HANDLE", "sum")
+            # Share the van's profiler so ENABLE_PROFILING covers the
+            # collective data plane (reference: van.cc:29-77,440-457).
             self.engine = CollectiveEngine(
-                mesh=self._make_mesh(), server_handle=handle
+                mesh=self._make_mesh(), server_handle=handle,
+                profiler=self.profiler,
             )
             self.sparse_engine = SparseEngine(
-                self.engine.mesh, self.engine.axis
+                self.engine.mesh, self.engine.axis,
+                profiler=self.profiler,
             )
 
     def stop_transport(self) -> None:

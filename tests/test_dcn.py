@@ -1,0 +1,103 @@
+"""Cross-slice (DCN) tier: 2 slices x 4 devices must agree with the
+single 8-device mesh.
+
+The 8-device virtual CPU mesh is partitioned into two 4-device "slices",
+each with its own CollectiveEngine (ICI tier); slice leaders exchange
+slice-sums through the KV message path over the tcp van (DCN tier,
+key-range sharded across 2 servers = the MultiVan rail pattern,
+multi_van.h:173-197).  The composed result must equal one flat
+8-device push_pull.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.parallel import CollectiveEngine
+from pslite_tpu.parallel.dcn import DcnKVWorker
+
+from helpers import LoopbackCluster
+
+
+def _slice_meshes():
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return (
+        Mesh(np.asarray(devs[:4]), ("kv",)),
+        Mesh(np.asarray(devs[4:8]), ("kv",)),
+    )
+
+
+def test_two_slices_match_single_mesh():
+    mesh_a, mesh_b = _slice_meshes()
+    num_keys, val_len = 4, 50
+    keys = np.arange(num_keys, dtype=np.uint64) + 10
+    total = num_keys * val_len
+    rng = np.random.default_rng(5)
+    # 8 global worker rows: 4 per slice.
+    grads = rng.normal(size=(8, total)).astype(np.float32)
+
+    # Reference: one flat 8-device mesh (sum handle, fresh store of 0s).
+    from pslite_tpu.parallel import default_mesh
+
+    flat = CollectiveEngine(mesh=default_mesh())
+    flat.register_dense("ref", keys, val_len)
+    for _ in range(3):
+        expected = np.asarray(flat.push_pull("ref", grads))
+
+    # Composed: 2 slices over the tcp van with 2 servers (key-sharded
+    # DCN rails), default sum handle at the servers.
+    cluster = LoopbackCluster(num_workers=2, num_servers=2, van_type="tcp")
+    cluster.start()
+    servers = []
+    results = {}
+    errors = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+
+        def run_slice(slice_id, mesh):
+            try:
+                kv = KVWorker(0, 0, postoffice=cluster.workers[slice_id])
+                eng = CollectiveEngine(mesh=mesh)
+                leader = DcnKVWorker(kv, eng)
+                leader.register_dense("g", keys, val_len)
+                rows = grads[slice_id * 4:(slice_id + 1) * 4]
+                # Multiple rounds: the post-pull barrier must keep every
+                # slice reading round r's aggregate before round r+1's
+                # pushes land at the accumulating servers.
+                for _ in range(3):
+                    out = leader.push_pull("g", rows)
+                dev = leader.to_device("g", out)
+                results[slice_id] = (out, np.asarray(dev))
+            except Exception as exc:  # propagate to the main thread
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=run_slice, args=(i, m), daemon=True)
+            for i, m in enumerate((mesh_a, mesh_b))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert set(results) == {0, 1}, "a slice leader hung"
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+    for slice_id, (host_out, dev_out) in results.items():
+        np.testing.assert_allclose(host_out, expected, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(dev_out, expected, rtol=1e-5,
+                                   atol=1e-5)

@@ -42,3 +42,48 @@ def test_profiler_event_log_and_byte_counters(tmp_path):
         key, event, ts = line.split(",")
         assert event.split("_")[0] in ("send", "recv")
         assert int(ts) > 0
+
+
+def test_engine_path_events_and_byte_counters(tmp_path):
+    """ENABLE_PROFILING must cover the collective fast path too
+    (engine-path analog of van.cc:29-77): per-op
+    (bucket, op, ts, bytes, µs) events plus engine byte counters next to
+    Van.send_bytes/recv_bytes."""
+    import pytest
+
+    pytest.importorskip("jax")
+    path = tmp_path / "engine_trace.csv"
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="ici",
+        env_extra={"ENABLE_PROFILING": "1", "PROFILE_PATH": str(path)},
+    )
+    cluster.start()
+    try:
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.arange(2, dtype=np.uint64)
+        val_len = 16
+        worker.register_dense("prof", keys, val_len)
+        vals = np.ones(2 * val_len, dtype=np.float32)
+        outs = np.zeros_like(vals)
+        worker.wait(worker.push_pull(keys, vals, outs))
+        worker.wait(worker.push(keys, vals))
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+
+        eng = worker.engine
+        payload = 2 * val_len * 4
+        assert eng.push_bytes == 2 * payload  # push_pull + push
+        assert eng.pull_bytes == 2 * payload  # push_pull + pull
+    finally:
+        cluster.finalize()
+
+    lines = path.read_text().strip().splitlines()
+    engine_lines = [ln for ln in lines if "_engine," in ln]
+    ops = {ln.split(",")[1] for ln in engine_lines}
+    assert "push_pull_engine" in ops, lines
+    assert "push_engine" in ops, lines
+    assert "pull_engine" in ops, lines
+    for ln in engine_lines:
+        bucket, op, ts, nbytes, dur = ln.split(",")
+        assert bucket == "prof"
+        assert int(ts) > 0 and int(nbytes) > 0 and int(dur) >= 0
