@@ -33,7 +33,11 @@ class Customer:
         self.customer_id = customer_id
         self._recv_handle = recv_handle
         self._po = postoffice
-        self._tracker: List[List[int]] = []  # [expected, received] per ts
+        # ts -> [expected, received]; insertion-ordered and pruned of old
+        # completed entries (bounded, unlike the reference's ever-growing
+        # vector) — see _prune_tracker_locked.
+        self._tracker: Dict[int, List[int]] = {}
+        self._next_ts = 0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
@@ -66,31 +70,57 @@ class Customer:
         else:
             num = num_responses
         with self._cv:
-            self._tracker.append([num, 0])
-            return len(self._tracker) - 1
+            ts = self._next_ts
+            self._next_ts += 1
+            self._tracker[ts] = [num, 0]
+            self._prune_tracker_locked()
+            return ts
+
+    _MAX_TRACKER_ENTRIES = 8192
+
+    def _prune_tracker_locked(self) -> None:
+        """Bound tracker growth (the reference grows forever,
+        customer.cc:32-40): evict the oldest COMPLETED entries beyond the
+        window; a pruned timestamp reads back as complete."""
+        while len(self._tracker) > self._MAX_TRACKER_ENTRIES:
+            oldest = next(iter(self._tracker))
+            exp, got = self._tracker[oldest]
+            if got < exp:
+                break  # never prune an in-flight request
+            del self._tracker[oldest]
+
+    def _entry(self, timestamp: int):
+        entry = self._tracker.get(timestamp)
+        if entry is not None:
+            return entry
+        # Only timestamps we actually issued may read back as "pruned =
+        # long complete"; a future/bogus ts is a caller bug — fail loud
+        # (the pre-bounded tracker raised IndexError here).
+        if 0 <= timestamp < self._next_ts:
+            return (0, 0)
+        raise KeyError(f"unknown timestamp {timestamp}")
 
     def wait_request(self, timestamp: int, timeout: Optional[float] = None) -> bool:
         hooks = self._take_hooks(timestamp)
         for hook in hooks:
             hook()
         with self._cv:
-            if timeout is None:
-                self._cv.wait_for(
-                    lambda: self._tracker[timestamp][0] <= self._tracker[timestamp][1]
-                )
-                return True
-            return self._cv.wait_for(
-                lambda: self._tracker[timestamp][0] <= self._tracker[timestamp][1],
-                timeout,
+            done = lambda: (  # noqa: E731
+                self._entry(timestamp)[0] <= self._entry(timestamp)[1]
             )
+            if timeout is None:
+                self._cv.wait_for(done)
+                return True
+            return self._cv.wait_for(done, timeout)
 
     def num_response(self, timestamp: int) -> int:
         with self._mu:
-            return self._tracker[timestamp][1]
+            return self._entry(timestamp)[1]
 
     def add_response(self, timestamp: int, num: int = 1) -> None:
         with self._cv:
-            self._tracker[timestamp][1] += num
+            if timestamp in self._tracker:
+                self._tracker[timestamp][1] += num
             self._cv.notify_all()
 
     _MAX_HOOK_ENTRIES = 256
@@ -130,9 +160,7 @@ class Customer:
                 _log.warning(f"recv handle raised: {exc!r}")
             finally:
                 if not msg.meta.request:
-                    with self._cv:
-                        self._tracker[msg.meta.timestamp][1] += 1
-                        self._cv.notify_all()
+                    self.add_response(msg.meta.timestamp)
 
     def stop(self) -> None:
         self._queue.push(None)

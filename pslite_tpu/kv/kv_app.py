@@ -22,6 +22,7 @@ Capability parity with the reference's ``include/ps/kv_app.h``:
 
 from __future__ import annotations
 
+import itertools
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -69,6 +70,12 @@ class KVMeta:
 # meta.option marker: vals travel as int8 blocks + fp32 scales (gradient
 # compression for DCN-class links; ops/quantize.py scheme).
 OPT_COMPRESS_INT8 = 1
+# Zero-copy pull (is_worker_zpull_, kv_app.h:727-792): the transport
+# delivers each server's pull-response slice directly into the worker's
+# pre-registered buffer; meta.addr carries (buf_id << 40) | byte_offset.
+# (Defined in message.py so transports can consume them without importing
+# the app layer.)
+from ..message import OPT_ZPULL, ZPULL_OFF_BITS as _ZPULL_OFF_BITS  # noqa: E402,E501
 
 
 def default_slicer(
@@ -123,12 +130,15 @@ class KVWorker:
         self._recv_kvs: Dict[int, List[KVPairs]] = {}
         self._pull_dst: Dict[int, Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = {}
         self._slicer = default_slicer
-        # Message-path pulls always reassemble into the caller's buffer in
-        # _finish (the shm van already saved the socket copy by aliasing
-        # /dev/shm; the ICI engine path never reaches _finish at all).
-        # True delivery-in-place (kv_app.h is_worker_zpull_) exists on the
-        # engine path via device-resident results (get_pulled).
-        self._zero_copy_pull = False
+        # Zero-copy pull (is_worker_zpull_, kv_app.h:727-792): buffers
+        # allocated via alloc_pull_buffer are transport-backed (shm van);
+        # servers write their response slices directly into them and
+        # _finish skips reassembly.  Ordinary caller buffers reassemble as
+        # usual; the ICI engine path never reaches _finish at all.
+        self._zpull_bufs: Dict[Tuple[int, int, int], dict] = {}
+        self._zpull_ts: set = set()
+        self._zpull_seq = itertools.count(1)
+        self.zpull_hits = 0  # pulls completed without reassembly
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
@@ -144,6 +154,95 @@ class KVWorker:
     def set_slicer(self, slicer) -> None:
         """Custom slicer hook (kv_app.h:256-265)."""
         self._slicer = slicer
+
+    # -- zero-copy pull (is_worker_zpull_) -----------------------------------
+
+    def alloc_pull_buffer(self, keys, val_len: int, dtype=np.float32):
+        """Allocate a transport-backed pull destination for exactly these
+        keys (fixed ``val_len`` values per key).
+
+        Pulls of these keys into the returned array are delivered in
+        place: each server writes its response slice directly into the
+        buffer at the slice's offset and ``_finish`` skips reassembly —
+        the ``is_worker_zpull_`` contract (kv_app.h:727-792).  Requires a
+        transport with an ``alloc_pull_segment`` hook (shm van, same
+        host); returns None when the transport can't back it (callers
+        then pull into ordinary arrays).  Contract: at most one
+        outstanding pull per buffer (kv_app.h:210-217).
+        """
+        alloc = getattr(self.po.van, "alloc_pull_segment", None)
+        if alloc is None:
+            return None
+        if self._slicer is not default_slicer:
+            # The per-server offsets below assume the default key-range
+            # partition; a custom slicer would misplace slices silently.
+            log.warning("alloc_pull_buffer: custom slicer set; zero-copy "
+                        "pull disabled for this worker")
+            return None
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        log.check(len(keys) > 0, "empty key set")
+        itemsize = np.dtype(dtype).itemsize
+        total = len(keys) * val_len * itemsize
+        buf_id = next(self._zpull_seq)
+        raw = alloc(buf_id, total)
+        if raw is None:
+            return None
+        vals = raw[:total].view(np.dtype(dtype))
+        # Per-server byte offsets of this buffer's slices (fixed-k layout,
+        # mirroring DefaultSlicer's key-range partition).
+        ranges = self.po.get_server_key_ranges()
+        offsets = {}
+        off = 0
+        for rank, rng in enumerate(ranges):
+            n = int(
+                np.searchsorted(keys, rng.end)
+                - np.searchsorted(keys, rng.begin)
+            )
+            offsets[rank] = off
+            off += n * val_len * itemsize
+        sig = (len(keys), int(keys[0]), int(keys[-1]))
+        with self._mu:
+            old = self._zpull_bufs.get(sig)
+            self._zpull_bufs[sig] = {
+                "buf_id": buf_id,
+                "keys": keys,
+                "vals": vals,
+                "offsets": offsets,
+            }
+        if old is not None:
+            # Re-registration: release the displaced segment instead of
+            # leaking it until van shutdown.
+            free = getattr(self.po.van, "free_pull_segment", None)
+            if free is not None:
+                free(old["buf_id"])
+        return vals
+
+    def free_pull_buffer(self, keys) -> None:
+        """Release a registered pull buffer (and its transport segment)."""
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        sig = (len(keys), int(keys[0]), int(keys[-1]))
+        with self._mu:
+            reg = self._zpull_bufs.pop(sig, None)
+        if reg is not None:
+            free = getattr(self.po.van, "free_pull_segment", None)
+            if free is not None:
+                free(reg["buf_id"])
+
+    def _zpull_lookup(self, keys: np.ndarray, vals) -> Optional[dict]:
+        if self._slicer is not default_slicer:
+            return None
+        sig = (len(keys), int(keys[0]), int(keys[-1])) if len(keys) else None
+        with self._mu:
+            reg = self._zpull_bufs.get(sig)
+        if reg is None or not isinstance(vals, np.ndarray):
+            return None
+        if vals is not reg["vals"] and not (
+            vals.base is not None and np.shares_memory(vals, reg["vals"])
+        ):
+            return None
+        if not np.array_equal(reg["keys"], keys):
+            return None
+        return reg
 
     # -- ICI collective fast path -------------------------------------------
 
@@ -321,13 +420,17 @@ class KVWorker:
             return self._engine_dispatch(result, out=vals, callback=callback,
                                          keep_result=True)
         ts = self._customer.new_request(SERVER_GROUP)
+        zpull = self._zpull_lookup(keys, vals) if lens is None else None
         with self._mu:
             if callback is not None:
                 self._callbacks[ts] = callback
             self._pull_dst[ts] = (keys, vals, lens)
+            if zpull is not None:
+                self._zpull_ts.add(ts)
         kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
-                   val_dtype=vals.dtype, val_nbytes=vals.nbytes)
+                   val_dtype=vals.dtype, val_nbytes=vals.nbytes,
+                   zpull=zpull)
         return ts
 
     def push_pull(
@@ -380,6 +483,7 @@ class KVWorker:
         val_dtype=None,
         val_nbytes: int = 0,
         compress: Optional[str] = None,
+        zpull: Optional[dict] = None,
     ) -> None:
         ranges = self.po.get_server_key_ranges()
         sliced = self._slicer(kvs, ranges)
@@ -409,7 +513,17 @@ class KVWorker:
                 m.val_len = val_nbytes
             else:
                 m.val_len = part.vals.nbytes
-            m.addr = id(part.vals)  # address token for same-process fast paths
+            if zpull is not None:
+                # Registered-buffer routing: the transport writes this
+                # slice's response at (buf_id, offset) in the worker's
+                # buffer (the rdma_van pull_addr_ / ucx w_pool_ analog).
+                m.option = OPT_ZPULL
+                m.addr = (
+                    (zpull["buf_id"] << _ZPULL_OFF_BITS)
+                    | zpull["offsets"][group_rank]
+                )
+            else:
+                m.addr = id(part.vals)  # same-process fast-path token
             msg.add_data(SArray(part.keys))
             if compress == "int8" and push:  # dtype validated in push()
                 from ..ops.quantize import np_quantize_int8
@@ -451,7 +565,19 @@ class KVWorker:
         with self._mu:
             chunks = self._recv_kvs.pop(ts, [])
             dst = self._pull_dst.pop(ts, None)
-        if dst is not None and chunks and not self._zero_copy_pull:
+            zpull = ts in self._zpull_ts
+            self._zpull_ts.discard(ts)
+        if zpull and chunks and dst is not None and all(
+            np.shares_memory(c.vals, dst[1]) for c in chunks
+        ):
+            # Delivered in place: every chunk aliases the registered
+            # buffer, so reassembly would be a self-copy — skip it
+            # (is_worker_zpull_; falls through to the copy below if any
+            # transport hop didn't honor the registration).
+            self.zpull_hits += 1
+            self._run_callback(ts)
+            return
+        if dst is not None and chunks:
             keys, vals_out, lens_out = dst
             chunks.sort(key=lambda kv: int(kv.keys[0]) if len(kv.keys) else 0)
             total = sum(c.vals.nbytes for c in chunks)

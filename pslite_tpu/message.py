@@ -67,6 +67,15 @@ except ImportError:  # pragma: no cover
     pass
 
 
+# Zero-copy pull option (is_worker_zpull_): when meta.option == OPT_ZPULL
+# on a pull request/response, meta.addr encodes the worker's registered
+# pull buffer as (buf_id << ZPULL_OFF_BITS) | slice_byte_offset.  Lives
+# here (not the app layer) so transports can consume it without importing
+# kv_app.
+OPT_ZPULL = 2
+ZPULL_OFF_BITS = 40
+
+
 def dtype_code(dt) -> int:
     return _DTYPE_TO_CODE.get(np.dtype(dt), 2)  # default: raw bytes
 

@@ -26,7 +26,7 @@ from typing import Dict
 import numpy as np
 
 from .. import wire
-from ..message import Message
+from ..message import Message, OPT_ZPULL, ZPULL_OFF_BITS
 from ..sarray import SArray
 from ..utils import logging as log
 from .tcp_van import TcpVan
@@ -95,8 +95,87 @@ class ShmVan(TcpVan):
             self._segments[name] = seg
             return seg
 
+    # -- zero-copy pull (is_worker_zpull_) -----------------------------------
+
+    def _pull_segment_name(self, worker_id: int, buf_id: int) -> str:
+        # Namespaced by the cluster's scheduler port (identical across the
+        # cluster's processes, unlike the pid-default PS_SHM_NS) so the
+        # server derives the same name the worker allocated under.
+        ns = self.env.find("PS_SHM_NS")
+        if not ns:
+            ns = self.env.find("DMLC_PS_ROOT_PORT", "0")
+        return f"pslpull_{ns}_{worker_id}_{buf_id}"
+
+    def alloc_pull_segment(self, buf_id: int, nbytes: int):
+        """Worker-side: create the registered pull buffer as a shm segment
+        servers on this host write into directly (the rdma_van
+        pull_addr_ / ucx w_pool_ analog).  Returns a uint8 view."""
+        name = self._pull_segment_name(self.my_node.id, buf_id)
+        seg = self._segment(name, nbytes, create=True)
+        return np.frombuffer(seg.mm, dtype=np.uint8, count=nbytes)
+
+    def free_pull_segment(self, buf_id: int) -> None:
+        """Release a registered pull buffer's segment (unlink + unmap)."""
+        name = self._pull_segment_name(self.my_node.id, buf_id)
+        with self._seg_mu:
+            seg = self._segments.pop(name, None)
+        if seg is not None:
+            seg.close(unlink=True)
+
+    def _try_zpull_send(self, msg: Message) -> int:
+        """Server-side: write the pull-response payload straight into the
+        worker's registered segment; only keys (+lens) cross the socket.
+        Returns -1 when the fast path doesn't apply."""
+        m = msg.meta
+        if (
+            m.request
+            or not m.pull
+            or m.option != OPT_ZPULL
+            or len(msg.data) < 2
+            or not m.control.empty()
+            or not self._same_host(m.recver)
+        ):
+            return -1
+        buf_id = m.addr >> ZPULL_OFF_BITS
+        off = m.addr & ((1 << ZPULL_OFF_BITS) - 1)
+        name = self._pull_segment_name(m.recver, buf_id)
+        vals = msg.data[1]
+        raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
+        try:
+            # No exists() pre-check: the worker may unlink the segment
+            # between a check and the open (shutdown race) — treat any
+            # open failure as "not registered" and fall back.
+            seg = self._segment(name, off + raw.nbytes, create=False)
+        except OSError:
+            return -1
+        if seg.size < off + raw.nbytes:
+            return -1
+        seg.mm[off : off + raw.nbytes] = raw
+
+        desc = {
+            "zpull_seg": name,
+            "off": off,
+            "nbytes": raw.nbytes,
+            "code": m.data_type[1],
+        }
+        if m.body:
+            # Preserve a user body, same invariant as the generic path.
+            desc["body"] = base64.b64encode(bytes(m.body)).decode("ascii")
+        meta_only = Message()
+        meta_only.meta = copy.copy(m)
+        meta_only.meta.body = json.dumps(desc).encode()
+        meta_only.meta.shm_data = True
+        meta_only.meta.data_type = (
+            [m.data_type[0]] + list(m.data_type[2:])
+        )
+        meta_only.data = [msg.data[0]] + list(msg.data[2:])
+        return super().send_msg(meta_only) + raw.nbytes
+
     def send_msg(self, msg: Message) -> int:
         m = msg.meta
+        sent = self._try_zpull_send(msg)
+        if sent >= 0:
+            return sent
         total = sum(d.nbytes for d in msg.data)
         if (
             not msg.data
@@ -146,6 +225,43 @@ class ShmVan(TcpVan):
         if msg.meta.shm_data:
             info = json.loads(msg.meta.body.decode())
             msg.meta.shm_data = False
+            if "zpull_seg" in info:
+                # Worker-side zero-copy pull: the payload already sits in
+                # the registered buffer (same mmap this process handed
+                # out in alloc_pull_segment) — alias it back into the
+                # message so the app sees delivery-in-place.
+                try:
+                    seg = self._segment(
+                        info["zpull_seg"], info["off"] + info["nbytes"],
+                        create=False,
+                    )
+                except OSError:
+                    # Buffer freed while the response was in flight:
+                    # deliver the message without vals (the waiter was
+                    # abandoned along with the buffer).
+                    log.warning(
+                        f"zpull segment {info['zpull_seg']} gone; "
+                        f"dropping payload"
+                    )
+                    msg.meta.body = b""
+                    return msg
+                vals = np.frombuffer(
+                    seg.mm, dtype=wire.code_dtype(info["code"]),
+                    count=info["nbytes"] // np.dtype(
+                        wire.code_dtype(info["code"])
+                    ).itemsize,
+                    offset=info["off"],
+                )
+                msg.data = [msg.data[0], SArray(vals)] + list(msg.data[1:])
+                msg.meta.data_type = (
+                    [msg.meta.data_type[0], info["code"]]
+                    + list(msg.meta.data_type[1:])
+                )
+                msg.meta.body = (
+                    base64.b64decode(info["body"]) if "body" in info
+                    else b""
+                )
+                return msg
             seg = self._segment(info["seg"], sum(info["lens"]), create=False)
             view = memoryview(seg.mm)
             off = 0

@@ -93,6 +93,52 @@ def test_shm_preserves_user_body_with_data():
         cluster.finalize()
 
 
+def test_zero_copy_pull_address_identity():
+    """is_worker_zpull_ (kv_app.h:727-792): pulls into a registered
+    transport-backed buffer are delivered in place — servers write their
+    slices directly into the buffer, and the worker skips reassembly.
+    Mirrors the registered-buffer address-identity check of
+    test_benchmark.cc:169-181, for pulls."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=2, van_type="shm")
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        ranges = cluster.workers[0].get_server_key_ranges()
+        keys = np.array(
+            sorted(r.begin + 1 for r in ranges), dtype=np.uint64
+        )
+        val_len = 4096
+        vals = np.linspace(0, 1, len(keys) * val_len).astype(np.float32)
+        worker.wait(worker.push(keys, vals))
+
+        buf = worker.alloc_pull_buffer(keys, val_len)
+        assert buf is not None, "shm van must back registered pull buffers"
+        buf[:] = -1.0  # sentinel: delivery must overwrite in place
+        worker.wait(worker.pull(keys, buf))
+        np.testing.assert_allclose(buf, vals, rtol=1e-6)
+        assert worker.zpull_hits == 1, "pull was reassembled, not in-place"
+
+        # Steady state: the same buffer keeps working (segment reuse).
+        worker.wait(worker.pull(keys, buf))
+        np.testing.assert_allclose(buf, vals, rtol=1e-6)
+        assert worker.zpull_hits == 2
+
+        # Ordinary arrays still use the reassembly path.
+        plain = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, plain))
+        np.testing.assert_allclose(plain, vals, rtol=1e-6)
+        assert worker.zpull_hits == 2
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
 def test_multi_van_push_pull():
     cluster = LoopbackCluster(
         num_workers=2, num_servers=1, van_type="multi",

@@ -87,3 +87,35 @@ def test_randomized_push_pull_soak():
         for s in servers:
             s.stop()
         cluster.finalize()
+
+
+def test_customer_tracker_bounded():
+    """The request tracker must not grow without bound over a long run
+    (the reference's vector grows forever); pruned timestamps still read
+    back as complete."""
+    from pslite_tpu.customer import Customer
+    from pslite_tpu.environment import Environment
+    from pslite_tpu.message import Role
+    from pslite_tpu.postoffice import Postoffice
+
+    env = Environment({
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "lo", "DMLC_PS_ROOT_PORT": "1",
+    })
+    po = Postoffice(Role.WORKER, env=env)
+    cust = Customer(0, 0, lambda msg: None, po)
+    try:
+        cap = Customer._MAX_TRACKER_ENTRIES
+        for _ in range(cap + 500):
+            ts = cust.new_request(0, num_responses=1)
+            cust.add_response(ts, 1)
+        assert len(cust._tracker) <= cap
+        # A pruned (ancient, completed) timestamp reads as complete.
+        assert cust.wait_request(0, timeout=0.1)
+        # The newest timestamps are still tracked precisely.
+        ts = cust.new_request(0, num_responses=2)
+        assert not cust.wait_request(ts, timeout=0.05)
+        cust.add_response(ts, 2)
+        assert cust.wait_request(ts, timeout=5)
+    finally:
+        cust.stop()
