@@ -2,13 +2,18 @@
 #
 #   make native         build the C++ transport core
 #   make native ASAN=1  ... with AddressSanitizer
+#   make native TSAN=1  ... with ThreadSanitizer (io thread vs callers)
 #   make test           run the full suite (virtual 8-device CPU mesh)
 #   make bench          run the headline benchmark on the local accelerator
 #   make lint           byte-compile every Python module
 
 ASAN ?= 0
+TSAN ?= 0
 ifeq ($(ASAN), 1)
 CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=address"
+endif
+ifeq ($(TSAN), 1)
+CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=thread"
 endif
 
 .PHONY: all native test bench lint clean

@@ -80,14 +80,19 @@ class Customer:
 
     def _prune_tracker_locked(self) -> None:
         """Bound tracker growth (the reference grows forever,
-        customer.cc:32-40): evict the oldest COMPLETED entries beyond the
-        window; a pruned timestamp reads back as complete."""
-        while len(self._tracker) > self._MAX_TRACKER_ENTRIES:
-            oldest = next(iter(self._tracker))
-            exp, got = self._tracker[oldest]
-            if got < exp:
-                break  # never prune an in-flight request
-            del self._tracker[oldest]
+        customer.cc:32-40): sweep out old COMPLETED entries beyond the
+        window; a pruned timestamp reads back as complete.  In-flight
+        entries are skipped (never pruned), so one stuck request cannot
+        re-unbound the tracker — only genuinely outstanding ones remain."""
+        if len(self._tracker) <= self._MAX_TRACKER_ENTRIES:
+            return
+        keep_recent = self._MAX_TRACKER_ENTRIES // 2
+        completed = [
+            ts for ts, (exp, got) in self._tracker.items() if got >= exp
+        ]
+        if len(completed) > keep_recent:
+            for ts in completed[: len(completed) - keep_recent]:
+                del self._tracker[ts]
 
     def _entry(self, timestamp: int):
         entry = self._tracker.get(timestamp)

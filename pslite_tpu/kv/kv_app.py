@@ -77,6 +77,10 @@ OPT_COMPRESS_INT8 = 1
 # the app layer.)
 from ..message import OPT_ZPULL, ZPULL_OFF_BITS as _ZPULL_OFF_BITS  # noqa: E402,E501
 
+# buf_ids are process-global so two KVWorker apps sharing one node (same
+# postoffice/van) can never derive the same shm segment name.
+_ZPULL_SEQ = itertools.count(1)
+
 
 def default_slicer(
     kvs: KVPairs, ranges: List[Range]
@@ -137,7 +141,6 @@ class KVWorker:
         # usual; the ICI engine path never reaches _finish at all.
         self._zpull_bufs: Dict[Tuple[int, int, int], dict] = {}
         self._zpull_ts: set = set()
-        self._zpull_seq = itertools.count(1)
         self.zpull_hits = 0  # pulls completed without reassembly
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
@@ -183,7 +186,7 @@ class KVWorker:
         log.check(len(keys) > 0, "empty key set")
         itemsize = np.dtype(dtype).itemsize
         total = len(keys) * val_len * itemsize
-        buf_id = next(self._zpull_seq)
+        buf_id = next(_ZPULL_SEQ)
         raw = alloc(buf_id, total)
         if raw is None:
             return None
@@ -203,6 +206,15 @@ class KVWorker:
         sig = (len(keys), int(keys[0]), int(keys[-1]))
         with self._mu:
             old = self._zpull_bufs.get(sig)
+            # Same (len, first, last) but DIFFERENT keys would silently
+            # free a live buffer the caller still uses — refuse; same keys
+            # is a legitimate reallocation and displaces the old one.
+            log.check(
+                old is None or np.array_equal(old["keys"], keys),
+                "alloc_pull_buffer: a different key set with the same "
+                "signature is already registered; free_pull_buffer it "
+                "first",
+            )
             self._zpull_bufs[sig] = {
                 "buf_id": buf_id,
                 "keys": keys,

@@ -114,6 +114,24 @@ class ShmVan(TcpVan):
         seg = self._segment(name, nbytes, create=True)
         return np.frombuffer(seg.mm, dtype=np.uint8, count=nbytes)
 
+    _MAX_PULL_MAPPINGS = 64
+
+    def _cap_pull_mappings(self) -> None:
+        """Bound server-side mappings of OTHER nodes' pull segments: the
+        worker unlinks freed segments, but this process's cached mmap
+        would keep the pages resident forever (buf_ids never repeat, so
+        stale entries are never displaced).  Evict oldest beyond a
+        window; a still-live segment just re-opens on next use."""
+        mine = f"pslpull_" + (self.env.find("PS_SHM_NS") or
+                              self.env.find("DMLC_PS_ROOT_PORT", "0"))
+        with self._seg_mu:
+            names = [
+                n for n, s in self._segments.items()
+                if n.startswith(mine) and not s.created
+            ]
+            for n in names[: max(0, len(names) - self._MAX_PULL_MAPPINGS)]:
+                self._segments.pop(n).close()
+
     def free_pull_segment(self, buf_id: int) -> None:
         """Release a registered pull buffer's segment (unlink + unmap)."""
         name = self._pull_segment_name(self.my_node.id, buf_id)
@@ -151,6 +169,7 @@ class ShmVan(TcpVan):
         if seg.size < off + raw.nbytes:
             return -1
         seg.mm[off : off + raw.nbytes] = raw
+        self._cap_pull_mappings()
 
         desc = {
             "zpull_seg": name,

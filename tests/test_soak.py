@@ -112,6 +112,14 @@ def test_customer_tracker_bounded():
         assert len(cust._tracker) <= cap
         # A pruned (ancient, completed) timestamp reads as complete.
         assert cust.wait_request(0, timeout=0.1)
+        # One stuck (never-completed) request must not re-unbound the
+        # tracker: completed entries issued after it still get pruned.
+        stuck = cust.new_request(0, num_responses=99)
+        for _ in range(cap + 500):
+            ts = cust.new_request(0, num_responses=1)
+            cust.add_response(ts, 1)
+        assert len(cust._tracker) <= cap + 1
+        assert stuck in cust._tracker  # in-flight is never pruned
         # The newest timestamps are still tracked precisely.
         ts = cust.new_request(0, num_responses=2)
         assert not cust.wait_request(ts, timeout=0.05)
