@@ -9,6 +9,9 @@
 
 ASAN ?= 0
 TSAN ?= 0
+ifeq ($(ASAN)$(TSAN), 11)
+$(error ASAN and TSAN are mutually exclusive)
+endif
 ifeq ($(ASAN), 1)
 CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=address"
 endif

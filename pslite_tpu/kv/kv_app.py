@@ -184,6 +184,17 @@ class KVWorker:
             return None
         keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
         log.check(len(keys) > 0, "empty key set")
+        sig = (len(keys), int(keys[0]), int(keys[-1]))
+        with self._mu:
+            old = self._zpull_bufs.get(sig)
+        # Same (len, first, last) but DIFFERENT keys would silently free a
+        # live buffer the caller still uses — refuse BEFORE allocating the
+        # new segment; same keys is a legitimate reallocation.
+        log.check(
+            old is None or np.array_equal(old["keys"], keys),
+            "alloc_pull_buffer: a different key set with the same "
+            "signature is already registered; free_pull_buffer it first",
+        )
         itemsize = np.dtype(dtype).itemsize
         total = len(keys) * val_len * itemsize
         buf_id = next(_ZPULL_SEQ)
@@ -203,18 +214,8 @@ class KVWorker:
             )
             offsets[rank] = off
             off += n * val_len * itemsize
-        sig = (len(keys), int(keys[0]), int(keys[-1]))
         with self._mu:
             old = self._zpull_bufs.get(sig)
-            # Same (len, first, last) but DIFFERENT keys would silently
-            # free a live buffer the caller still uses — refuse; same keys
-            # is a legitimate reallocation and displaces the old one.
-            log.check(
-                old is None or np.array_equal(old["keys"], keys),
-                "alloc_pull_buffer: a different key set with the same "
-                "signature is already registered; free_pull_buffer it "
-                "first",
-            )
             self._zpull_bufs[sig] = {
                 "buf_id": buf_id,
                 "keys": keys,

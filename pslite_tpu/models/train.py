@@ -26,18 +26,23 @@ from .transformer import ModelConfig, init_params, loss_fn
 
 
 def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
-                       seed: int = 0):
+                       seed: int = 0, sp_strategy: str = "ring"):
     """Returns (step_fn, flat_store, token_sharding, store_sharding).
 
     ``step_fn(flat_store, inputs, targets) -> (flat_store, loss)`` is jitted
     with donated store; inputs/targets are ``[B, T]`` int32 sharded
     ``P('dp', 'sp')``.
+
+    ``sp_strategy`` picks the sequence-parallel attention: ``"ring"``
+    (ppermute K/V ring, minimal residency) or ``"ulysses"`` (all-to-all
+    head/sequence swap, 2 collectives — needs heads % sp == 0).
     """
     import jax
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.ring_attention import ring_attention
+    from ..parallel.ulysses import ulysses_attention
     from .ps_step import make_flat_ps_step
     from .transformer import ParallelCtx
 
@@ -58,6 +63,14 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
             f"mlp hidden width {cfg.mlp_ratio * cfg.dim} must divide evenly "
             f"over the {sp}-way model axis"
         )
+    if sp_strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sp_strategy {sp_strategy!r}")
+    if sp_strategy == "ulysses" and cfg.heads % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads ({cfg.heads}) divisible by the "
+            f"{sp}-way sequence axis"
+        )
+    attn = ring_attention if sp_strategy == "ring" else ulysses_attention
 
     params0 = init_params(jax.random.PRNGKey(seed), cfg)
 
@@ -68,7 +81,7 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
         # tensor parallelism (sharded MLP matmuls), and — for MoE configs —
         # expert parallelism, all at once.
         ctx = ParallelCtx(
-            attn_fn=lambda q, k, v: ring_attention(
+            attn_fn=lambda q, k, v: attn(
                 q, k, v, sp_axis, causal=True
             ),
             pos_offset=sp_idx * t_local,

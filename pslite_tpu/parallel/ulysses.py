@@ -1,0 +1,70 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence swap.
+
+The complementary long-context strategy to ``ring_attention``: instead of
+rotating K/V blocks around a ring, one ``all_to_all`` re-shards the
+activations from sequence-parallel ``[B, T/S, H, D]`` to head-parallel
+``[B, T, H/S, D]``, attention runs *locally* over the full sequence for
+this shard's heads, and a second ``all_to_all`` swaps back.  Two
+collectives per attention call (each moving ``1/S`` of the activations)
+versus the ring's ``S`` neighbor hops — the better trade when heads are
+plentiful and the mesh axis is small, while ring attention wins at very
+long sequences that do not fit even transposed.  (The reference has no
+sequence code at all — SURVEY §2.9; both strategies are new, TPU-first
+scope.)
+
+Layout inside ``shard_map`` over ``axis_name``: inputs are the
+sequence-sharded ``[B, T_local, H, D]`` with global order shard-major,
+matching ``ring_attention`` exactly, so the two are drop-in
+interchangeable.  Requires ``H`` divisible by the axis size.
+"""
+
+from __future__ import annotations
+
+
+def _all_to_all_seq_to_heads(x, axis_name: str, num_shards: int):
+    """[B, T_local, H, D] -> [B, T_global, H/S, D] via one all_to_all."""
+    from jax import lax
+
+    B, T, H, D = x.shape
+    S = num_shards
+    # Split the head dim into S groups, all_to_all the group dim against
+    # the sequence: shard s ends up holding head-group s for EVERY
+    # sequence shard, i.e. the full sequence for its heads.
+    x = x.reshape(B, T, S, H // S, D)
+    # all_to_all over axis: split_axis=2 (head groups), concat_axis=1
+    # (sequence blocks, shard-major => global order preserved).
+    y = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                       tiled=True)
+    return y.reshape(B, T * S, H // S, D)
+
+
+def _all_to_all_heads_to_seq(x, axis_name: str, num_shards: int):
+    """[B, T_global, H/S, D] -> [B, T_local, H, D] (inverse transform)."""
+    from jax import lax
+
+    B, Tg, Hs, D = x.shape
+    S = num_shards
+    x = x.reshape(B, S, Tg // S, Hs, D)
+    y = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                       tiled=True)
+    return y.reshape(B, Tg // S, Hs * S, D)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: float | None = None):
+    """All-to-all sequence-parallel attention; call inside shard_map over
+    ``axis_name``.  Same contract as :func:`ring_attention`: inputs and
+    output are ``[B, T_local, H, D]`` per shard, shard-major global
+    order."""
+    from jax import lax
+
+    from .ring_attention import reference_attention
+
+    S = lax.psum(1, axis_name)
+    qh = _all_to_all_seq_to_heads(q, axis_name, S)
+    kh = _all_to_all_seq_to_heads(k, axis_name, S)
+    vh = _all_to_all_seq_to_heads(v, axis_name, S)
+    # Full-sequence attention over this shard's head group; the
+    # reference kernel already returns [B, T_global, H/S, D].
+    oh = reference_attention(qh, kh, vh, causal=causal, scale=scale)
+    return _all_to_all_heads_to_seq(oh, axis_name, S)

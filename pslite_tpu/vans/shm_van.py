@@ -21,7 +21,7 @@ import json
 import mmap
 import os
 import threading
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -74,6 +74,7 @@ class ShmVan(TcpVan):
         self._ns = self.env.find("PS_SHM_NS", str(os.getpid()))
         self._peer_hosts: Dict[int, str] = {}
         self._min_bytes = self.env.find_int("PS_SHM_MIN_BYTES", 4096)
+        self._pull_ns_cache: Optional[str] = None
 
     def connect_transport(self, node) -> None:
         super().connect_transport(node)
@@ -97,14 +98,21 @@ class ShmVan(TcpVan):
 
     # -- zero-copy pull (is_worker_zpull_) -----------------------------------
 
-    def _pull_segment_name(self, worker_id: int, buf_id: int) -> str:
+    @property
+    def _pull_ns(self) -> str:
         # Namespaced by the cluster's scheduler port (identical across the
         # cluster's processes, unlike the pid-default PS_SHM_NS) so the
         # server derives the same name the worker allocated under.
-        ns = self.env.find("PS_SHM_NS")
-        if not ns:
-            ns = self.env.find("DMLC_PS_ROOT_PORT", "0")
-        return f"pslpull_{ns}_{worker_id}_{buf_id}"
+        ns = self._pull_ns_cache
+        if ns is None:
+            ns = self.env.find("PS_SHM_NS") or self.env.find(
+                "DMLC_PS_ROOT_PORT", "0"
+            )
+            self._pull_ns_cache = ns
+        return ns
+
+    def _pull_segment_name(self, worker_id: int, buf_id: int) -> str:
+        return f"pslpull_{self._pull_ns}_{worker_id}_{buf_id}"
 
     def alloc_pull_segment(self, buf_id: int, nbytes: int):
         """Worker-side: create the registered pull buffer as a shm segment
@@ -122,8 +130,7 @@ class ShmVan(TcpVan):
         would keep the pages resident forever (buf_ids never repeat, so
         stale entries are never displaced).  Evict oldest beyond a
         window; a still-live segment just re-opens on next use."""
-        mine = f"pslpull_" + (self.env.find("PS_SHM_NS") or
-                              self.env.find("DMLC_PS_ROOT_PORT", "0"))
+        mine = f"pslpull_{self._pull_ns}"
         with self._seg_mu:
             names = [
                 n for n, s in self._segments.items()
@@ -159,6 +166,8 @@ class ShmVan(TcpVan):
         name = self._pull_segment_name(m.recver, buf_id)
         vals = msg.data[1]
         raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
+        with self._seg_mu:
+            is_new_mapping = name not in self._segments
         try:
             # No exists() pre-check: the worker may unlink the segment
             # between a check and the open (shutdown race) — treat any
@@ -169,7 +178,9 @@ class ShmVan(TcpVan):
         if seg.size < off + raw.nbytes:
             return -1
         seg.mm[off : off + raw.nbytes] = raw
-        self._cap_pull_mappings()
+        if is_new_mapping:
+            # Eviction only matters when the mapping count grew.
+            self._cap_pull_mappings()
 
         desc = {
             "zpull_seg": name,
