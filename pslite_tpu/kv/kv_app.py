@@ -424,16 +424,31 @@ class KVWorker:
         cmd: int = 0,
         callback: Optional[Callable[[], None]] = None,
         priority: int = 0,
+        compress: Optional[str] = None,
     ) -> int:
-        """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792)."""
+        """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792).
+
+        ``compress='int8'`` quarters pull-response wire bytes (the
+        server quantizes blockwise before sending; decompressed here).
+        float32 fixed-length values only; ignored on the collective path
+        and mutually exclusive with registered zero-copy pull buffers.
+        """
         keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        if compress is not None:
+            log.check(compress == "int8", f"unknown compression {compress!r}")
+            log.check(lens is None, "compress requires fixed-length values")
+            log.check(vals.dtype == np.float32,
+                      "compress='int8' requires float32 values")
         route = self._engine_route(keys, cmd, lens)
         if route is not None:
             result = self.engine.pull(route)
             return self._engine_dispatch(result, out=vals, callback=callback,
                                          keep_result=True)
         ts = self._customer.new_request(SERVER_GROUP)
-        zpull = self._zpull_lookup(keys, vals) if lens is None else None
+        zpull = (
+            self._zpull_lookup(keys, vals)
+            if lens is None and compress is None else None
+        )
         with self._mu:
             if callback is not None:
                 self._callbacks[ts] = callback
@@ -443,7 +458,7 @@ class KVWorker:
         kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
                    val_dtype=vals.dtype, val_nbytes=vals.nbytes,
-                   zpull=zpull)
+                   zpull=zpull, compress=compress)
         return ts
 
     def push_pull(
@@ -536,6 +551,9 @@ class KVWorker:
                     | zpull["offsets"][group_rank]
                 )
             else:
+                if compress == "int8" and pull and not push:
+                    # Ask the server to quantize its response slice.
+                    m.option = OPT_COMPRESS_INT8
                 m.addr = id(part.vals)  # same-process fast-path token
             msg.add_data(SArray(part.keys))
             if compress == "int8" and push:  # dtype validated in push()
@@ -560,12 +578,24 @@ class KVWorker:
             return  # workers only receive responses
         ts = msg.meta.timestamp
         if msg.meta.pull and len(msg.data) >= 2:
-            kvs = KVPairs(
-                keys=msg.data[0].astype_view(np.uint64).numpy(),
-                vals=msg.data[1].numpy(),
-                lens=(msg.data[2].astype_view(np.int32).numpy()
-                      if len(msg.data) > 2 else None),
-            )
+            if msg.meta.option == OPT_COMPRESS_INT8 and len(msg.data) >= 3:
+                # Server quantized the response slice; val_len carries
+                # the slice's uncompressed byte count.
+                from ..ops.quantize import decode_int8_payload
+
+                kvs = KVPairs(
+                    keys=msg.data[0].astype_view(np.uint64).numpy(),
+                    vals=decode_int8_payload(
+                        msg.data[1], msg.data[2], msg.meta.val_len
+                    ),
+                )
+            else:
+                kvs = KVPairs(
+                    keys=msg.data[0].astype_view(np.uint64).numpy(),
+                    vals=msg.data[1].numpy(),
+                    lens=(msg.data[2].astype_view(np.int32).numpy()
+                          if len(msg.data) > 2 else None),
+                )
             with self._mu:
                 self._recv_kvs.setdefault(ts, []).append(kvs)
         # The Customer increments the response count *after* this handle, so
@@ -661,6 +691,30 @@ class KVServer:
         m.val_len = req.val_len
         m.option = req.option
         if res is not None and not res.empty():
+            if (
+                req.pull
+                and req.option == OPT_COMPRESS_INT8
+                and res.lens is None
+                and res.vals.dtype == np.float32
+            ):
+                # Pull-side wire compression (the worker asked via the
+                # request option): quantize the response slice; val_len
+                # carries the slice's uncompressed byte count so the
+                # worker can size the dequantize.
+                from ..ops.quantize import np_quantize_int8
+
+                q, scales, _n = np_quantize_int8(res.vals)
+                m.val_len = res.vals.nbytes
+                msg.add_data(SArray(res.keys))
+                msg.add_data(SArray(q.reshape(-1)))
+                msg.add_data(SArray(scales))
+                self.po.van.send(msg)
+                return
+            if m.option == OPT_COMPRESS_INT8:
+                # Declined to compress (lens / non-float32): the echoed
+                # option must not claim quantized data or the worker
+                # would misdecode the plain payload.
+                m.option = 0
             msg.add_data(SArray(res.keys))
             msg.add_data(SArray(res.vals))
             if res.lens is not None:
@@ -689,13 +743,11 @@ class KVServer:
         if len(msg.data) >= 2:
             kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
             if meta.option == OPT_COMPRESS_INT8 and meta.push:
-                from ..ops.quantize import QUANT_BLOCK, np_dequantize_int8
+                from ..ops.quantize import decode_int8_payload
 
-                q = msg.data[1].astype_view(np.int8).numpy().reshape(
-                    -1, QUANT_BLOCK
+                kvs.vals = decode_int8_payload(
+                    msg.data[1], msg.data[2], meta.val_len
                 )
-                scales = msg.data[2].astype_view(np.float32).numpy()
-                kvs.vals = np_dequantize_int8(q, scales, meta.val_len // 4)
             else:
                 kvs.vals = msg.data[1].numpy()
                 if len(msg.data) > 2:

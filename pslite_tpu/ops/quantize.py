@@ -55,6 +55,18 @@ def np_dequantize_int8(q, scales, n: int):
     return x.reshape(-1)[:n]
 
 
+def decode_int8_payload(q_sarray, scales_sarray, val_len: int):
+    """Decode the wire layout of an int8-compressed message payload
+    (data[1] = int8 codes, data[2] = fp32 scales, meta.val_len =
+    uncompressed byte count) — the single decoder both directions of the
+    message path share."""
+    import numpy as _np
+
+    q = q_sarray.astype_view(_np.int8).numpy().reshape(-1, QUANT_BLOCK)
+    scales = scales_sarray.astype_view(_np.float32).numpy()
+    return np_dequantize_int8(q, scales, val_len // 4)
+
+
 @jax.jit
 def quantize_int8(x):
     """flat fp32 -> (int8 ``[rows, 128]``, fp32 scales ``[rows, 128]``).

@@ -165,6 +165,21 @@ def test_fused_handle_checkpoint_resume(mesh, tmp_path):
     np.testing.assert_allclose(resumed, expected, rtol=1e-5, atol=1e-5)
 
 
+def test_dense_bfloat16_bucket(mesh):
+    """bfloat16 buckets (the MXU-native dtype) work through the fused
+    push_pull path with tolerable precision."""
+    import jax.numpy as jnp
+
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 64
+    eng.register_dense("bf16", keys, val_len, dtype=jnp.bfloat16)
+    W = eng.num_shards
+    grads = np.ones((W, 2 * val_len), dtype=np.float32)
+    pulled = np.asarray(eng.push_pull("bf16", grads), dtype=np.float32)
+    np.testing.assert_allclose(pulled, float(W), rtol=1e-2)
+
+
 def test_dense_init_roundtrip(mesh):
     eng = CollectiveEngine(mesh=mesh)
     keys = np.arange(5, dtype=np.uint64)

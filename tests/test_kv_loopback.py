@@ -274,6 +274,85 @@ def test_compressed_push():
         cluster.finalize()
 
 
+def test_compressed_pull():
+    """int8 compression on pull responses (the pull-side mirror of
+    compressed push): the server quantizes its response slice, wire bytes
+    shrink ~4x, values land within quantization error."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=2)
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        ranges = cluster.workers[0].get_server_key_ranges()
+        keys = np.array(
+            sorted(r.begin + 2 for r in ranges), dtype=np.uint64
+        )
+        n = len(keys) * 32 * 1024
+        vals = np.random.default_rng(1).normal(size=n).astype(np.float32)
+        worker.wait(worker.push(keys, vals))
+
+        before = sum(po.van.send_bytes for po in cluster.servers)
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out, compress="int8"))
+        wire_bytes = sum(
+            po.van.send_bytes for po in cluster.servers
+        ) - before
+        assert wire_bytes < vals.nbytes / 3  # ~4x smaller + overhead
+
+        step = np.abs(vals).reshape(-1, 128).max(axis=1) / 127.0
+        tol = np.repeat(step, 128) * 0.51 + 1e-6
+        assert np.all(np.abs(out - vals) <= tol)
+
+        # Plain pull still returns exact values.
+        exact = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, exact))
+        np.testing.assert_allclose(exact, vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_compressed_pull_declined_for_variable_length():
+    """A server whose handle responds with variable-length values (lens)
+    declines to quantize; the echoed option must then NOT claim
+    compressed data or the worker would misdecode the plain payload."""
+    from pslite_tpu.kv.kv_app import KVPairs
+
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    servers = []
+    try:
+        vals = np.arange(256, dtype=np.float32)
+
+        def handle(req_meta, req_data, server):
+            if req_meta.pull:
+                server.response(req_meta, KVPairs(
+                    keys=req_data.keys,
+                    vals=vals,
+                    lens=np.array([256], dtype=np.int32),
+                ))
+            else:
+                server.response(req_meta)
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(handle)
+        servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([3], dtype=np.uint64)
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out, compress="int8"))
+        np.testing.assert_allclose(out, vals)  # exact: not quantized
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
 def test_registered_recv_buffer_identity():
     """The reference benchmark proves zero-copy delivery by checking pushes
     land in the pre-registered buffer (test_benchmark.cc:169-181); the

@@ -7,6 +7,7 @@ KVServerDefaultHandle semantics.  Catches slicer/reassembly/ordering
 regressions no single-scenario test pins down.
 """
 
+import os
 import numpy as np
 
 from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
@@ -15,6 +16,9 @@ from helpers import LoopbackCluster
 
 
 def test_randomized_push_pull_soak():
+    # PS_SOAK_ROUNDS extends the horizon (default keeps CI fast; the
+    # bounded tracker makes long horizons safe — see
+    # test_customer_tracker_bounded).
     rng = np.random.default_rng(1234)
     cluster = LoopbackCluster(num_workers=2, num_servers=3)
     cluster.start()
@@ -45,7 +49,8 @@ def test_randomized_push_pull_soak():
         k = 8  # values per key
         model = {}  # host reference: key -> np.ndarray
 
-        for round_idx in range(30):
+        rounds = int(os.environ.get("PS_SOAK_ROUNDS", "30"))
+        for round_idx in range(rounds):
             w = workers[round_idx % 2]
             # Random subset of the pool, sorted (the KV contract).
             take = rng.random(len(pool)) < 0.5
