@@ -27,6 +27,7 @@ class ModelConfig:
     mlp_ratio: int = 4
     moe_experts: int = 0  # >0: replace the MLP with a top-1 routed MoE
     dtype: str = "float32"  # params dtype; matmuls cast to bfloat16 on TPU
+    remat: bool = False  # jax.checkpoint each layer: trade FLOPs for HBM
 
 
 @dataclass(frozen=True)
@@ -189,7 +190,7 @@ def forward(
 
     compute_dt = jnp.bfloat16 if x.dtype != jnp.float64 else x.dtype
 
-    for layer in params["layers"]:
+    def layer_fn(layer, x):
         h = _rmsnorm(x, layer["ln1"])
         qkv = (h.astype(compute_dt) @ layer["qkv"].astype(compute_dt)).astype(
             x.dtype
@@ -203,9 +204,17 @@ def forward(
                  ).astype(x.dtype)
         h = _rmsnorm(x, layer["ln2"])
         if "moe" in layer:
-            x = x + _moe(layer, h, compute_dt, ctx, cfg)
-        else:
-            x = x + _mlp(layer, h, compute_dt, ctx, cfg)
+            return x + _moe(layer, h, compute_dt, ctx, cfg)
+        return x + _mlp(layer, h, compute_dt, ctx, cfg)
+
+    if cfg.remat:
+        # Rematerialize activations in the backward pass: per-layer
+        # jax.checkpoint trades recompute FLOPs for HBM residency (long
+        # sequences / deep stacks).
+        layer_fn = jax.checkpoint(layer_fn, static_argnums=())
+
+    for layer in params["layers"]:
+        x = layer_fn(layer, x)
 
     x = _rmsnorm(x, params["ln_f"])
     logits = (x.astype(compute_dt) @ params["embed"].T.astype(compute_dt)

@@ -64,3 +64,26 @@ def test_train_step_ulysses_strategy():
     # Same math, different communication schedule: losses must agree.
     np.testing.assert_allclose(losses["ring"], losses["ulysses"],
                                rtol=1e-4, atol=1e-5)
+
+
+def test_train_step_remat_matches():
+    """cfg.remat trades FLOPs for activation memory without changing the
+    math: losses match the non-remat config."""
+    import jax
+    import numpy as np
+
+    from pslite_tpu.models.train import make_ps_train_step, toy_batch
+    from pslite_tpu.models.transformer import ModelConfig
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((2, 4), ("dp", "sp"))
+    losses = {}
+    for remat in (False, True):
+        cfg = ModelConfig(vocab=64, dim=32, heads=2, layers=2, remat=remat)
+        step, store, tok_sharding, _ = make_ps_train_step(cfg, mesh, lr=0.1)
+        inputs, targets = toy_batch(cfg, batch=2, seq=16)
+        inputs = jax.device_put(inputs, tok_sharding)
+        targets = jax.device_put(targets, tok_sharding)
+        store, loss = step(store, inputs, targets)
+        losses[remat] = float(loss)
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
