@@ -217,6 +217,7 @@ def main() -> None:
                 eng, "bench_host", 4, (64 << 10) // 4, 2, host_grads=True
             )
             fused = None
+            trace_gbps = None
         else:
             # Median of 3 rounds: single-run numbers on a shared chip vary
             # ~20%; the driver records whatever one invocation prints.
@@ -237,6 +238,13 @@ def main() -> None:
                 eng, "bench_fused", 40, (1 << 20) // 4, 8,
                 handle="sgd_momentum:0.01,0.9",
             )
+            # Model-shaped workload: the ResNet-50 gradient trace
+            # (~205 MB/step in ~35 size-bucketed tensors) as one grouped
+            # dispatch per step — the BASELINE config-4 replay.
+            from pslite_tpu.models.resnet_trace import replay as rn50
+
+            rn_bytes, rn_dt = rn50(eng, steps=5)
+            trace_gbps = rn_bytes / rn_dt / 1e9
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
         hbm_est = _hbm_estimate(probe.get("device_kind", ""))
@@ -264,6 +272,9 @@ def main() -> None:
                 "host_origin_goodput": round(host_path, 2),
                 "fused_sgdm_goodput": (
                     round(fused, 2) if fused is not None else None
+                ),
+                "resnet50_trace_goodput": (
+                    round(trace_gbps, 2) if trace_gbps is not None else None
                 ),
                 "hbm_util_est": hbm_util,
                 "note": (

@@ -101,7 +101,7 @@ def replay(engine, steps: int = 1, bucket_bytes: int = 4 << 20,
     glist = [grads[n] for n in names]
     # Grouped dispatch supports stateless handles only; engines built
     # with fused optimizer handles fall back to per-bucket replay.
-    grouped = grouped and not engine._is_stateful(engine._server_handle)
+    grouped = grouped and not engine.handle_is_stateful
 
     def one_step():
         if grouped:

@@ -259,6 +259,13 @@ class CollectiveEngine:
             handle.startswith("sgd_momentum") or handle.startswith("adam")
         )
 
+    @property
+    def handle_is_stateful(self) -> bool:
+        """Whether the engine's default server handle carries optimizer
+        state (fused sgd_momentum/adam) — such handles are unsupported by
+        the grouped program (public predicate for callers)."""
+        return self._is_stateful(self._server_handle)
+
     def _program(self, op: str, padded_len: int, dtype, handle_key) -> Callable:
         """Jitted SPMD program for (op, shape, dtype, handle) — the
         executable-cache analog of the reference's per-(key,push,recver)
@@ -615,8 +622,13 @@ class CollectiveEngine:
         finally:
             for n in reversed(ordered):
                 self._bucket_mu[n].release()
-        for n, b in zip(names, buckets):
-            self._observe(n, "push_pull", b, t0)
+        for i, (n, b) in enumerate(zip(names, buckets)):
+            # One dispatch happened: attribute its latency to the first
+            # bucket's event only (zero for the rest) so summed profiler
+            # durations aren't inflated k-fold; byte counters are per
+            # bucket as usual.
+            self._observe(n, "push_pull", b,
+                          t0 if i == 0 else time.perf_counter())
         return [p[: b.total_len] for p, b in zip(pulled, buckets)]
 
     def _group_program(self, shapes_key, handle_key) -> Callable:

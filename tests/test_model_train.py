@@ -84,6 +84,10 @@ def test_train_step_remat_matches():
         inputs, targets = toy_batch(cfg, batch=2, seq=16)
         inputs = jax.device_put(inputs, tok_sharding)
         targets = jax.device_put(targets, tok_sharding)
-        store, loss = step(store, inputs, targets)
-        losses[remat] = float(loss)
+        # TWO steps: the step-2 loss depends on step-1's GRADIENTS (the
+        # store update), which is exactly what remat recomputes — a
+        # single-step loss would be a pre-update tautology.
+        store, _ = step(store, inputs, targets)
+        store, loss2 = step(store, inputs, targets)
+        losses[remat] = float(loss2)
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
