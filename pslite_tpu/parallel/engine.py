@@ -59,16 +59,29 @@ class DenseBucket:
 ServerHandle = Union[str, Callable]
 
 
-def _rs_update_ag(store_l, grads_l, handle, axis):
-    """The core per-bucket aggregation semantics shared by the single and
-    grouped programs: reduce-scatter across workers, apply the server
-    handle to this shard, all-gather the updated store (push=aggregate,
-    update, pull — kv_app.h:430-452 fused into the collectives)."""
+def _aggregate(grads_l, axis, worker_axis=None):
+    """Worker-reduction of a local grads block — psum_scatter on the 1-D
+    colocated layout (reduce+shard in one hop), psum over the worker axis
+    on a 2-D layout (the kv sharding is already in the data layout)."""
     from jax import lax
 
-    agg = lax.psum_scatter(
-        grads_l[0], axis, scatter_dimension=0, tiled=True
-    )
+    if worker_axis is None:
+        return lax.psum_scatter(
+            grads_l[0], axis, scatter_dimension=0, tiled=True
+        )
+    return lax.psum(grads_l[0], worker_axis)
+
+
+def _rs_update_ag(store_l, grads_l, handle, axis, worker_axis=None):
+    """The core per-bucket aggregation semantics shared by the single and
+    grouped programs: reduce(-scatter) across workers, apply the server
+    handle to this shard, all-gather the updated store (push=aggregate,
+    update, pull — kv_app.h:430-452 fused into the collectives).
+
+    See :func:`_aggregate` for the 1-D vs 2-D reduction shapes."""
+    from jax import lax
+
+    agg = _aggregate(grads_l, axis, worker_axis)
     new_store = handle(store_l, agg)
     pulled = lax.all_gather(new_store, axis, tiled=True)
     return new_store, pulled
@@ -90,7 +103,16 @@ class CollectiveEngine:
         axis_name: str = "kv",
         server_handle: ServerHandle = "sum",
         profiler=None,
+        worker_axis: Optional[str] = None,
     ):
+        """``worker_axis``: optional second mesh axis carrying the worker
+        fan-in, decoupling worker count from server-shard count (the
+        reference's W workers vs S servers asymmetry, on the collective
+        path).  With a 2-D mesh ``(dp, kv)``: gradients are summed over
+        ``dp`` (the worker reduction) and scattered over ``kv`` (the
+        server key-range sharding); stores live sharded over ``kv``,
+        replicated over ``dp``.  Default None = the 1-D colocated layout
+        where the one axis is both."""
         import jax
 
         from .mesh import default_mesh
@@ -99,7 +121,16 @@ class CollectiveEngine:
 
         self.mesh = mesh if mesh is not None else default_mesh(axis_name)
         self.axis = axis_name
+        self.worker_axis = worker_axis
+        if worker_axis is not None:
+            log.check(worker_axis in self.mesh.axis_names,
+                      f"worker axis {worker_axis!r} not in mesh")
         self.num_shards = self.mesh.shape[axis_name]
+        # Worker fan-in rows of the grads array.
+        self.num_workers = (
+            self.mesh.shape[worker_axis] if worker_axis is not None
+            else self.num_shards
+        )
         # Fixed at construction; cached off the hot path.
         self._multiprocess = mesh_is_multiprocess(self.mesh)
         self._local_shard_count = (
@@ -290,18 +321,17 @@ class CollectiveEngine:
             handle = self._handle_fn(
                 self._server_handle if handle_key == "_default" else handle_key
             )
+        waxis = self.worker_axis
         store_spec = P(axis)
-        grads_spec = P(axis, None)
+        grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
         repl_spec = P(None)
 
         def _push_pull(store_l, grads_l):
             # grads_l: [1, padded]; reduce-scatter across workers => my shard
-            return _rs_update_ag(store_l, grads_l, handle, axis)
+            return _rs_update_ag(store_l, grads_l, handle, axis, waxis)
 
         def _push(store_l, grads_l):
-            agg = lax.psum_scatter(
-                grads_l[0], axis, scatter_dimension=0, tiled=True
-            )
+            agg = _aggregate(grads_l, axis, waxis)
             new = handle(store_l, agg)
             # Tiny non-donated completion token: callers block on this
             # instead of the store (which the next push donates).
@@ -462,12 +492,38 @@ class CollectiveEngine:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if self.worker_axis is not None:
+            sharding = NamedSharding(
+                self.mesh, P(self.worker_axis, self.axis)
+            )
+        else:
+            sharding = NamedSharding(self.mesh, P(self.axis, None))
         if isinstance(grads, jax.Array) and grads.ndim == 2:
             if grads.shape[1] == bucket.padded_len:
+                # Row count must match the worker fan-in exactly — a
+                # silent reshard would drop rows (the shard body reads
+                # one local row per device position).
+                log.check_eq(int(grads.shape[0]), self.num_workers,
+                             "bad worker dim")
                 if grads.sharding == sharding:
                     return grads
                 return jax.device_put(grads, sharding)
+        if self.worker_axis is not None:
+            arr = jnp.asarray(grads, dtype=bucket.dtype)
+            if arr.ndim == 1:
+                arr = jnp.broadcast_to(arr, (self.num_workers, arr.shape[0]))
+            log.check_eq(int(arr.shape[0]), self.num_workers,
+                         "bad worker dim")
+            if arr.shape[1] != bucket.padded_len:
+                log.check_eq(int(arr.shape[1]), bucket.total_len,
+                             "bad grad len")
+                arr = jnp.pad(
+                    arr, ((0, 0), (0, bucket.padded_len - bucket.total_len))
+                )
+            log.check(not self._is_multiprocess(),
+                      "host arrays on a multi-process 2-D mesh are not "
+                      "supported yet; pass pre-sharded jax.Arrays")
+            return jax.device_put(arr, sharding)
         if self._is_multiprocess():
             arr = np.asarray(grads, dtype=np.dtype(bucket.dtype))
             local = self._local_shards()
@@ -520,6 +576,9 @@ class CollectiveEngine:
     def _resolve_handle(self, handle: Optional[ServerHandle]):
         resolved = self._server_handle if handle is None else handle
         if self._is_stateful(resolved):
+            log.check(self.worker_axis is None,
+                      "stateful (fused optimizer) handles are not yet "
+                      "supported on 2-D meshes")
             return resolved, resolved  # stateful handles key by full string
         return resolved, ("_default" if handle is None else handle)
 
@@ -596,6 +655,8 @@ class CollectiveEngine:
         log.check(len(names) == len(grads_list), "names/grads mismatch")
         log.check(len(set(names)) == len(names),
                   "duplicate bucket in group (stores are donated)")
+        log.check(self.worker_axis is None,
+                  "push_pull_group is 1-D-mesh only for now")
         resolved, handle_key = self._resolve_handle(handle)
         log.check(not self._is_stateful(resolved),
                   "push_pull_group supports stateless handles only")

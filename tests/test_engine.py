@@ -165,6 +165,40 @@ def test_fused_handle_checkpoint_resume(mesh, tmp_path):
     np.testing.assert_allclose(resumed, expected, rtol=1e-5, atol=1e-5)
 
 
+def test_two_axis_mesh_decouples_workers_from_shards():
+    """2-D (dp, kv) mesh: 2 worker rows x 4 server shards — the W != S
+    asymmetry of the reference, on the collective path."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp")
+    assert eng.num_workers == 2 and eng.num_shards == 4
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 40
+    eng.register_dense("b2d", keys, val_len)
+    rng = np.random.default_rng(21)
+    grads = rng.normal(size=(2, 3 * val_len)).astype(np.float32)
+    pulled = np.asarray(eng.push_pull("b2d", grads))
+    np.testing.assert_allclose(pulled, grads.sum(axis=0), rtol=1e-5)
+
+    # push-only + pull round trip accumulates.
+    token = eng.push("b2d", grads)
+    token.block_until_ready()
+    out = np.asarray(eng.pull("b2d"))
+    np.testing.assert_allclose(out, 2 * grads.sum(axis=0), rtol=1e-5)
+
+    # Wrong worker-row count must fail loud, not silently drop rows —
+    # including the pre-sharded device-array fast path.
+    import jax
+
+    bad_host = np.ones((4, eng.bucket("b2d").padded_len), np.float32)
+    with pytest.raises(Exception, match="bad worker dim"):
+        eng.push_pull("b2d", bad_host)
+    bad_dev = jax.device_put(bad_host)
+    with pytest.raises(Exception, match="bad worker dim"):
+        eng.push_pull("b2d", bad_dev)
+
+
 def test_push_pull_group_matches_singles(mesh):
     """One grouped program over several buckets == per-bucket push_pulls
     (same aggregation, one dispatch)."""
