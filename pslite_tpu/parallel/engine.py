@@ -125,6 +125,9 @@ class CollectiveEngine:
         if worker_axis is not None:
             log.check(worker_axis in self.mesh.axis_names,
                       f"worker axis {worker_axis!r} not in mesh")
+            log.check(worker_axis != axis_name,
+                      "worker_axis must differ from the kv axis (leave it "
+                      "None for the 1-D colocated layout)")
         self.num_shards = self.mesh.shape[axis_name]
         # Worker fan-in rows of the grads array.
         self.num_workers = (
@@ -384,17 +387,13 @@ class CollectiveEngine:
 
         def _push(store_l, *rest):
             state_l, grads_l = rest[:-1], rest[-1]
-            agg = lax.psum_scatter(
-                grads_l[0], axis, scatter_dimension=0, tiled=True
-            )
+            agg = _aggregate(grads_l, axis)
             new_store, new_state = sfn(store_l, tuple(state_l), agg)
             return (new_store, *new_state, new_store[:1])  # token last
 
         def _push_pull(store_l, *rest):
             state_l, grads_l = rest[:-1], rest[-1]
-            agg = lax.psum_scatter(
-                grads_l[0], axis, scatter_dimension=0, tiled=True
-            )
+            agg = _aggregate(grads_l, axis)
             new_store, new_state = sfn(store_l, tuple(state_l), agg)
             pulled = lax.all_gather(new_store, axis, tiled=True)
             return (new_store, *new_state, pulled)

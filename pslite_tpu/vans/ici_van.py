@@ -44,7 +44,7 @@ class _IciDataPlane:
         self.engine = None
         self.sparse_engine = None
         self._mesh = None
-        self._distributed_opts = None
+        self._dist_lease = False
 
     def set_mesh(self, mesh) -> None:
         """Install a specific mesh before start() (tests, multi-host)."""
@@ -59,13 +59,12 @@ class _IciDataPlane:
         if self._multihost():
             # Join the global jax.distributed runtime before first backend
             # use; every worker process contributes its local devices to
-            # one global mesh (the DCN/ICI-spanning deployment).
+            # one global mesh (the DCN/ICI-spanning deployment).  Lease-
+            # counted: with several worker instances per process the
+            # runtime survives until the LAST instance stops.
             from ..parallel import distributed
 
-            self._distributed_opts = distributed.init_distributed(self.env)
-            log.info(
-                f"ici multihost: jax.distributed {self._distributed_opts}"
-            )
+            self._dist_lease = distributed.acquire(self.env)
             return distributed.global_mesh()
         return None  # CollectiveEngine defaults to the local-device mesh
 
@@ -92,14 +91,11 @@ class _IciDataPlane:
 
     def stop_transport(self) -> None:
         super().stop_transport()
-        if self._distributed_opts is not None:
-            self._distributed_opts = None
-            try:
-                import jax
+        if self._dist_lease:
+            self._dist_lease = False
+            from ..parallel import distributed
 
-                jax.distributed.shutdown()
-            except Exception as exc:  # best-effort: interpreter teardown
-                log.vlog(1, f"jax.distributed.shutdown: {exc!r}")
+            distributed.release()
 
     def register_recv_buffer(self, sender_id: int, key: int, buffer) -> None:
         # Donated HBM buffers make delivery-in-place the default on this

@@ -61,3 +61,103 @@ def test_ici_tcp_two_process_push_pull():
         assert p.returncode == 0, f"child failed:\n{out}"
     worker_outs = [o for o in outputs if "WORKER_OK 24.0" in o]
     assert len(worker_outs) == 2, f"expected 2 worker OKs, got: {outputs}"
+
+
+def test_init_distributed_idempotent(monkeypatch):
+    """A process hosting several worker instances (groups/JOINT) must
+    join jax.distributed once; later calls are no-ops."""
+    import jax
+
+    from pslite_tpu.environment import Environment
+    from pslite_tpu.parallel import distributed
+
+    env = Environment({
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": "12345",
+        "DMLC_RANK": "0",
+    })
+    calls = []
+    # Restore module lease state after the test (monkeypatch teardown).
+    monkeypatch.setattr(distributed, "_leases", 0)
+    monkeypatch.setattr(distributed, "_opts", None)
+    monkeypatch.setattr(distributed, "_owned", False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    assert distributed.init_distributed(env) is None
+    assert calls == []
+
+    # acquire() on an externally-owned runtime takes a lease but release()
+    # must never shut that runtime down.
+    shutdowns = []
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: shutdowns.append(1))
+    assert distributed.acquire(env) is True
+    distributed.release()
+    assert shutdowns == []
+
+
+def test_acquire_release_owned_lifecycle(monkeypatch):
+    """Owned path: acquire initializes once; two leases; the runtime is
+    shut down exactly once, on the LAST release.  Mismatched cluster
+    options are refused."""
+    import jax
+    import pytest
+
+    from pslite_tpu.environment import Environment
+    from pslite_tpu.parallel import distributed
+    from pslite_tpu.utils import logging as log
+
+    env = Environment({
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": "12345",
+        "DMLC_RANK": "0",
+    })
+    monkeypatch.setattr(distributed, "_leases", 0)
+    monkeypatch.setattr(distributed, "_opts", None)
+    monkeypatch.setattr(distributed, "_owned", False)
+    state = {"init": 0, "shutdown": 0, "up": False}
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: state["up"])
+
+    def fake_init(**kw):
+        state["init"] += 1
+        state["up"] = True
+
+    def fake_shutdown():
+        state["shutdown"] += 1
+        state["up"] = False
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(jax.distributed, "shutdown", fake_shutdown)
+
+    assert distributed.acquire(env) is True   # initializes
+    assert distributed.acquire(env) is True   # reuses (same opts)
+    assert state["init"] == 1
+
+    # A different cluster description must be refused while leased.
+    env_other = Environment({
+        "DMLC_NUM_WORKER": "2",
+        "DMLC_PS_ROOT_URI": "10.0.0.9",
+        "DMLC_PS_ROOT_PORT": "999",
+        "DMLC_RANK": "0",
+    })
+    with pytest.raises(log.CheckError, match="mismatched"):
+        distributed.acquire(env_other)
+
+    distributed.release()
+    assert state["shutdown"] == 0  # sibling lease still active
+    distributed.release()
+    assert state["shutdown"] == 1  # last owned lease out
+    distributed.release()          # extra release is a no-op
+    assert state["shutdown"] == 1
+
+    # Single-process configs never touch the distributed runtime.
+    env1 = Environment({"DMLC_NUM_WORKER": "1"})
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: (_ for _ in ()).throw(AssertionError))
+    assert distributed.init_distributed(env1) is None
