@@ -1,0 +1,268 @@
+"""Fused ring push_pull kernel (Pallas, TPU): reduce-scatter + server
+update + all-gather in ONE kernel over the ICI ring.
+
+The XLA path of :class:`~pslite_tpu.parallel.engine.CollectiveEngine`
+lowers ``push_pull`` to three ops (``psum_scatter`` → handle →
+``all_gather``): the reduced shard and the updated shard each make an HBM
+round trip between ops, and the all-gather cannot start until the whole
+update finishes.  This kernel is the TPU-native analog of the reference's
+steady-state one-sided RDMA pipeline (rdma_transport.h:323-357 — data
+WRITE + meta WRITE_WITH_IMM per hop, no intermediate copies): a single
+ring program per device where
+
+1. each reduce-scatter hop DMAs a chunk to the right neighbor's VMEM and
+   accumulates the incoming chunk (compute overlapped with the wire),
+2. the server handle (``KVServerDefaultHandle`` semantics,
+   kv_app.h:430-452) is applied in VMEM the moment the owned chunk's sum
+   completes — no HBM round trip, and
+3. the updated chunk immediately re-enters the ring as the all-gather
+   payload while later chunks are still reducing.
+
+Flow control: two communication slots per device with credit semaphores —
+a sender may reuse slot ``k`` only after the receiver signals that it has
+consumed the previous payload in ``k`` (the ring neighbors otherwise have
+no back-pressure and a fast sub-ring could clobber an unread slot; the
+reference's AddressPool plays the same role for RDMA imm slots,
+van_common.h:72-122).
+
+Off-TPU the kernel runs under the Pallas TPU interpreter so the unit
+tests exercise the full semaphore/DMA protocol on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_LANES = 128
+_SUBLANES = 8
+_TILE = _LANES * _SUBLANES  # minimum chunk granularity (floats)
+
+def derive_collective_id(*key_parts) -> int:
+    """Deterministic collective_id in [1, 31] for a ring program.
+
+    Concurrently dispatched collective kernels sharing an id share the
+    global barrier semaphore, so distinct programs should get distinct
+    ids.  The id must ALSO be identical for the same logical program in
+    every process of a multi-process mesh (each process compiles its own
+    copy; mismatched ids would pair mismatched barrier semaphores across
+    devices) — hence a stable hash of the program key rather than a
+    process-local counter.  Collisions degrade to a shared barrier
+    semaphore, which stays correct under the engine's consistent
+    dispatch ordering — never incorrect, only less isolated."""
+    import zlib
+
+    text = "|".join(str(p) for p in key_parts)
+    return 1 + (zlib.crc32(text.encode()) % 31)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def ring_chunk_len(total_len: int, num_devices: int, dtype=None) -> int:
+    """Per-device chunk length (elements) the kernel will use for a
+    bucket of ``total_len`` elements: ceil to the VMEM tile — (8, 128)
+    for 4-byte dtypes, (16, 128) for 2-byte (bf16) sublane packing."""
+    tile = _TILE
+    if dtype is not None and jnp.dtype(dtype).itemsize == 2:
+        tile = 2 * _TILE
+    chunk = -(-total_len // num_devices)
+    return -(-chunk // tile) * tile
+
+
+def _kernel_body(n: int, axis_name: str, handle: Callable):
+    """Build the unrolled kernel for a static ring size ``n``.
+
+    Refs (per device d):
+      grads_ref   ANY  [n*rows, 128] — my worker row, n chunks
+      store_ref   VMEM [rows, 128]   — my store shard (chunk d)
+      out_store   VMEM [rows, 128]
+      out_pulled  ANY  [n*rows, 128] — replicated result
+      send_buf    VMEM [rows, 128]
+      recv_buf    VMEM [2, rows, 128]
+      gchunk      VMEM [rows, 128]   — staging for grads chunks
+      send_sem/recv_sem  DMA((2,))
+      cap_sem     REGULAR((2,))      — credits from my right neighbor
+      local_sem   DMA(())            — HBM<->VMEM staging copies
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(grads_ref, store_ref, out_store_ref, out_pulled_ref,
+               send_buf, recv_buf, gchunk, send_sem, recv_sem, cap_sem,
+               local_sem):
+        d = lax.axis_index(axis_name)
+        right = lax.rem(d + 1, n)
+        left = lax.rem(d + n - 1, n)
+        rows = store_ref.shape[0]
+
+        # Ring-entry barrier: a fast neighbor must not DMA into our
+        # scratch before this invocation owns it.
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(
+            barrier, inc=1, device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+        def stage_grads_chunk(chunk_idx):
+            """DMA grads chunk ``chunk_idx`` (dynamic) HBM -> gchunk."""
+            cp = pltpu.make_async_copy(
+                grads_ref.at[pl.ds(chunk_idx * rows, rows)],
+                gchunk,
+                local_sem,
+            )
+            cp.start()
+            cp.wait()
+
+        def write_pulled(chunk_idx, src_ref):
+            cp = pltpu.make_async_copy(
+                src_ref,
+                out_pulled_ref.at[pl.ds(chunk_idx * rows, rows)],
+                local_sem,
+            )
+            cp.start()
+            cp.wait()
+
+        def send_step(t: int):
+            """DMA send_buf into the right neighbor's recv slot t%2."""
+            if t >= 2:
+                # Credit: my right neighbor freed its slot t%2 (from t-2).
+                pltpu.semaphore_wait(cap_sem.at[t % 2], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=send_buf,
+                dst_ref=recv_buf.at[t % 2],
+                send_sem=send_sem.at[t % 2],
+                recv_sem=recv_sem.at[t % 2],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+
+        def free_slot(k: int):
+            """Tell my LEFT neighbor its outgoing slot k is consumable."""
+            pltpu.semaphore_signal(
+                cap_sem.at[k], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        # ---- phase 1: ring reduce-scatter (steps 0..n-2) ----------------
+        # At step t, send chunk (d + n-1-t) % n; for t>0 that is the chunk
+        # received at t-1 plus my own contribution.  After step n-2 the
+        # chunk received last is (d+1... ) such that my OWNED chunk is d.
+        for t in range(n - 1):
+            c_t = lax.rem(d + n - 1 - t, n)
+            stage_grads_chunk(c_t)
+            if t == 0:
+                send_buf[...] = gchunk[...]
+            else:
+                send_buf[...] = recv_buf[(t - 1) % 2] + gchunk[...]
+                free_slot((t - 1) % 2)
+            send_step(t)
+
+        # ---- boundary: own chunk complete -> apply the server handle ----
+        stage_grads_chunk(d)
+        if n >= 2:
+            summed = recv_buf[(n - 2) % 2] + gchunk[...]
+            free_slot((n - 2) % 2)
+        else:
+            summed = gchunk[...]
+        updated = handle(store_ref[...], summed)
+        out_store_ref[...] = updated
+        write_pulled(d, out_store_ref)
+
+        # ---- phase 2: ring all-gather of updated chunks -----------------
+        # AG step s2 (global t = n-1+s2): send chunk (d - s2) % n; s2=0
+        # sends my freshly updated chunk, later steps forward what arrived.
+        for s2 in range(n - 1):
+            t = n - 1 + s2
+            if s2 == 0:
+                send_buf[...] = updated
+            else:
+                send_buf[...] = recv_buf[(t - 1) % 2]
+                write_pulled(lax.rem(d - s2 + n, n), send_buf)
+                free_slot((t - 1) % 2)
+            send_step(t)
+        if n >= 2:
+            # Final arrival: chunk (d - (n-1)) % n == (d+1) % n.
+            last = 2 * (n - 1) - 1
+            send_buf[...] = recv_buf[last % 2]
+            write_pulled(lax.rem(d + 1, n), send_buf)
+            free_slot(last % 2)
+            # Drain the one un-consumed credit per slot (the credits for
+            # the final sends have no matching wait) so the scratch
+            # semaphores are zero at kernel exit — leftover counts would
+            # poison the next collective kernel reusing them.
+            pltpu.semaphore_wait(cap_sem.at[0], 1)
+            pltpu.semaphore_wait(cap_sem.at[1], 1)
+
+    return kernel
+
+
+def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
+                   axis_name: str, num_devices: int,
+                   collective_id: int = None):
+    """Run the fused RS+update+AG ring inside a shard_map body.
+
+    Args (per-device views inside shard_map):
+      grads_chunks: [n, chunk] — my worker row viewed as n ring chunks
+                    (``chunk`` must be a multiple of 1024 — see
+                    :func:`ring_chunk_len`).
+      store_chunk:  [chunk]    — my store shard.
+      handle:       jittable (store_chunk, summed_grads) -> new_store
+                    applied blockwise in VMEM (elementwise-safe handles
+                    only: padding lanes flow through it).
+    Returns (new_store_chunk [chunk], pulled [n*chunk]).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = num_devices
+    chunk = store_chunk.shape[0]
+    if chunk % _TILE:
+        raise ValueError(f"chunk {chunk} not a multiple of {_TILE}")
+    if collective_id is None:
+        collective_id = derive_collective_id(
+            n, chunk, str(store_chunk.dtype)
+        )
+    rows = chunk // _LANES
+    dtype = store_chunk.dtype
+    g2 = grads_chunks.reshape(n * rows, _LANES)
+    s2 = store_chunk.reshape(rows, _LANES)
+
+    kernel = _kernel_body(n, axis_name, handle)
+    out_store, out_pulled = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, _LANES), dtype),
+            jax.ShapeDtypeStruct((n * rows, _LANES), dtype),
+        ),
+        in_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, _LANES), dtype),       # send_buf
+            pltpu.VMEM((2, rows, _LANES), dtype),    # recv_buf
+            pltpu.VMEM((rows, _LANES), dtype),       # gchunk
+            pltpu.SemaphoreType.DMA((2,)),           # send_sem
+            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
+            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem
+            pltpu.SemaphoreType.DMA,                 # local_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=(pltpu.InterpretParams() if _use_interpret() else False),
+    )(g2, s2)
+    return out_store.reshape(chunk), out_pulled.reshape(n * chunk)

@@ -27,6 +27,7 @@ address" contract (test_benchmark.cc:169-181) maps to donated device buffers
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -104,8 +105,18 @@ class CollectiveEngine:
         server_handle: ServerHandle = "sum",
         profiler=None,
         worker_axis: Optional[str] = None,
+        impl: Optional[str] = None,
     ):
-        """``worker_axis``: optional second mesh axis carrying the worker
+        """``impl``: data-plane implementation for stateless ``push_pull``
+        — ``"xla"`` (default; psum_scatter → handle → all_gather as three
+        XLA ops) or ``"pallas"`` (the fused ring kernel of
+        ``ops/ring_collective.py``: one kernel per device, the update
+        applied in VMEM between the reduce-scatter and all-gather ring
+        phases).  Defaults to env ``PS_ICI_IMPL``.  Configs the kernel
+        cannot serve (1-device mesh, 2-D mesh, stateful handles,
+        non-f32/bf16 dtypes) fall back to XLA transparently.
+
+        ``worker_axis``: optional second mesh axis carrying the worker
         fan-in, decoupling worker count from server-shard count (the
         reference's W workers vs S servers asymmetry, on the collective
         path).  With a 2-D mesh ``(dp, kv)``: gradients are summed over
@@ -140,6 +151,9 @@ class CollectiveEngine:
             local_shard_count(self.mesh) if self._multiprocess
             else self.num_shards
         )
+        self.impl = impl or os.environ.get("PS_ICI_IMPL", "xla")
+        log.check(self.impl in ("xla", "pallas"),
+                  f"unknown engine impl {self.impl!r}")
         self._server_handle = server_handle
         self._buckets: Dict[str, DenseBucket] = {}
         self._stores: Dict[str, jax.Array] = {}
@@ -366,6 +380,81 @@ class CollectiveEngine:
             jitted = jax.jit(fn)
         else:
             raise ValueError(op)
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _effective_impl(self, dtype, resolved_handle) -> str:
+        """Resolve the configured impl against what the fused ring kernel
+        supports; everything else runs the XLA collective path.  Custom
+        callable handles are excluded: the kernel applies the handle
+        blockwise in VMEM (with tile-padding lanes flowing through it),
+        which is only guaranteed sound for the built-in elementwise
+        handles."""
+        if self.impl != "pallas":
+            return "xla"
+        if self.worker_axis is not None or self.num_shards < 2:
+            return "xla"
+        if np.dtype(dtype).itemsize not in (2, 4):
+            return "xla"
+        if callable(resolved_handle):
+            return "xla"
+        return "pallas"
+
+    def _ring_program(self, padded_len: int, dtype, handle_key) -> Callable:
+        """Fused ring RS+update+AG push_pull (ops/ring_collective.py):
+        same signature and cache discipline as the XLA push_pull program.
+
+        The kernel needs the per-device chunk tiled to (sublane, 128);
+        buckets whose chunk is not already tile-aligned are padded inside
+        the program (XLA fuses the pad) and sliced on the way out, so the
+        engine-visible shapes are unchanged."""
+        key = ("ring_pp", padded_len, str(dtype), handle_key)
+        with self._mu:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.ring_collective import (
+            derive_collective_id,
+            ring_chunk_len,
+            ring_push_pull,
+        )
+
+        handle = self._handle_fn(
+            self._server_handle if handle_key == "_default" else handle_key
+        )
+        axis = self.axis
+        n = self.num_shards
+        chunk0 = padded_len // n
+        kchunk = ring_chunk_len(padded_len, n, dtype)
+
+        def body(store_l, grads_l):
+            g = grads_l[0].reshape(n, chunk0)
+            s = store_l
+            if kchunk != chunk0:
+                g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
+                s = jnp.pad(s, (0, kchunk - chunk0))
+            new, pulled = ring_push_pull(
+                g, s, handle, axis, n,
+                collective_id=derive_collective_id(*key),
+            )
+            if kchunk != chunk0:
+                new = new[:chunk0]
+                pulled = pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
+            return new, pulled
+
+        fn = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(axis, None)),
+            out_specs=(P(axis), P(None)),
+        )
+        jitted = jax.jit(fn, donate_argnums=(0,))
         with self._mu:
             self._programs[key] = jitted
         return jitted
@@ -602,9 +691,14 @@ class CollectiveEngine:
                 pulled = outs[-1]
             self._observe(name, "push_pull", bucket, t0)
             return pulled[: bucket.total_len]
-        prog = self._program(
-            "push_pull", bucket.padded_len, bucket.dtype, handle_key
-        )
+        if self._effective_impl(bucket.dtype, resolved) == "pallas":
+            prog = self._ring_program(
+                bucket.padded_len, bucket.dtype, handle_key
+            )
+        else:
+            prog = self._program(
+                "push_pull", bucket.padded_len, bucket.dtype, handle_key
+            )
         with self._bucket_mu[name]:
             new_store, pulled = prog(self._stores[name], g)
             self._stores[name] = new_store

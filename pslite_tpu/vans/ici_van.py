@@ -83,6 +83,7 @@ class _IciDataPlane:
             self.engine = CollectiveEngine(
                 mesh=self._make_mesh(), server_handle=handle,
                 profiler=self.profiler,
+                impl=self.env.find("PS_ICI_IMPL", None),
             )
             self.sparse_engine = SparseEngine(
                 self.engine.mesh, self.engine.axis,

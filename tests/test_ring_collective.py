@@ -1,0 +1,168 @@
+"""Fused ring push_pull kernel (ops/ring_collective.py) — correctness on
+the virtual CPU mesh via the Pallas TPU interpreter, and parity with the
+engine's XLA collective path.
+
+The kernel is the TPU-native analog of the reference's steady-state
+one-sided RDMA pipeline (rdma_transport.h:323-357): reduce-scatter hops,
+server update in VMEM, all-gather hops — one kernel, full semaphore/DMA
+flow control exercised by the interpreter.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pslite_tpu.ops.ring_collective import ring_chunk_len, ring_push_pull
+from pslite_tpu.parallel.engine import CollectiveEngine
+from pslite_tpu.parallel.mesh import shard_map_compat as shard_map
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("kv",))
+
+
+def _run_kernel(n, chunk, handle, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    total = n * chunk
+    grads = rng.randn(n, total).astype(dtype)
+    store0 = rng.randn(total).astype(dtype)
+
+    def body(store_l, grads_l):
+        g = grads_l[0].reshape(n, chunk)
+        return ring_push_pull(g, store_l, handle, "kv", n)
+
+    f = jax.jit(
+        shard_map(
+            body,
+            mesh=_mesh(n),
+            in_specs=(P("kv"), P("kv", None)),
+            out_specs=(P("kv"), P(None)),
+        )
+    )
+    new_store, pulled = f(jnp.asarray(store0), jnp.asarray(grads))
+    return grads, store0, np.asarray(new_store), np.asarray(pulled)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ring_sum_matches_host(n):
+    chunk = ring_chunk_len(n * 1024, n)
+    grads, store0, new_store, pulled = _run_kernel(
+        n, chunk, lambda s, a: s + a
+    )
+    want = store0 + grads.sum(0)
+    np.testing.assert_allclose(new_store, want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pulled, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_sgd_handle():
+    n = 4
+    chunk = ring_chunk_len(n * 1024, n)
+    lr = 0.05
+    grads, store0, new_store, pulled = _run_kernel(
+        n, chunk, lambda s, a: s - lr * a, seed=1
+    )
+    want = store0 - lr * grads.sum(0)
+    np.testing.assert_allclose(new_store, want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pulled, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_bf16():
+    n = 2
+    chunk = ring_chunk_len(n * 2048, n, jnp.bfloat16)
+    assert chunk % 2048 == 0  # (16, 128) tile for 2-byte dtypes
+    rng = np.random.RandomState(2)
+    total = n * chunk
+    grads = rng.randn(n, total).astype(np.float32)
+    store0 = rng.randn(total).astype(np.float32)
+
+    def body(store_l, grads_l):
+        g = grads_l[0].reshape(n, chunk)
+        return ring_push_pull(g, store_l, lambda s, a: s + a, "kv", n)
+
+    f = jax.jit(
+        shard_map(
+            body,
+            mesh=_mesh(n),
+            in_specs=(P("kv"), P("kv", None)),
+            out_specs=(P("kv"), P(None)),
+        )
+    )
+    new_store, pulled = f(
+        jnp.asarray(store0, jnp.bfloat16), jnp.asarray(grads, jnp.bfloat16)
+    )
+    want = (
+        store0.astype(np.float32)
+        + grads.astype(np.float32).sum(0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_store, np.float32), want, rtol=0.05, atol=0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(pulled, np.float32), want, rtol=0.05, atol=0.1
+    )
+
+
+class TestEnginePallasImpl:
+    """Engine integration: impl='pallas' must agree with impl='xla'."""
+
+    def _engines(self, n, handle="sum"):
+        mesh = _mesh(n)
+        ex = CollectiveEngine(mesh=mesh, server_handle=handle, impl="xla")
+        ep = CollectiveEngine(mesh=mesh, server_handle=handle, impl="pallas")
+        return ex, ep
+
+    def test_push_pull_parity_tile_aligned(self):
+        n = 4
+        ex, ep = self._engines(n)
+        keys = np.arange(4, dtype=np.uint64)
+        val_len = 1024 * n // 4  # total = 4096 = n*1024, tile-aligned
+        rng = np.random.RandomState(3)
+        grads = rng.randn(n, 4 * val_len).astype(np.float32)
+        for eng in (ex, ep):
+            eng.register_dense("b", keys, val_len)
+        for step in range(3):
+            ox = np.asarray(ex.push_pull("b", grads * (step + 1)))
+            op = np.asarray(ep.push_pull("b", grads * (step + 1)))
+            np.testing.assert_allclose(op, ox, rtol=1e-5, atol=1e-5)
+
+    def test_push_pull_parity_needs_padding(self):
+        # total = 8*300 = 2400 -> chunk0 = 300, kernel pads to 1024.
+        n = 8
+        ex, ep = self._engines(n, handle="sgd:0.1")
+        keys = np.arange(8, dtype=np.uint64)
+        rng = np.random.RandomState(4)
+        grads = rng.randn(n, 8 * 300).astype(np.float32)
+        for eng in (ex, ep):
+            eng.register_dense("p", keys, 300)
+        ox = np.asarray(ex.push_pull("p", grads))
+        op = np.asarray(ep.push_pull("p", grads))
+        np.testing.assert_allclose(op, ox, rtol=1e-5, atol=1e-5)
+
+    def test_fallbacks_still_work(self):
+        # 1-device mesh and callable handles fall back to XLA silently.
+        ep = CollectiveEngine(mesh=_mesh(1), impl="pallas")
+        keys = np.arange(2, dtype=np.uint64)
+        ep.register_dense("f", keys, 8)
+        out = np.asarray(ep.push_pull("f", np.ones(16, np.float32)))
+        np.testing.assert_allclose(out, np.ones(16), rtol=1e-6)
+
+        ep2 = CollectiveEngine(mesh=_mesh(2), impl="pallas")
+        ep2.register_dense("g", keys, 1024)
+        custom = lambda s, a: s + 2.0 * a  # callable -> xla path
+        grads = np.ones((2, 2048), np.float32)
+        out = np.asarray(ep2.push_pull("g", grads, handle=custom))
+        np.testing.assert_allclose(out, 4.0 * np.ones(2048), rtol=1e-6)
+
+    def test_pallas_then_pull_consistent(self):
+        # pull (XLA program) must see the ring kernel's store update.
+        n = 4
+        _, ep = self._engines(n)
+        keys = np.arange(4, dtype=np.uint64)
+        ep.register_dense("c", keys, 1024)
+        grads = np.ones((n, 4096), np.float32)
+        ep.push_pull("c", grads)
+        pulled = np.asarray(ep.pull("c"))
+        np.testing.assert_allclose(pulled, n * np.ones(4096), rtol=1e-6)
