@@ -10,7 +10,7 @@ steady-state one-sided RDMA pipeline (rdma_transport.h:323-357 — data
 WRITE + meta WRITE_WITH_IMM per hop, no intermediate copies): a single
 ring program per device where
 
-1. each reduce-scatter hop DMAs a chunk to the right neighbor's VMEM and
+1. each reduce-scatter hop DMAs a chunk to the neighbor's VMEM and
    accumulates the incoming chunk (compute overlapped with the wire),
 2. the server handle (``KVServerDefaultHandle`` semantics,
    kv_app.h:430-452) is applied in VMEM the moment the owned chunk's sum
@@ -18,12 +18,20 @@ ring program per device where
 3. the updated chunk immediately re-enters the ring as the all-gather
    payload while later chunks are still reducing.
 
-Flow control: two communication slots per device with credit semaphores —
-a sender may reuse slot ``k`` only after the receiver signals that it has
-consumed the previous payload in ``k`` (the ring neighbors otherwise have
-no back-pressure and a fast sub-ring could clobber an unread slot; the
-reference's AddressPool plays the same role for RDMA imm slots,
-van_common.h:72-122).
+**Bidirectional mode** (default): each chunk is split in half and the
+halves travel the ring in opposite directions simultaneously — both ICI
+link directions carry payload every step, doubling the per-hop bandwidth
+exactly like XLA's own bidirectional collectives (and like the
+reference's multi-rail MultiVan splits traffic across NICs,
+multi_van.h:173-197).  The two directions are independent half-rings
+whose remote DMAs are started back-to-back and waited together.
+
+Flow control: two communication slots per direction per device with
+credit semaphores — a sender may reuse slot ``k`` only after the receiver
+signals that it has consumed the previous payload in ``k`` (the ring
+neighbors otherwise have no back-pressure and a fast sub-ring could
+clobber an unread slot; the reference's AddressPool plays the same role
+for RDMA imm slots, van_common.h:72-122).
 
 Off-TPU the kernel runs under the Pallas TPU interpreter so the unit
 tests exercise the full semaphore/DMA protocol on the virtual CPU mesh.
@@ -39,7 +47,12 @@ from jax import lax
 
 _LANES = 128
 _SUBLANES = 8
-_TILE = _LANES * _SUBLANES  # minimum chunk granularity (floats)
+_TILE = _LANES * _SUBLANES  # minimum chunk granularity (fp32 elements)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
 
 def derive_collective_id(*key_parts) -> int:
     """Deterministic collective_id in [1, 31] for a ring program.
@@ -59,35 +72,44 @@ def derive_collective_id(*key_parts) -> int:
     return 1 + (zlib.crc32(text.encode()) % 31)
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def ring_chunk_len(total_len: int, num_devices: int, dtype=None) -> int:
+def ring_chunk_len(total_len: int, num_devices: int, dtype=None,
+                   bidir: bool = True) -> int:
     """Per-device chunk length (elements) the kernel will use for a
     bucket of ``total_len`` elements: ceil to the VMEM tile — (8, 128)
-    for 4-byte dtypes, (16, 128) for 2-byte (bf16) sublane packing."""
+    for 4-byte dtypes, (16, 128) for 2-byte (bf16) sublane packing —
+    doubled in bidirectional mode so each half-chunk stays tiled."""
     tile = _TILE
     if dtype is not None and jnp.dtype(dtype).itemsize == 2:
         tile = 2 * _TILE
+    if bidir:
+        tile = 2 * tile
     chunk = -(-total_len // num_devices)
     return -(-chunk // tile) * tile
 
 
-def _kernel_body(n: int, axis_name: str, handle: Callable):
-    """Build the unrolled kernel for a static ring size ``n``.
+def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int):
+    """Build the unrolled kernel for a static ring size ``n`` with
+    ``ndir`` directions (1 = clockwise only, 2 = bidirectional halves).
 
-    Refs (per device d):
+    Refs (per device d; rows = chunk rows, h = rows // ndir):
       grads_ref   ANY  [n*rows, 128] — my worker row, n chunks
       store_ref   VMEM [rows, 128]   — my store shard (chunk d)
       out_store   VMEM [rows, 128]
       out_pulled  ANY  [n*rows, 128] — replicated result
-      send_buf    VMEM [rows, 128]
-      recv_buf    VMEM [2, rows, 128]
-      gchunk      VMEM [rows, 128]   — staging for grads chunks
-      send_sem/recv_sem  DMA((2,))
-      cap_sem     REGULAR((2,))      — credits from my right neighbor
+      send_buf    VMEM [ndir, h, 128]
+      recv_buf    VMEM [ndir, 2, h, 128]
+      gchunk      VMEM [ndir, h, 128] — staging for grads half-chunks
+      send_sem/recv_sem  DMA((ndir, 2))
+      cap_sem     REGULAR((ndir, 2)) — credits from the downstream peer
       local_sem   DMA(())            — HBM<->VMEM staging copies
+
+    Direction 0 sends to the RIGHT neighbor (receives from left);
+    direction 1 sends to the LEFT (receives from right).  Per direction
+    ``dir`` the chunk schedule mirrors:
+      RS step t   : send chunk (d -+ (1 + t)) % n
+      owned chunk : d (both directions — each owns its half)
+      AG step s2  : send chunk (d -+ s2) % n
+    (``-`` for dir 0, ``+`` for dir 1).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -99,6 +121,30 @@ def _kernel_body(n: int, axis_name: str, handle: Callable):
         right = lax.rem(d + 1, n)
         left = lax.rem(d + n - 1, n)
         rows = store_ref.shape[0]
+        h = rows // ndir
+        dirs = range(ndir)
+
+        def send_peer(dr):
+            return right if dr == 0 else left
+
+        def credit_peer(dr):
+            # The device whose sends I consume (upstream): I signal it
+            # when one of MY slots frees; MY credits arrive from my
+            # downstream peer symmetrically.
+            return left if dr == 0 else right
+
+        def rs_chunk(dr, t):
+            # Chunk sent at RS step t (also the chunk RECEIVED at t-1
+            # plus my own contribution); t = n-1 yields the owned chunk d.
+            if dr == 0:
+                return lax.rem(d + n - 1 - t, n)
+            return lax.rem(d + 1 + t, n)
+
+        def ag_chunk(dr, s2):
+            # Chunk sent at AG step s2 (s2=0 is my updated chunk d).
+            if dr == 0:
+                return lax.rem(d - s2 + n, n)
+            return lax.rem(d + s2, n)
 
         # Ring-entry barrier: a fast neighbor must not DMA into our
         # scratch before this invocation owns it.
@@ -111,132 +157,149 @@ def _kernel_body(n: int, axis_name: str, handle: Callable):
             device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-        def stage_grads_chunk(chunk_idx):
-            """DMA grads chunk ``chunk_idx`` (dynamic) HBM -> gchunk."""
+        def stage_grads(dr, chunk_idx):
+            """DMA my grads half-chunk (dynamic index) HBM -> gchunk."""
             cp = pltpu.make_async_copy(
-                grads_ref.at[pl.ds(chunk_idx * rows, rows)],
-                gchunk,
+                grads_ref.at[pl.ds(chunk_idx * rows + dr * h, h)],
+                gchunk.at[dr],
                 local_sem,
             )
             cp.start()
             cp.wait()
 
-        def write_pulled(chunk_idx, src_ref):
+        def write_pulled(dr, chunk_idx, src_ref):
             cp = pltpu.make_async_copy(
                 src_ref,
-                out_pulled_ref.at[pl.ds(chunk_idx * rows, rows)],
+                out_pulled_ref.at[pl.ds(chunk_idx * rows + dr * h, h)],
                 local_sem,
             )
             cp.start()
             cp.wait()
 
-        def send_step(t: int):
-            """DMA send_buf into the right neighbor's recv slot t%2."""
+        def start_send(dr, t):
+            """Start the remote DMA of send_buf[dr] into the peer's
+            recv slot t%2; returns the handle for a later wait."""
             if t >= 2:
-                # Credit: my right neighbor freed its slot t%2 (from t-2).
-                pltpu.semaphore_wait(cap_sem.at[t % 2], 1)
+                # Credit: my downstream peer freed its slot t%2 (t-2).
+                pltpu.semaphore_wait(cap_sem.at[dr, t % 2], 1)
             rdma = pltpu.make_async_remote_copy(
-                src_ref=send_buf,
-                dst_ref=recv_buf.at[t % 2],
-                send_sem=send_sem.at[t % 2],
-                recv_sem=recv_sem.at[t % 2],
-                device_id=right,
+                src_ref=send_buf.at[dr],
+                dst_ref=recv_buf.at[dr, t % 2],
+                send_sem=send_sem.at[dr, t % 2],
+                recv_sem=recv_sem.at[dr, t % 2],
+                device_id=send_peer(dr),
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
             rdma.start()
-            rdma.wait()
+            return rdma
 
-        def free_slot(k: int):
-            """Tell my LEFT neighbor its outgoing slot k is consumable."""
+        def free_slot(dr, k):
+            """Tell my upstream peer its outgoing slot k is consumable."""
             pltpu.semaphore_signal(
-                cap_sem.at[k], inc=1, device_id=left,
+                cap_sem.at[dr, k], inc=1, device_id=credit_peer(dr),
                 device_id_type=pltpu.DeviceIdType.LOGICAL)
 
         # ---- phase 1: ring reduce-scatter (steps 0..n-2) ----------------
-        # At step t, send chunk (d + n-1-t) % n; for t>0 that is the chunk
-        # received at t-1 plus my own contribution.  After step n-2 the
-        # chunk received last is (d+1... ) such that my OWNED chunk is d.
         for t in range(n - 1):
-            c_t = lax.rem(d + n - 1 - t, n)
-            stage_grads_chunk(c_t)
-            if t == 0:
-                send_buf[...] = gchunk[...]
-            else:
-                send_buf[...] = recv_buf[(t - 1) % 2] + gchunk[...]
-                free_slot((t - 1) % 2)
-            send_step(t)
+            rdmas = []
+            for dr in dirs:
+                stage_grads(dr, rs_chunk(dr, t))
+                if t == 0:
+                    send_buf[dr] = gchunk[dr]
+                else:
+                    send_buf[dr] = recv_buf[dr, (t - 1) % 2] + gchunk[dr]
+                    free_slot(dr, (t - 1) % 2)
+                rdmas.append(start_send(dr, t))
+            for rdma in rdmas:
+                rdma.wait()
 
         # ---- boundary: own chunk complete -> apply the server handle ----
-        stage_grads_chunk(d)
-        if n >= 2:
-            summed = recv_buf[(n - 2) % 2] + gchunk[...]
-            free_slot((n - 2) % 2)
-        else:
-            summed = gchunk[...]
-        updated = handle(store_ref[...], summed)
-        out_store_ref[...] = updated
-        write_pulled(d, out_store_ref)
+        updated = []
+        for dr in dirs:
+            stage_grads(dr, d)
+            if n >= 2:
+                summed = recv_buf[dr, (n - 2) % 2] + gchunk[dr]
+                free_slot(dr, (n - 2) % 2)
+            else:
+                summed = gchunk[dr]
+            # Elementwise handle: applying per half == applying whole.
+            up = handle(store_ref[pl.ds(dr * h, h)], summed)
+            updated.append(up)
+            out_store_ref[pl.ds(dr * h, h)] = up
+            write_pulled(dr, d, out_store_ref.at[pl.ds(dr * h, h)])
 
         # ---- phase 2: ring all-gather of updated chunks -----------------
-        # AG step s2 (global t = n-1+s2): send chunk (d - s2) % n; s2=0
-        # sends my freshly updated chunk, later steps forward what arrived.
         for s2 in range(n - 1):
             t = n - 1 + s2
-            if s2 == 0:
-                send_buf[...] = updated
-            else:
-                send_buf[...] = recv_buf[(t - 1) % 2]
-                write_pulled(lax.rem(d - s2 + n, n), send_buf)
-                free_slot((t - 1) % 2)
-            send_step(t)
+            rdmas = []
+            for dr in dirs:
+                if s2 == 0:
+                    send_buf[dr] = updated[dr]
+                else:
+                    send_buf[dr] = recv_buf[dr, (t - 1) % 2]
+                    write_pulled(dr, ag_chunk(dr, s2), send_buf.at[dr])
+                    free_slot(dr, (t - 1) % 2)
+                rdmas.append(start_send(dr, t))
+            for rdma in rdmas:
+                rdma.wait()
         if n >= 2:
-            # Final arrival: chunk (d - (n-1)) % n == (d+1) % n.
             last = 2 * (n - 1) - 1
-            send_buf[...] = recv_buf[last % 2]
-            write_pulled(lax.rem(d + 1, n), send_buf)
-            free_slot(last % 2)
-            # Drain the one un-consumed credit per slot (the credits for
-            # the final sends have no matching wait) so the scratch
-            # semaphores are zero at kernel exit — leftover counts would
-            # poison the next collective kernel reusing them.
-            pltpu.semaphore_wait(cap_sem.at[0], 1)
-            pltpu.semaphore_wait(cap_sem.at[1], 1)
+            for dr in dirs:
+                # Final arrival: chunk (d -+ (n-1)) % n.
+                send_buf[dr] = recv_buf[dr, last % 2]
+                write_pulled(dr, ag_chunk(dr, n - 1), send_buf.at[dr])
+                free_slot(dr, last % 2)
+                # Drain the one un-consumed credit per slot (the credits
+                # for the final sends have no matching wait) so the
+                # scratch semaphores are zero at kernel exit — leftover
+                # counts would poison the next collective kernel.
+                pltpu.semaphore_wait(cap_sem.at[dr, 0], 1)
+                pltpu.semaphore_wait(cap_sem.at[dr, 1], 1)
 
     return kernel
 
 
 def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                    axis_name: str, num_devices: int,
-                   collective_id: int = None):
+                   collective_id: int = None, bidir: bool = True):
     """Run the fused RS+update+AG ring inside a shard_map body.
 
     Args (per-device views inside shard_map):
       grads_chunks: [n, chunk] — my worker row viewed as n ring chunks
-                    (``chunk`` must be a multiple of 1024 — see
-                    :func:`ring_chunk_len`).
+                    (``chunk`` must satisfy :func:`ring_chunk_len` for
+                    the chosen ``bidir`` mode and dtype).
       store_chunk:  [chunk]    — my store shard.
       handle:       jittable (store_chunk, summed_grads) -> new_store
                     applied blockwise in VMEM (elementwise-safe handles
-                    only: padding lanes flow through it).
+                    only: padding lanes flow through it, and in
+                    bidirectional mode it runs once per half-chunk).
+      bidir:        split each chunk across both ring directions (both
+                    ICI link directions utilized — the default).
     Returns (new_store_chunk [chunk], pulled [n*chunk]).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = num_devices
+    ndir = 2 if bidir else 1
     chunk = store_chunk.shape[0]
-    if chunk % _TILE:
-        raise ValueError(f"chunk {chunk} not a multiple of {_TILE}")
+    min_tile = _TILE * ndir * (2 if store_chunk.dtype.itemsize == 2 else 1)
+    if chunk % min_tile:
+        raise ValueError(
+            f"chunk {chunk} not a multiple of {min_tile} "
+            f"(bidir={bidir}, dtype={store_chunk.dtype})"
+        )
     if collective_id is None:
         collective_id = derive_collective_id(
-            n, chunk, str(store_chunk.dtype)
+            n, chunk, str(store_chunk.dtype), ndir
         )
     rows = chunk // _LANES
+    h = rows // ndir
     dtype = store_chunk.dtype
     g2 = grads_chunks.reshape(n * rows, _LANES)
     s2 = store_chunk.reshape(rows, _LANES)
 
-    kernel = _kernel_body(n, axis_name, handle)
+    kernel = _kernel_body(n, axis_name, handle, ndir)
     out_store, out_pulled = pl.pallas_call(
         kernel,
         out_shape=(
@@ -252,13 +315,13 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
             pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
-            pltpu.VMEM((rows, _LANES), dtype),       # send_buf
-            pltpu.VMEM((2, rows, _LANES), dtype),    # recv_buf
-            pltpu.VMEM((rows, _LANES), dtype),       # gchunk
-            pltpu.SemaphoreType.DMA((2,)),           # send_sem
-            pltpu.SemaphoreType.DMA((2,)),           # recv_sem
-            pltpu.SemaphoreType.REGULAR((2,)),       # cap_sem
-            pltpu.SemaphoreType.DMA,                 # local_sem
+            pltpu.VMEM((ndir, h, _LANES), dtype),     # send_buf
+            pltpu.VMEM((ndir, 2, h, _LANES), dtype),  # recv_buf
+            pltpu.VMEM((ndir, h, _LANES), dtype),     # gchunk
+            pltpu.SemaphoreType.DMA((ndir, 2)),       # send_sem
+            pltpu.SemaphoreType.DMA((ndir, 2)),       # recv_sem
+            pltpu.SemaphoreType.REGULAR((ndir, 2)),   # cap_sem
+            pltpu.SemaphoreType.DMA,                  # local_sem
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id

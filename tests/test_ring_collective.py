@@ -24,7 +24,7 @@ def _mesh(n):
     return Mesh(np.array(jax.devices()[:n]), ("kv",))
 
 
-def _run_kernel(n, chunk, handle, dtype=np.float32, seed=0):
+def _run_kernel(n, chunk, handle, dtype=np.float32, seed=0, bidir=True):
     rng = np.random.RandomState(seed)
     total = n * chunk
     grads = rng.randn(n, total).astype(dtype)
@@ -32,7 +32,7 @@ def _run_kernel(n, chunk, handle, dtype=np.float32, seed=0):
 
     def body(store_l, grads_l):
         g = grads_l[0].reshape(n, chunk)
-        return ring_push_pull(g, store_l, handle, "kv", n)
+        return ring_push_pull(g, store_l, handle, "kv", n, bidir=bidir)
 
     f = jax.jit(
         shard_map(
@@ -47,10 +47,11 @@ def _run_kernel(n, chunk, handle, dtype=np.float32, seed=0):
 
 
 @pytest.mark.parametrize("n", [2, 4, 8])
-def test_ring_sum_matches_host(n):
-    chunk = ring_chunk_len(n * 1024, n)
+@pytest.mark.parametrize("bidir", [True, False])
+def test_ring_sum_matches_host(n, bidir):
+    chunk = ring_chunk_len(n * 1024, n, bidir=bidir)
     grads, store0, new_store, pulled = _run_kernel(
-        n, chunk, lambda s, a: s + a
+        n, chunk, lambda s, a: s + a, bidir=bidir
     )
     want = store0 + grads.sum(0)
     np.testing.assert_allclose(new_store, want, rtol=1e-5, atol=1e-5)
