@@ -894,6 +894,104 @@ class CollectiveEngine:
         with self._bucket_mu[name]:
             self._stores[name] = placed
 
+    def reshard(self, mesh, axis_name: Optional[str] = None) -> None:
+        """Re-lay every registered bucket (store + optimizer state) onto
+        a new mesh — the engine-side ELASTIC tier.
+
+        The reference's recovery path re-admits a node into the same
+        roster under the dead node's id (van.cc:266-332); on the
+        collective data plane the roster IS the mesh, so scaling the
+        server fleet up/down means resharding the live state onto the
+        new device set.  Key-range shards are recut for the new shard
+        count (GetServerKeyRanges semantics, postoffice.cc:257-268),
+        optimizer state moves with the stores, and compiled programs are
+        dropped and rebuilt lazily on first touch — exactly like
+        first-push rendezvous after a topology change.
+
+        Single-process meshes on both sides (state moves via a host
+        round trip); 1-D layouts only.  Callers' grads arrays must use
+        the NEW worker fan-in after this returns.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .placement import mesh_is_multiprocess
+
+        log.check(self.worker_axis is None, "reshard is 1-D-mesh only")
+        log.check(
+            not self._multiprocess and not mesh_is_multiprocess(mesh),
+            "reshard requires single-process meshes on both sides",
+        )
+        axis = axis_name or self.axis
+        log.check(axis in mesh.axis_names,
+                  f"axis {axis!r} not in new mesh")
+        with self._mu:
+            names = list(self._buckets)
+        ordered = sorted(names)
+        for n in ordered:
+            self._bucket_mu[n].acquire()
+        try:
+            # Snapshot all live state to host while every bucket is
+            # quiesced (the donated buffers cannot be in flight).
+            snap = {}
+            for n in names:
+                b = self._buckets[n]
+                store = np.asarray(self._stores[n])[: b.total_len].copy()
+                opt = None
+                if n in self._opt_states:
+                    opt = (
+                        self._opt_kinds[n],
+                        [np.asarray(a).copy()
+                         for a in self._opt_states[n]],
+                    )
+                snap[n] = (b, store, opt)
+
+            self.mesh = mesh
+            self.axis = axis
+            self.num_shards = mesh.shape[axis]
+            self.num_workers = self.num_shards
+            self._multiprocess = False
+            self._local_shard_count = self.num_shards
+            with self._mu:
+                self._programs.clear()
+            sharding = NamedSharding(mesh, P(axis))
+
+            def _repad(flat_host, total, padded, dt):
+                out = np.zeros(padded, dtype=np.dtype(dt))
+                out[:total] = flat_host[:total]
+                return jax.device_put(out, sharding)
+
+            for n in names:
+                b, store, opt = snap[n]
+                b.padded_len = (
+                    -(-b.total_len // self.num_shards) * self.num_shards
+                )
+                self._stores[n] = _repad(
+                    store, b.total_len, b.padded_len, b.dtype
+                )
+                if opt is None:
+                    self._opt_states.pop(n, None)
+                    self._opt_kinds.pop(n, None)
+                    continue
+                kind, arrs = opt
+                if kind == "sgd_momentum":
+                    state = (_repad(arrs[0], b.total_len, b.padded_len,
+                                    b.dtype),)
+                else:  # adam: m, v, per-shard step counter
+                    step = float(arrs[2][0]) if len(arrs[2]) else 0.0
+                    state = (
+                        _repad(arrs[0], b.total_len, b.padded_len, b.dtype),
+                        _repad(arrs[1], b.total_len, b.padded_len, b.dtype),
+                        jax.device_put(
+                            np.full(self.num_shards, step, np.float32),
+                            sharding,
+                        ),
+                    )
+                self._opt_states[n] = state
+        finally:
+            for n in reversed(ordered):
+                self._bucket_mu[n].release()
+
     def block(self, name: Optional[str] = None) -> None:
         """Wait for outstanding device work (ZPush/Wait semantics)."""
         if name is not None:

@@ -334,5 +334,59 @@ class SparseEngine:
         with self._table_mu[name]:
             self._stores[name] = placed
 
+    def reshard(self, mesh, axis_name: Optional[str] = None) -> None:
+        """Re-lay every registered table onto a new mesh — the sparse
+        half of the engine elastic tier (see CollectiveEngine.reshard).
+
+        Rows are de-interleaved to global order on the host, the
+        row→shard mapping is recut for the new shard count (global row r
+        lives on shard ``r % S`` — the modulo sharding that load-balances
+        skewed key distributions), and programs rebuild lazily."""
+        from .placement import mesh_is_multiprocess
+
+        log.check(
+            not self._multiprocess and not mesh_is_multiprocess(mesh),
+            "reshard requires single-process meshes on both sides",
+        )
+        axis = axis_name or self.axis
+        log.check(axis in mesh.axis_names,
+                  f"axis {axis!r} not in new mesh")
+        with self._mu:
+            names = list(self._tables)
+        ordered = sorted(names)
+        for n in ordered:
+            self._table_mu[n].acquire()
+        try:
+            snap = {}
+            for n in names:
+                t = self._tables[n]
+                host = np.asarray(self._stores[n])
+                S, rps = self.num_shards, t.rows_per_shard
+                glob = (
+                    host.reshape(S, rps, t.dim)
+                    .transpose(1, 0, 2)
+                    .reshape(-1, t.dim)[: t.num_rows]
+                    .copy()
+                )
+                snap[n] = (t, glob)
+
+            self.mesh = mesh
+            self.axis = axis
+            self.num_shards = mesh.shape[axis]
+            self._multiprocess = False
+            self._local_shard_count = self.num_shards
+            with self._mu:
+                self._programs.clear()
+            for n in names:
+                t, glob = snap[n]
+                # register_sparse re-interleaves init rows for the new
+                # shard count and replaces the table/store in place.
+                self.register_sparse(
+                    n, t.num_rows, t.dim, dtype=t.dtype, init=glob
+                )
+        finally:
+            for n in reversed(ordered):
+                self._table_mu[n].release()
+
     def table(self, name: str) -> SparseTable:
         return self._tables[name]

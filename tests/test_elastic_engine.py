@@ -1,0 +1,126 @@
+"""Engine elastic tier: reshard live dense buckets and sparse tables onto
+a different mesh (scale the server fleet up/down) without losing state.
+
+The reference's elasticity is roster-level (dead-id inheritance,
+van.cc:266-332; keepalive restart); on the collective data plane the
+roster IS the mesh, so the equivalent capability is state-preserving
+resharding with key ranges recut for the new shard count
+(postoffice.cc:257-268 semantics).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from pslite_tpu.parallel.engine import CollectiveEngine
+from pslite_tpu.parallel.sparse import SparseEngine
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("kv",))
+
+
+def test_dense_shrink_then_grow():
+    eng = CollectiveEngine(mesh=_mesh(8))
+    keys = np.arange(6, dtype=np.uint64)
+    val_len = 100  # total=600: padded 600->608 on 8, ->600 on 4
+    eng.register_dense("b", keys, val_len)
+    rng = np.random.RandomState(0)
+    g8 = rng.randn(8, 600).astype(np.float32)
+    out = np.asarray(eng.push_pull("b", g8))
+    want = g8.sum(0)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    eng.reshard(_mesh(4))
+    assert eng.num_shards == 4
+    # State survived the recut.
+    np.testing.assert_allclose(
+        np.asarray(eng.pull("b")), want, rtol=1e-5
+    )
+    # Continued training on the new fan-in.
+    g4 = rng.randn(4, 600).astype(np.float32)
+    out = np.asarray(eng.push_pull("b", g4))
+    want = want + g4.sum(0)
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+    eng.reshard(_mesh(8))
+    np.testing.assert_allclose(
+        np.asarray(eng.pull("b")), want, rtol=1e-4
+    )
+
+
+def test_dense_opt_state_survives():
+    eng = CollectiveEngine(mesh=_mesh(8), server_handle="sgd_momentum:0.1,0.9")
+    keys = np.arange(4, dtype=np.uint64)
+    eng.register_dense("m", keys, 64)
+    g = np.ones((8, 256), np.float32)
+    a = np.asarray(eng.push_pull("m", g))
+    eng.reshard(_mesh(4))
+    b = np.asarray(eng.push_pull("m", np.ones((4, 256), np.float32)))
+
+    # Host replay of sgd+momentum (store0=0, mom0=0): step 1 sums 8
+    # worker rows of ones, step 2 (after reshard) sums 4.
+    store, mom = 0.0, 0.0
+    expect = []
+    for total in (8.0, 4.0):
+        mom = 0.9 * mom + total
+        store = store - 0.1 * mom
+        expect.append(store)
+    np.testing.assert_allclose(a, np.full(256, expect[0]), rtol=1e-5)
+    np.testing.assert_allclose(b, np.full(256, expect[1]), rtol=1e-5)
+
+    kind, state = eng.opt_state("m")
+    assert kind == "sgd_momentum"
+    np.testing.assert_allclose(
+        np.asarray(state[0])[:256], mom, rtol=1e-5
+    )
+
+
+def test_dense_adam_step_counter_survives():
+    eng = CollectiveEngine(mesh=_mesh(4), server_handle="adam")
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("a", keys, 64)
+    eng.push_pull("a", np.ones((4, 128), np.float32))
+    eng.reshard(_mesh(2))
+    kind, state = eng.opt_state("a")
+    assert kind == "adam"
+    # step counter: one entry per (new) shard, value preserved.
+    assert state[2].shape == (2,)
+    np.testing.assert_allclose(np.asarray(state[2]), 1.0)
+    eng.push_pull("a", np.ones((2, 128), np.float32))
+    _, state = eng.opt_state("a")
+    np.testing.assert_allclose(np.asarray(state[2]), 2.0)
+
+
+def test_sparse_reshard_preserves_rows():
+    se = SparseEngine(_mesh(8))
+    rows, dim = 37, 8  # deliberately not divisible by either shard count
+    init = np.arange(rows * dim, dtype=np.float32).reshape(rows, dim)
+    se.register_sparse("t", rows, dim, init=init)
+    idx = np.array([0, 5, 17, 36], dtype=np.int32)
+    got = np.asarray(se.pull("t", np.broadcast_to(idx, (8, 4))))
+    np.testing.assert_allclose(got[0], init[idx], rtol=1e-6)
+
+    se.reshard(_mesh(4))
+    assert se.num_shards == 4
+    got = np.asarray(se.pull("t", np.broadcast_to(idx, (4, 4))))
+    np.testing.assert_allclose(got[0], init[idx], rtol=1e-6)
+
+    # Pushes keep working on the new mesh.
+    grads = np.ones((4, 4, dim), np.float32)
+    se.push("t", np.broadcast_to(idx, (4, 4)), grads)
+    got = np.asarray(se.pull("t", np.broadcast_to(idx, (4, 4))))
+    np.testing.assert_allclose(got[0], init[idx] + 4.0, rtol=1e-5)
+
+
+def test_reshard_rejects_2d_layout():
+    import pytest
+
+    from pslite_tpu.parallel.mesh import make_mesh
+    from pslite_tpu.utils.logging import CheckError
+
+    mesh = make_mesh((2, 4), ("dp", "kv"))
+    eng = CollectiveEngine(mesh=mesh, worker_axis="dp")
+    with pytest.raises(CheckError):
+        eng.reshard(_mesh(4))
