@@ -399,6 +399,13 @@ class CollectiveEngine:
             return "xla"
         if callable(resolved_handle):
             return "xla"
+        if self._multiprocess:
+            # Real multi-host TPU rings ride ICI fine, but the off-TPU
+            # interpreter cannot DMA to another process's devices.
+            import jax
+
+            if jax.default_backend() != "tpu":
+                return "xla"
         return "pallas"
 
     def _ring_program(self, padded_len: int, dtype, handle_key) -> Callable:

@@ -106,6 +106,42 @@ def test_ring_bf16():
     )
 
 
+def test_ring_randomized_configs():
+    """Property check across random ring sizes / chunk shapes / handles:
+    the fused kernel must match the host reduction bit-for-bit-ish for
+    any tile-legal geometry."""
+    rng = np.random.RandomState(99)
+    handles = {
+        "sum": lambda s, a: s + a,
+        "assign": lambda s, a: a,
+        "sgd": lambda s, a: s - 0.3 * a,
+    }
+    for trial in range(4):
+        n = int(rng.choice([2, 3, 4, 8]))
+        bidir = bool(rng.randint(2))
+        chunk = ring_chunk_len(
+            n * int(rng.randint(1, 5)) * 1024, n, bidir=bidir
+        )
+        name, handle = list(handles.items())[trial % len(handles)]
+        grads, store0, new_store, pulled = _run_kernel(
+            n, chunk, handle, seed=trial, bidir=bidir
+        )
+        agg = grads.sum(0)
+        want = {
+            "sum": store0 + agg,
+            "assign": agg,
+            "sgd": store0 - 0.3 * agg,
+        }[name]
+        np.testing.assert_allclose(
+            new_store, want, rtol=1e-4, atol=1e-4,
+            err_msg=f"trial={trial} n={n} bidir={bidir} handle={name}",
+        )
+        np.testing.assert_allclose(
+            pulled, want, rtol=1e-4, atol=1e-4,
+            err_msg=f"trial={trial} n={n} bidir={bidir} handle={name}",
+        )
+
+
 class TestEnginePallasImpl:
     """Engine integration: impl='pallas' must agree with impl='xla'."""
 
