@@ -3,5 +3,14 @@ tables, and sequence-parallel primitives."""
 
 from .mesh import default_mesh, make_mesh
 from .engine import CollectiveEngine, DenseBucket
+from .pipeline import pipeline_apply, pipeline_loss, stack_layers
 
-__all__ = ["CollectiveEngine", "DenseBucket", "default_mesh", "make_mesh"]
+__all__ = [
+    "CollectiveEngine",
+    "DenseBucket",
+    "default_mesh",
+    "make_mesh",
+    "pipeline_apply",
+    "pipeline_loss",
+    "stack_layers",
+]

@@ -1,0 +1,135 @@
+"""Pipeline parallelism (GPipe-style microbatching) over a ``pp`` mesh
+axis — TPU-first: one SPMD program where every stage runs the same
+scanned schedule and activations hop stage-to-stage with ``ppermute``.
+
+The reference has no pipeline tier (SURVEY §2.9: PP absent); this module
+is new TPU scope, same as the TP/SP/EP additions.  Design:
+
+- The layer stack is **stacked** (each param leaf gains a leading
+  ``[num_layers, ...]`` axis) and sharded ``P('pp', ...)`` so stage ``s``
+  holds layers ``[s*L/S, (s+1)*L/S)`` — the PS view: the pipeline axis IS
+  a key-range sharding of the layer parameters, exactly like servers own
+  key ranges (postoffice.cc:257-268), and gradient push/pull for stage
+  params needs no cross-stage reduction (each stage is the sole owner of
+  its range).
+- Microbatches stream through a ``lax.scan`` of ``M + S - 1`` ticks:
+  stage 0 injects microbatch ``t``, every stage applies its layer block,
+  ``ppermute`` rotates activations to the next stage, the last stage
+  records its finished microbatch.  No data-dependent Python control
+  flow — the whole pipeline is one compiled program (GPipe fill/drain
+  bubble of ``(S-1)/(M+S-1)``).
+- Backward flows through the scanned ``ppermute`` chain automatically
+  (reverse-mode turns the rotation into the opposite rotation), so
+  ``jax.grad`` of the pipelined loss gives each stage its local layer
+  gradients — nothing extra to wire.
+
+Composes with data parallelism by nesting axes (``('dp', 'pp')`` mesh:
+psum gradients over ``dp`` as usual) and with the engine: stage params
+are pushed/pulled as buckets whose key ranges align with stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stack_layers(layer_params_list):
+    """Stack a list of per-layer param pytrees into one pytree whose
+    leaves carry a leading ``[num_layers, ...]`` axis (shard it
+    ``P('pp', ...)`` to give each stage its block)."""
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs, axis=0), *layer_params_list
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_micros,
+    axis: str,
+    num_stages: int,
+):
+    """Run microbatches through the pipeline; call inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(stage_params, act) -> act`` — applies THIS stage's
+        layer block; output must have the activation's shape/dtype (the
+        circulating format).  ``stage_params`` leaves have leading dim
+        ``layers_per_stage``; loop or scan over it inside.
+      stage_params: this device's block of the stacked layer params.
+      x_micros: ``[M, mb, ...]`` microbatched activations, replicated
+        across the axis (stage 0 consumes them).
+      axis: the pipeline mesh axis name.
+      num_stages: the (static) size of the pipeline axis.
+
+    Returns ``[M, mb, ...]`` finished activations — VALID ON THE LAST
+    STAGE ONLY (zeros elsewhere); reduce or mask accordingly (e.g. the
+    loss pattern of :func:`pipeline_loss`).
+    """
+    S = num_stages
+    my = lax.axis_index(axis)
+    M = x_micros.shape[0]
+    ticks = M + S - 1  # static: M and S are trace-time constants
+
+    act0 = jnp.zeros_like(x_micros[0])
+    outs0 = jnp.zeros_like(x_micros)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        act_in, outs = carry
+        # Stage 0 injects microbatch t (clamped once the pipe drains).
+        inject = x_micros[jnp.clip(t, 0, M - 1)]
+        x = jnp.where(my == 0, inject, act_in)
+        y = stage_fn(stage_params, x)
+        # Last stage finished microbatch (t - (S-1)) this tick.
+        slot = t - (S - 1)
+        valid = (my == (S - 1)) & (slot >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(slot, 0, M - 1), 0
+        )
+        outs = jnp.where(valid, upd, outs)
+        act_out = lax.ppermute(y, axis, perm)
+        return (act_out, outs), None
+
+    (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(ticks))
+    return outs
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    head_params,
+    x_micros,
+    axis: str,
+    num_stages: int,
+):
+    """Pipelined forward + last-stage loss, replicated across stages.
+
+    ``loss_fn(head_params, finished_micros) -> scalar`` runs on the last
+    stage's outputs (the unembed/readout — ``head_params`` should be
+    replicated over the axis); the scalar is masked to the last stage
+    and ``psum``-replicated so every stage returns the same loss and
+    ``jax.grad`` gives every stage its local layer gradients plus the
+    full head gradient on the last stage (psum head grads over the axis
+    if the head must stay replicated).
+    """
+    outs = pipeline_apply(
+        stage_fn, stage_params, x_micros, axis, num_stages
+    )
+    S = num_stages
+    my = lax.axis_index(axis)
+    local = loss_fn(head_params, outs)
+    masked = jnp.where(my == (S - 1), local, jnp.zeros_like(local))
+    # Replicate the VALUE with a non-differentiable psum: the cotangent
+    # must seed each device's ``masked`` with exactly 1 (the transposed
+    # ppermute chain then carries the last stage's cotangent back across
+    # stages).  Differentiating through the psum itself would scale the
+    # seed by the axis size under the unchecked-replication shard_map
+    # this framework uses (S x too-large gradients).
+    replicated = lax.psum(lax.stop_gradient(masked), axis)
+    return masked + replicated - lax.stop_gradient(masked)
