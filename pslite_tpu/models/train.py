@@ -99,6 +99,172 @@ def make_ps_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
     return step, flat_store, token_sharding, store_sharding
 
 
+def make_pp_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
+                       num_micro: int = 4, seed: int = 0):
+    """PS training step with PIPELINE parallelism over the mesh's last
+    axis (optionally data parallelism over a leading ``dp`` axis).
+
+    The PS view: each pipeline stage owns the key range covering its
+    layer block — the stacked layer params are sharded ``P('pp', ...)``
+    and the stage-local SGD update IS the server-shard update (no
+    cross-stage reduction exists because each stage is the sole owner of
+    its range, the same invariant as key-range server sharding,
+    postoffice.cc:257-268).  Replicated head params (embed / final norm)
+    behave like a fully-replicated bucket: grads psum over pp (only the
+    last stage holds non-zero head cotangents), pmean over dp, applied
+    identically everywhere.
+
+    Returns ``(step_fn, state, token_sharding)`` with
+    ``state = (stacked_layers, head)`` already device_put onto the mesh;
+    ``step_fn(state, inputs, targets) -> (state, loss)``; inputs/targets
+    ``[dp, M, mb, T]`` int32 (microbatched along M).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat as shard_map
+    from ..parallel.pipeline import (
+        pipeline_loss,
+        stack_layers,
+    )
+    from .transformer import _rmsnorm
+
+    axes = tuple(mesh.axis_names)
+    pp_axis = axes[-1]
+    S = mesh.shape[pp_axis]
+    dp_axis = axes[0] if len(axes) > 1 else None
+    if cfg.layers % S != 0:
+        raise ValueError(
+            f"layers={cfg.layers} must divide over the {S}-stage pipeline"
+        )
+    if cfg.moe_experts:
+        raise ValueError("pp step supports dense layers only for now")
+
+    params0 = init_params(jax.random.PRNGKey(seed), cfg)
+    stacked0 = stack_layers(params0["layers"])
+    head0 = {"embed": params0["embed"], "ln_f": params0["ln_f"]}
+
+    D, H = cfg.dim, cfg.heads
+    hd = D // H
+
+    def _embed(head, tokens):
+        x = head["embed"][tokens]  # [mb, T, D]
+        T = x.shape[1]
+        pos = jnp.arange(T)
+        freqs = jnp.exp(-jnp.arange(0, D, 2) / D * jnp.log(10000.0))
+        ang = pos[:, None] * freqs[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        return x + pe[None].astype(x.dtype)
+
+    def _one_layer(layer, x):
+        from ..parallel.ring_attention import reference_attention
+
+        compute_dt = jnp.bfloat16 if x.dtype != jnp.float64 else x.dtype
+        B, T, _ = x.shape
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = (
+            h.astype(compute_dt) @ layer["qkv"].astype(compute_dt)
+        ).astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        o = reference_attention(
+            q.reshape(B, T, H, hd),
+            k.reshape(B, T, H, hd),
+            v.reshape(B, T, H, hd),
+            causal=True,
+        ).reshape(B, T, D)
+        x = x + (
+            o.astype(compute_dt) @ layer["proj"].astype(compute_dt)
+        ).astype(x.dtype)
+        h = _rmsnorm(x, layer["ln2"])
+        h1 = jax.nn.gelu(
+            (h.astype(compute_dt) @ layer["mlp_in"].astype(compute_dt)
+             ).astype(x.dtype)
+        )
+        return x + (
+            h1.astype(compute_dt) @ layer["mlp_out"].astype(compute_dt)
+        ).astype(x.dtype)
+
+    def _stage_fn(stage_layers, x):
+        def body(xc, layer):
+            return _one_layer(layer, xc), None
+
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    def _head_loss(head, outs, tgt_micros):
+        # outs: [M, mb, T, D] finished activations (last stage).
+        compute_dt = jnp.bfloat16
+        x = _rmsnorm(outs, head["ln_f"])
+        logits = (
+            x.astype(compute_dt) @ head["embed"].T.astype(compute_dt)
+        ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, tgt_micros[..., None], axis=-1
+        )[..., 0]
+        return nll.mean()
+
+    def _local_step(stacked_l, head_r, inp_l, tgt_l):
+        if dp_axis is not None:
+            inp_l, tgt_l = inp_l[0], tgt_l[0]
+
+        def _loss(sl, hr):
+            x_micros = jax.vmap(lambda t: _embed(hr, t))(inp_l)
+            return pipeline_loss(
+                _stage_fn,
+                lambda h, outs: _head_loss(h, outs, tgt_l),
+                sl,
+                hr,
+                x_micros,
+                pp_axis,
+                S,
+            )
+
+        loss, (g_sl, g_hr) = jax.value_and_grad(_loss, argnums=(0, 1))(
+            stacked_l, head_r
+        )
+        # Head grads live on the last stage only: sum over pp; average
+        # both over dp replicas.
+        g_hr = jax.tree.map(lambda g: lax.psum(g, pp_axis), g_hr)
+        if dp_axis is not None:
+            g_sl = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_sl)
+            g_hr = jax.tree.map(lambda g: lax.pmean(g, dp_axis), g_hr)
+            loss = lax.pmean(loss, dp_axis)
+        new_sl = jax.tree.map(lambda p, g: p - lr * g, stacked_l, g_sl)
+        new_hr = jax.tree.map(lambda p, g: p - lr * g, head_r, g_hr)
+        return new_sl, new_hr, loss
+
+    layer_spec = P(pp_axis)
+    repl_spec = P()
+    tok_spec = P(dp_axis) if dp_axis is not None else P(None)
+    fn = shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(layer_spec, repl_spec, tok_spec, tok_spec),
+        out_specs=(layer_spec, repl_spec, repl_spec),
+    )
+    jitted = jax.jit(fn, donate_argnums=(0, 1))
+
+    def step(state, inputs, targets):
+        sl, hr = state
+        new_sl, new_hr, loss = jitted(sl, hr, inputs, targets)
+        return (new_sl, new_hr), loss
+
+    stacked = jax.device_put(
+        stacked0,
+        jax.tree.map(
+            lambda _: NamedSharding(mesh, P(pp_axis)), stacked0
+        ),
+    )
+    head = jax.device_put(
+        head0, jax.tree.map(lambda _: NamedSharding(mesh, P()), head0)
+    )
+    token_sharding = NamedSharding(mesh, tok_spec)
+    return step, (stacked, head), token_sharding
+
+
 def toy_batch(cfg: ModelConfig, batch: int, seq: int, seed: int = 1):
     """Deterministic toy LM data: predict (token + 1) mod vocab."""
     import numpy as np

@@ -326,6 +326,9 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
-        interpret=(pltpu.InterpretParams() if _use_interpret() else False),
+        interpret=(
+            pltpu.InterpretParams(dma_execution_mode="eager")
+            if _use_interpret() else False
+        ),
     )(g2, s2)
     return out_store.reshape(chunk), out_pulled.reshape(n * chunk)

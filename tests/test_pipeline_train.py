@@ -1,0 +1,84 @@
+"""PP-integrated flagship training (models/train.py::make_pp_train_step):
+pipeline stages as PS key-range owners of the layer stack.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pslite_tpu.models.train import make_pp_train_step
+from pslite_tpu.models.transformer import ModelConfig, init_params, loss_fn
+
+
+def _mesh(shape, names):
+    n = int(np.prod(shape))
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+
+
+def test_pp_first_loss_matches_sequential():
+    cfg = ModelConfig(vocab=32, dim=16, heads=2, layers=4)
+    mesh = _mesh((4,), ("pp",))
+    M, mb, T = 4, 2, 8
+    step, state, tok_sharding = make_pp_train_step(
+        cfg, mesh, lr=0.1, num_micro=M
+    )
+    rng = np.random.default_rng(0)
+    inputs = rng.integers(0, cfg.vocab, size=(M, mb, T)).astype(np.int32)
+    targets = (inputs + 1) % cfg.vocab
+    state, loss = step(
+        state,
+        jax.device_put(inputs, tok_sharding),
+        jax.device_put(targets, tok_sharding),
+    )
+    # Reference: the same init params, full batch, single device.
+    params0 = init_params(jax.random.PRNGKey(0), cfg)
+    want = loss_fn(
+        params0,
+        jnp.asarray(inputs.reshape(M * mb, T)),
+        jnp.asarray(targets.reshape(M * mb, T)),
+        cfg,
+    )
+    np.testing.assert_allclose(float(loss), float(want), rtol=2e-2)
+
+
+def test_pp_loss_decreases():
+    cfg = ModelConfig(vocab=16, dim=16, heads=2, layers=4)
+    mesh = _mesh((4,), ("pp",))
+    M, mb, T = 2, 2, 8
+    step, state, tok_sharding = make_pp_train_step(
+        cfg, mesh, lr=0.3, num_micro=M
+    )
+    rng = np.random.default_rng(1)
+    inputs = rng.integers(0, cfg.vocab, size=(M, mb, T)).astype(np.int32)
+    targets = (inputs + 1) % cfg.vocab
+    inputs = jax.device_put(inputs, tok_sharding)
+    targets = jax.device_put(targets, tok_sharding)
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, inputs, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_pp_with_dp_axis():
+    cfg = ModelConfig(vocab=16, dim=16, heads=2, layers=4)
+    mesh = _mesh((2, 4), ("dp", "pp"))
+    M, mb, T = 2, 2, 8
+    step, state, tok_sharding = make_pp_train_step(
+        cfg, mesh, lr=0.15, num_micro=M
+    )
+    rng = np.random.default_rng(2)
+    inputs = rng.integers(0, cfg.vocab, size=(2, M, mb, T)).astype(np.int32)
+    targets = (inputs + 1) % cfg.vocab
+    inputs = jax.device_put(inputs, tok_sharding)
+    targets = jax.device_put(targets, tok_sharding)
+    losses = []
+    for _ in range(14):
+        state, loss = step(state, inputs, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    # Tiny model at an aggressive lr oscillates; require clear net
+    # progress rather than monotonicity.
+    assert min(losses[7:]) < losses[0] * 0.82, losses
