@@ -87,9 +87,12 @@ def ring_chunk_len(total_len: int, num_devices: int, dtype=None,
     return -(-chunk // tile) * tile
 
 
-def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int):
+def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
+                 with_ag: bool = True):
     """Build the unrolled kernel for a static ring size ``n`` with
     ``ndir`` directions (1 = clockwise only, 2 = bidirectional halves).
+    ``with_ag=False`` builds the push-only variant: reduce-scatter +
+    fused update, no all-gather phase and no pulled output ref.
 
     Refs (per device d; rows = chunk rows, h = rows // ndir):
       grads_ref   ANY  [n*rows, 128] — my worker row, n chunks
@@ -114,9 +117,11 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(grads_ref, store_ref, out_store_ref, out_pulled_ref,
-               send_buf, recv_buf, gchunk, send_sem, recv_sem, cap_sem,
-               local_sem):
+    def kernel(grads_ref, store_ref, out_store_ref, *rest):
+        if with_ag:
+            out_pulled_ref, rest = rest[0], rest[1:]
+        (send_buf, recv_buf, gchunk, send_sem, recv_sem, cap_sem,
+         local_sem) = rest
         d = lax.axis_index(axis_name)
         right = lax.rem(d + 1, n)
         left = lax.rem(d + n - 1, n)
@@ -226,7 +231,19 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int):
             up = handle(store_ref[pl.ds(dr * h, h)], summed)
             updated.append(up)
             out_store_ref[pl.ds(dr * h, h)] = up
-            write_pulled(dr, d, out_store_ref.at[pl.ds(dr * h, h)])
+            if with_ag:
+                write_pulled(dr, d, out_store_ref.at[pl.ds(dr * h, h)])
+
+        if not with_ag:
+            # Push-only: no all-gather phase.  Drain the un-consumed
+            # credits (one per slot that received at least once) so the
+            # scratch semaphores exit at zero.
+            if n >= 2:
+                for dr in dirs:
+                    pltpu.semaphore_wait(cap_sem.at[dr, 0], 1)
+                    if n >= 3:
+                        pltpu.semaphore_wait(cap_sem.at[dr, 1], 1)
+            return
 
         # ---- phase 2: ring all-gather of updated chunks -----------------
         for s2 in range(n - 1):
@@ -259,6 +276,68 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int):
     return kernel
 
 
+def _ring_call(grads_chunks, store_chunk, handle: Callable,
+               axis_name: str, num_devices: int, collective_id,
+               bidir: bool, with_ag: bool):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = num_devices
+    ndir = 2 if bidir else 1
+    chunk = store_chunk.shape[0]
+    min_tile = _TILE * ndir * (2 if store_chunk.dtype.itemsize == 2 else 1)
+    if chunk % min_tile:
+        raise ValueError(
+            f"chunk {chunk} not a multiple of {min_tile} "
+            f"(bidir={bidir}, dtype={store_chunk.dtype})"
+        )
+    if collective_id is None:
+        collective_id = derive_collective_id(
+            n, chunk, str(store_chunk.dtype), ndir, with_ag
+        )
+    rows = chunk // _LANES
+    h = rows // ndir
+    dtype = store_chunk.dtype
+    g2 = grads_chunks.reshape(n * rows, _LANES)
+    s2 = store_chunk.reshape(rows, _LANES)
+
+    out_shape = [jax.ShapeDtypeStruct((rows, _LANES), dtype)]
+    out_specs = [pl.BlockSpec(memory_space=pltpu.VMEM)]
+    if with_ag:
+        out_shape.append(jax.ShapeDtypeStruct((n * rows, _LANES), dtype))
+        out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+
+    kernel = _kernel_body(n, axis_name, handle, ndir, with_ag=with_ag)
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=tuple(out_shape),
+        in_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        out_specs=tuple(out_specs),
+        scratch_shapes=[
+            pltpu.VMEM((ndir, h, _LANES), dtype),     # send_buf
+            pltpu.VMEM((ndir, 2, h, _LANES), dtype),  # recv_buf
+            pltpu.VMEM((ndir, h, _LANES), dtype),     # gchunk
+            pltpu.SemaphoreType.DMA((ndir, 2)),       # send_sem
+            pltpu.SemaphoreType.DMA((ndir, 2)),       # recv_sem
+            pltpu.SemaphoreType.REGULAR((ndir, 2)),   # cap_sem
+            pltpu.SemaphoreType.DMA,                  # local_sem
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=(
+            pltpu.InterpretParams(dma_execution_mode="eager")
+            if _use_interpret() else False
+        ),
+    )(g2, s2)
+    if with_ag:
+        return outs[0].reshape(chunk), outs[1].reshape(n * chunk)
+    return outs[0].reshape(chunk)
+
+
 def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                    axis_name: str, num_devices: int,
                    collective_id: int = None, bidir: bool = True):
@@ -277,58 +356,19 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                     ICI link directions utilized — the default).
     Returns (new_store_chunk [chunk], pulled [n*chunk]).
     """
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    return _ring_call(grads_chunks, store_chunk, handle, axis_name,
+                      num_devices, collective_id, bidir, with_ag=True)
 
-    n = num_devices
-    ndir = 2 if bidir else 1
-    chunk = store_chunk.shape[0]
-    min_tile = _TILE * ndir * (2 if store_chunk.dtype.itemsize == 2 else 1)
-    if chunk % min_tile:
-        raise ValueError(
-            f"chunk {chunk} not a multiple of {min_tile} "
-            f"(bidir={bidir}, dtype={store_chunk.dtype})"
-        )
-    if collective_id is None:
-        collective_id = derive_collective_id(
-            n, chunk, str(store_chunk.dtype), ndir
-        )
-    rows = chunk // _LANES
-    h = rows // ndir
-    dtype = store_chunk.dtype
-    g2 = grads_chunks.reshape(n * rows, _LANES)
-    s2 = store_chunk.reshape(rows, _LANES)
 
-    kernel = _kernel_body(n, axis_name, handle, ndir)
-    out_store, out_pulled = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((rows, _LANES), dtype),
-            jax.ShapeDtypeStruct((n * rows, _LANES), dtype),
-        ),
-        in_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((ndir, h, _LANES), dtype),     # send_buf
-            pltpu.VMEM((ndir, 2, h, _LANES), dtype),  # recv_buf
-            pltpu.VMEM((ndir, h, _LANES), dtype),     # gchunk
-            pltpu.SemaphoreType.DMA((ndir, 2)),       # send_sem
-            pltpu.SemaphoreType.DMA((ndir, 2)),       # recv_sem
-            pltpu.SemaphoreType.REGULAR((ndir, 2)),   # cap_sem
-            pltpu.SemaphoreType.DMA,                  # local_sem
-        ],
-        compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=collective_id
-        ),
-        interpret=(
-            pltpu.InterpretParams(dma_execution_mode="eager")
-            if _use_interpret() else False
-        ),
-    )(g2, s2)
-    return out_store.reshape(chunk), out_pulled.reshape(n * chunk)
+def ring_push(grads_chunks, store_chunk, handle: Callable,
+              axis_name: str, num_devices: int,
+              collective_id: int = None, bidir: bool = True):
+    """Push-only ring: reduce-scatter + fused server update, no
+    all-gather (the ``ZPush`` leg alone).  Same contract as
+    :func:`ring_push_pull`; returns just the new store chunk.
+
+    (There is deliberately no pull-only ring: a bare all-gather has no
+    update to fuse, so XLA's native all_gather is already optimal.)
+    """
+    return _ring_call(grads_chunks, store_chunk, handle, axis_name,
+                      num_devices, collective_id, bidir, with_ag=False)

@@ -416,7 +416,12 @@ class CollectiveEngine:
         buckets whose chunk is not already tile-aligned are padded inside
         the program (XLA fuses the pad) and sliced on the way out, so the
         engine-visible shapes are unchanged."""
-        key = ("ring_pp", padded_len, str(dtype), handle_key)
+        return self._ring_program_op("push_pull", padded_len, dtype,
+                                     handle_key)
+
+    def _ring_program_op(self, op: str, padded_len: int, dtype,
+                         handle_key) -> Callable:
+        key = (f"ring_{op}", padded_len, str(dtype), handle_key)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
@@ -429,6 +434,7 @@ class CollectiveEngine:
         from ..ops.ring_collective import (
             derive_collective_id,
             ring_chunk_len,
+            ring_push,
             ring_push_pull,
         )
 
@@ -439,27 +445,43 @@ class CollectiveEngine:
         n = self.num_shards
         chunk0 = padded_len // n
         kchunk = ring_chunk_len(padded_len, n, dtype)
+        cid = derive_collective_id(*key)
 
-        def body(store_l, grads_l):
+        def _padded(store_l, grads_l):
             g = grads_l[0].reshape(n, chunk0)
             s = store_l
             if kchunk != chunk0:
                 g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
                 s = jnp.pad(s, (0, kchunk - chunk0))
+            return g, s
+
+        def body_pp(store_l, grads_l):
+            g, s = _padded(store_l, grads_l)
             new, pulled = ring_push_pull(
-                g, s, handle, axis, n,
-                collective_id=derive_collective_id(*key),
+                g, s, handle, axis, n, collective_id=cid
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
                 pulled = pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
             return new, pulled
 
+        def body_push(store_l, grads_l):
+            g, s = _padded(store_l, grads_l)
+            new = ring_push(g, s, handle, axis, n, collective_id=cid)
+            if kchunk != chunk0:
+                new = new[:chunk0]
+            # Completion token, same contract as the XLA push program.
+            return new, new[:1]
+
+        if op == "push_pull":
+            body, out_specs = body_pp, (P(axis), P(None))
+        else:
+            body, out_specs = body_push, (P(axis), P(axis))
         fn = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(axis), P(axis, None)),
-            out_specs=(P(axis), P(None)),
+            out_specs=out_specs,
         )
         jitted = jax.jit(fn, donate_argnums=(0,))
         with self._mu:
@@ -731,9 +753,14 @@ class CollectiveEngine:
                 token = outs[-1]
             self._observe(name, "push", bucket, t0)
             return token
-        prog = self._program(
-            "push", bucket.padded_len, bucket.dtype, handle_key
-        )
+        if self._effective_impl(bucket.dtype, resolved) == "pallas":
+            prog = self._ring_program_op(
+                "push", bucket.padded_len, bucket.dtype, handle_key
+            )
+        else:
+            prog = self._program(
+                "push", bucket.padded_len, bucket.dtype, handle_key
+            )
         with self._bucket_mu[name]:
             new_store, token = prog(self._stores[name], g)
             self._stores[name] = new_store

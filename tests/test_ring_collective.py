@@ -15,7 +15,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from pslite_tpu.ops.ring_collective import ring_chunk_len, ring_push_pull
+from pslite_tpu.ops.ring_collective import (
+    ring_chunk_len,
+    ring_push,
+    ring_push_pull,
+)
 from pslite_tpu.parallel.engine import CollectiveEngine
 from pslite_tpu.parallel.mesh import shard_map_compat as shard_map
 
@@ -106,6 +110,34 @@ def test_ring_bf16():
     )
 
 
+@pytest.mark.parametrize("n", [2, 3, 4])
+@pytest.mark.parametrize("bidir", [True, False])
+def test_ring_push_only(n, bidir):
+    chunk = ring_chunk_len(n * 1024, n, bidir=bidir)
+    total = n * chunk
+    rng = np.random.RandomState(7)
+    grads = rng.randn(n, total).astype(np.float32)
+    store0 = rng.randn(total).astype(np.float32)
+
+    def body(store_l, grads_l):
+        g = grads_l[0].reshape(n, chunk)
+        return ring_push(g, store_l, lambda s, a: s + a, "kv", n,
+                         bidir=bidir)
+
+    f = jax.jit(
+        shard_map(
+            body,
+            mesh=_mesh(n),
+            in_specs=(P("kv"), P("kv", None)),
+            out_specs=P("kv"),
+        )
+    )
+    new_store = np.asarray(f(jnp.asarray(store0), jnp.asarray(grads)))
+    np.testing.assert_allclose(
+        new_store, store0 + grads.sum(0), rtol=1e-5, atol=1e-5
+    )
+
+
 def test_ring_randomized_configs():
     """Property check across random ring sizes / chunk shapes / handles:
     the fused kernel must match the host reduction bit-for-bit-ish for
@@ -192,6 +224,49 @@ class TestEnginePallasImpl:
         grads = np.ones((2, 2048), np.float32)
         out = np.asarray(ep2.push_pull("g", grads, handle=custom))
         np.testing.assert_allclose(out, 4.0 * np.ones(2048), rtol=1e-6)
+
+    def test_push_only_parity(self):
+        n = 4
+        ex, ep = self._engines(n)
+        keys = np.arange(4, dtype=np.uint64)
+        rng = np.random.RandomState(8)
+        grads = rng.randn(n, 4 * 300).astype(np.float32)
+        for eng in (ex, ep):
+            eng.register_dense("po", keys, 300)
+            eng.push("po", grads)
+            eng.push("po", grads)
+        np.testing.assert_allclose(
+            np.asarray(ep.pull("po")), np.asarray(ex.pull("po")),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_interleaved_ops_soak(self):
+        """Randomized push_pull/push/pull interleavings on the pallas
+        impl track a host replay (store donation + program cache under
+        op mixing)."""
+        n = 8
+        rng = np.random.RandomState(11)
+        ep = CollectiveEngine(mesh=_mesh(n), impl="pallas")
+        keys = np.arange(3, dtype=np.uint64)
+        ep.register_dense("s", keys, 400)
+        host = np.zeros(1200, np.float32)
+        for _ in range(10):
+            op = rng.choice(["push_pull", "push", "pull"])
+            if op == "pull":
+                np.testing.assert_allclose(
+                    np.asarray(ep.pull("s")), host, rtol=1e-4, atol=1e-4
+                )
+                continue
+            g = rng.randn(n, 1200).astype(np.float32)
+            host = host + g.sum(0)
+            if op == "push_pull":
+                out = np.asarray(ep.push_pull("s", g))
+                np.testing.assert_allclose(out, host, rtol=1e-4, atol=1e-4)
+            else:
+                ep.push("s", g)
+        np.testing.assert_allclose(
+            np.asarray(ep.pull("s")), host, rtol=1e-4, atol=1e-4
+        )
 
     def test_pallas_then_pull_consistent(self):
         # pull (XLA program) must see the ring kernel's store update.
