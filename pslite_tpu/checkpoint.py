@@ -126,6 +126,103 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
             sparse_engine.set_store_array(name, data[f"sparse/{name}"])
 
 
+class AsyncEngineCheckpointer:
+    """Non-blocking engine checkpoints: the device-side snapshot happens
+    at call time (``store_array``'s copy under the bucket lock — cheap,
+    async-dispatched), while the host fetch and file write run on a
+    background thread so the training loop never blocks on IO.
+
+    The snapshot is consistent as of the ``save()`` call: pushes applied
+    after ``save()`` returns are NOT in the checkpoint, exactly like a
+    synchronous save at that point.  ``wait()`` joins all pending writes
+    (call before shutdown); a failed write surfaces on the next
+    ``save()``/``wait()`` as an exception.
+    """
+
+    def __init__(self, max_pending: int = 2):
+        import queue
+        import threading
+
+        self._q = queue.Queue(maxsize=max_pending)
+        self._errors = []
+        self._mu = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._run, name="async-ckpt", daemon=True
+        )
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            arrays, meta, path = item
+            try:
+                host = {k: np.asarray(v) for k, v in arrays.items()}
+                host["__meta__"] = np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8
+                )
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tmp = path + ".tmp"
+                np.savez(tmp, **host)
+                # np.savez appends .npz to the filename it writes.
+                os.replace(
+                    tmp if tmp.endswith(".npz") else tmp + ".npz",
+                    path if path.endswith(".npz") else path + ".npz",
+                )
+            except Exception as exc:  # noqa: BLE001 - surfaced to caller
+                with self._mu:
+                    self._errors.append(exc)
+            finally:
+                self._q.task_done()
+
+    def _raise_pending_error(self):
+        with self._mu:
+            if self._errors:
+                raise self._errors.pop(0)
+
+    def save(self, engine, path: str, sparse_engine=None) -> None:
+        """Queue a snapshot of the engine (same layout as
+        :func:`save_engine`); blocks only if ``max_pending`` writes are
+        already in flight (back-pressure, not data loss)."""
+        self._raise_pending_error()
+        arrays = {}
+        meta = {"dense": {}, "sparse": {}, "opt": {}}
+        for name, bucket in engine._buckets.items():
+            arrays[f"dense/{name}"] = engine.store_array(name)
+            meta["dense"][name] = {
+                "keys": bucket.keys.tolist(),
+                "val_len": bucket.val_len,
+                "total_len": bucket.total_len,
+            }
+            opt = engine.opt_state(name)
+            if opt is not None:
+                kind, states = opt
+                meta["opt"][name] = {"kind": kind, "n": len(states)}
+                for i, s in enumerate(states):
+                    arrays[f"opt/{name}/{i}"] = s
+        if sparse_engine is not None:
+            for name, table in sparse_engine._tables.items():
+                arrays[f"sparse/{name}"] = sparse_engine.store_array(name)
+                meta["sparse"][name] = {
+                    "num_rows": table.num_rows,
+                    "dim": table.dim,
+                }
+        self._q.put((arrays, meta, path))
+
+    def wait(self) -> None:
+        """Block until every queued checkpoint is on disk; re-raise the
+        first background failure if one occurred."""
+        self._q.join()
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        self.wait()
+        self._q.put(None)
+        self._worker.join()
+
+
 def save_kv_store(store: Dict[int, np.ndarray], path: str) -> None:
     """Snapshot a message-path server store (e.g. KVServerDefaultHandle)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
