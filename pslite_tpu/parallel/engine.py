@@ -820,13 +820,21 @@ class CollectiveEngine:
         return [p[: b.total_len] for p, b in zip(pulled, buckets)]
 
     def _group_program(self, shapes_key, handle_key) -> Callable:
-        key = ("group_pp", shapes_key, handle_key)
+        use_ring = False
+        if self.impl == "pallas" and self.num_shards >= 2 and not callable(
+            self._server_handle if handle_key == "_default" else handle_key
+        ):
+            use_ring = all(
+                np.dtype(dt).itemsize in (2, 4) for _, dt in shapes_key
+            )
+        key = ("group_pp", shapes_key, handle_key, use_ring)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
             return prog
 
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
         axis = self.axis
@@ -837,12 +845,41 @@ class CollectiveEngine:
         store_spec = P(axis)
         grads_spec = P(axis, None)
         repl_spec = P(None)
+        n = self.num_shards
+
+        def _ring_one(i, padded_len, dtype, store_l, grads_l):
+            from ..ops.ring_collective import (
+                derive_collective_id,
+                ring_chunk_len,
+                ring_push_pull,
+            )
+
+            chunk0 = padded_len // n
+            kchunk = ring_chunk_len(padded_len, n, dtype)
+            g = grads_l[0].reshape(n, chunk0)
+            s = store_l
+            if kchunk != chunk0:
+                g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
+                s = jnp.pad(s, (0, kchunk - chunk0))
+            new, pulled = ring_push_pull(
+                g, s, handle, axis, n,
+                collective_id=derive_collective_id(*key, i),
+            )
+            if kchunk != chunk0:
+                new = new[:chunk0]
+                pulled = pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
+            return new, pulled
 
         def _body(*args):
             stores, grads = args[:k], args[k:]
             new_stores, pulled = [], []
-            for store_l, grads_l in zip(stores, grads):
-                new, out = _rs_update_ag(store_l, grads_l, handle, axis)
+            for i, (store_l, grads_l) in enumerate(zip(stores, grads)):
+                if use_ring:
+                    padded_len, dt = shapes_key[i]
+                    new, out = _ring_one(i, padded_len, dt, store_l,
+                                         grads_l)
+                else:
+                    new, out = _rs_update_ag(store_l, grads_l, handle, axis)
                 new_stores.append(new)
                 pulled.append(out)
             return (*new_stores, *pulled)
