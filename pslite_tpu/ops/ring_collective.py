@@ -73,13 +73,16 @@ def derive_collective_id(*key_parts) -> int:
 
 
 def ring_chunk_len(total_len: int, num_devices: int, dtype=None,
-                   bidir: bool = True) -> int:
+                   bidir: bool = True, compress: bool = False) -> int:
     """Per-device chunk length (elements) the kernel will use for a
     bucket of ``total_len`` elements: ceil to the VMEM tile — (8, 128)
-    for 4-byte dtypes, (16, 128) for 2-byte (bf16) sublane packing —
-    doubled in bidirectional mode so each half-chunk stays tiled."""
+    for 4-byte dtypes, (16, 128) for 2-byte (bf16) sublane packing,
+    (32, 128) for int8-compressed payloads — doubled in bidirectional
+    mode so each half-chunk stays tiled."""
     tile = _TILE
-    if dtype is not None and jnp.dtype(dtype).itemsize == 2:
+    if compress:
+        tile = 4 * _TILE  # int8 comm buffers need (32, 128) tiles
+    elif dtype is not None and jnp.dtype(dtype).itemsize == 2:
         tile = 2 * _TILE
     if bidir:
         tile = 2 * tile
@@ -88,19 +91,24 @@ def ring_chunk_len(total_len: int, num_devices: int, dtype=None,
 
 
 def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
-                 with_ag: bool = True):
+                 with_ag: bool = True, compress: bool = False):
     """Build the unrolled kernel for a static ring size ``n`` with
     ``ndir`` directions (1 = clockwise only, 2 = bidirectional halves).
     ``with_ag=False`` builds the push-only variant: reduce-scatter +
     fused update, no all-gather phase and no pulled output ref.
+    ``compress=True`` quantizes every hop payload to int8 with a per-hop
+    absmax scale riding in a sidecar buffer — 4x fewer wire bytes.
 
     Refs (per device d; rows = chunk rows, h = rows // ndir):
       grads_ref   ANY  [n*rows, 128] — my worker row, n chunks
       store_ref   VMEM [rows, 128]   — my store shard (chunk d)
       out_store   VMEM [rows, 128]
       out_pulled  ANY  [n*rows, 128] — replicated result
-      send_buf    VMEM [ndir, h, 128]
-      recv_buf    VMEM [ndir, 2, h, 128]
+      send_buf    VMEM [ndir, h, 128]     (int8 [ndir, h+32, 128] when
+      recv_buf    VMEM [ndir, 2, h, 128]   compressed: payload rows plus
+                                           32 int8 rows carrying the f32
+                                           absmax scale, bitcast — ONE
+                                           DMA per hop, scale embedded)
       gchunk      VMEM [ndir, h, 128] — staging for grads half-chunks
       send_sem/recv_sem  DMA((ndir, 2))
       cap_sem     REGULAR((ndir, 2)) — credits from the downstream peer
@@ -113,6 +121,14 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
       owned chunk : d (both directions — each owns its half)
       AG step s2  : send chunk (d -+ s2) % n
     (``-`` for dir 0, ``+`` for dir 1).
+
+    Compressed semantics: reduce-scatter partial sums are re-quantized
+    at every hop (error O(hops), the usual compressed-all-reduce
+    trade-off); the all-gather payload is quantized ONCE at the owner
+    and forwarded verbatim, and every device — including the owner —
+    writes the DEQUANTIZED payload to the pulled output so the
+    replicated result is identical everywhere.  The store update itself
+    applies to the dequantized sum at full precision.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -183,7 +199,9 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
 
         def start_send(dr, t):
             """Start the remote DMA of send_buf[dr] into the peer's
-            recv slot t%2; returns the handle for a later wait."""
+            recv slot t%2 (compressed payloads carry their scale in the
+            trailing rows — still one DMA); returns the handles for a
+            later wait."""
             if t >= 2:
                 # Credit: my downstream peer freed its slot t%2 (t-2).
                 pltpu.semaphore_wait(cap_sem.at[dr, t % 2], 1)
@@ -196,7 +214,29 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
             rdma.start()
-            return rdma
+            return [rdma]
+
+        def quantize_to_send(dr, vals):
+            """Write vals (f32 [h,128]) into send_buf[dr]: int8 payload
+            in the leading rows, the f32 absmax scale bitcast into the
+            trailing 32 int8 rows."""
+            amax = jnp.max(jnp.abs(vals))
+            scale = jnp.maximum(amax / 127.0, 1e-30)
+            q = jnp.clip(jnp.round(vals / scale), -127, 127)
+            send_buf[dr, :h] = q.astype(jnp.int8)
+            send_buf[dr, h:] = pltpu.bitcast(
+                jnp.full((_SUBLANES, _LANES), scale, jnp.float32),
+                jnp.int8,
+            )
+
+        def _embedded_scale(buf_rows):
+            """f32 scale from a compressed buffer's trailing rows."""
+            return pltpu.bitcast(buf_rows, jnp.float32)[0, 0]
+
+        def dequant_recv(dr, slot):
+            """f32 view of the compressed payload in recv slot."""
+            scale = _embedded_scale(recv_buf[dr, slot, h:])
+            return recv_buf[dr, slot, :h].astype(jnp.float32) * scale
 
         def free_slot(dr, k):
             """Tell my upstream peer its outgoing slot k is consumable."""
@@ -210,11 +250,20 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
             for dr in dirs:
                 stage_grads(dr, rs_chunk(dr, t))
                 if t == 0:
-                    send_buf[dr] = gchunk[dr]
+                    if compress:
+                        quantize_to_send(dr, gchunk[dr])
+                    else:
+                        send_buf[dr] = gchunk[dr]
                 else:
-                    send_buf[dr] = recv_buf[dr, (t - 1) % 2] + gchunk[dr]
+                    if compress:
+                        acc = dequant_recv(dr, (t - 1) % 2) + gchunk[dr]
+                        quantize_to_send(dr, acc)
+                    else:
+                        send_buf[dr] = (
+                            recv_buf[dr, (t - 1) % 2] + gchunk[dr]
+                        )
                     free_slot(dr, (t - 1) % 2)
-                rdmas.append(start_send(dr, t))
+                rdmas.extend(start_send(dr, t))
             for rdma in rdmas:
                 rdma.wait()
 
@@ -223,7 +272,10 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
         for dr in dirs:
             stage_grads(dr, d)
             if n >= 2:
-                summed = recv_buf[dr, (n - 2) % 2] + gchunk[dr]
+                if compress:
+                    summed = dequant_recv(dr, (n - 2) % 2) + gchunk[dr]
+                else:
+                    summed = recv_buf[dr, (n - 2) % 2] + gchunk[dr]
                 free_slot(dr, (n - 2) % 2)
             else:
                 summed = gchunk[dr]
@@ -231,7 +283,10 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
             up = handle(store_ref[pl.ds(dr * h, h)], summed)
             updated.append(up)
             out_store_ref[pl.ds(dr * h, h)] = up
-            if with_ag:
+            if with_ag and (not compress or n == 1):
+                # Compressed owners write their chunk during AG s2==0
+                # instead (the dequantized view — every device must see
+                # the identical replicated result).
                 write_pulled(dr, d, out_store_ref.at[pl.ds(dr * h, h)])
 
         if not with_ag:
@@ -246,25 +301,48 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
             return
 
         # ---- phase 2: ring all-gather of updated chunks -----------------
+        # Compressed: quantize ONCE at the owner (s2==0), forward the
+        # int8 payload verbatim afterwards — no per-hop re-quantization
+        # error in this phase.
         for s2 in range(n - 1):
             t = n - 1 + s2
             rdmas = []
             for dr in dirs:
                 if s2 == 0:
-                    send_buf[dr] = updated[dr]
+                    if compress:
+                        quantize_to_send(dr, updated[dr])
+                        gchunk[dr] = (
+                            send_buf[dr, :h].astype(jnp.float32)
+                            * _embedded_scale(send_buf[dr, h:])
+                        )
+                        write_pulled(dr, d, gchunk.at[dr])
+                    else:
+                        send_buf[dr] = updated[dr]
                 else:
+                    # Forward verbatim (compressed: payload + embedded
+                    # scale travel as one buffer — no re-quantization).
                     send_buf[dr] = recv_buf[dr, (t - 1) % 2]
-                    write_pulled(dr, ag_chunk(dr, s2), send_buf.at[dr])
+                    if compress:
+                        gchunk[dr] = dequant_recv(dr, (t - 1) % 2)
+                        write_pulled(dr, ag_chunk(dr, s2), gchunk.at[dr])
+                    else:
+                        write_pulled(dr, ag_chunk(dr, s2),
+                                     send_buf.at[dr])
                     free_slot(dr, (t - 1) % 2)
-                rdmas.append(start_send(dr, t))
+                rdmas.extend(start_send(dr, t))
             for rdma in rdmas:
                 rdma.wait()
         if n >= 2:
             last = 2 * (n - 1) - 1
             for dr in dirs:
                 # Final arrival: chunk (d -+ (n-1)) % n.
-                send_buf[dr] = recv_buf[dr, last % 2]
-                write_pulled(dr, ag_chunk(dr, n - 1), send_buf.at[dr])
+                if compress:
+                    gchunk[dr] = dequant_recv(dr, last % 2)
+                    write_pulled(dr, ag_chunk(dr, n - 1), gchunk.at[dr])
+                else:
+                    send_buf[dr] = recv_buf[dr, last % 2]
+                    write_pulled(dr, ag_chunk(dr, n - 1),
+                                 send_buf.at[dr])
                 free_slot(dr, last % 2)
                 # Drain the one un-consumed credit per slot (the credits
                 # for the final sends have no matching wait) so the
@@ -278,26 +356,35 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
 
 def _ring_call(grads_chunks, store_chunk, handle: Callable,
                axis_name: str, num_devices: int, collective_id,
-               bidir: bool, with_ag: bool):
+               bidir: bool, with_ag: bool, compress: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = num_devices
     ndir = 2 if bidir else 1
     chunk = store_chunk.shape[0]
-    min_tile = _TILE * ndir * (2 if store_chunk.dtype.itemsize == 2 else 1)
+    if compress and store_chunk.dtype != jnp.float32:
+        raise ValueError("int8 wire compression requires float32 stores")
+    if compress:
+        min_tile = 4 * _TILE * ndir
+    else:
+        min_tile = _TILE * ndir * (
+            2 if store_chunk.dtype.itemsize == 2 else 1
+        )
     if chunk % min_tile:
         raise ValueError(
             f"chunk {chunk} not a multiple of {min_tile} "
-            f"(bidir={bidir}, dtype={store_chunk.dtype})"
+            f"(bidir={bidir}, compress={compress}, "
+            f"dtype={store_chunk.dtype})"
         )
     if collective_id is None:
         collective_id = derive_collective_id(
-            n, chunk, str(store_chunk.dtype), ndir, with_ag
+            n, chunk, str(store_chunk.dtype), ndir, with_ag, compress
         )
     rows = chunk // _LANES
     h = rows // ndir
     dtype = store_chunk.dtype
+    comm_dtype = jnp.int8 if compress else dtype
     g2 = grads_chunks.reshape(n * rows, _LANES)
     s2 = store_chunk.reshape(rows, _LANES)
 
@@ -307,7 +394,21 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
         out_shape.append(jax.ShapeDtypeStruct((n * rows, _LANES), dtype))
         out_specs.append(pl.BlockSpec(memory_space=pl.ANY))
 
-    kernel = _kernel_body(n, axis_name, handle, ndir, with_ag=with_ag)
+    # Compressed comm buffers append 32 int8 rows (one bitcast f32
+    # (8, 128) tile) carrying the absmax scale — one DMA moves both.
+    comm_rows = h + 4 * _SUBLANES if compress else h
+    scratch = [
+        pltpu.VMEM((ndir, comm_rows, _LANES), comm_dtype),     # send_buf
+        pltpu.VMEM((ndir, 2, comm_rows, _LANES), comm_dtype),  # recv_buf
+        pltpu.VMEM((ndir, h, _LANES), dtype),                  # gchunk
+        pltpu.SemaphoreType.DMA((ndir, 2)),                    # send_sem
+        pltpu.SemaphoreType.DMA((ndir, 2)),                    # recv_sem
+        pltpu.SemaphoreType.REGULAR((ndir, 2)),                # cap_sem
+        pltpu.SemaphoreType.DMA,                               # local_sem
+    ]
+
+    kernel = _kernel_body(n, axis_name, handle, ndir, with_ag=with_ag,
+                          compress=compress)
     outs = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
@@ -316,15 +417,7 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         out_specs=tuple(out_specs),
-        scratch_shapes=[
-            pltpu.VMEM((ndir, h, _LANES), dtype),     # send_buf
-            pltpu.VMEM((ndir, 2, h, _LANES), dtype),  # recv_buf
-            pltpu.VMEM((ndir, h, _LANES), dtype),     # gchunk
-            pltpu.SemaphoreType.DMA((ndir, 2)),       # send_sem
-            pltpu.SemaphoreType.DMA((ndir, 2)),       # recv_sem
-            pltpu.SemaphoreType.REGULAR((ndir, 2)),   # cap_sem
-            pltpu.SemaphoreType.DMA,                  # local_sem
-        ],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
@@ -340,7 +433,8 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
 
 def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                    axis_name: str, num_devices: int,
-                   collective_id: int = None, bidir: bool = True):
+                   collective_id: int = None, bidir: bool = True,
+                   compress: bool = False):
     """Run the fused RS+update+AG ring inside a shard_map body.
 
     Args (per-device views inside shard_map):
@@ -357,12 +451,14 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
     Returns (new_store_chunk [chunk], pulled [n*chunk]).
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
-                      num_devices, collective_id, bidir, with_ag=True)
+                      num_devices, collective_id, bidir, with_ag=True,
+                      compress=compress)
 
 
 def ring_push(grads_chunks, store_chunk, handle: Callable,
               axis_name: str, num_devices: int,
-              collective_id: int = None, bidir: bool = True):
+              collective_id: int = None, bidir: bool = True,
+              compress: bool = False):
     """Push-only ring: reduce-scatter + fused server update, no
     all-gather (the ``ZPush`` leg alone).  Same contract as
     :func:`ring_push_pull`; returns just the new store chunk.
@@ -371,4 +467,5 @@ def ring_push(grads_chunks, store_chunk, handle: Callable,
     update to fuse, so XLA's native all_gather is already optimal.)
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
-                      num_devices, collective_id, bidir, with_ag=False)
+                      num_devices, collective_id, bidir, with_ag=False,
+                      compress=compress)

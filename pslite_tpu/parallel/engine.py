@@ -106,6 +106,7 @@ class CollectiveEngine:
         profiler=None,
         worker_axis: Optional[str] = None,
         impl: Optional[str] = None,
+        wire_compress: Optional[str] = None,
     ):
         """``impl``: data-plane implementation for stateless ``push_pull``
         — ``"xla"`` (default; psum_scatter → handle → all_gather as three
@@ -154,6 +155,18 @@ class CollectiveEngine:
         self.impl = impl or os.environ.get("PS_ICI_IMPL", "xla")
         log.check(self.impl in ("xla", "pallas"),
                   f"unknown engine impl {self.impl!r}")
+        # Wire compression on the ring data plane (pallas impl only):
+        # "int8" quantizes every hop payload with an embedded absmax
+        # scale — 4x fewer ICI bytes, lossy (the reference's int8 wire
+        # compression applied to the collective itself).  f32 buckets
+        # only; other configs ignore it.
+        self.wire_compress = (
+            wire_compress
+            if wire_compress is not None
+            else os.environ.get("PS_ICI_COMPRESS", "")
+        ) or None
+        log.check(self.wire_compress in (None, "int8"),
+                  f"unknown wire_compress {self.wire_compress!r}")
         self._server_handle = server_handle
         self._buckets: Dict[str, DenseBucket] = {}
         self._stores: Dict[str, jax.Array] = {}
@@ -419,9 +432,16 @@ class CollectiveEngine:
         return self._ring_program_op("push_pull", padded_len, dtype,
                                      handle_key)
 
+    def _ring_compress(self, dtype) -> bool:
+        return (
+            self.wire_compress == "int8"
+            and np.dtype(dtype) == np.float32
+        )
+
     def _ring_program_op(self, op: str, padded_len: int, dtype,
                          handle_key) -> Callable:
-        key = (f"ring_{op}", padded_len, str(dtype), handle_key)
+        compress = self._ring_compress(dtype)
+        key = (f"ring_{op}", padded_len, str(dtype), handle_key, compress)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
@@ -444,7 +464,7 @@ class CollectiveEngine:
         axis = self.axis
         n = self.num_shards
         chunk0 = padded_len // n
-        kchunk = ring_chunk_len(padded_len, n, dtype)
+        kchunk = ring_chunk_len(padded_len, n, dtype, compress=compress)
         cid = derive_collective_id(*key)
 
         def _padded(store_l, grads_l):
@@ -458,7 +478,8 @@ class CollectiveEngine:
         def body_pp(store_l, grads_l):
             g, s = _padded(store_l, grads_l)
             new, pulled = ring_push_pull(
-                g, s, handle, axis, n, collective_id=cid
+                g, s, handle, axis, n, collective_id=cid,
+                compress=compress,
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
@@ -467,7 +488,8 @@ class CollectiveEngine:
 
         def body_push(store_l, grads_l):
             g, s = _padded(store_l, grads_l)
-            new = ring_push(g, s, handle, axis, n, collective_id=cid)
+            new = ring_push(g, s, handle, axis, n, collective_id=cid,
+                            compress=compress)
             if kchunk != chunk0:
                 new = new[:chunk0]
             # Completion token, same contract as the XLA push program.
@@ -854,8 +876,10 @@ class CollectiveEngine:
                 ring_push_pull,
             )
 
+            compress = self._ring_compress(dtype)
             chunk0 = padded_len // n
-            kchunk = ring_chunk_len(padded_len, n, dtype)
+            kchunk = ring_chunk_len(padded_len, n, dtype,
+                                    compress=compress)
             g = grads_l[0].reshape(n, chunk0)
             s = store_l
             if kchunk != chunk0:
@@ -864,6 +888,7 @@ class CollectiveEngine:
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n,
                 collective_id=derive_collective_id(*key, i),
+                compress=compress,
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
