@@ -218,6 +218,7 @@ def main() -> None:
             )
             fused = None
             trace_gbps = None
+            emb_ms = None
         else:
             # Median of 3 rounds: single-run numbers on a shared chip vary
             # ~20%; the driver records whatever one invocation prints.
@@ -245,6 +246,15 @@ def main() -> None:
 
             rn_bytes, rn_dt = rn50(eng, steps=5)
             trace_gbps = rn_bytes / rn_dt / 1e9
+            # Sparse tier: the 1M-key zipf-skewed embedding push/pull —
+            # the BASELINE config-5 replay (gather + scatter-add bound).
+            from pslite_tpu.models.embedding import replay as emb
+
+            from pslite_tpu.parallel.sparse import SparseEngine
+
+            se = SparseEngine(eng.mesh, eng.axis)
+            emb_bytes, emb_dt = emb(se, steps=5)
+            emb_ms = emb_dt * 1e3
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
         hbm_est = _hbm_estimate(probe.get("device_kind", ""))
@@ -275,6 +285,9 @@ def main() -> None:
                 ),
                 "resnet50_trace_goodput": (
                     round(trace_gbps, 2) if trace_gbps is not None else None
+                ),
+                "embedding_1m_ms_per_step": (
+                    round(emb_ms, 1) if emb_ms is not None else None
                 ),
                 "hbm_util_est": hbm_util,
                 "note": (
