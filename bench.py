@@ -93,6 +93,33 @@ def _hbm_estimate(device_kind: str) -> float | None:
     return None
 
 
+def _hbm_peak_measured(iters: int = 50) -> float:
+    """Practical HBM peak (GB/s) via a chained donated triad
+    (s = s*a + g, 64 MB, traffic = read s + read g + write s = 3x).
+
+    Measured the same way the engine loop is (donated chain, host wall
+    clock) so the utilization ratio cancels any tunnel-timing skew.  A
+    chained data dependency defeats simple result-caching of repeated
+    identical executions, but is NOT a guarantee: r02 observed the
+    tunnel returning a 9.8 TB/s chained triad, so treat the result as an
+    upper bound and let the caller's timing_suspect guard judge it."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 16 << 20
+    g = jnp.ones((n,), jnp.float32)
+    step = jax.jit(lambda s, g: s * 0.999 + g, donate_argnums=(0,))
+    s = jnp.zeros((n,), jnp.float32)
+    s = step(s, g)
+    s.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = step(s, g)
+    s.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return 3 * (n * 4) / dt / 1e9
+
+
 def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
              host_grads: bool = False, handle=None) -> float:
     """Goodput (GB/s) of iterated push_pull on one registered bucket.
@@ -220,14 +247,16 @@ def main() -> None:
             trace_gbps = None
             emb_ms = None
         else:
-            # Median of 3 rounds: single-run numbers on a shared chip vary
-            # ~20%; the driver records whatever one invocation prints.
+            # Median of 5 rounds: single-run numbers through the shared
+            # tunnel vary up to ~2x between invocations (r02 observed
+            # 531 vs 1144 GB/s); the driver records whatever one
+            # invocation prints.
             iters = 30
             runs = sorted(
                 _measure(eng, "bench", 40, (1 << 20) // 4, iters)
-                for _ in range(3)
+                for _ in range(5)
             )
-            headline = runs[1]
+            headline = runs[2]
             headline_cfg = "40x1MB"
             host_path = _measure(
                 eng, "bench_host", 40, (1 << 20) // 4, 8, host_grads=True
@@ -257,13 +286,39 @@ def main() -> None:
             emb_ms = emb_dt * 1e3
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
-        hbm_est = _hbm_estimate(probe.get("device_kind", ""))
-        hbm_util = None
-        if hbm_est:
-            # Lower-bound HBM traffic of the fused step: read grads, read
-            # store, write store, write pulled = 4 x payload per iter.
-            # headline GB/s = 2 x payload / s, so traffic >= 2 x headline.
-            hbm_util = round(2 * headline / hbm_est, 3)
+        hbm_spec = _hbm_estimate(probe.get("device_kind", ""))
+        hbm_peak = None
+        if not quick:
+            try:
+                hbm_peak = _hbm_peak_measured()
+            except Exception:  # noqa: BLE001 - calibration is best-effort
+                hbm_peak = None
+        # HBM traffic of the fused 1-device step: read grads + read
+        # store + write store (outputs alias) = 3 x payload per iter;
+        # headline GB/s = 2 x payload / s, so traffic = 1.5 x headline.
+        # Two denominators, both reported: the public spec for the
+        # reported device kind, and a practical peak measured with the
+        # same chained-donation pattern as the engine loop.  When the
+        # measured "peak" exceeds spec by >1.5x the tunnel is eliding or
+        # pipelining device work and ALL wall-clock numbers in this run
+        # are upper bounds (r02 observed both a 47 PFLOP/s matmul and a
+        # 9.8 TB/s triad through the axon tunnel).
+        hbm_util = round(1.5 * headline / hbm_spec, 3) if hbm_spec else None
+        hbm_util_meas = (
+            round(1.5 * headline / hbm_peak, 3) if hbm_peak else None
+        )
+        # Absolute bound keeps the guard alive for unlisted device kinds
+        # (no single chip moves > ~3.3 TB/s HBM as of 2026).
+        timing_suspect = bool(hbm_peak) and (
+            (hbm_spec is not None and hbm_peak > 1.5 * hbm_spec)
+            or hbm_peak > 3300.0
+        )
+        suspect_note = (
+            "; TIMING SUSPECT: measured peak exceeds physical device "
+            "bandwidth — the tunnel elides/pipelines device work, treat "
+            "all wall-clock numbers as upper bounds"
+            if timing_suspect else ""
+        )
 
         baseline = 70.0  # GB/s: 70% of a ~100 GB/s per-chip ICI budget
         _emit(
@@ -289,13 +344,20 @@ def main() -> None:
                 "embedding_1m_ms_per_step": (
                     round(emb_ms, 1) if emb_ms is not None else None
                 ),
-                "hbm_util_est": hbm_util,
+                "hbm_util_vs_spec": hbm_util,
+                "hbm_util_vs_measured": hbm_util_meas,
+                "hbm_peak_measured": (
+                    round(hbm_peak, 1) if hbm_peak else None
+                ),
+                "hbm_spec": hbm_spec,
+                "timing_suspect": timing_suspect,
                 "note": (
                     "single-chip: collectives degenerate to HBM-local ops; "
                     "vs_baseline is an ICI-budget ratio the 1-device path "
-                    "does not traverse — hbm_util_est is the honest "
-                    "single-chip measure"
-                ) if single_chip else "multi-chip ICI path",
+                    "does not traverse — hbm_util_vs_* are the honest "
+                    "single-chip measures"
+                    + suspect_note
+                ) if single_chip else "multi-chip ICI path" + suspect_note,
             }
         )
     except Exception as exc:  # noqa: BLE001 - one parseable line, always
