@@ -308,10 +308,16 @@ def main() -> None:
             round(1.5 * headline / hbm_peak, 3) if hbm_peak else None
         )
         # Absolute bound keeps the guard alive for unlisted device kinds
-        # (no single chip moves > ~3.3 TB/s HBM as of 2026).
-        timing_suspect = bool(hbm_peak) and (
-            (hbm_spec is not None and hbm_peak > 1.5 * hbm_spec)
-            or hbm_peak > 3300.0
+        # (no single chip moves > ~3.3 TB/s HBM as of 2026).  The
+        # headline itself also trips the guard: a utilization > 1 means
+        # the engine loop "moved" more than the chip's HBM bandwidth.
+        timing_suspect = (
+            bool(hbm_peak) and (
+                (hbm_spec is not None and hbm_peak > 1.5 * hbm_spec)
+                or hbm_peak > 3300.0
+            )
+        ) or (hbm_util is not None and hbm_util > 1.0) or (
+            hbm_util_meas is not None and hbm_util_meas > 1.0
         )
         suspect_note = (
             "; TIMING SUSPECT: measured peak exceeds physical device "
