@@ -65,6 +65,7 @@ class KVMeta:
     addr: int = 0
     val_len: int = 0
     option: int = 0
+    priority: int = 0
 
 
 # meta.option marker: vals travel as int8 blocks + fp32 scales (gradient
@@ -526,6 +527,7 @@ class KVWorker:
                 continue
             msg = Message()
             m = msg.meta
+            m.priority = part.priority
             m.app_id = self._customer.app_id
             m.customer_id = self._customer.customer_id
             m.request = True
@@ -690,6 +692,9 @@ class KVServer:
         m.addr = req.addr
         m.val_len = req.val_len
         m.option = req.option
+        # Echo the request's priority: the response carries the bulk
+        # bytes on a pull, so scheduling must apply where they travel.
+        m.priority = req.priority
         if res is not None and not res.empty():
             if (
                 req.pull
@@ -738,6 +743,7 @@ class KVServer:
             addr=msg.meta.addr,
             val_len=msg.meta.val_len,
             option=msg.meta.option,
+            priority=msg.meta.priority,
         )
         kvs = KVPairs()
         if len(msg.data) >= 2:

@@ -157,6 +157,10 @@ class Meta:
     option: int = 0
     sid: int = EMPTY_ID
     data_size: int = 0
+    # Send-scheduling hint (KVPairs.priority): consumed by the sender's
+    # PS_PRIORITY_SCHED heap, and carried on the wire so a server can
+    # echo the request's priority into its (bulk) pull response.
+    priority: int = 0
     src_dev_type: int = int(DeviceType.UNK)
     src_dev_id: int = -1
     dst_dev_type: int = int(DeviceType.UNK)

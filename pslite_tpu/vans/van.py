@@ -14,6 +14,7 @@ send_msg / recv_msg / stop_transport``.
 from __future__ import annotations
 
 import copy
+import heapq
 import os
 import random
 import sys
@@ -69,6 +70,22 @@ class Van:
         self._send_sids: Dict[int, int] = {}
         self._recv_expected: Dict[int, int] = {}
         self._recv_buffered: Dict[int, Dict[int, Message]] = {}
+        # Optional priority send scheduling (PS_PRIORITY_SCHED=1): data
+        # messages drain through a max-heap so higher-priority tensors
+        # (KVPairs.priority, e.g. front layers a training step needs
+        # first) overtake lower ones queued behind a busy link — the
+        # BytePS communication-scheduling idea, new TPU-framework scope
+        # (the reference sends strictly FIFO).  sids are assigned at
+        # DISPATCH time so receive-side ordering (PS_FORCE_REQ_ORDER)
+        # sees a consistent sequence.  Control messages bypass the heap.
+        self._prio_sched = bool(self.env.find_int("PS_PRIORITY_SCHED", 0))
+        self._prio_heap: List[Tuple[int, int, Message]] = []
+        self._prio_cv = threading.Condition()
+        self._prio_seq = 0
+        self._prio_thread: Optional[threading.Thread] = None
+        self._prio_stop = False
+        self._prio_abort = False
+        self._prio_error: Optional[Exception] = None
 
     # -- transport interface -------------------------------------------------
 
@@ -99,6 +116,9 @@ class Van:
     def start(self, customer_id: int) -> None:
         with self._start_mu:
             if self._init_stage == 0:
+                self._prio_stop = False  # re-arm after a prior stop()
+                self._prio_abort = False
+                self._prio_error = None
                 self._init_nodes()
                 port = self.bind_transport(self.my_node, max_retry=40)
                 # Transports that bind multiple rails populate node.ports
@@ -170,6 +190,7 @@ class Van:
             self._connected_nodes[addr] = node.id
 
     def stop(self) -> None:
+        self._drain_priority_queue()
         if self.resender is not None:
             # Flush unacked messages (e.g. barrier replies a lossy link
             # dropped) before tearing the transport down.
@@ -207,6 +228,41 @@ class Van:
     def send(self, msg: Message) -> int:
         if msg.meta.sender == EMPTY_ID:
             msg.meta.sender = self.my_node.id
+        if self._prio_error is not None:
+            # A prior async dispatch failed; surface it on the next send
+            # so the application sees the transport error instead of a
+            # silent wait() hang (the sync path raises in place).  Read-
+            # and-clear under the lock: two racing senders must not both
+            # claim (and one re-raise None of) the same error.
+            with self._prio_cv:
+                exc, self._prio_error = self._prio_error, None
+            if exc is not None:
+                raise exc
+        if msg.meta.control.empty() and self._prio_sched:
+            with self._prio_cv:
+                # _prio_stop re-checked under the lock: a concurrent
+                # drain could have retired the consumer since the
+                # unlocked fast path — fall through to inline dispatch
+                # rather than stranding the message in the heap.
+                if not self._prio_stop:
+                    # Heap orders by (-priority, seq): highest priority
+                    # first, FIFO within a priority level.
+                    heapq.heappush(
+                        self._prio_heap,
+                        (-msg.meta.priority, self._prio_seq, msg),
+                    )
+                    self._prio_seq += 1
+                    if self._prio_thread is None:
+                        self._prio_thread = threading.Thread(
+                            target=self._priority_sender,
+                            name="van-prio-send", daemon=True,
+                        )
+                        self._prio_thread.start()
+                    self._prio_cv.notify()
+                    return 0  # bytes are accounted at dispatch
+        return self._dispatch_send(msg)
+
+    def _dispatch_send(self, msg: Message) -> int:
         if msg.meta.control.empty():
             with self._timestamp_mu:
                 sid = self._send_sids.get(msg.meta.recver, 0)
@@ -221,6 +277,59 @@ class Van:
             self.profiler.record(msg.meta.key, "send", msg.meta.push)
         log.vlog(2, f"SEND {msg.debug_string()}")
         return nbytes
+
+    def _priority_sender(self) -> None:
+        while True:
+            with self._prio_cv:
+                while not self._prio_heap and not self._prio_stop:
+                    self._prio_cv.wait()
+                if self._prio_abort:
+                    if self._prio_heap:
+                        log.error(
+                            f"priority queue aborted with "
+                            f"{len(self._prio_heap)} undispatched messages"
+                        )
+                        self._prio_heap.clear()
+                    self._prio_cv.notify_all()
+                    return
+                if not self._prio_heap and self._prio_stop:
+                    return
+                _, _, msg = heapq.heappop(self._prio_heap)
+                drained = not self._prio_heap
+            try:
+                self._dispatch_send(msg)
+            except Exception as exc:
+                # Async dispatch cannot raise to the caller; park the
+                # error for the next send() and log loudly (without
+                # PS_RESEND the message is lost and its wait() hangs).
+                log.error(f"priority send failed: {exc!r}")
+                self._prio_error = exc
+            if drained:
+                with self._prio_cv:
+                    self._prio_cv.notify_all()  # wake drain waiters
+
+    def _drain_priority_queue(self, timeout_s: float = 10.0) -> None:
+        """Block until every queued data message has been dispatched
+        (called before TERMINATE so shutdown cannot overtake data),
+        then retire the consumer.  Leaves the scheduler restart-safe:
+        late sends dispatch inline while _prio_stop holds, and stop()
+        re-arms the flags for a fresh start()."""
+        if not self._prio_sched:
+            return
+        deadline = time.monotonic() + timeout_s
+        with self._prio_cv:
+            while self._prio_heap and time.monotonic() < deadline:
+                self._prio_cv.wait(timeout=0.1)
+            self._prio_stop = True
+            if self._prio_heap:
+                # Deadline expired with messages still queued (stuck
+                # link): abort the consumer rather than letting it keep
+                # dispatching into a transport stop() is tearing down.
+                self._prio_abort = True
+            self._prio_cv.notify_all()
+        if self._prio_thread is not None:
+            self._prio_thread.join(timeout=5)
+            self._prio_thread = None
 
     def send_msg_locked(self, msg: Message) -> int:
         """Raw retransmit path used by the Resender (no re-buffering)."""

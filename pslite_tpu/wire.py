@@ -31,13 +31,13 @@ from .message import (
 from .sarray import SArray
 
 MAGIC = 0x50535450  # "PSTP"
-WIRE_VERSION = 1
+WIRE_VERSION = 2  # v2: priority field (send scheduling echo)
 
 _META_FIXED = struct.Struct(
     "<B"  # version
     "iiiii i"  # head app_id customer_id timestamp sender recver
     "B"  # flags: request|push|pull|simple_app
-    "Q Q q q i q"  # key addr val_len option sid data_size
+    "Q Q q q i q i"  # key addr val_len option sid data_size priority
     "b i b i"  # src_dev_type src_dev_id dst_dev_type dst_dev_id
     "B i Q"  # control_cmd barrier_group msg_sig
     "H H I"  # num_nodes num_data_types body_len
@@ -127,6 +127,7 @@ def pack_meta(meta: Meta) -> bytes:
         meta.option,
         meta.sid,
         meta.data_size,
+        meta.priority,
         meta.src_dev_type,
         meta.src_dev_id,
         meta.dst_dev_type,
@@ -164,6 +165,7 @@ def unpack_meta(buf: bytes) -> Meta:
         option,
         sid,
         data_size,
+        priority,
         src_dt,
         src_di,
         dst_dt,
@@ -210,6 +212,7 @@ def unpack_meta(buf: bytes) -> Meta:
         option=option,
         sid=sid,
         data_size=data_size,
+        priority=priority,
         src_dev_type=src_dt,
         src_dev_id=src_di,
         dst_dev_type=dst_dt,

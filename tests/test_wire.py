@@ -46,6 +46,7 @@ def _sample_meta() -> Meta:
         option=-5,
         sid=77,
         data_size=8192,
+        priority=9,
         src_dev_type=2,
         src_dev_id=0,
         dst_dev_type=1,
