@@ -121,27 +121,34 @@ def _hbm_peak_measured(iters: int = 50) -> float:
 
 
 def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
-             host_grads: bool = False, handle=None) -> float:
+             host_grads: bool = False, handle=None, dtype=None) -> float:
     """Goodput (GB/s) of iterated push_pull on one registered bucket.
 
     ``host_grads=True`` measures the message-origin path real users hit:
     the host->HBM ``device_put`` of a (persistent) host numpy buffer runs
     inside the timed loop (round-1 bench only ever timed pre-sharded
-    device arrays).  Allocation of fresh host arrays is NOT included."""
+    device arrays).  Allocation of fresh host arrays is NOT included.
+    ``dtype`` (default float32) sets the bucket dtype; goodput counts
+    actual payload bytes, so bf16 buckets move half the bytes per
+    element."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if dtype is None:
+        dtype = jnp.float32
+    itemsize = np.dtype(dtype).itemsize
     keys = np.arange(num_keys, dtype=np.uint64)
-    eng.register_dense(name, keys, val_len)
+    eng.register_dense(name, keys, val_len, dtype=dtype)
     bucket = eng.bucket(name)
     sharding = NamedSharding(eng.mesh, P(eng.axis, None))
     if host_grads:
-        inp = np.ones((eng.num_shards, bucket.padded_len), np.float32)
+        inp = np.ones((eng.num_shards, bucket.padded_len),
+                      np.dtype(dtype))
     else:
         inp = jax.device_put(
-            jnp.ones((eng.num_shards, bucket.padded_len), jnp.float32),
+            jnp.ones((eng.num_shards, bucket.padded_len), dtype),
             sharding,
         )
     # Warmup: compile + first-touch (the rendezvous equivalent).
@@ -153,7 +160,7 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
         out = eng.push_pull(name, inp, handle=handle)
     out.block_until_ready()
     elapsed = time.perf_counter() - t0
-    payload = num_keys * val_len * 4  # bytes per direction
+    payload = num_keys * val_len * itemsize  # bytes per direction
     return 2 * payload * iters / elapsed / 1e9  # push + pull
 
 
@@ -244,6 +251,7 @@ def main() -> None:
                 eng, "bench_host", 4, (64 << 10) // 4, 2, host_grads=True
             )
             fused = None
+            bf16 = None
             trace_gbps = None
             emb_ms = None
         else:
@@ -267,6 +275,14 @@ def main() -> None:
             fused = _measure(
                 eng, "bench_fused", 40, (1 << 20) // 4, 8,
                 handle="sgd_momentum:0.01,0.9",
+            )
+            # bf16 buckets: same element count as the headline, half the
+            # bytes — the TPU-native dtype for gradient exchange.
+            import jax.numpy as _jnp
+
+            bf16 = _measure(
+                eng, "bench_bf16", 40, (1 << 20) // 4, 8,
+                dtype=_jnp.bfloat16,
             )
             # Model-shaped workload: the ResNet-50 gradient trace
             # (~205 MB/step in ~35 size-bucketed tensors) as one grouped
@@ -341,6 +357,9 @@ def main() -> None:
                 "n_devices": probe.get("n"),
                 "sweep_1key": sweep,
                 "host_origin_goodput": round(host_path, 2),
+                "bf16_goodput": (
+                    round(bf16, 2) if bf16 is not None else None
+                ),
                 "fused_sgdm_goodput": (
                     round(fused, 2) if fused is not None else None
                 ),

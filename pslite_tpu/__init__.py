@@ -19,6 +19,7 @@ from .kv import (
     KVPairs,
     KVServer,
     KVServerDefaultHandle,
+    KVServerOptimizerHandle,
     KVWorker,
     SimpleApp,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "KVPairs",
     "KVServer",
     "KVServerDefaultHandle",
+    "KVServerOptimizerHandle",
     "KVWorker",
     "Message",
     "Meta",

@@ -1,4 +1,5 @@
-from .kv_app import KVMeta, KVPairs, KVServer, KVServerDefaultHandle, KVWorker
+from .kv_app import (KVMeta, KVPairs, KVServer, KVServerDefaultHandle,
+                     KVServerOptimizerHandle, KVWorker)
 from .simple_app import SimpleApp, SimpleData
 
 __all__ = [
@@ -6,6 +7,7 @@ __all__ = [
     "KVPairs",
     "KVServer",
     "KVServerDefaultHandle",
+    "KVServerOptimizerHandle",
     "KVWorker",
     "SimpleApp",
     "SimpleData",

@@ -659,6 +659,10 @@ class KVServer:
         self._customer = Customer(app_id, app_id, self._process, self.po)
         self._handle: Optional[Callable[[KVMeta, KVPairs, "KVServer"], None]] = None
         self._recv_buffers: Dict[Tuple[int, int], np.ndarray] = {}
+        # Count of pushes the TRANSPORT placed directly into a registered
+        # buffer (vs the kv_app copy fallback) — observability for the
+        # zero-copy delivery contract.
+        self.delivered_in_place = 0
 
     def set_request_handle(
         self, handle: Callable[[KVMeta, KVPairs, "KVServer"], None]
@@ -761,13 +765,22 @@ class KVServer:
         if meta.push and len(kvs.keys):
             reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
             if reg is not None:
-                # Deliver into the pre-registered buffer and alias it, so the
-                # app-level address-identity check of the reference benchmark
-                # (test_benchmark.cc:169-181) holds.
-                flat = reg.reshape(-1).view(np.uint8)
-                raw = kvs.vals.reshape(-1).view(np.uint8)
-                flat[: raw.nbytes] = raw
-                kvs.vals = reg.reshape(-1)[: len(kvs.vals.reshape(-1).view(reg.dtype))]
+                if np.shares_memory(kvs.vals, reg):
+                    # The transport already delivered in place (shm van
+                    # register_recv_buffer hook) — alias only, no copy.
+                    self.delivered_in_place += 1
+                    kvs.vals = kvs.vals.view(reg.dtype)
+                else:
+                    # Fallback for transports without the hook: copy into
+                    # the pre-registered buffer and alias it, so the
+                    # app-level address-identity check of the reference
+                    # benchmark (test_benchmark.cc:169-181) holds.
+                    flat = reg.reshape(-1).view(np.uint8)
+                    raw = kvs.vals.reshape(-1).view(np.uint8)
+                    flat[: raw.nbytes] = raw
+                    kvs.vals = reg.reshape(-1)[
+                        : len(kvs.vals.reshape(-1).view(reg.dtype))
+                    ]
         log.check(self._handle is not None, "KVServer handle not set")
         self._handle(meta, kvs, self)
 
@@ -800,6 +813,94 @@ class KVServerDefaultHandle:
             res = KVPairs(
                 keys=req_data.keys,
                 vals=(np.concatenate(vals) if vals else np.empty(0, np.float32)),
+            )
+            server.response(req_meta, res)
+        else:
+            server.response(req_meta)
+
+
+class KVServerOptimizerHandle:
+    """Server-side optimizer for the async-PS pattern (docs/overview.md
+    of the reference: workers push gradients with no inter-worker
+    barrier; the SERVER owns the optimizer and applies each push as it
+    arrives; pulls return current parameters).
+
+    push => params[key] = update(params[key], grad); pull => params[key].
+    The engine path's equivalent is the fused Pallas handles
+    (``server_handle="sgd_momentum"/"adam"``); this is the message-path
+    (host/numpy) twin so both PS aggregation modes offer optimizers.
+
+    ``kind``: "sgd" | "sgd_momentum" | "adam".  Unknown keys initialize
+    to zeros on first push (or seed via ``init``).
+    """
+
+    def __init__(self, kind: str = "sgd", lr: float = 0.01,
+                 momentum: float = 0.9, betas=(0.9, 0.999),
+                 eps: float = 1e-8):
+        log.check(kind in ("sgd", "sgd_momentum", "adam"),
+                  f"unknown optimizer {kind!r}")
+        self.kind = kind
+        self.lr = lr
+        self.momentum = momentum
+        self.betas = betas
+        self.eps = eps
+        self.store: Dict[int, np.ndarray] = {}
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+        self._t: Dict[int, int] = {}
+
+    def init(self, key: int, value: np.ndarray) -> None:
+        self.store[int(key)] = np.asarray(value, np.float32).copy()
+
+    def _apply(self, key: int, grad: np.ndarray) -> None:
+        p = self.store.get(key)
+        if p is None:
+            p = np.zeros_like(grad)
+        if self.kind == "sgd":
+            p = p - self.lr * grad
+        elif self.kind == "sgd_momentum":
+            m = self._m.get(key, np.zeros_like(grad))
+            m = self.momentum * m + grad
+            self._m[key] = m
+            p = p - self.lr * m
+        else:  # adam
+            b1, b2 = self.betas
+            t = self._t.get(key, 0) + 1
+            self._t[key] = t
+            m = b1 * self._m.get(key, np.zeros_like(grad)) + (1 - b1) * grad
+            v = b2 * self._v.get(key, np.zeros_like(grad)) + (
+                1 - b2
+            ) * grad * grad
+            self._m[key] = m
+            self._v[key] = v
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            p = p - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        self.store[key] = p
+
+    def __call__(self, req_meta: KVMeta, req_data: KVPairs,
+                 server: KVServer):
+        if req_meta.push:
+            n = len(req_data.keys)
+            if n:
+                log.check(len(req_data.vals) % n == 0, "bad push shape")
+                k = len(req_data.vals) // n
+                for i, key in enumerate(req_data.keys):
+                    self._apply(
+                        int(key),
+                        req_data.vals[i * k : (i + 1) * k].astype(
+                            np.float32, copy=False
+                        ),
+                    )
+        if req_meta.pull:
+            for k in req_data.keys:
+                log.check(int(k) in self.store,
+                          f"pull of unknown key {k}")
+            vals = [self.store[int(k)] for k in req_data.keys]
+            res = KVPairs(
+                keys=req_data.keys,
+                vals=(np.concatenate(vals) if vals
+                      else np.empty(0, np.float32)),
             )
             server.response(req_meta, res)
         else:

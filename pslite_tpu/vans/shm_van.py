@@ -75,6 +75,11 @@ class ShmVan(TcpVan):
         self._peer_hosts: Dict[int, str] = {}
         self._min_bytes = self.env.find_int("PS_SHM_MIN_BYTES", 4096)
         self._pull_ns_cache: Optional[str] = None
+        # (sender_id, key) -> pre-registered push receive buffer: the
+        # transport delivers push payloads straight into it (the NIC-DMA
+        # semantics of RegisterRecvBuffer, kv_app.h:396-403) instead of
+        # materializing a fresh array for kv_app to copy from.
+        self._push_recv_bufs: Dict[tuple, np.ndarray] = {}
 
     def connect_transport(self, node) -> None:
         super().connect_transport(node)
@@ -248,6 +253,53 @@ class ShmVan(TcpVan):
         sent = super().send_msg(meta_only)
         return sent + total
 
+    def register_recv_buffer(self, sender_id: int, key: int,
+                             buffer: np.ndarray) -> None:
+        """Transport-level registered push buffer (van.h:114-116 hook):
+        payloads for (sender, key) land in ``buffer`` at delivery."""
+        self._push_recv_bufs[(sender_id, key)] = buffer
+
+    def _deliver_registered_push(self, msg: Message) -> None:
+        """If a registered buffer exists for this push, place the vals
+        payload into it and alias the message's vals SArray to the
+        buffer — in-place delivery at the transport, not a kv_app
+        after-the-fact copy.
+
+        Shares the module's at-most-one-outstanding-message-per
+        (key, direction) contract (see module docstring): the buffer is
+        written at recv time on the van thread, so a second in-flight
+        push for the same (sender, key) would overwrite it before the
+        handler reads the first — exactly as the reused shm segments
+        (and the reference's registered buffers, kv_app.h:210-217)
+        already require callers to wait() between same-key pushes.
+
+        Compressed pushes are excluded: their wire payload is quantized
+        int8, not the values the registered buffer promises."""
+        from ..kv.kv_app import OPT_COMPRESS_INT8
+
+        m = msg.meta
+        if not (m.push and m.request and m.control.empty()
+                and m.option != OPT_COMPRESS_INT8
+                and len(msg.data) >= 2):
+            return
+        reg = self._push_recv_bufs.get((m.sender, m.key))
+        if reg is None:
+            return
+        vals = msg.data[1]
+        flat = reg.reshape(-1).view(np.uint8)
+        raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
+        if raw.nbytes > flat.nbytes:
+            log.warning(
+                f"registered buffer for key {m.key} too small "
+                f"({flat.nbytes} < {raw.nbytes}); delivering unpinned"
+            )
+            return
+        flat[: raw.nbytes] = raw
+        n = raw.nbytes // np.dtype(vals.dtype).itemsize
+        msg.data[1] = SArray(
+            reg.reshape(-1).view(vals.dtype)[:n]
+        )
+
     def recv_msg(self):
         msg = super().recv_msg()
         if msg is None:
@@ -306,6 +358,7 @@ class ShmVan(TcpVan):
             msg.meta.body = (
                 base64.b64decode(info["body"]) if "body" in info else b""
             )
+        self._deliver_registered_push(msg)
         return msg
 
     def stop_transport(self) -> None:

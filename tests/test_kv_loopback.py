@@ -387,3 +387,124 @@ def test_registered_recv_buffer_identity():
         for s in servers:
             s.stop()
         cluster.finalize()
+
+
+def test_registered_recv_buffer_transport_delivery_shm():
+    """On the shm van the TRANSPORT delivers pushes into the registered
+    buffer (register_recv_buffer hook) — not the kv_app copy fallback:
+    KVServer.delivered_in_place counts the hook firing."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=1,
+                              van_type="shm",
+                              env_extra={"PS_SHM_MIN_BYTES": "1"})
+    cluster.start()
+    servers = []
+    try:
+        seen = {}
+
+        def handle(meta, data, server):
+            if meta.push:
+                seen["vals"] = data.vals
+            server.response(meta)
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(handle)
+        servers.append(srv)
+
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        worker_id = cluster.workers[0].van.my_node.id
+        registered = np.zeros(4096, dtype=np.float32)
+        srv.register_recv_buffer(worker_id, 7, registered)
+
+        vals = np.arange(4096, dtype=np.float32)
+        worker.wait(worker.push(np.array([7], np.uint64), vals))
+        assert "vals" in seen
+        assert np.shares_memory(seen["vals"], registered)
+        np.testing.assert_allclose(registered, vals)
+        assert srv.delivered_in_place == 1, srv.delivered_in_place
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_server_optimizer_handle_async_sgd():
+    """Async-PS: two workers push gradients with NO inter-worker barrier;
+    the server owns the optimizer (KVServerOptimizerHandle) and applies
+    each push on arrival.  Plain SGD is order-independent, so the final
+    params equal -lr * sum(all grads)."""
+    from pslite_tpu import KVServerOptimizerHandle
+
+    cluster = LoopbackCluster(num_workers=2, num_servers=1)
+    cluster.start()
+    servers = []
+    try:
+        handle = KVServerOptimizerHandle(kind="sgd", lr=0.1)
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(handle)
+        servers.append(srv)
+        workers = [KVWorker(0, 0, postoffice=po) for po in cluster.workers]
+
+        keys = np.array([3, 9], np.uint64)
+        rng = np.random.default_rng(0)
+        grads = [rng.normal(size=8).astype(np.float32) for _ in range(6)]
+        ts = []
+        for i, g in enumerate(grads):  # interleaved, unsynchronized
+            ts.append((workers[i % 2], workers[i % 2].push(keys, g)))
+        for w, t in ts:
+            w.wait(t)
+        out = np.zeros(8, np.float32)
+        workers[0].wait(workers[0].pull(keys, out))
+        expected = -0.1 * np.sum(grads, axis=0)
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_server_optimizer_handle_momentum_adam():
+    """Stateful kinds match a host reference loop (single worker, so
+    application order is deterministic)."""
+    from pslite_tpu import KVServerOptimizerHandle
+
+    for kind in ("sgd_momentum", "adam"):
+        cluster = LoopbackCluster(num_workers=1, num_servers=1)
+        cluster.start()
+        servers = []
+        try:
+            handle = KVServerOptimizerHandle(kind=kind, lr=0.05)
+            handle.init(1, np.ones(4, np.float32))
+            srv = KVServer(0, postoffice=cluster.servers[0])
+            srv.set_request_handle(handle)
+            servers.append(srv)
+            w = KVWorker(0, 0, postoffice=cluster.workers[0])
+
+            rng = np.random.default_rng(7)
+            grads = [rng.normal(size=4).astype(np.float32)
+                     for _ in range(5)]
+            for g in grads:
+                w.wait(w.push(np.array([1], np.uint64), g))
+            out = np.zeros(4, np.float32)
+            w.wait(w.pull(np.array([1], np.uint64), out))
+
+            # Host reference.
+            p = np.ones(4, np.float32)
+            if kind == "sgd_momentum":
+                m = np.zeros(4)
+                for g in grads:
+                    m = 0.9 * m + g
+                    p = p - 0.05 * m
+            else:
+                m = np.zeros(4)
+                v = np.zeros(4)
+                for t, g in enumerate(grads, 1):
+                    m = 0.9 * m + 0.1 * g
+                    v = 0.999 * v + 0.001 * g * g
+                    p = p - 0.05 * (m / (1 - 0.9 ** t)) / (
+                        np.sqrt(v / (1 - 0.999 ** t)) + 1e-8
+                    )
+            np.testing.assert_allclose(out, p, rtol=1e-5, atol=1e-6)
+        finally:
+            for s in servers:
+                s.stop()
+            cluster.finalize()

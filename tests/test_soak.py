@@ -20,7 +20,13 @@ def test_randomized_push_pull_soak():
     # bounded tracker makes long horizons safe — see
     # test_customer_tracker_bounded).
     rng = np.random.default_rng(1234)
-    cluster = LoopbackCluster(num_workers=2, num_servers=3)
+    # PS_SOAK_PRIORITY=1 additionally soaks the priority send scheduler
+    # (random per-request priorities through the van heap).
+    prio = bool(int(os.environ.get("PS_SOAK_PRIORITY", "0")))
+    cluster = LoopbackCluster(
+        num_workers=2, num_servers=3,
+        env_extra={"PS_PRIORITY_SCHED": "1"} if prio else None,
+    )
     cluster.start()
     servers = []
     try:
@@ -57,9 +63,10 @@ def test_randomized_push_pull_soak():
             if not take.any():
                 continue
             keys = pool[take]
+            pr = int(rng.integers(0, 10)) if prio else 0
             if rng.random() < 0.6 or not model:
                 vals = rng.normal(size=len(keys) * k).astype(np.float32)
-                w.wait(w.push(keys, vals))
+                w.wait(w.push(keys, vals, priority=pr))
                 for i, key in enumerate(keys):
                     seg = vals[i * k : (i + 1) * k]
                     key = int(key)
@@ -72,7 +79,7 @@ def test_randomized_push_pull_soak():
                 if len(known) == 0:
                     continue
                 out = np.zeros(len(known) * k, dtype=np.float32)
-                w.wait(w.pull(known, out))
+                w.wait(w.pull(known, out, priority=pr))
                 expected = np.concatenate(
                     [model[int(key)] for key in known]
                 )
