@@ -236,6 +236,55 @@ def load_kv_store(path: str) -> Dict[int, np.ndarray]:
     return {int(k): data[k] for k in data.files}
 
 
+def save_server_handle(handle, path: str) -> None:
+    """Snapshot a message-path server handle — params AND optimizer
+    state, so a keepalive-restarted server (tracker/local.py exit-254
+    elasticity) resumes async-PS training exactly where it died.
+
+    Supports ``KVServerDefaultHandle`` (store only) and
+    ``KVServerOptimizerHandle`` (store + momentum/adam slots + step
+    counts).  The reference has no server persistence at all (its
+    server state dies with the handler's memory — SURVEY §5)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # list() snapshots guard against the van receive thread inserting
+    # first-seen keys mid-iteration.  Per-key consistency holds because
+    # _apply replaces values atomically; for a bitwise-exact multi-slot
+    # snapshot (e.g. adam m/v of the same in-flight key), quiesce the
+    # server (stop pushing / drain) before saving.
+    arrays = {f"s_{k}": v for k, v in list(handle.store.items())}
+    for slot in ("_m", "_v"):
+        for k, v in list(getattr(handle, slot, {}).items()):
+            arrays[f"{slot}_{k}"] = v
+    t = getattr(handle, "_t", None)
+    if t:
+        items = sorted(list(t.items()))
+        arrays["t_keys"] = np.asarray([k for k, _ in items], np.int64)
+        arrays["t_vals"] = np.asarray([v for _, v in items], np.int64)
+    np.savez(path, **arrays)
+
+
+def load_server_handle(handle, path: str) -> None:
+    """Restore state saved by :func:`save_server_handle` into a freshly
+    constructed handle (hyperparameters come from the constructor)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    data = np.load(path)
+    t_map = {}
+    if "t_keys" in data.files:
+        t_map = dict(
+            zip(data["t_keys"].tolist(), data["t_vals"].tolist())
+        )
+    for name in data.files:
+        if name.startswith("s_"):
+            handle.store[int(name[2:])] = data[name]
+        elif name.startswith("_m_"):
+            handle._m[int(name[3:])] = data[name]
+        elif name.startswith("_v_"):
+            handle._v[int(name[3:])] = data[name]
+    if t_map and hasattr(handle, "_t"):
+        handle._t.update(t_map)
+
+
 def save_train_state(flat_store, step: int, path: str) -> str:
     """Snapshot the flagship training loop's sharded parameter store.
 

@@ -68,9 +68,9 @@ class KVMeta:
     priority: int = 0
 
 
-# meta.option marker: vals travel as int8 blocks + fp32 scales (gradient
-# compression for DCN-class links; ops/quantize.py scheme).
-OPT_COMPRESS_INT8 = 1
+# Re-exported from message.py (transports consume it there without
+# importing the app layer; kept here for existing importers).
+from ..message import OPT_COMPRESS_INT8  # noqa: E402,F401
 # Zero-copy pull (is_worker_zpull_, kv_app.h:727-792): the transport
 # delivers each server's pull-response slice directly into the worker's
 # pre-registered buffer; meta.addr carries (buf_id << 40) | byte_offset.

@@ -75,6 +75,11 @@ except ImportError:  # pragma: no cover
 OPT_ZPULL = 2
 ZPULL_OFF_BITS = 40
 
+# meta.option marker: vals travel as int8 blocks + fp32 scales (gradient
+# compression for DCN-class links; ops/quantize.py scheme).  Lives here
+# for the same layering reason as OPT_ZPULL.
+OPT_COMPRESS_INT8 = 1
+
 
 def dtype_code(dt) -> int:
     return _DTYPE_TO_CODE.get(np.dtype(dt), 2)  # default: raw bytes

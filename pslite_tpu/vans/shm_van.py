@@ -26,7 +26,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import wire
-from ..message import Message, OPT_ZPULL, ZPULL_OFF_BITS
+from ..message import Message, OPT_COMPRESS_INT8, OPT_ZPULL, ZPULL_OFF_BITS
 from ..sarray import SArray
 from ..utils import logging as log
 from .tcp_van import TcpVan
@@ -259,24 +259,24 @@ class ShmVan(TcpVan):
         payloads for (sender, key) land in ``buffer`` at delivery."""
         self._push_recv_bufs[(sender_id, key)] = buffer
 
-    def _deliver_registered_push(self, msg: Message) -> None:
-        """If a registered buffer exists for this push, place the vals
-        payload into it and alias the message's vals SArray to the
-        buffer — in-place delivery at the transport, not a kv_app
-        after-the-fact copy.
+    def deliver_data_msg(self, msg: Message) -> None:
+        """Van hook (runs after drop/dedup/ordering): if a registered
+        buffer exists for this push, place the vals payload into it and
+        alias the message's vals SArray to the buffer — in-place
+        delivery at the transport, not a kv_app after-the-fact copy.
 
         Shares the module's at-most-one-outstanding-message-per
-        (key, direction) contract (see module docstring): the buffer is
-        written at recv time on the van thread, so a second in-flight
-        push for the same (sender, key) would overwrite it before the
-        handler reads the first — exactly as the reused shm segments
-        (and the reference's registered buffers, kv_app.h:210-217)
-        already require callers to wait() between same-key pushes.
+        (key, direction) contract (see module docstring): a second
+        in-flight push for the same (sender, key) would overwrite the
+        buffer before the handler reads the first — exactly as the
+        reused shm segments (and the reference's registered buffers,
+        kv_app.h:210-217) already require callers to wait() between
+        same-key pushes.
 
         Compressed pushes are excluded: their wire payload is quantized
-        int8, not the values the registered buffer promises."""
-        from ..kv.kv_app import OPT_COMPRESS_INT8
-
+        int8, not the values the registered buffer promises.  Any
+        placement failure delivers the message unpinned rather than
+        disturbing the pump."""
         m = msg.meta
         if not (m.push and m.request and m.control.empty()
                 and m.option != OPT_COMPRESS_INT8
@@ -285,20 +285,26 @@ class ShmVan(TcpVan):
         reg = self._push_recv_bufs.get((m.sender, m.key))
         if reg is None:
             return
-        vals = msg.data[1]
-        flat = reg.reshape(-1).view(np.uint8)
-        raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
-        if raw.nbytes > flat.nbytes:
-            log.warning(
-                f"registered buffer for key {m.key} too small "
-                f"({flat.nbytes} < {raw.nbytes}); delivering unpinned"
+        try:
+            vals = msg.data[1]
+            flat = reg.reshape(-1).view(np.uint8)
+            raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
+            if raw.nbytes > flat.nbytes:
+                log.warning(
+                    f"registered buffer for key {m.key} too small "
+                    f"({flat.nbytes} < {raw.nbytes}); delivering unpinned"
+                )
+                return
+            flat[: raw.nbytes] = raw
+            n = raw.nbytes // np.dtype(vals.dtype).itemsize
+            msg.data[1] = SArray(
+                reg.reshape(-1).view(vals.dtype)[:n]
             )
-            return
-        flat[: raw.nbytes] = raw
-        n = raw.nbytes // np.dtype(vals.dtype).itemsize
-        msg.data[1] = SArray(
-            reg.reshape(-1).view(vals.dtype)[:n]
-        )
+        except Exception as exc:  # malformed push: deliver unpinned
+            log.warning(
+                f"registered-buffer delivery failed for key {m.key}: "
+                f"{exc!r}; delivering unpinned"
+            )
 
     def recv_msg(self):
         msg = super().recv_msg()
@@ -358,7 +364,6 @@ class ShmVan(TcpVan):
             msg.meta.body = (
                 base64.b64decode(info["body"]) if "body" in info else b""
             )
-        self._deliver_registered_push(msg)
         return msg
 
     def stop_transport(self) -> None:

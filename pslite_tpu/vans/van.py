@@ -457,7 +457,14 @@ class Van:
         self._recv_expected[sender] = expected
         return ready
 
+    def deliver_data_msg(self, msg: Message) -> None:
+        """Transport hook: last-mile payload placement (e.g. registered
+        recv buffers).  Runs AFTER drop injection, resender dedup, and
+        ordering — a suppressed duplicate must never touch an app
+        buffer.  Default: no-op."""
+
     def _process_data_msg(self, msg: Message) -> None:
+        self.deliver_data_msg(msg)
         self.profiler.record(msg.meta.key, "recv", msg.meta.push)
         app_id = msg.meta.app_id
         # Workers demux by customer_id (several KVWorker customers share one

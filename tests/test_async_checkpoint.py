@@ -72,3 +72,44 @@ def test_async_error_surfaces(tmp_path):
     eng2.register_dense("b", np.arange(2, dtype=np.uint64), 16)
     restore_engine(eng2, ok)
     ck.close()
+
+
+def test_server_handle_checkpoint_resume(tmp_path):
+    """Async-PS server restart: snapshot the optimizer handle mid-stream,
+    restore into a fresh handle, continue pushing — identical to an
+    uninterrupted run (stateful kinds included)."""
+    from pslite_tpu.checkpoint import load_server_handle, save_server_handle
+    from pslite_tpu.kv.kv_app import KVMeta, KVPairs, KVServerOptimizerHandle
+
+    class _Sink:
+        def response(self, *a, **k):
+            pass
+
+    def push(h, key, grad):
+        h(KVMeta(push=True),
+          KVPairs(keys=np.array([key], np.uint64), vals=grad), _Sink())
+
+    rng = np.random.default_rng(3)
+    grads = [rng.normal(size=6).astype(np.float32) for _ in range(8)]
+
+    for kind in ("sgd", "sgd_momentum", "adam"):
+        ref = KVServerOptimizerHandle(kind=kind, lr=0.02)
+        ref.init(4, np.ones(6, np.float32))
+        for g in grads:
+            push(ref, 4, g)
+
+        first = KVServerOptimizerHandle(kind=kind, lr=0.02)
+        first.init(4, np.ones(6, np.float32))
+        for g in grads[:4]:
+            push(first, 4, g)
+        path = str(tmp_path / f"handle_{kind}")
+        save_server_handle(first, path)
+
+        resumed = KVServerOptimizerHandle(kind=kind, lr=0.02)
+        load_server_handle(resumed, path)
+        for g in grads[4:]:
+            push(resumed, 4, g)
+        np.testing.assert_allclose(
+            resumed.store[4], ref.store[4], rtol=1e-6, atol=1e-7,
+            err_msg=kind,
+        )
