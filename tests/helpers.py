@@ -9,6 +9,7 @@ Environment override map, so one OS process hosts many logical nodes.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from typing import Dict, List, Optional
 
@@ -44,6 +45,10 @@ class LoopbackCluster:
             "DMLC_NODE_HOST": host,
             "PS_VAN_TYPE": van_type,
         }
+        # PS_TEST_PRIORITY=1 runs the whole in-process matrix with the
+        # priority send scheduler on — a cross-cutting race flush.
+        if os.environ.get("PS_TEST_PRIORITY"):
+            self.base_env.setdefault("PS_PRIORITY_SCHED", "1")
         if env_extra:
             self.base_env.update(env_extra)
         self.scheduler = self._make(Role.SCHEDULER, 0)
