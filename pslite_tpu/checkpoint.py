@@ -33,12 +33,14 @@ def save_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     handed to orbax as-is, so multi-host saves write per-shard)."""
     import orbax.checkpoint as ocp
 
-    state = {"dense": {}, "sparse": {}}
+    state = {"dense": {}, "sparse": {}, "sparse_acc": {}}
     for name in engine._buckets:
         state["dense"][name] = engine.store_array(name)
     if sparse_engine is not None:
         for name in sparse_engine._tables:
             state["sparse"][name] = sparse_engine.store_array(name)
+            if name in sparse_engine._acc:
+                state["sparse_acc"][name] = sparse_engine.acc_array(name)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.abspath(path), state, force=True)
         ckptr.wait_until_finished()
@@ -49,12 +51,23 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     the target shardings exist (same contract as restore_engine)."""
     import orbax.checkpoint as ocp
 
-    target = {"dense": {}, "sparse": {}}
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    target = {"dense": {}, "sparse": {}, "sparse_acc": {}}
     for name in engine._buckets:
         target["dense"][name] = engine.store_spec(name)
     if sparse_engine is not None:
         for name in sparse_engine._tables:
             target["sparse"][name] = sparse_engine.store_spec(name)
+            if name in sparse_engine._acc:
+                acc = sparse_engine._acc[name]
+                target["sparse_acc"][name] = jax.ShapeDtypeStruct(
+                    acc.shape, acc.dtype,
+                    sharding=NamedSharding(
+                        sparse_engine.mesh, P(sparse_engine.axis)
+                    ),
+                )
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(os.path.abspath(path), target)
     # The targets are ShapeDtypeStructs carrying the live stores'
@@ -66,6 +79,8 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     if sparse_engine is not None:
         for name, arr in state["sparse"].items():
             sparse_engine.set_store_array(name, arr)
+        for name, arr in state.get("sparse_acc", {}).items():
+            sparse_engine.set_acc_array(name, arr)
 
 
 def save_engine(engine, path: str, sparse_engine=None) -> None:
@@ -94,7 +109,12 @@ def save_engine(engine, path: str, sparse_engine=None) -> None:
             meta["sparse"][name] = {
                 "num_rows": table.num_rows,
                 "dim": table.dim,
+                "has_acc": name in sparse_engine._acc,
             }
+            if name in sparse_engine._acc:
+                arrays[f"sparse_acc/{name}"] = np.asarray(
+                    sparse_engine.acc_array(name)
+                )
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
     )
@@ -122,8 +142,10 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
             [data[f"opt/{name}/{i}"] for i in range(info["n"])],
         )
     if sparse_engine is not None:
-        for name in meta["sparse"]:
+        for name, info in meta["sparse"].items():
             sparse_engine.set_store_array(name, data[f"sparse/{name}"])
+            if info.get("has_acc"):
+                sparse_engine.set_acc_array(name, data[f"sparse_acc/{name}"])
 
 
 class AsyncEngineCheckpointer:

@@ -59,17 +59,25 @@ def predict(mlp, emb_rows, dense_feats, cfg: DLRMConfig):
 
 
 def make_train_step(cfg: DLRMConfig, engine, sparse_engine, lr: float = 0.1,
-                    seed: int = 0):
+                    seed: int = 0, emb_optimizer: str = None):
     """Returns ``step(idx, dense, labels) -> loss`` driving both PS planes.
 
     ``idx``: [W, B, num_cat] rows per worker shard; ``dense``:
     [W, B, num_dense]; ``labels``: [W, B] in {0,1}.
+
+    ``emb_optimizer="row_adagrad"`` trains the embedding table with the
+    fused row-wise Adagrad handle (the industry-standard sparse
+    optimizer) instead of plain SGD scatter-add.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.flatten_util import ravel_pytree
 
+    from ..utils import logging as log
+
+    log.check(emb_optimizer in (None, "row_adagrad"),
+              f"unknown emb_optimizer {emb_optimizer!r}")
     W = engine.num_shards
     mlp0 = init_mlp(jax.random.PRNGKey(seed), cfg)
     flat0, unravel = ravel_pytree(mlp0)
@@ -118,7 +126,12 @@ def make_train_step(cfg: DLRMConfig, engine, sparse_engine, lr: float = 0.1,
         engine.push("dlrm_mlp", -lr * g_flat / W, handle="sum")
         # -- sparse push: per-row gradients scatter-add into the table ------
         g_rows = g_rows.reshape(W, B * cfg.num_cat, cfg.emb_dim)
-        sparse_engine.push("dlrm_emb", flat_idx, -lr * g_rows)
+        if emb_optimizer == "row_adagrad":
+            # Raw gradient: the fused handle applies -lr*G/(sqrt(acc)+eps).
+            sparse_engine.push("dlrm_emb", flat_idx, g_rows,
+                               handle=f"row_adagrad:{lr}")
+        else:
+            sparse_engine.push("dlrm_emb", flat_idx, -lr * g_rows)
         return loss
 
     return step

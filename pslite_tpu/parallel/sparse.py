@@ -64,6 +64,9 @@ class SparseEngine:
         self._counter_mu = threading.Lock()
         self._tables: Dict[str, SparseTable] = {}
         self._stores: Dict[str, object] = {}
+        # Row-wise Adagrad accumulators ([rows], same modulo row-sharding
+        # as the table), created lazily by push(handle="row_adagrad:...").
+        self._acc: Dict[str, object] = {}
         self._programs: Dict[tuple, Callable] = {}
         self._mu = threading.Lock()
         # Per-table write locks: push donates the store buffer, so the
@@ -109,8 +112,9 @@ class SparseEngine:
             self._table_mu.setdefault(name, threading.Lock())
         return table
 
-    def _sparse_program(self, op: str, table: SparseTable, batch: int):
-        key = (op, table.name, batch)
+    def _sparse_program(self, op: str, table: SparseTable, batch: int,
+                        params: tuple = ()):
+        key = (op, table.name, batch, params)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
@@ -127,19 +131,46 @@ class SparseEngine:
 
         def _push(store_l, idx_l, grads_l):
             # store_l: [R, d]; idx_l: [1, n]; grads_l: [1, n, d]
+            new = store_l + _row_aggregate(
+                store_l.dtype, store_l.shape[1], idx_l, grads_l
+            )
+            # Tiny non-donated completion token: callers block on this
+            # instead of the store (which the next push donates).
+            return new, new[:1, :1]
+
+        def _row_aggregate(dtype, dim, idx_l, grads_l):
+            # Per-shard aggregate gradient G [R, d]: all-gather every
+            # worker's (indices, grads), keep rows this shard owns
+            # (global row r lives on shard r % S at local row r // S;
+            # unowned rows scatter into the R dump slot), scatter-add.
             all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
             all_g = lax.all_gather(grads_l[0], axis, tiled=True)  # [W*n, d]
             my = lax.axis_index(axis)
             owned = (all_idx % S) == my
             local_rows = jnp.where(owned, all_idx // S, R)  # R = dump slot
-            padded = jnp.zeros((R + 1, store_l.shape[1]), store_l.dtype)
+            padded = jnp.zeros((R + 1, dim), dtype)
             padded = padded.at[local_rows].add(
                 jnp.where(owned[:, None], all_g, 0)
             )
-            new = store_l + padded[:R]
-            # Tiny non-donated completion token: callers block on this
-            # instead of the store (which the next push donates).
-            return new, new[:1, :1]
+            return padded[:R]
+
+        def _push_row_adagrad(store_l, acc_l, idx_l, grads_l, lr, eps):
+            # Sync-PS optimizer semantics: aggregate ALL workers'
+            # contributions per row first (the server-side sum), then one
+            # row-wise Adagrad step on the aggregate — the DLRM-standard
+            # embedding update.  Untouched rows see G == 0 and are
+            # unchanged (acc += 0, step 0).  lr/eps arrive as traced
+            # scalars, so per-step schedules reuse ONE compiled program.
+            G = _row_aggregate(
+                store_l.dtype, store_l.shape[1], idx_l, grads_l
+            )
+            acc_new = acc_l + jnp.mean(
+                G.astype(jnp.float32) ** 2, axis=1
+            )
+            step = (lr * G.astype(jnp.float32)
+                    / (jnp.sqrt(acc_new)[:, None] + eps))
+            new = store_l - step.astype(store_l.dtype)
+            return new, acc_new, new[:1, :1]
 
         def _pull(store_l, idx_l):
             # Route each worker its rows via psum_scatter over the worker dim.
@@ -163,6 +194,17 @@ class SparseEngine:
                 out_specs=(P(axis, None), P(axis, None)),
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
+        elif op == "push_row_adagrad":
+            # lr/eps are traced scalar args (replicated): one compiled
+            # program serves every learning-rate schedule step.
+            fn = shard_map(
+                _push_row_adagrad,
+                mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis), P(axis, None),
+                          P(axis, None, None), P(), P()),
+                out_specs=(P(axis, None), P(axis), P(axis, None)),
+            )
+            jitted = jax.jit(fn, donate_argnums=(0, 1))
         elif op == "pull":
             fn = shard_map(
                 _pull,
@@ -246,18 +288,103 @@ class SparseEngine:
             self.profiler.record_engine(name, f"sparse_{op}", payload,
                                         dur_us)
 
-    def push(self, name: str, indices, grads):
+    def _ensure_acc(self, name: str, table: SparseTable) -> None:
+        if name in self._acc:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._acc[name] = self._place(
+            np.zeros(table.rows_per_shard * self.num_shards, np.float32),
+            NamedSharding(self.mesh, P(self.axis)),
+        )
+
+    def ensure_acc(self, name: str) -> None:
+        """Create the (zero) Adagrad accumulator for a registered table —
+        needed before an orbax restore in a fresh process, where the
+        restore target must exist without running a push first."""
+        with self._table_mu[name]:
+            self._ensure_acc(name, self._tables[name])
+
+    def acc_array(self, name: str):
+        """Adagrad accumulator snapshot (checkpointing); row-interleaved
+        like the table store."""
+        import jax.numpy as jnp
+
+        with self._table_mu[name]:
+            log.check(name in self._acc, f"no accumulator for {name!r}")
+            return jnp.copy(self._acc[name])
+
+    def set_acc_array(self, name: str, value) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        table = self._tables[name]
+        expected = (table.rows_per_shard * self.num_shards,)
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        if isinstance(value, jax.Array):
+            # Sharded restores (multi-host): assign directly, same
+            # contract as set_store_array.
+            equivalent = value.sharding == sharding or (
+                hasattr(value.sharding, "is_equivalent_to")
+                and value.sharding.is_equivalent_to(sharding, value.ndim)
+            )
+            if equivalent:
+                log.check_eq(tuple(value.shape), expected,
+                             "bad accumulator shape")
+                with self._table_mu[name]:
+                    self._acc[name] = value
+                return
+        host = np.asarray(value, np.float32)
+        log.check_eq(host.shape, expected, "bad accumulator shape")
+        placed = self._place(host, sharding)
+        with self._table_mu[name]:
+            self._acc[name] = placed
+
+    @staticmethod
+    def _parse_handle(handle: str) -> tuple:
+        kind, _, rest = handle.partition(":")
+        log.check(kind == "row_adagrad", f"unknown sparse handle {kind!r}")
+        lr, eps = 0.01, 1e-8
+        if rest:
+            parts = rest.split(",")
+            lr = float(parts[0])
+            if len(parts) > 1:
+                eps = float(parts[1])
+        return kind, (lr, eps)
+
+    def push(self, name: str, indices, grads, handle: str = None):
         """indices: [W, n] int rows per worker; grads: [W, n, d].
         Duplicate rows (within or across workers) accumulate — the
-        aggregation contract of the default server handle."""
+        aggregation contract of the default server handle.
+
+        ``handle="row_adagrad:lr,eps"`` instead applies the
+        DLRM-standard row-wise Adagrad: the per-row aggregate gradient
+        updates a per-row accumulator, and the row steps by
+        ``-lr * G / (sqrt(acc) + eps)`` — the fused sparse analog of the
+        dense engine's optimizer handles."""
         t0 = time.perf_counter()
         table = self._tables[name]
         idx, g = self._prep(table, indices, grads)
-        prog = self._sparse_program("push", table, int(idx.shape[1]))
-        with self._table_mu[name]:
-            new_store, token = prog(self._stores[name], idx, g)
-            self._stores[name] = new_store
-        self._observe(name, "push", table, int(idx.shape[1]), t0)
+        batch = int(idx.shape[1])
+        if handle is None:
+            prog = self._sparse_program("push", table, batch)
+            with self._table_mu[name]:
+                new_store, token = prog(self._stores[name], idx, g)
+                self._stores[name] = new_store
+        else:
+            import jax.numpy as jnp
+
+            _, (lr, eps) = self._parse_handle(handle)
+            prog = self._sparse_program("push_row_adagrad", table, batch)
+            with self._table_mu[name]:
+                self._ensure_acc(name, table)
+                new_store, new_acc, token = prog(
+                    self._stores[name], self._acc[name], idx, g,
+                    jnp.float32(lr), jnp.float32(eps),
+                )
+                self._stores[name] = new_store
+                self._acc[name] = new_acc
+        self._observe(name, "push", table, batch, t0)
         # The token is a tiny non-donated output that becomes ready when
         # the push completes — block on it freely (the store itself is
         # donated by the next push, so it must not escape).
@@ -368,7 +495,14 @@ class SparseEngine:
                     .reshape(-1, t.dim)[: t.num_rows]
                     .copy()
                 )
-                snap[n] = (t, glob)
+                acc_glob = None
+                if n in self._acc:
+                    acc_host = np.asarray(self._acc[n])
+                    acc_glob = (
+                        acc_host.reshape(S, rps).transpose(1, 0)
+                        .reshape(-1)[: t.num_rows].copy()
+                    )
+                snap[n] = (t, glob, acc_glob)
 
             self.mesh = mesh
             self.axis = axis
@@ -378,12 +512,25 @@ class SparseEngine:
             with self._mu:
                 self._programs.clear()
             for n in names:
-                t, glob = snap[n]
+                t, glob, acc_glob = snap[n]
                 # register_sparse re-interleaves init rows for the new
                 # shard count and replaces the table/store in place.
                 self.register_sparse(
                     n, t.num_rows, t.dim, dtype=t.dtype, init=glob
                 )
+                if acc_glob is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    t2 = self._tables[n]
+                    S2, rps2 = self.num_shards, t2.rows_per_shard
+                    arr = np.zeros(rps2 * S2, np.float32)
+                    arr[: t2.num_rows] = acc_glob
+                    arr = arr.reshape(rps2, S2).transpose(1, 0).reshape(-1)
+                    # Direct placement: reshard already holds the table
+                    # locks set_acc_array would re-acquire.
+                    self._acc[n] = self._place(
+                        arr, NamedSharding(self.mesh, P(self.axis))
+                    )
         finally:
             for n in reversed(ordered):
                 self._table_mu[n].release()

@@ -61,3 +61,22 @@ def test_dlrm_hybrid_training_loss_decreases():
     # The embedding table actually learned (rows moved away from zero).
     table = np.asarray(sparse.store_array("dlrm_emb"))
     assert np.abs(table).max() > 0
+
+
+def test_dlrm_row_adagrad_training_loss_decreases():
+    """DLRM with the fused row-wise Adagrad embedding optimizer learns
+    (and exercises the accumulator across steps)."""
+    cfg = DLRMConfig(num_rows=256, emb_dim=8, num_cat=3, num_dense=4,
+                     hidden=32)
+    mesh = default_mesh()
+    engine = CollectiveEngine(mesh=mesh)
+    sparse = SparseEngine(mesh, engine.axis)
+    step = make_dlrm_step(cfg, engine, sparse, lr=0.2,
+                          emb_optimizer="row_adagrad")
+    W = engine.num_shards
+    idx, dense, labels = dlrm_batch(cfg, workers=W, batch=16, seed=1)
+    losses = [float(step(idx, dense, labels)) for _ in range(15)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.95, losses
+    acc = np.asarray(sparse.acc_array("dlrm_emb"))
+    assert (acc > 0).any()  # accumulator actually tracked G^2
