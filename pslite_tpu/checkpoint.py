@@ -39,8 +39,12 @@ def save_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     if sparse_engine is not None:
         for name in sparse_engine._tables:
             state["sparse"][name] = sparse_engine.store_array(name)
-            if name in sparse_engine._acc:
-                state["sparse_acc"][name] = sparse_engine.acc_array(name)
+            # ALWAYS save an accumulator (zeros when the table never saw
+            # an adagrad push): the restore target can then be built from
+            # registration alone, with no save/restore structure
+            # mismatch either way.
+            sparse_engine.ensure_acc(name)
+            state["sparse_acc"][name] = sparse_engine.acc_array(name)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.abspath(path), state, force=True)
         ckptr.wait_until_finished()
@@ -60,14 +64,17 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     if sparse_engine is not None:
         for name in sparse_engine._tables:
             target["sparse"][name] = sparse_engine.store_spec(name)
-            if name in sparse_engine._acc:
-                acc = sparse_engine._acc[name]
-                target["sparse_acc"][name] = jax.ShapeDtypeStruct(
-                    acc.shape, acc.dtype,
-                    sharding=NamedSharding(
-                        sparse_engine.mesh, P(sparse_engine.axis)
-                    ),
-                )
+            # Mirror of save: every registered table has an acc entry in
+            # the checkpoint, so target it unconditionally (no
+            # ensure_acc pre-call needed by users).
+            sparse_engine.ensure_acc(name)
+            acc = sparse_engine._acc[name]
+            target["sparse_acc"][name] = jax.ShapeDtypeStruct(
+                acc.shape, acc.dtype,
+                sharding=NamedSharding(
+                    sparse_engine.mesh, P(sparse_engine.axis)
+                ),
+            )
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(os.path.abspath(path), target)
     # The targets are ShapeDtypeStructs carrying the live stores'
@@ -230,7 +237,12 @@ class AsyncEngineCheckpointer:
                 meta["sparse"][name] = {
                     "num_rows": table.num_rows,
                     "dim": table.dim,
+                    "has_acc": name in sparse_engine._acc,
                 }
+                if name in sparse_engine._acc:
+                    arrays[f"sparse_acc/{name}"] = (
+                        sparse_engine.acc_array(name)
+                    )
         self._q.put((arrays, meta, path))
 
     def wait(self) -> None:

@@ -112,9 +112,8 @@ class SparseEngine:
             self._table_mu.setdefault(name, threading.Lock())
         return table
 
-    def _sparse_program(self, op: str, table: SparseTable, batch: int,
-                        params: tuple = ()):
-        key = (op, table.name, batch, params)
+    def _sparse_program(self, op: str, table: SparseTable, batch: int):
+        key = (op, table.name, batch)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:

@@ -148,3 +148,43 @@ def test_engine_checkpoint_orbax_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(sp2.pull("ot", idx)), np.asarray(sp.pull("ot", idx))
     )
+
+
+def test_engine_checkpoint_orbax_adagrad_acc(tmp_path):
+    """Orbax roundtrip carries the sparse Adagrad accumulator with no
+    ensure_acc pre-call by the restorer."""
+    from pslite_tpu.checkpoint import (
+        have_orbax,
+        restore_engine_orbax,
+        save_engine_orbax,
+    )
+
+    if not have_orbax():
+        import pytest
+
+        pytest.skip("orbax not installed")
+    import jax
+    from jax.sharding import Mesh
+
+    from pslite_tpu.parallel.engine import CollectiveEngine
+    from pslite_tpu.parallel.sparse import SparseEngine
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("kv",))
+    rng = np.random.default_rng(2)
+    rows, dim = 11, 4
+    idx = rng.integers(0, rows, size=(4, 3)).astype(np.int32)
+    g = rng.normal(size=(4, 3, dim)).astype(np.float32)
+
+    eng = CollectiveEngine(mesh=mesh)
+    se = SparseEngine(mesh)
+    se.register_sparse("t", rows, dim)
+    se.push("t", idx, g, handle="row_adagrad:0.1")
+    want_acc = np.asarray(se.acc_array("t"))
+    assert (want_acc > 0).any()
+    save_engine_orbax(eng, str(tmp_path / "ck"), sparse_engine=se)
+
+    se2 = SparseEngine(mesh)
+    se2.register_sparse("t", rows, dim)
+    restore_engine_orbax(CollectiveEngine(mesh=mesh), str(tmp_path / "ck"),
+                         sparse_engine=se2)
+    np.testing.assert_allclose(np.asarray(se2.acc_array("t")), want_acc)
