@@ -82,6 +82,48 @@ def sgd_update(store, mom, agg, lr: float = 0.01, momentum: float = 0.9):
     return new_store.reshape(-1)[:n], new_mom.reshape(-1)[:n]
 
 
+@functools.partial(jax.jit, static_argnames=("lr", "eps"))
+def adagrad_update(store, acc, agg, lr: float = 0.01, eps: float = 1e-8):
+    """One fused Adagrad pass: ``acc += agg**2;
+    store -= lr*agg/(sqrt(acc)+eps)``.
+
+    Returns ``(new_store, new_acc)``; both alias their inputs' buffers —
+    the elementwise twin of the sparse engine's row-wise variant
+    (parallel/sparse.py), completing the server-optimizer family
+    (kv_app.h:430-452 hot loop as one HBM pass).
+    """
+    from jax.experimental import pallas as pl
+
+    n = store.shape[0]
+    padded, rows, block_rows, grid = _tile_geometry(n)
+    store_t = _to_tiles(store, padded)
+    acc_t = _to_tiles(acc, padded)
+    agg_t = _to_tiles(agg, padded)
+
+    def kernel(store_ref, acc_ref, agg_ref, out_store_ref, out_acc_ref):
+        g = agg_ref[:, :]
+        a = acc_ref[:, :] + g * g
+        out_acc_ref[:, :] = a
+        out_store_ref[:, :] = store_ref[:, :] - lr * g / (
+            jnp.sqrt(a) + eps
+        )
+
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    new_store, new_acc = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(store_t.shape, store_t.dtype),
+            jax.ShapeDtypeStruct(acc_t.shape, acc_t.dtype),
+        ),
+        grid=(grid,),
+        in_specs=[spec, spec, spec],
+        out_specs=(spec, spec),
+        input_output_aliases={0: 0, 1: 1},
+        interpret=_use_interpret(),
+    )(store_t, acc_t, agg_t)
+    return new_store.reshape(-1)[:n], new_acc.reshape(-1)[:n]
+
+
 @functools.partial(jax.jit, static_argnames=("lr", "beta1", "beta2", "eps"))
 def adam_update(store, m, v, agg, step, lr: float = 1e-3,
                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):

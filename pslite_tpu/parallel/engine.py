@@ -253,7 +253,7 @@ class CollectiveEngine:
             return lambda store, agg: store + agg
         if handle == "assign":
             return lambda store, agg: agg
-        if handle.startswith("sgd_momentum") or handle.startswith("adam"):
+        if self._is_stateful(handle):
             raise ValueError(
                 f"{handle!r} is stateful — resolved via _stateful_handle"
             )
@@ -312,12 +312,24 @@ class CollectiveEngine:
                 return new_store, (new_m, new_v, step_l + 1.0)
 
             return 3, fn
+        if handle.startswith("adagrad"):
+            lr, eps = self._handle_params(handle, (0.01, 1e-8))
+
+            def fn(store_l, state_l, agg):
+                new_store, new_acc = fused_update.adagrad_update(
+                    store_l, state_l[0], agg, lr=lr, eps=eps
+                )
+                return new_store, (new_acc,)
+
+            return 1, fn
         raise ValueError(f"not a stateful handle: {handle!r}")
 
     @staticmethod
     def _is_stateful(handle) -> bool:
         return isinstance(handle, str) and (
-            handle.startswith("sgd_momentum") or handle.startswith("adam")
+            handle.startswith("sgd_momentum")
+            or handle.startswith("adam")
+            or handle.startswith("adagrad")
         )
 
     @property
@@ -565,7 +577,7 @@ class CollectiveEngine:
 
         sharding = NamedSharding(self.mesh, P(self.axis))
         dt = np.dtype(bucket.dtype)
-        if kind == "sgd_momentum":
+        if kind in ("sgd_momentum", "adagrad"):
             state = (self._place(np.zeros(bucket.padded_len, dt), sharding),)
         else:  # adam
             state = (
@@ -1070,7 +1082,7 @@ class CollectiveEngine:
                     self._opt_kinds.pop(n, None)
                     continue
                 kind, arrs = opt
-                if kind == "sgd_momentum":
+                if kind in ("sgd_momentum", "adagrad"):
                     state = (_repad(arrs[0], b.total_len, b.padded_len,
                                     b.dtype),)
                 else:  # adam: m, v, per-shard step counter

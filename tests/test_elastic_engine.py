@@ -156,3 +156,21 @@ def test_sparse_reshard_carries_adagrad_state():
     se.push("t", idx4, g4, handle="row_adagrad:0.1")
     got = np.asarray(se.pull("t", all_idx))[0]
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dense_reshard_carries_adagrad_state():
+    eng = CollectiveEngine(mesh=_mesh(8))
+    keys = np.arange(2, dtype=np.uint64)
+    init = np.linspace(0, 1, 2 * 64).astype(np.float32)
+    eng.register_dense("p", keys, 64, init=init)
+    g = np.ones((8, 2 * 64), np.float32)
+    eng.push_pull("p", g, handle="adagrad:0.1")
+    before = np.asarray(eng.opt_state("p")[1][0])
+    eng.reshard(_mesh(4))
+    kind, arrs = eng.opt_state("p")
+    assert kind == "adagrad" and len(arrs) == 1
+    np.testing.assert_allclose(np.asarray(arrs[0])[: 2 * 64],
+                               before[: 2 * 64], rtol=1e-6)
+    out = np.asarray(eng.push_pull("p", np.ones((4, 2 * 64), np.float32),
+                                   handle="adagrad:0.1"))
+    assert np.isfinite(out).all()

@@ -324,3 +324,28 @@ def test_sparse_row_adagrad(mesh):
     snap = np.asarray(eng.acc_array("emb"))
     eng.set_acc_array("emb", snap)
     assert snap.shape == (eng.table("emb").rows_per_shard * W,)
+
+
+def test_fused_adagrad_handle_parity(mesh):
+    """The fused Adagrad kernel as a dense server handle must match the
+    host recurrence (dense twin of the sparse row_adagrad)."""
+    lr, eps = 0.05, 1e-8
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 100
+    init = np.linspace(-1, 1, 3 * val_len).astype(np.float32)
+    eng.register_dense("ag", keys, val_len, init=init)
+    W = eng.num_shards
+    rng = np.random.default_rng(13)
+
+    ref_store = init.copy().astype(np.float64)
+    ref_acc = np.zeros_like(ref_store)
+    for _ in range(4):
+        grads = rng.normal(size=(W, 3 * val_len)).astype(np.float32)
+        pulled = np.asarray(
+            eng.push_pull("ag", grads, handle=f"adagrad:{lr},{eps}")
+        )
+        g = grads.sum(axis=0).astype(np.float64)
+        ref_acc = ref_acc + g * g
+        ref_store = ref_store - lr * g / (np.sqrt(ref_acc) + eps)
+        np.testing.assert_allclose(pulled, ref_store, rtol=1e-4, atol=1e-4)

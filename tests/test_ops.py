@@ -82,3 +82,25 @@ def test_quantize_zero_input():
     q, s = quantize_int8(x)
     out = dequantize_int8(q, s, 1024)
     np.testing.assert_array_equal(np.asarray(out), 0)
+
+
+def test_adagrad_update_matches_reference():
+    from pslite_tpu.ops.fused_update import adagrad_update
+
+    rng = np.random.default_rng(3)
+    n = 3000  # not block-aligned
+    store = rng.normal(size=n).astype(np.float32)
+    acc = np.abs(rng.normal(size=n)).astype(np.float32)
+    agg = rng.normal(size=n).astype(np.float32)
+    lr, eps = 0.05, 1e-8
+
+    new_store, new_acc = adagrad_update(
+        jnp.asarray(store), jnp.asarray(acc), jnp.asarray(agg),
+        lr=lr, eps=eps,
+    )
+    ref_acc = acc + agg * agg
+    ref_store = store - lr * agg / (np.sqrt(ref_acc) + eps)
+    np.testing.assert_allclose(np.asarray(new_acc), ref_acc, rtol=1e-6,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_store), ref_store, rtol=1e-5,
+                               atol=1e-6)
