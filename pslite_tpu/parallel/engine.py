@@ -335,8 +335,9 @@ class CollectiveEngine:
     @property
     def handle_is_stateful(self) -> bool:
         """Whether the engine's default server handle carries optimizer
-        state (fused sgd_momentum/adam) — such handles are unsupported by
-        the grouped program (public predicate for callers)."""
+        state (fused sgd_momentum/adam/adagrad) — such handles are
+        unsupported by the grouped program (public predicate for
+        callers)."""
         return self._is_stateful(self._server_handle)
 
     def _program(self, op: str, padded_len: int, dtype, handle_key) -> Callable:

@@ -43,6 +43,57 @@ class SparseTable:
     dtype: object
 
 
+
+def _agg_rows(axis, S, R, dtype, dim, idx_l, grads_l):
+    """Per-shard aggregate gradient G [R, d]: all-gather every worker's
+    (indices, grads), keep rows this shard owns (global row r lives on
+    shard r % S at local row r // S; unowned rows scatter into the R dump
+    slot), scatter-add.  Shared by the single-table and group programs —
+    change ownership/scatter semantics HERE only."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
+    all_g = lax.all_gather(grads_l[0], axis, tiled=True)  # [W*n, d]
+    my = lax.axis_index(axis)
+    owned = (all_idx % S) == my
+    local_rows = jnp.where(owned, all_idx // S, R)  # R = dump slot
+    padded = jnp.zeros((R + 1, dim), dtype)
+    padded = padded.at[local_rows].add(
+        jnp.where(owned[:, None], all_g, 0)
+    )
+    return padded[:R]
+
+
+def _adagrad_rows(store_l, acc_l, G, lr, eps):
+    """Row-wise Adagrad on the aggregated gradient (the DLRM-standard
+    embedding update): acc += mean(G^2, rows); row -= lr*G/(sqrt+eps).
+    Untouched rows see G == 0 and are unchanged.  Shared single/group."""
+    import jax.numpy as jnp
+
+    acc_new = acc_l + jnp.mean(G.astype(jnp.float32) ** 2, axis=1)
+    step = (lr * G.astype(jnp.float32)
+            / (jnp.sqrt(acc_new)[:, None] + eps))
+    return store_l - step.astype(store_l.dtype), acc_new
+
+
+def _pull_rows(axis, S, store_l, idx_l):
+    """Per-shard pull body: materialize owned rows for every worker's
+    index list, route each worker its batch via psum_scatter over the
+    worker dimension.  Shared single/group."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
+    my = lax.axis_index(axis)
+    owned = (all_idx % S) == my
+    local_rows = jnp.where(owned, all_idx // S, 0)
+    vals = jnp.where(owned[:, None], store_l[local_rows], 0)  # [W*n, d]
+    vals = vals.reshape(S, -1, store_l.shape[1])  # [W, n, d]
+    return lax.psum_scatter(vals, axis, scatter_dimension=0,
+                            tiled=True)[0]  # [n, d] for my indices
+
+
 class SparseEngine:
     """Sparse tables on the same mesh/axis as a CollectiveEngine."""
 
@@ -130,60 +181,25 @@ class SparseEngine:
 
         def _push(store_l, idx_l, grads_l):
             # store_l: [R, d]; idx_l: [1, n]; grads_l: [1, n, d]
-            new = store_l + _row_aggregate(
-                store_l.dtype, store_l.shape[1], idx_l, grads_l
+            new = store_l + _agg_rows(
+                axis, S, R, store_l.dtype, store_l.shape[1], idx_l, grads_l
             )
             # Tiny non-donated completion token: callers block on this
             # instead of the store (which the next push donates).
             return new, new[:1, :1]
 
-        def _row_aggregate(dtype, dim, idx_l, grads_l):
-            # Per-shard aggregate gradient G [R, d]: all-gather every
-            # worker's (indices, grads), keep rows this shard owns
-            # (global row r lives on shard r % S at local row r // S;
-            # unowned rows scatter into the R dump slot), scatter-add.
-            all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
-            all_g = lax.all_gather(grads_l[0], axis, tiled=True)  # [W*n, d]
-            my = lax.axis_index(axis)
-            owned = (all_idx % S) == my
-            local_rows = jnp.where(owned, all_idx // S, R)  # R = dump slot
-            padded = jnp.zeros((R + 1, dim), dtype)
-            padded = padded.at[local_rows].add(
-                jnp.where(owned[:, None], all_g, 0)
-            )
-            return padded[:R]
-
         def _push_row_adagrad(store_l, acc_l, idx_l, grads_l, lr, eps):
-            # Sync-PS optimizer semantics: aggregate ALL workers'
-            # contributions per row first (the server-side sum), then one
-            # row-wise Adagrad step on the aggregate — the DLRM-standard
-            # embedding update.  Untouched rows see G == 0 and are
-            # unchanged (acc += 0, step 0).  lr/eps arrive as traced
-            # scalars, so per-step schedules reuse ONE compiled program.
-            G = _row_aggregate(
-                store_l.dtype, store_l.shape[1], idx_l, grads_l
+            # Sync-PS optimizer semantics (kv_app.h:430-452 as one fused
+            # program); lr/eps arrive as traced scalars, so per-step
+            # schedules reuse ONE compiled program.
+            G = _agg_rows(
+                axis, S, R, store_l.dtype, store_l.shape[1], idx_l, grads_l
             )
-            acc_new = acc_l + jnp.mean(
-                G.astype(jnp.float32) ** 2, axis=1
-            )
-            step = (lr * G.astype(jnp.float32)
-                    / (jnp.sqrt(acc_new)[:, None] + eps))
-            new = store_l - step.astype(store_l.dtype)
+            new, acc_new = _adagrad_rows(store_l, acc_l, G, lr, eps)
             return new, acc_new, new[:1, :1]
 
         def _pull(store_l, idx_l):
-            # Route each worker its rows via psum_scatter over the worker dim.
-            all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
-            my = lax.axis_index(axis)
-            owned = (all_idx % S) == my
-            local_rows = jnp.where(owned, all_idx // S, 0)
-            vals = jnp.where(
-                owned[:, None], store_l[local_rows], 0
-            )  # [W*n, d]
-            vals = vals.reshape(S, -1, store_l.shape[1])  # [W, n, d]
-            mine = lax.psum_scatter(vals, axis, scatter_dimension=0,
-                                    tiled=True)  # [1, n, d]
-            return mine[0]  # [n, d] rows for my local indices
+            return _pull_rows(axis, S, store_l, idx_l)
 
         if op == "push":
             fn = shard_map(
@@ -388,6 +404,181 @@ class SparseEngine:
         # the push completes — block on it freely (the store itself is
         # donated by the next push, so it must not escape).
         return token
+
+    def _sparse_group_program(self, op: str, tables, batches: tuple):
+        """One jitted program over SEVERAL tables (one dispatch instead
+        of len(tables) — the many-embedding-tables pattern of a real
+        recommender step, dense analog: engine.push_pull_group)."""
+        key = (op, tuple(t.name for t in tables), batches)
+        with self._mu:
+            prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        import jax
+        from jax import lax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis
+        S = self.num_shards
+        k = len(tables)
+        Rs = [t.rows_per_shard for t in tables]
+
+        store_spec = P(axis, None)
+        acc_spec = P(axis)
+        idx_spec = P(axis, None)
+        g_spec = P(axis, None, None)
+
+        if op == "push":
+            def body(*args):
+                stores = args[:k]
+                idxs = args[k:2 * k]
+                grads = args[2 * k:]
+                new = [
+                    s + _agg_rows(axis, S, Rs[i], s.dtype, s.shape[1],
+                                  idxs[i], grads[i])
+                    for i, s in enumerate(stores)
+                ]
+                return (*new, new[0][:1, :1])
+
+            fn = shard_map(
+                body, mesh=self.mesh,
+                in_specs=tuple([store_spec] * k + [idx_spec] * k
+                               + [g_spec] * k),
+                out_specs=tuple([store_spec] * k + [store_spec]),
+            )
+            jitted = jax.jit(fn, donate_argnums=tuple(range(k)))
+        elif op == "push_row_adagrad":
+            def body(*args):
+                stores = args[:k]
+                accs = args[k:2 * k]
+                idxs = args[2 * k:3 * k]
+                grads = args[3 * k:4 * k]
+                lr, eps = args[4 * k], args[4 * k + 1]
+                new_s, new_a = [], []
+                for i, (s, a) in enumerate(zip(stores, accs)):
+                    G = _agg_rows(axis, S, Rs[i], s.dtype, s.shape[1],
+                                  idxs[i], grads[i])
+                    n2, a2 = _adagrad_rows(s, a, G, lr, eps)
+                    new_s.append(n2)
+                    new_a.append(a2)
+                return (*new_s, *new_a, new_s[0][:1, :1])
+
+            fn = shard_map(
+                body, mesh=self.mesh,
+                in_specs=tuple([store_spec] * k + [acc_spec] * k
+                               + [idx_spec] * k + [g_spec] * k
+                               + [P(), P()]),
+                out_specs=tuple([store_spec] * k + [acc_spec] * k
+                                + [store_spec]),
+            )
+            jitted = jax.jit(fn, donate_argnums=tuple(range(2 * k)))
+        elif op == "pull":
+            def body(*args):
+                stores = args[:k]
+                idxs = args[k:]
+                return tuple(
+                    _pull_rows(axis, S, s, idxs[i])
+                    for i, s in enumerate(stores)
+                )
+
+            fn = shard_map(
+                body, mesh=self.mesh,
+                in_specs=tuple([store_spec] * k + [idx_spec] * k),
+                out_specs=tuple([store_spec] * k),
+            )
+            jitted = jax.jit(fn)
+        else:
+            raise ValueError(op)
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _lock_tables(self, names):
+        ordered = sorted(set(names))
+        for n in ordered:
+            self._table_mu[n].acquire()
+        return ordered
+
+    def _unlock_tables(self, ordered):
+        for n in reversed(ordered):
+            self._table_mu[n].release()
+
+    def push_group(self, names, indices_list, grads_list,
+                   handle: str = None):
+        """Push SEVERAL tables in one dispatch; same semantics per table
+        as :meth:`push` (``handle`` applies to all)."""
+        log.check(len(names) == len(indices_list) == len(grads_list),
+                  "group length mismatch")
+        log.check(len(set(names)) == len(names),
+                  "duplicate table in group (stores are donated)")
+        t0 = time.perf_counter()
+        tables = [self._tables[n] for n in names]
+        prepped = [
+            self._prep(t, i, g)
+            for t, i, g in zip(tables, indices_list, grads_list)
+        ]
+        idxs = [p[0] for p in prepped]
+        gs = [p[1] for p in prepped]
+        batches = tuple(int(i.shape[1]) for i in idxs)
+        ordered = self._lock_tables(names)
+        try:
+            if handle is None:
+                prog = self._sparse_group_program("push", tables, batches)
+                outs = prog(*[self._stores[n] for n in names], *idxs, *gs)
+                for i, n in enumerate(names):
+                    self._stores[n] = outs[i]
+                token = outs[len(names)]
+            else:
+                import jax.numpy as jnp
+
+                _, (lr, eps) = self._parse_handle(handle)
+                prog = self._sparse_group_program(
+                    "push_row_adagrad", tables, batches
+                )
+                for n, t in zip(names, tables):
+                    self._ensure_acc(n, t)
+                outs = prog(
+                    *[self._stores[n] for n in names],
+                    *[self._acc[n] for n in names],
+                    *idxs, *gs, jnp.float32(lr), jnp.float32(eps),
+                )
+                kk = len(names)
+                for i, n in enumerate(names):
+                    self._stores[n] = outs[i]
+                    self._acc[n] = outs[kk + i]
+                token = outs[2 * kk]
+        finally:
+            self._unlock_tables(ordered)
+        for i, (n, t) in enumerate(zip(names, tables)):
+            # One dispatch: attribute latency to the first table only so
+            # summed profiler durations aren't inflated k-fold.
+            self._observe(n, "push", t, batches[i],
+                          t0 if i == 0 else time.perf_counter())
+        return token
+
+    def pull_group(self, names, indices_list):
+        """Pull SEVERAL tables in one dispatch; returns the list of
+        [W, n_i, d_i] arrays in ``names`` order."""
+        log.check(len(names) == len(indices_list), "group length mismatch")
+        t0 = time.perf_counter()
+        tables = [self._tables[n] for n in names]
+        idxs = [self._prep(t, i)[0] for t, i in zip(tables, indices_list)]
+        batches = tuple(int(i.shape[1]) for i in idxs)
+        prog = self._sparse_group_program("pull", tables, batches)
+        ordered = self._lock_tables(names)
+        try:
+            outs = prog(*[self._stores[n] for n in names], *idxs)
+        finally:
+            self._unlock_tables(ordered)
+        for i, (n, t) in enumerate(zip(names, tables)):
+            self._observe(n, "pull", t, batches[i],
+                          t0 if i == 0 else time.perf_counter())
+        return [
+            o.reshape(self.num_shards, -1, t.dim)
+            for o, t in zip(outs, tables)
+        ]
 
     def pull(self, name: str, indices):
         """indices: [W, n] -> [W, n, d] rows, each worker shard receiving its
