@@ -23,6 +23,7 @@
 #include <sys/socket.h>
 #include <sys/types.h>
 #include <sys/uio.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -102,6 +103,84 @@ class Core {
     epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
     io_thread_ = std::thread([this] { IoLoop(); });
     return ntohs(addr.sin_port);
+  }
+
+  // DMLC_LOCAL mode: listen on a unix-domain socket instead of TCP
+  // (the zmq van's ipc:///tmp/<port> switch, zmq_van.h:107-115).  The
+  // caller owns port-number retry; this binds exactly `path`.
+  int BindLocal(const char* path, int backlog) {
+    sockaddr_un addr{};
+    if (strlen(path) >= sizeof(addr.sun_path)) return -ENAMETOOLONG;
+    int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -errno;
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+    if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    if (listen(fd, backlog) < 0) {
+      int err = -errno;
+      close(fd);
+      unlink(path);
+      return err;
+    }
+    bound_path_ = path;
+    listen_fd_ = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    io_thread_ = std::thread([this] { IoLoop(); });
+    return 0;
+  }
+
+  int ConnectLocal(int node_id, const char* path) {
+    sockaddr_un addr{};
+    if (strlen(path) >= sizeof(addr.sun_path)) return -ENAMETOOLONG;
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -errno;
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+    // Bounded connect (30 s), same invariant as the TCP path: a listener
+    // with a wedged accept loop and full backlog must not stall forever.
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    if (rc < 0 && errno == EAGAIN) {
+      // AF_UNIX semantics (unix(7)): EAGAIN means the listener's backlog
+      // is full and NO connection is in progress — polling would report
+      // the unconnected fd writable and fake a success.  Fail now; the
+      // caller's retry loop redials.
+      close(fd);
+      return -EAGAIN;
+    }
+    if (rc < 0 && errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      rc = poll(&pfd, 1, 30000);
+      if (rc <= 0) {
+        close(fd);
+        return rc == 0 ? -ETIMEDOUT : -errno;
+      }
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) {
+        close(fd);
+        return -err;
+      }
+    } else if (rc < 0) {
+      int err = -errno;
+      close(fd);
+      return err;
+    }
+    fcntl(fd, F_SETFL, flags);
+    std::lock_guard<std::mutex> lk(send_mu_);
+    auto it = send_fds_.find(node_id);
+    if (it != send_fds_.end()) close(it->second);
+    send_fds_[node_id] = fd;
+    return 0;
   }
 
   int Connect(int node_id, const char* host, int port) {
@@ -245,6 +324,10 @@ class Core {
       close(listen_fd_);
       listen_fd_ = -1;
     }
+    if (!bound_path_.empty()) {
+      unlink(bound_path_.c_str());
+      bound_path_.clear();
+    }
     queue_cv_.notify_all();
   }
 
@@ -376,6 +459,7 @@ class Core {
 
   int epfd_;
   int listen_fd_ = -1;
+  std::string bound_path_;
   std::thread io_thread_;
   std::atomic<bool> stopped_{false};
   std::unordered_map<int, Conn*> conns_;  // io thread only
@@ -405,6 +489,14 @@ int psl_bind(void* h, int port, int backlog) {
 
 int psl_connect(void* h, int node_id, const char* host, int port) {
   return static_cast<Core*>(h)->Connect(node_id, host, port);
+}
+
+int psl_bind_local(void* h, const char* path, int backlog) {
+  return static_cast<Core*>(h)->BindLocal(path, backlog);
+}
+
+int psl_connect_local(void* h, int node_id, const char* path) {
+  return static_cast<Core*>(h)->ConnectLocal(node_id, path);
 }
 
 long long psl_send(void* h, int node_id, const uint8_t* meta,

@@ -41,28 +41,43 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(os.path.abspath(path)
                               if os.path.sep in path else path)
-        except OSError:
+            _declare(lib)
+        except (OSError, AttributeError):
+            # AttributeError = stale .so missing a symbol (make -C cpp not
+            # rerun after an update): fall through to the next candidate
+            # or the pure-Python path rather than breaking every van.
             continue
-        lib.psl_create.restype = ctypes.c_void_p
-        lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
-        lib.psl_connect.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
-        ]
-        lib.psl_send.restype = ctypes.c_longlong
-        lib.psl_send.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32, ctypes.c_uint32,
-            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.psl_recv.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(_FrameView), ctypes.c_int
-        ]
-        lib.psl_frame_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-        lib.psl_stop.argtypes = [ctypes.c_void_p]
-        lib.psl_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
     return None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    """Declare every symbol's signature; a stale .so missing one raises
+    AttributeError here (caught by load's candidate loop)."""
+    lib.psl_create.restype = ctypes.c_void_p
+    lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.psl_connect.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.psl_bind_local.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.psl_connect_local.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p
+    ]
+    lib.psl_send.restype = ctypes.c_longlong
+    lib.psl_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.psl_recv.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_FrameView), ctypes.c_int
+    ]
+    lib.psl_frame_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.psl_stop.argtypes = [ctypes.c_void_p]
+    lib.psl_destroy.argtypes = [ctypes.c_void_p]
 
 
 class NativeTransport:
@@ -82,6 +97,17 @@ class NativeTransport:
 
     def connect(self, node_id: int, host: str, port: int) -> None:
         rc = self._lib.psl_connect(self._h, node_id, host.encode(), port)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def bind_local(self, path: str, backlog: int = 128) -> None:
+        """DMLC_LOCAL mode: listen on a unix-domain socket at ``path``."""
+        rc = self._lib.psl_bind_local(self._h, path.encode(), backlog)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def connect_local(self, node_id: int, path: str) -> None:
+        rc = self._lib.psl_connect_local(self._h, node_id, path.encode())
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
 

@@ -13,9 +13,11 @@ socket loops can be offloaded to it via ``pslite_tpu.vans.native``.
 
 from __future__ import annotations
 
+import os
 import random
 import socket
 import struct
+import tempfile
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -27,6 +29,14 @@ from ..message import Message, Node
 from ..utils import logging as log
 from ..utils.queues import ThreadsafeQueue
 from .van import Van
+
+
+def _local_sock_path(port: int) -> str:
+    """DMLC_LOCAL addressing: every peer derives the same unix-socket path
+    from the advertised port number (the reference's ipc:///tmp/<port>
+    scheme, zmq_van.h:107-115,175-178 — addresses stay port-shaped on the
+    wire, only the transport endpoint changes)."""
+    return os.path.join(tempfile.gettempdir(), f"pslite_ipc_{port}.sock")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
@@ -63,10 +73,15 @@ class TcpVan(Van):
         self._send_addrs: Dict[int, Tuple[str, int]] = {}
         self._socks_mu = threading.Lock()
         self._closing = False
+        # DMLC_LOCAL: unix-domain sockets for same-host clusters.
+        self._local = bool(self.env.find_int("DMLC_LOCAL", 0))
+        self._bound_path: Optional[str] = None
 
     # -- transport interface -------------------------------------------------
 
     def bind_transport(self, node: Node, max_retry: int) -> int:
+        if self._local:
+            return self._bind_local(node, max_retry)
         if self._native is not None:
             port = node.port
             for attempt in range(max_retry + 1):
@@ -97,6 +112,60 @@ class TcpVan(Van):
         self._accept_thread.start()
         return port
 
+    @staticmethod
+    def _reclaim_stale_local(path: str) -> None:
+        """A crashed run leaves its socket file behind (the classic zmq
+        ipc:// footgun); bind would then fail EADDRINUSE forever on the
+        fixed scheduler port.  Probe it: connection-refused means no
+        listener owns the file — unlink and let bind retake the address
+        (the AF_UNIX analog of SO_REUSEADDR)."""
+        if not os.path.exists(path):
+            return
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.settimeout(1)
+            probe.connect(path)
+        except ConnectionRefusedError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        except OSError:
+            pass
+        finally:
+            probe.close()
+
+    def _bind_local(self, node: Node, max_retry: int) -> int:
+        """DMLC_LOCAL bind: listen on a unix socket whose path encodes the
+        advertised port number; the port rides through ADD_NODE unchanged
+        so the rest of the control plane is oblivious."""
+        port = node.port or 10000 + random.randint(0, 40000)
+        for attempt in range(max_retry + 1):
+            path = _local_sock_path(port)
+            self._reclaim_stale_local(path)
+            s = None
+            try:
+                if self._native is not None:
+                    self._native.bind_local(path)
+                    self._bound_path = None  # native core unlinks on stop
+                    return port
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.bind(path)
+                s.listen(128)
+                self._listener = s
+                self._bound_path = path
+                self._accept_thread = threading.Thread(
+                    target=self._accept_loop, name="tcp-accept", daemon=True
+                )
+                self._accept_thread.start()
+                return port
+            except OSError:
+                if s is not None:
+                    s.close()
+                if attempt == max_retry:
+                    raise
+                port = 10000 + random.randint(0, 40000)
+
     def _retry_connect(self, connect_once):
         """Peers start concurrently; retry until the remote listener is up
         (zmq's async connect gives the reference this for free).  Each
@@ -116,21 +185,31 @@ class TcpVan(Van):
     def connect_transport(self, node: Node) -> None:
         if node.id < 0:
             return
+        if self._local:
+            self._connect_local(node)
+            return
         if self._native is not None:
             self._retry_connect(
                 lambda: self._native.connect(node.id, node.hostname, node.port)
             )
             return
+        def connect_once():
+            s = socket.create_connection((node.hostname, node.port),
+                                         timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+
+        self._dial_and_swap(node, connect_once)
+
+    def _dial_and_swap(self, node: Node, connect_once) -> None:
+        """Shared pure-python dial sequence: dedup (ADD_NODE broadcasts
+        re-issue connects), retry the dial, then swap the peer socket under
+        the lock and close any predecessor."""
         with self._socks_mu:
-            prev_addr = self._send_addrs.get(node.id)
-            if prev_addr == (node.hostname, node.port) and node.id in self._send_socks:
+            if (self._send_addrs.get(node.id) == (node.hostname, node.port)
+                    and node.id in self._send_socks):
                 return
-        sock = self._retry_connect(
-            lambda: socket.create_connection(
-                (node.hostname, node.port), timeout=30
-            )
-        )
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock = self._retry_connect(connect_once)
         with self._socks_mu:
             old = self._send_socks.pop(node.id, None)
             self._send_socks[node.id] = sock
@@ -140,6 +219,31 @@ class TcpVan(Van):
                 old.close()
             except OSError:
                 pass
+
+    def _connect_local(self, node: Node) -> None:
+        path = _local_sock_path(node.port)
+        if self._native is not None:
+            with self._socks_mu:
+                if self._send_addrs.get(node.id) == (node.hostname, node.port):
+                    return
+            self._retry_connect(
+                lambda: self._native.connect_local(node.id, path)
+            )
+            with self._socks_mu:
+                self._send_addrs[node.id] = (node.hostname, node.port)
+            return
+        def connect_once():
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(30)
+            try:
+                s.connect(path)
+            except OSError:
+                s.close()
+                raise
+            s.settimeout(None)
+            return s
+
+        self._dial_and_swap(node, connect_once)
 
     def send_msg(self, msg: Message) -> int:
         recver = msg.meta.recver
@@ -192,6 +296,12 @@ class TcpVan(Van):
                 s.close()
             except OSError:
                 pass
+        if self._bound_path is not None:
+            try:
+                os.unlink(self._bound_path)
+            except OSError:
+                pass
+            self._bound_path = None
         self._queue.push(None)  # wakes the pure-Python recv path
 
     def post_stop(self) -> None:
@@ -208,7 +318,8 @@ class TcpVan(Van):
                 conn, _addr = self._listener.accept()
             except OSError:
                 break
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if not self._local:  # TCP_NODELAY is meaningless on AF_UNIX
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(
                 target=self._reader_loop, args=(conn,), name="tcp-reader",
                 daemon=True,

@@ -109,3 +109,90 @@ def test_tcp_cluster_multiprocess():
         assert p.returncode == 0, f"child failed:\n{out}"
     worker_outs = [o for o in outputs if "WORKER_OK" in o]
     assert len(worker_outs) == 2, f"expected 2 worker OKs, got: {outputs}"
+
+
+def _run_local_mode_cluster(env_extra):
+    """DMLC_LOCAL=1: the whole cluster rides unix-domain sockets
+    (the reference's ipc:///tmp/<port> mode, zmq_van.h:107-115)."""
+    from pslite_tpu.vans.tcp_van import _local_sock_path
+
+    cluster = LoopbackCluster(
+        num_workers=2, num_servers=1, van_type="tcp", env_extra=env_extra,
+    )
+    cluster.start()
+    servers = []
+    try:
+        # The advertised ports must map to live unix-socket files.
+        for po in list(cluster.servers) + list(cluster.workers):
+            path = _local_sock_path(po.van.my_node.port)
+            assert os.path.exists(path), f"no unix socket at {path}"
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w0 = KVWorker(0, 0, postoffice=cluster.workers[0])
+        w1 = KVWorker(0, 0, postoffice=cluster.workers[1])
+        keys = np.array([5, 9], dtype=np.uint64)
+        vals = np.arange(256, dtype=np.float32)
+        w0.wait(w0.push(keys, vals))
+        w1.wait(w1.push(keys, vals))
+        out = np.zeros_like(vals)
+        w0.wait(w0.pull(keys, out))
+        np.testing.assert_allclose(out, 2 * vals, rtol=1e-6)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+    # Sockets are unlinked on shutdown (stale ipc files are the classic
+    # zmq ipc:// footgun the van must not reproduce).
+    leftovers = [
+        p
+        for po in list(cluster.servers) + list(cluster.workers)
+        for p in [_local_sock_path(po.van.my_node.port)]
+        if os.path.exists(p)
+    ]
+    assert not leftovers, f"stale unix sockets: {leftovers}"
+
+
+def test_dmlc_local_unix_sockets_native():
+    _run_local_mode_cluster({"DMLC_LOCAL": "1"})
+
+
+def test_dmlc_local_unix_sockets_pure_python():
+    _run_local_mode_cluster({"DMLC_LOCAL": "1", "PS_NATIVE": "0"})
+
+
+def test_dmlc_local_reclaims_stale_socket():
+    """A crashed run's leftover socket file must not wedge the next
+    cluster: bind probes the path and reclaims it when nothing listens."""
+    import socket
+
+    from pslite_tpu.vans.tcp_van import _local_sock_path
+
+    port = get_available_port()
+    stale = _local_sock_path(port)
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(stale)
+    s.close()  # file remains, no listener — the crash signature
+    assert os.path.exists(stale)
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="tcp",
+        env_extra={"DMLC_LOCAL": "1", "DMLC_PS_ROOT_PORT": str(port)},
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([3], dtype=np.uint64)
+        vals = np.ones(64, np.float32)
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+    finally:
+        for s2 in servers:
+            s2.stop()
+        cluster.finalize()
