@@ -471,6 +471,119 @@ class Core {
   std::condition_variable queue_cv_;
 };
 
+// Parallel memcpy pool for the shm van's segment writes — the native
+// counterpart of the reference IPC transport's async copy thread pool
+// (rdma_transport.h:469-633, BYTEPS_IPC_COPY_NUM_THREADS): multi-MB
+// payload copies are split across persistent native threads, GIL-free
+// (Python enters through a ctypes call, which releases the GIL).
+class CopyPool {
+ public:
+  explicit CopyPool(int n_threads)
+      : n_(n_threads < 1 ? 1 : n_threads) {
+    for (int i = 0; i < n_; ++i) {
+      threads_.emplace_back([this] { Work(); });
+    }
+  }
+
+  ~CopyPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  void Copy(uint8_t* dst, const uint8_t* src, uint64_t n) {
+    constexpr uint64_t kMinChunk = 1ull << 20;  // below this, inline memcpy
+    uint64_t want = n / kMinChunk;
+    int parts = static_cast<int>(
+        want < 1 ? 1 : (want > static_cast<uint64_t>(n_) + 1
+                            ? static_cast<uint64_t>(n_) + 1
+                            : want));
+    if (parts <= 1) {
+      memcpy(dst, src, n);
+      return;
+    }
+    // One job at a time per pool; concurrent callers serialize here.
+    std::lock_guard<std::mutex> caller_lk(caller_mu_);
+    Job job;
+    job.dst = dst;
+    job.src = src;
+    job.n = n;
+    job.parts = parts;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      job_ = &job;
+      ++seq_;
+    }
+    cv_.notify_all();
+    RunChunks(&job);  // the caller is a worker too
+    // The job lives on this stack: wait until every chunk is copied AND
+    // every attached worker detached before letting it go out of scope.
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] {
+      return job.done.load() == job.parts && job.workers == 0;
+    });
+    job_ = nullptr;
+  }
+
+ private:
+  struct Job {
+    uint8_t* dst = nullptr;
+    const uint8_t* src = nullptr;
+    uint64_t n = 0;
+    int parts = 0;
+    std::atomic<int> next{0};
+    std::atomic<int> done{0};
+    int workers = 0;  // attached pool threads; guarded by mu_
+  };
+
+  void RunChunks(Job* job) {
+    int finished = 0;
+    for (int i = job->next.fetch_add(1); i < job->parts;
+         i = job->next.fetch_add(1)) {
+      uint64_t lo = job->n * i / job->parts;
+      uint64_t hi = job->n * (i + 1) / job->parts;
+      memcpy(job->dst + lo, job->src + lo, hi - lo);
+      ++finished;
+    }
+    if (finished) job->done.fetch_add(finished);
+  }
+
+  void Work() {
+    uint64_t seen = 0;
+    while (true) {
+      Job* job = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || seq_ != seen; });
+        if (stop_) return;
+        seen = seq_;
+        job = job_;  // may already be null (job finished without us)
+        if (job != nullptr) ++job->workers;
+      }
+      if (job == nullptr) continue;
+      RunChunks(job);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --job->workers;
+      }
+      done_cv_.notify_all();
+    }
+  }
+
+  int n_;
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::mutex caller_mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  Job* job_ = nullptr;
+  uint64_t seq_ = 0;
+  bool stop_ = false;
+};
+
 }  // namespace
 
 extern "C" {
@@ -518,6 +631,15 @@ int psl_recv(void* h, psl_frame_view* out, int timeout_ms) {
 }
 
 void psl_frame_free(uint8_t* buf) { free(buf); }
+
+void* psl_copy_pool_create(int n_threads) { return new CopyPool(n_threads); }
+
+void psl_copy_pool_copy(void* p, void* dst, const void* src, uint64_t n) {
+  static_cast<CopyPool*>(p)->Copy(static_cast<uint8_t*>(dst),
+                                  static_cast<const uint8_t*>(src), n);
+}
+
+void psl_copy_pool_destroy(void* p) { delete static_cast<CopyPool*>(p); }
 
 void psl_stop(void* h) { static_cast<Core*>(h)->Stop(); }
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
 from typing import List, Optional, Tuple
 
 _LIB_PATHS = [
@@ -78,6 +79,62 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.psl_frame_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     lib.psl_stop.argtypes = [ctypes.c_void_p]
     lib.psl_destroy.argtypes = [ctypes.c_void_p]
+    lib.psl_copy_pool_create.restype = ctypes.c_void_p
+    lib.psl_copy_pool_create.argtypes = [ctypes.c_int]
+    lib.psl_copy_pool_copy.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
+    ]
+    lib.psl_copy_pool_destroy.argtypes = [ctypes.c_void_p]
+
+
+class CopyPool:
+    """Parallel memcpy on persistent native threads — the IPC transport's
+    copy-thread-pool analog (rdma_transport.h:469-633).  ctypes releases
+    the GIL for the call, so the pool threads and the caller all stream
+    bytes concurrently on multi-core hosts."""
+
+    def __init__(self, n_threads: int):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError("native core not available")
+        self._h = self._lib.psl_copy_pool_create(n_threads)
+
+    def copy(self, dst_addr: int, src_addr: int, nbytes: int) -> None:
+        """Raw-pointer copy; the caller owns keeping both buffers alive."""
+        h = self._h
+        if not h:
+            raise RuntimeError("copy pool is closed")
+        self._lib.psl_copy_pool_copy(h, dst_addr, src_addr, nbytes)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.psl_copy_pool_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort: pools are owned by long-lived vans
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_shared_pool: Optional[CopyPool] = None
+_shared_pool_mu = threading.Lock()
+
+
+def shared_copy_pool(n_threads: int) -> Optional[CopyPool]:
+    """One process-wide pool, like the reference's single
+    BYTEPS_IPC_COPY_NUM_THREADS pool: co-located vans share its threads
+    (Copy serializes jobs internally), and its lifetime is the process —
+    individual van shutdown never races a peer van's in-flight copy.
+    The first caller's thread count wins."""
+    global _shared_pool
+    if load() is None:
+        return None
+    with _shared_pool_mu:
+        if _shared_pool is None:
+            _shared_pool = CopyPool(n_threads)
+        return _shared_pool
 
 
 class NativeTransport:

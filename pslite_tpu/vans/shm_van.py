@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import base64
 import copy
+import ctypes
 import json
 import mmap
 import os
@@ -32,6 +33,10 @@ from ..utils import logging as log
 from .tcp_van import TcpVan
 
 _SHM_DIR = "/dev/shm"
+
+# Payloads at least this large go through the native parallel-copy pool
+# (chunks below it aren't worth the handoff).
+_COPY_POOL_MIN = 1 << 20
 
 
 class _Segment:
@@ -80,6 +85,18 @@ class ShmVan(TcpVan):
         # semantics of RegisterRecvBuffer, kv_app.h:396-403) instead of
         # materializing a fresh array for kv_app to copy from.
         self._push_recv_bufs: Dict[tuple, np.ndarray] = {}
+        # Native parallel-copy pool for multi-MB segment writes — the
+        # reference IPC transport's copy-thread-pool
+        # (BYTEPS_IPC_COPY_NUM_THREADS=4, rdma_transport.h:570-589).
+        # Process-wide and process-lived: co-located vans share it, and a
+        # van shutting down can never free it under a peer's in-flight
+        # copy.  PS_NATIVE gating rode in via self._native (TcpVan).
+        self._copy_pool = None
+        n_copy = self.env.find_int("PS_SHM_COPY_THREADS", 4)
+        if n_copy > 0 and self._native is not None:
+            from . import native as _native_mod
+
+            self._copy_pool = _native_mod.shared_copy_pool(n_copy)
 
     def connect_transport(self, node) -> None:
         super().connect_transport(node)
@@ -100,6 +117,21 @@ class ShmVan(TcpVan):
             seg = _Segment(name, size, create)
             self._segments[name] = seg
             return seg
+
+    def _copy_into(self, dst_addr: int, arr: np.ndarray) -> None:
+        """One copy path for every payload: multi-MB copies spread across
+        the shared native pool's threads, the rest memmove inline."""
+        if self._copy_pool is not None and arr.nbytes >= _COPY_POOL_MIN:
+            self._copy_pool.copy(dst_addr, arr.ctypes.data, arr.nbytes)
+        else:
+            ctypes.memmove(dst_addr, arr.ctypes.data, arr.nbytes)
+
+    def _seg_write(self, seg: _Segment, off: int, data) -> int:
+        """Copy one payload into a segment; returns bytes written."""
+        arr = np.ascontiguousarray(data)
+        dst = ctypes.addressof(ctypes.c_char.from_buffer(seg.mm, off))
+        self._copy_into(dst, arr)
+        return arr.nbytes
 
     # -- zero-copy pull (is_worker_zpull_) -----------------------------------
 
@@ -170,19 +202,19 @@ class ShmVan(TcpVan):
         off = m.addr & ((1 << ZPULL_OFF_BITS) - 1)
         name = self._pull_segment_name(m.recver, buf_id)
         vals = msg.data[1]
-        raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
+        arr = np.ascontiguousarray(vals.data)
         with self._seg_mu:
             is_new_mapping = name not in self._segments
         try:
             # No exists() pre-check: the worker may unlink the segment
             # between a check and the open (shutdown race) — treat any
             # open failure as "not registered" and fall back.
-            seg = self._segment(name, off + raw.nbytes, create=False)
+            seg = self._segment(name, off + arr.nbytes, create=False)
         except OSError:
             return -1
-        if seg.size < off + raw.nbytes:
+        if seg.size < off + arr.nbytes:
             return -1
-        seg.mm[off : off + raw.nbytes] = raw
+        self._seg_write(seg, off, arr)
         if is_new_mapping:
             # Eviction only matters when the mapping count grew.
             self._cap_pull_mappings()
@@ -190,7 +222,7 @@ class ShmVan(TcpVan):
         desc = {
             "zpull_seg": name,
             "off": off,
-            "nbytes": raw.nbytes,
+            "nbytes": arr.nbytes,
             "code": m.data_type[1],
         }
         if m.body:
@@ -204,7 +236,7 @@ class ShmVan(TcpVan):
             [m.data_type[0]] + list(m.data_type[2:])
         )
         meta_only.data = [msg.data[0]] + list(msg.data[2:])
-        return super().send_msg(meta_only) + raw.nbytes
+        return super().send_msg(meta_only) + arr.nbytes
 
     def send_msg(self, msg: Message) -> int:
         m = msg.meta
@@ -229,9 +261,7 @@ class ShmVan(TcpVan):
         seg = self._segment(name, total, create=True)
         off = 0
         for d in msg.data:
-            raw = memoryview(np.ascontiguousarray(d.data)).cast("B")
-            seg.mm[off : off + raw.nbytes] = raw
-            off += raw.nbytes
+            off += self._seg_write(seg, off, d.data)
 
         meta_only = Message()
         meta_only.meta = copy.copy(m)  # don't mutate the caller's message
@@ -288,15 +318,15 @@ class ShmVan(TcpVan):
         try:
             vals = msg.data[1]
             flat = reg.reshape(-1).view(np.uint8)
-            raw = memoryview(np.ascontiguousarray(vals.data)).cast("B")
-            if raw.nbytes > flat.nbytes:
+            arr = np.ascontiguousarray(vals.data)
+            if arr.nbytes > flat.nbytes:
                 log.warning(
                     f"registered buffer for key {m.key} too small "
-                    f"({flat.nbytes} < {raw.nbytes}); delivering unpinned"
+                    f"({flat.nbytes} < {arr.nbytes}); delivering unpinned"
                 )
                 return
-            flat[: raw.nbytes] = raw
-            n = raw.nbytes // np.dtype(vals.dtype).itemsize
+            self._copy_into(flat.ctypes.data, arr)
+            n = arr.nbytes // np.dtype(vals.dtype).itemsize
             msg.data[1] = SArray(
                 reg.reshape(-1).view(vals.dtype)[:n]
             )
@@ -368,6 +398,7 @@ class ShmVan(TcpVan):
 
     def stop_transport(self) -> None:
         super().stop_transport()
+        # The copy pool is shared and process-lived: never closed here.
         with self._seg_mu:
             for seg in self._segments.values():
                 seg.close(unlink=seg.created)

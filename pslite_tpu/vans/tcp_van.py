@@ -13,6 +13,7 @@ socket loops can be offloaded to it via ``pslite_tpu.vans.native``.
 
 from __future__ import annotations
 
+import fcntl
 import os
 import random
 import socket
@@ -142,9 +143,18 @@ class TcpVan(Van):
         port = node.port or 10000 + random.randint(0, 40000)
         for attempt in range(max_retry + 1):
             path = _local_sock_path(port)
-            self._reclaim_stale_local(path)
+            # Reclaim+bind must be atomic against same-host racers: between
+            # probing a stale file and unlinking it, a peer may have bound
+            # the same path — unlink would then orphan its LIVE listener.
+            # DMLC_LOCAL is same-host by definition, so an flock on a
+            # sibling lock file closes the window.  The tiny .lock files
+            # are left behind deliberately: unlinking them would hand a
+            # third process a different inode to lock, reopening the race.
+            lock_fd = os.open(path + ".lock", os.O_CREAT | os.O_RDWR, 0o600)
             s = None
             try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX)
+                self._reclaim_stale_local(path)
                 if self._native is not None:
                     self._native.bind_local(path)
                     self._bound_path = None  # native core unlinks on stop
@@ -165,6 +175,12 @@ class TcpVan(Van):
                 if attempt == max_retry:
                     raise
                 port = 10000 + random.randint(0, 40000)
+            finally:
+                try:
+                    fcntl.flock(lock_fd, fcntl.LOCK_UN)
+                except OSError:
+                    pass
+                os.close(lock_fd)
 
     def _retry_connect(self, connect_once):
         """Peers start concurrently; retry until the remote listener is up
