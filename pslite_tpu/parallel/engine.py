@@ -174,6 +174,10 @@ class CollectiveEngine:
         # adam: m, v, step), sharded like the store and donated each step.
         self._opt_states: Dict[str, tuple] = {}
         self._opt_kinds: Dict[str, str] = {}
+        # Pinned pull-output buffers (PinMemory / w_pool_ analog,
+        # ucx_van.h:603-623): pulls for a registered bucket land in the
+        # same HBM buffer every time via donation of the previous output.
+        self._pinned_pulls: Dict[str, object] = {}
         self._programs: Dict[tuple, Callable] = {}
         self._mu = threading.Lock()
         # Per-bucket write locks: the jitted programs donate the store
@@ -358,7 +362,7 @@ class CollectiveEngine:
         mesh = self.mesh
         if op in ("push_st", "push_pull_st"):
             return self._stateful_program(op, key, handle_key)
-        if op == "pull":
+        if op in ("pull", "pull_pinned"):
             handle = None  # pull is read-only; no server update to fuse
         else:
             handle = self._handle_fn(
@@ -383,6 +387,21 @@ class CollectiveEngine:
         def _pull(store_l):
             return lax.all_gather(store_l, axis, tiled=True)
 
+        def _pull_pinned(prev_l, store_l):
+            # prev_l is the previous pinned output, passed to donate its
+            # buffer: jit pairs it with the shape-identical output, so the
+            # gather lands at the registered address.  The output must
+            # *use* prev_l or jit prunes the arg and drops the alias; the
+            # integer bitcast &0 keeps the dependence without float
+            # arithmetic (prev*0 would resurrect NaNs from stale lanes).
+            import jax.numpy as jnp
+
+            pulled = lax.all_gather(store_l, axis, tiled=True)
+            nbits = np.dtype(pulled.dtype).itemsize * 8
+            idt = jnp.dtype(f"int{nbits}")
+            dep = lax.bitcast_convert_type(prev_l, idt) & jnp.array(0, idt)
+            return pulled + lax.bitcast_convert_type(dep, pulled.dtype)
+
         if op == "push_pull":
             fn = shard_map(
                 _push_pull,
@@ -404,6 +423,14 @@ class CollectiveEngine:
                 _pull, mesh=mesh, in_specs=(store_spec,), out_specs=repl_spec
             )
             jitted = jax.jit(fn)
+        elif op == "pull_pinned":
+            fn = shard_map(
+                _pull_pinned,
+                mesh=mesh,
+                in_specs=(repl_spec, store_spec),
+                out_specs=repl_spec,
+            )
+            jitted = jax.jit(fn, donate_argnums=(0,))
         else:
             raise ValueError(op)
         with self._mu:
@@ -936,6 +963,23 @@ class CollectiveEngine:
     def pull(self, name: str):
         t0 = time.perf_counter()
         bucket = self._buckets[name]
+        if name in self._pinned_pulls:
+            prog = self._program(
+                "pull_pinned", bucket.padded_len, bucket.dtype,
+                "_pull_pinned",
+            )
+            with self._bucket_mu[name]:
+                # Re-fetch under the lock: a concurrent unregister may
+                # have popped the entry since the unlocked check above.
+                pinned = self._pinned_pulls.get(name)
+                if pinned is not None:
+                    pulled = prog(pinned, self._stores[name])
+                    self._pinned_pulls[name] = pulled
+                    self._observe(name, "pull", bucket, t0)
+                    # Padded length: the caller registered the buffer and
+                    # owns its layout — slicing here would materialize a
+                    # copy and break the address-identity contract.
+                    return pulled
         prog = self._program("pull", bucket.padded_len, bucket.dtype, "_pull")
         # Bucket lock: a concurrent push donates the store buffer; reading
         # it unlocked could hand an already-donated array to the pull
@@ -944,6 +988,40 @@ class CollectiveEngine:
             pulled = prog(self._stores[name])
         self._observe(name, "pull", bucket, t0)
         return pulled[: bucket.total_len]
+
+    def register_pull_buffer(self, name: str):
+        """Pin a persistent pull-output buffer for ``name`` — the
+        PinMemory / ``w_pool_`` contract of the reference's UCX van
+        (ucx_van.h:603-623): after this, every ``pull(name)`` delivers the
+        gathered store into the SAME device buffer (donation aliases the
+        previous output to the next), the collective analog of responses
+        RDMA-written to the worker's registered address
+        (test_benchmark.cc:169-181).  Returns the initial (zeroed,
+        padded-length, replicated) buffer.
+
+        The usual registered-buffer contract applies: at most one
+        outstanding pull per bucket, and the caller must not hold stale
+        references across pulls (the old array's buffer is donated)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bucket = self._buckets[name]
+        buf = jax.device_put(
+            np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype)),
+            NamedSharding(self.mesh, P(None)),
+        )
+        with self._bucket_mu[name]:
+            self._pinned_pulls[name] = buf
+        return buf
+
+    def unregister_pull_buffer(self, name: str) -> None:
+        with self._bucket_mu[name]:
+            self._pinned_pulls.pop(name, None)
+
+    def pinned_pull_buffer(self, name: str):
+        """The current pinned output (identity checks / zero-copy reads)."""
+        with self._bucket_mu[name]:
+            return self._pinned_pulls.get(name)
 
     def store_array(self, name: str):
         """A consistent snapshot of the sharded server state (for
@@ -1078,6 +1156,14 @@ class CollectiveEngine:
                 self._stores[n] = _repad(
                     store, b.total_len, b.padded_len, b.dtype
                 )
+                if n in self._pinned_pulls:
+                    # Re-pin on the new mesh: the old pinned buffer's
+                    # devices/shape no longer match (a fresh address —
+                    # same as re-registering after recovery).
+                    self._pinned_pulls[n] = jax.device_put(
+                        np.zeros(b.padded_len, dtype=np.dtype(b.dtype)),
+                        NamedSharding(mesh, P(None)),
+                    )
                 if opt is None:
                     self._opt_states.pop(n, None)
                     self._opt_kinds.pop(n, None)

@@ -401,3 +401,56 @@ def test_sparse_group_ops_match_single(mesh):
             np.asarray(grp.acc_array(n)), np.asarray(one.acc_array(n)),
             rtol=1e-5, atol=1e-6, err_msg=n,
         )
+
+
+def test_pinned_pull_buffer_address_identity(mesh):
+    """PinMemory / w_pool_ analog (ucx_van.h:603-623): once a pull buffer
+    is registered, every pull lands the gathered store at the SAME device
+    addresses — the collective version of the reference's registered
+    recv-buffer identity check (test_benchmark.cc:169-181)."""
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(4, dtype=np.uint64)
+    eng.register_dense("pin0", keys, 64)  # total 256, divisible by 8
+    eng.register_pull_buffer("pin0")
+
+    def addrs(arr):
+        return sorted(
+            s.data.unsafe_buffer_pointer() for s in arr.addressable_shards
+        )
+
+    ones = np.ones(4 * 64, dtype=np.float32)
+    eng.push("pin0", ones)  # each of 8 workers pushes ones -> sum = 8
+    p1 = eng.pull("pin0")
+    a1 = addrs(p1)
+    np.testing.assert_allclose(np.asarray(p1), 8 * ones)
+    eng.push("pin0", ones)
+    p2 = eng.pull("pin0")
+    a2 = addrs(p2)
+    np.testing.assert_allclose(np.asarray(p2), 16 * ones)
+    assert a1 == a2, f"pull output moved: {a1} vs {a2}"
+    # A third pull without an intervening push: same address again.
+    p3 = eng.pull("pin0")
+    assert addrs(p3) == a1
+    np.testing.assert_allclose(np.asarray(p3), 16 * ones)
+
+    # Unregister restores plain (sliced, non-pinned) pulls.
+    eng.unregister_pull_buffer("pin0")
+    p4 = eng.pull("pin0")
+    np.testing.assert_allclose(np.asarray(p4), 16 * ones)
+
+
+def test_pinned_pull_padded_bucket(mesh):
+    """Padding: the pinned buffer is padded-length; values beyond
+    total_len are gather artifacts the caller ignores."""
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(3, dtype=np.uint64)
+    eng.register_dense("pin1", keys, 33)  # total 99 -> padded 104
+    eng.register_pull_buffer("pin1")
+    base = np.arange(99, dtype=np.float32)
+    grads = np.stack([base for _ in range(eng.num_shards)])
+    eng.push("pin1", grads)
+    pulled = eng.pull("pin1")
+    assert pulled.shape[0] == eng._buckets["pin1"].padded_len
+    np.testing.assert_allclose(
+        np.asarray(pulled)[:99], 8 * base, rtol=1e-6
+    )
