@@ -14,18 +14,23 @@
 // Build: make -C cpp   ->  cpp/libpslite_core.so
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <fcntl.h>
 #include <netdb.h>
 #include <poll.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/epoll.h>
+#include <sys/file.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -53,6 +58,32 @@ struct Frame {
   //   then data segments back to back
 };
 
+// Cross-process SPSC byte pipe over a /dev/shm mapping — the reference's
+// vendored in-process lock-free SPSC ring (spsc_queue.h) extended across
+// processes for same-host meta traffic.  Stream semantics: the writer
+// copies frame bytes in as space allows, the reader pumps them through
+// the same reassembly state machine as a TCP stream, so a pipe is a
+// drop-in replacement for the socket between two co-located nodes.
+struct PipeHdr {
+  uint32_t magic;  // kPipeMagic
+  uint32_t pad;
+  uint64_t size;  // data-region bytes
+  alignas(64) std::atomic<uint64_t> head;  // consumed; reader-owned
+  alignas(64) std::atomic<uint64_t> tail;  // produced; writer-owned
+};
+
+constexpr uint32_t kPipeMagic = 0x50535242;  // "PSRB"
+constexpr size_t kPipeDataOff = 4096;        // header page
+
+struct WritePipe {
+  PipeHdr* hdr = nullptr;
+  uint8_t* data = nullptr;
+  int fd = -1;  // holds LOCK_SH for writer-liveness
+  size_t map_len = 0;
+  std::string path;
+  std::mutex mu;  // in-process senders serialize whole frames
+};
+
 // Per-connection frame reassembly state machine.
 struct Conn {
   int fd = -1;
@@ -65,6 +96,15 @@ struct Conn {
   size_t body_size = 0;
 
   ~Conn() { free(frame.buf); }
+};
+
+struct ReadPipe {
+  PipeHdr* hdr = nullptr;
+  const uint8_t* data = nullptr;
+  int fd = -1;
+  size_t map_len = 0;
+  std::string path;
+  Conn conn;  // reassembly state for this byte stream
 };
 
 class Core {
@@ -183,6 +223,164 @@ class Core {
     return 0;
   }
 
+  // -- shm byte pipes (PS_SHM_RING) ---------------------------------------
+
+  // Writer side: create the pipe for (me -> node_id).  Serialized against
+  // same-host racers/stale files by an flock on a sibling .lock file; the
+  // pipe fd then holds LOCK_SH for the writer's lifetime so readers can
+  // probe liveness with LOCK_EX|LOCK_NB.
+  int PipeConnect(int node_id, const char* path, uint64_t data_bytes) {
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      auto it = pipes_by_path_.find(path);
+      if (it != pipes_by_path_.end()) {
+        pipes_[node_id] = it->second;  // re-connect of the same pair
+        return 0;
+      }
+    }
+    std::string lockp = std::string(path) + ".lock";
+    int lock_fd = open(lockp.c_str(), O_CREAT | O_RDWR, 0600);
+    if (lock_fd < 0) return -errno;
+    flock(lock_fd, LOCK_EX);
+    int rc = PipeCreateLocked(node_id, path, data_bytes);
+    flock(lock_fd, LOCK_UN);
+    close(lock_fd);
+    return rc;
+  }
+
+  int PipeCreateLocked(int node_id, const char* path, uint64_t data_bytes) {
+    // Reclaim a stale file (writer died): nobody holds LOCK_SH on it.
+    int old_fd = open(path, O_RDWR);
+    if (old_fd >= 0) {
+      if (flock(old_fd, LOCK_EX | LOCK_NB) == 0) {
+        unlink(path);
+        close(old_fd);
+      } else {
+        close(old_fd);
+        return -EEXIST;  // a live writer owns this name
+      }
+    }
+    int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0) return -errno;
+    size_t map_len = kPipeDataOff + data_bytes;
+    if (ftruncate(fd, static_cast<off_t>(map_len)) < 0) {
+      int err = -errno;
+      close(fd);
+      unlink(path);
+      return err;
+    }
+    void* mem =
+        mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (mem == MAP_FAILED) {
+      int err = -errno;
+      close(fd);
+      unlink(path);
+      return err;
+    }
+    auto* hdr = new (mem) PipeHdr();
+    hdr->size = data_bytes;
+    hdr->head.store(0);
+    hdr->tail.store(0);
+    hdr->magic = kPipeMagic;  // last: readers gate on it
+    flock(fd, LOCK_SH);       // writer-liveness token
+    auto* p = new WritePipe();
+    p->hdr = hdr;
+    p->data = static_cast<uint8_t*>(mem) + kPipeDataOff;
+    p->fd = fd;
+    p->map_len = map_len;
+    p->path = path;
+    std::lock_guard<std::mutex> lk(send_mu_);
+    pipes_[node_id] = p;
+    pipes_by_path_[p->path] = p;
+    return 0;
+  }
+
+  // Reader side: watch a directory for pipes named <prefix>*<suffix>
+  // (ours are pslpipe_<ns>_<senderport>_<myport>); the poller attaches
+  // them as they appear.  Discovery by scan — no announce handshake —
+  // because a booting node sends ADD_NODE before the scheduler ever
+  // learns its identity (van.cc:566-577 bootstrap ordering).
+  int PipeWatch(const char* dir, const char* prefix, const char* suffix,
+                int idle_cap_us) {
+    std::lock_guard<std::mutex> lk(pipe_mu_);
+    watches_.push_back({dir, prefix, suffix});
+    if (idle_cap_us > 0) pipe_idle_cap_us_ = idle_cap_us;
+    if (!pipe_thread_.joinable()) {
+      pipe_thread_ = std::thread([this] { PipeLoop(); });
+    }
+    return 0;
+  }
+
+  long long PipeSendFrame(WritePipe* p, const uint8_t* meta,
+                          uint32_t meta_len, uint32_t n_data,
+                          const uint8_t* const* data, const uint64_t* lens) {
+    uint8_t header[kHeaderSize];
+    memcpy(header, &kMagic, 4);
+    memcpy(header + 4, &meta_len, 4);
+    memcpy(header + 8, &n_data, 4);
+    std::vector<iovec> iov;
+    iov.reserve(3 + n_data);
+    iov.push_back({header, kHeaderSize});
+    iov.push_back({const_cast<uint64_t*>(lens), 8ull * n_data});
+    iov.push_back({const_cast<uint8_t*>(meta), meta_len});
+    long long total = kHeaderSize + 8ll * n_data + meta_len;
+    for (uint32_t i = 0; i < n_data; ++i) {
+      iov.push_back({const_cast<uint8_t*>(data[i]),
+                     static_cast<size_t>(lens[i])});
+      total += static_cast<long long>(lens[i]);
+    }
+    // Whole frames are written under the pipe mutex: in-process sender
+    // threads must not interleave bytes mid-frame.
+    std::lock_guard<std::mutex> lk(p->mu);
+    int rc = PipeWriteVec(p, iov.data(), iov.size());
+    return rc < 0 ? rc : total;
+  }
+
+  // Stream the iovecs into the ring.  Frame atomicity rule: the timeout
+  // applies only BEFORE the first byte is committed — once any byte is
+  // published, aborting would leave a truncated frame and desync the
+  // stream forever, so from then on this blocks like a socket sendall
+  // (bailing only on shutdown, when the pipe dies with the process).
+  int PipeWriteVec(WritePipe* p, const iovec* iov, size_t cnt) {
+    uint64_t tail = p->hdr->tail.load(std::memory_order_relaxed);
+    const uint64_t size = p->hdr->size;
+    uint64_t slept_us = 0;
+    int spins = 0;
+    bool committed = false;
+    for (size_t i = 0; i < cnt; ++i) {
+      const uint8_t* src = static_cast<const uint8_t*>(iov[i].iov_base);
+      uint64_t len = iov[i].iov_len;
+      while (len) {
+        uint64_t head = p->hdr->head.load(std::memory_order_acquire);
+        uint64_t space = size - (tail - head);
+        if (space == 0) {
+          // Reader stalled (or not yet attached): stream semantics mean
+          // we must wait, not reroute — rerouting would reorder.
+          if (stopped_) return -ECANCELED;
+          if (++spins < 128) continue;
+          timespec ts{0, 50 * 1000};
+          nanosleep(&ts, nullptr);
+          slept_us += 50;
+          if (!committed && slept_us > 60ull * 1000 * 1000) {
+            return -ETIMEDOUT;
+          }
+          continue;
+        }
+        spins = 0;
+        uint64_t pos = tail % size;
+        uint64_t n = space < len ? space : len;
+        if (n > size - pos) n = size - pos;  // contiguous run
+        memcpy(p->data + pos, src, n);
+        tail += n;
+        src += n;
+        len -= n;
+        p->hdr->tail.store(tail, std::memory_order_release);
+        committed = true;
+      }
+    }
+    return 0;
+  }
+
   int Connect(int node_id, const char* host, int port) {
     addrinfo hints{};
     hints.ai_family = AF_INET;
@@ -235,12 +433,31 @@ class Core {
   long long Send(int node_id, const uint8_t* meta, uint32_t meta_len,
                  uint32_t n_data, const uint8_t* const* data,
                  const uint64_t* lens) {
-    int fd;
+    // Gate against teardown: StopAndJoin must not free pipes while a
+    // sender is mid-copy into the mapping.
+    struct InflightGuard {
+      std::atomic<int>* n;
+      explicit InflightGuard(std::atomic<int>* c) : n(c) { ++*n; }
+      ~InflightGuard() { --*n; }
+    } guard(&inflight_sends_);
+    if (stopped_) return -ECANCELED;
+    WritePipe* pipe = nullptr;
+    int fd = -1;
     {
       std::lock_guard<std::mutex> lk(send_mu_);
-      auto it = send_fds_.find(node_id);
-      if (it == send_fds_.end()) return -ENOTCONN;
-      fd = it->second;
+      auto pit = pipes_.find(node_id);
+      if (pit != pipes_.end()) {
+        pipe = pit->second;
+      } else {
+        auto it = send_fds_.find(node_id);
+        if (it == send_fds_.end()) return -ENOTCONN;
+        fd = it->second;
+      }
+    }
+    // A connected pipe carries the WHOLE stream for this peer (mixing
+    // pipe and socket frames would lose ordering).
+    if (pipe != nullptr) {
+      return PipeSendFrame(pipe, meta, meta_len, n_data, data, lens);
     }
     uint8_t header[kHeaderSize];
     memcpy(header, &kMagic, 4);
@@ -334,7 +551,27 @@ class Core {
   void StopAndJoin() {
     Stop();
     if (io_thread_.joinable()) io_thread_.join();
+    if (pipe_thread_.joinable()) pipe_thread_.join();
+    // Wait for in-flight Sends to drain: freeing a pipe mapping under a
+    // sender's memcpy would be a use-after-munmap (stopped_ makes them
+    // bail at their next ring-full or entry check).
+    for (int i = 0; i < 5000 && inflight_sends_.load() > 0; ++i) {
+      timespec ts{0, 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+    for (auto& kv : rpipes_) ClosePipe(kv.second);
+    rpipes_.clear();
     std::lock_guard<std::mutex> lk(send_mu_);
+    for (auto& kv : pipes_by_path_) {
+      WritePipe* p = kv.second;
+      munmap(reinterpret_cast<void*>(p->hdr), p->map_len);
+      close(p->fd);  // releases the writer-liveness LOCK_SH
+      unlink(p->path.c_str());
+      unlink((p->path + ".lock").c_str());  // don't pollute /dev/shm
+      delete p;
+    }
+    pipes_by_path_.clear();
+    pipes_.clear();
     for (auto& kv : send_fds_) close(kv.second);
     send_fds_.clear();
     for (auto& kv : conns_) {
@@ -353,6 +590,209 @@ class Core {
 
  private:
   static constexpr int kSendLocks = 64;
+
+  void PipeLoop() {
+    uint64_t idle_us = 0;
+    uint64_t last_scan_ms = 0, last_live_ms = 0;
+    while (!stopped_) {
+      uint64_t now_ms = NowMs();
+      if (now_ms - last_scan_ms >= 100) {
+        last_scan_ms = now_ms;
+        ScanPipes();
+      }
+      bool check_liveness = false;
+      if (now_ms - last_live_ms >= 1000) {
+        last_live_ms = now_ms;
+        check_liveness = true;
+      }
+      long long moved = 0;
+      for (auto it = rpipes_.begin(); it != rpipes_.end();) {
+        ReadPipe* rp = it->second;
+        long long n = PumpPipe(rp);
+        if (n > 0) moved += n;
+        bool drop = n < 0;
+        if (drop) {
+          struct stat st{};
+          if (fstat(rp->fd, &st) == 0) {
+            bad_pipes_[rp->path] = st.st_ino;
+          }
+        }
+        if (!drop && check_liveness && n == 0) {
+          drop = ReclaimIfDead(rp);
+        }
+        if (drop) {
+          ClosePipe(rp);
+          it = rpipes_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      if (moved) {
+        idle_us = 0;
+      } else {
+        // Exponential backoff, capped: the cap trades idle CPU for tail
+        // latency (PS_SHM_RING_IDLE_US; single-core hosts want it high,
+        // dedicated cores can spin near zero).
+        uint64_t cap = pipe_idle_cap_us_;
+        idle_us = idle_us ? (idle_us * 2 < cap ? idle_us * 2 : cap) : 2;
+        timespec ts{0, static_cast<long>(idle_us * 1000)};
+        nanosleep(&ts, nullptr);
+      }
+    }
+  }
+
+  static uint64_t NowMs() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+  }
+
+  // Detach (and possibly reclaim the name of) a pipe whose writer died.
+  // Serialized under the sibling .lock and guarded by an inode check: a
+  // restarted writer may have already recreated the NAME with a fresh
+  // inode — unlinking blindly would orphan the new generation's pipe.
+  bool ReclaimIfDead(ReadPipe* rp) {
+    if (flock(rp->fd, LOCK_EX | LOCK_NB) != 0) return false;  // writer alive
+    flock(rp->fd, LOCK_UN);
+    std::string lockp = rp->path + ".lock";
+    int lock_fd = open(lockp.c_str(), O_CREAT | O_RDWR, 0600);
+    if (lock_fd < 0) return true;  // detach; scan re-attaches if live
+    if (flock(lock_fd, LOCK_EX | LOCK_NB) != 0) {
+      close(lock_fd);  // a writer is mid-create on this name: just detach
+      return true;
+    }
+    struct stat st_name{}, st_mine{};
+    if (stat(rp->path.c_str(), &st_name) != 0) {
+      // Writer already unlinked the pipe; drop the .lock we just
+      // recreated with O_CREAT or it leaks in /dev/shm forever.
+      unlink(lockp.c_str());
+    } else if (fstat(rp->fd, &st_mine) == 0 &&
+               st_name.st_ino == st_mine.st_ino &&
+               flock(rp->fd, LOCK_EX | LOCK_NB) == 0) {
+      unlink(rp->path.c_str());
+      unlink(lockp.c_str());
+    }
+    flock(lock_fd, LOCK_UN);
+    close(lock_fd);
+    return true;
+  }
+
+  void ScanPipes() {
+    std::vector<std::array<std::string, 3>> watches;
+    {
+      std::lock_guard<std::mutex> lk(pipe_mu_);
+      watches = watches_;
+    }
+    for (const auto& w : watches) {
+      DIR* d = opendir(w[0].c_str());
+      if (!d) continue;
+      while (dirent* e = readdir(d)) {
+        std::string name = e->d_name;
+        if (name.size() < w[1].size() + w[2].size()) continue;
+        if (name.compare(0, w[1].size(), w[1]) != 0) continue;
+        if (name.compare(name.size() - w[2].size(), w[2].size(), w[2]) != 0)
+          continue;
+        std::string path = w[0] + "/" + name;
+        if (rpipes_.count(path)) continue;
+        // A pipe dropped for a protocol error stays blacklisted for its
+        // inode's lifetime — re-attaching the same desynced stream would
+        // loop attach/fail forever.  A fresh inode (writer restarted)
+        // clears the entry.
+        auto bad = bad_pipes_.find(path);
+        if (bad != bad_pipes_.end()) {
+          struct stat st{};
+          if (stat(path.c_str(), &st) == 0 &&
+              static_cast<uint64_t>(st.st_ino) == bad->second) {
+            continue;
+          }
+          bad_pipes_.erase(bad);
+        }
+        TryAttachPipe(path);
+      }
+      closedir(d);
+    }
+  }
+
+  void TryAttachPipe(const std::string& path) {
+    std::string lockp = path + ".lock";
+    int lock_fd = open(lockp.c_str(), O_CREAT | O_RDWR, 0600);
+    if (lock_fd < 0) return;
+    flock(lock_fd, LOCK_EX);
+    int fd = open(path.c_str(), O_RDWR);
+    if (fd < 0) {
+      // Pipe vanished between scan and attach: drop the .lock we may
+      // have just created.
+      unlink(lockp.c_str());
+    }
+    if (fd >= 0) {
+      if (flock(fd, LOCK_EX | LOCK_NB) == 0) {
+        // No live writer: stale leftover — reclaim the name.
+        unlink(path.c_str());
+        unlink(lockp.c_str());
+        close(fd);
+      } else {
+        struct stat st{};
+        if (fstat(fd, &st) == 0 &&
+            static_cast<size_t>(st.st_size) > kPipeDataOff) {
+          size_t map_len = st.st_size;
+          void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE,
+                           MAP_SHARED, fd, 0);
+          if (mem != MAP_FAILED) {
+            auto* hdr = static_cast<PipeHdr*>(mem);
+            if (hdr->magic == kPipeMagic &&
+                hdr->size == map_len - kPipeDataOff) {
+              auto* rp = new ReadPipe();
+              rp->hdr = hdr;
+              rp->data = static_cast<uint8_t*>(mem) + kPipeDataOff;
+              rp->fd = fd;
+              rp->map_len = map_len;
+              rp->path = path;
+              rpipes_[path] = rp;
+              fd = -1;  // owned by rp now
+            } else {
+              munmap(mem, map_len);
+            }
+          }
+        }
+        if (fd >= 0) close(fd);
+      }
+    }
+    flock(lock_fd, LOCK_UN);
+    close(lock_fd);
+  }
+
+  // Drain available pipe bytes through the frame state machine.
+  // Returns bytes consumed, or -1 on protocol error.
+  long long PumpPipe(ReadPipe* rp) {
+    Conn* c = &rp->conn;
+    uint64_t head = rp->hdr->head.load(std::memory_order_relaxed);
+    const uint64_t size = rp->hdr->size;
+    long long consumed = 0;
+    while (true) {
+      uint64_t tail = rp->hdr->tail.load(std::memory_order_acquire);
+      uint64_t avail = tail - head;
+      if (avail == 0) break;
+      uint64_t n = c->want - c->got;
+      if (n > avail) n = avail;
+      uint64_t pos = head % size;
+      if (n > size - pos) n = size - pos;
+      memcpy(StageDst(c), rp->data + pos, n);
+      c->got += n;
+      head += n;
+      consumed += static_cast<long long>(n);
+      rp->hdr->head.store(head, std::memory_order_release);
+      if (c->got == c->want && !OnStageComplete(c)) return -1;
+    }
+    return consumed;
+  }
+
+  void ClosePipe(ReadPipe* rp) {
+    munmap(const_cast<uint8_t*>(
+               reinterpret_cast<const uint8_t*>(rp->hdr)),
+           rp->map_len);
+    close(rp->fd);
+    delete rp;
+  }
 
   void IoLoop() {
     epoll_event events[64];
@@ -395,65 +835,71 @@ class Core {
     }
   }
 
+  // Byte sink of the frame state machine for the current stage.
+  static uint8_t* StageDst(Conn* c) {
+    return (c->stage == 0 ? c->header : c->frame.buf) + c->got;
+  }
+
+  // Stage transition once got == want.  Returns false on protocol error.
+  // Shared by the fd reader and the shm-pipe pump: both are byte streams
+  // feeding the same reassembly.
+  bool OnStageComplete(Conn* c) {
+    if (c->stage == 0) {
+      uint32_t magic, meta_len, n_data;
+      memcpy(&magic, c->header, 4);
+      memcpy(&meta_len, c->header + 4, 4);
+      memcpy(&n_data, c->header + 8, 4);
+      if (magic != kMagic) return false;
+      c->frame.meta_len = meta_len;
+      c->frame.n_data = n_data;
+      // Read lens first to learn the body size.
+      c->body_size = 8ull * n_data + meta_len;
+      c->frame.buf = static_cast<uint8_t*>(malloc(c->body_size));
+      c->stage = 1;
+      c->want = 8ull * n_data;  // lens arrive first
+      c->got = 0;
+      if (c->want == 0) {
+        c->stage = 2;
+        c->want = meta_len;
+      }
+    } else if (c->stage == 1) {
+      // Lens complete: total body = lens + meta + sum(data).
+      uint64_t total = 0;
+      const uint64_t* lens = reinterpret_cast<uint64_t*>(c->frame.buf);
+      for (uint32_t i = 0; i < c->frame.n_data; ++i) total += lens[i];
+      size_t full = 8ull * c->frame.n_data + c->frame.meta_len + total;
+      c->frame.buf = static_cast<uint8_t*>(realloc(c->frame.buf, full));
+      c->body_size = full;
+      c->stage = 2;
+      c->want = full;
+      // got already == 8*n_data
+    } else {
+      // Frame complete.
+      {
+        std::lock_guard<std::mutex> lk(queue_mu_);
+        queue_.push_back(c->frame);
+      }
+      queue_cv_.notify_one();
+      c->frame = Frame();
+      c->stage = 0;
+      c->want = kHeaderSize;
+      c->got = 0;
+    }
+    return true;
+  }
+
   // Pump all available bytes through the frame state machine.  Returns
   // false when the peer closed or errored.
   bool ReadConn(Conn* c) {
     while (true) {
-      uint8_t* dst;
-      if (c->stage == 0) {
-        dst = c->header + c->got;
-      } else {
-        dst = c->frame.buf + c->got;
-      }
-      ssize_t n = read(c->fd, dst, c->want - c->got);
+      ssize_t n = read(c->fd, StageDst(c), c->want - c->got);
       if (n == 0) return false;
       if (n < 0) {
         return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
       }
       c->got += static_cast<size_t>(n);
       if (c->got < c->want) continue;
-      if (c->stage == 0) {
-        uint32_t magic, meta_len, n_data;
-        memcpy(&magic, c->header, 4);
-        memcpy(&meta_len, c->header + 4, 4);
-        memcpy(&n_data, c->header + 8, 4);
-        if (magic != kMagic) return false;
-        c->frame.meta_len = meta_len;
-        c->frame.n_data = n_data;
-        // Read lens first to learn the body size.
-        c->body_size = 8ull * n_data + meta_len;
-        c->frame.buf = static_cast<uint8_t*>(malloc(c->body_size));
-        c->stage = 1;
-        c->want = 8ull * n_data;  // lens arrive first
-        if (n_data == 0) c->want = 0;
-        c->got = 0;
-        if (c->want == 0) {
-          c->stage = 2;
-          c->want = meta_len;
-        }
-      } else if (c->stage == 1) {
-        // Lens complete: total body = lens + meta + sum(data).
-        uint64_t total = 0;
-        const uint64_t* lens = reinterpret_cast<uint64_t*>(c->frame.buf);
-        for (uint32_t i = 0; i < c->frame.n_data; ++i) total += lens[i];
-        size_t full = 8ull * c->frame.n_data + c->frame.meta_len + total;
-        c->frame.buf = static_cast<uint8_t*>(realloc(c->frame.buf, full));
-        c->body_size = full;
-        c->stage = 2;
-        c->want = full;
-        // got already == 8*n_data
-      } else {
-        // Frame complete.
-        {
-          std::lock_guard<std::mutex> lk(queue_mu_);
-          queue_.push_back(c->frame);
-        }
-        queue_cv_.notify_one();
-        c->frame = Frame();
-        c->stage = 0;
-        c->want = kHeaderSize;
-        c->got = 0;
-      }
+      if (!OnStageComplete(c)) return false;
     }
   }
 
@@ -464,6 +910,15 @@ class Core {
   std::atomic<bool> stopped_{false};
   std::unordered_map<int, Conn*> conns_;  // io thread only
   std::unordered_map<int, int> send_fds_;
+  std::unordered_map<int, WritePipe*> pipes_;                  // send_mu_
+  std::unordered_map<std::string, WritePipe*> pipes_by_path_;  // send_mu_
+  std::vector<std::array<std::string, 3>> watches_;  // pipe_mu_
+  std::unordered_map<std::string, ReadPipe*> rpipes_;  // pipe thread only
+  std::unordered_map<std::string, uint64_t> bad_pipes_;  // path -> inode
+  std::thread pipe_thread_;
+  std::mutex pipe_mu_;
+  std::atomic<uint64_t> pipe_idle_cap_us_{500};
+  std::atomic<int> inflight_sends_{0};
   std::mutex send_mu_;
   std::mutex per_fd_send_mu_[kSendLocks];
   std::deque<Frame> queue_;
@@ -606,6 +1061,16 @@ int psl_connect(void* h, int node_id, const char* host, int port) {
 
 int psl_bind_local(void* h, const char* path, int backlog) {
   return static_cast<Core*>(h)->BindLocal(path, backlog);
+}
+
+int psl_pipe_connect(void* h, int node_id, const char* path,
+                     uint64_t data_bytes) {
+  return static_cast<Core*>(h)->PipeConnect(node_id, path, data_bytes);
+}
+
+int psl_pipe_watch(void* h, const char* dir, const char* prefix,
+                   const char* suffix, int idle_cap_us) {
+  return static_cast<Core*>(h)->PipeWatch(dir, prefix, suffix, idle_cap_us);
 }
 
 int psl_connect_local(void* h, int node_id, const char* path) {

@@ -67,6 +67,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.psl_connect_local.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p
     ]
+    lib.psl_pipe_connect.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64
+    ]
+    lib.psl_pipe_watch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int,
+    ]
     lib.psl_send.restype = ctypes.c_longlong
     lib.psl_send.argtypes = [
         ctypes.c_void_p, ctypes.c_int,
@@ -165,6 +172,27 @@ class NativeTransport:
 
     def connect_local(self, node_id: int, path: str) -> None:
         rc = self._lib.psl_connect_local(self._h, node_id, path.encode())
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def pipe_connect(self, node_id: int, path: str, data_bytes: int) -> None:
+        """PS_SHM_RING: route this peer's whole stream through a
+        shared-memory SPSC byte pipe created at ``path``."""
+        rc = self._lib.psl_pipe_connect(
+            self._h, node_id, path.encode(), data_bytes
+        )
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def pipe_watch(self, directory: str, prefix: str, suffix: str,
+                   idle_cap_us: int = 0) -> None:
+        """Start attaching inbound pipes named <prefix>*<suffix> in
+        ``directory`` as they appear (poller thread).  ``idle_cap_us``
+        bounds the poller's idle backoff (0 = keep default)."""
+        rc = self._lib.psl_pipe_watch(
+            self._h, directory.encode(), prefix.encode(), suffix.encode(),
+            idle_cap_us,
+        )
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
 

@@ -97,11 +97,60 @@ class ShmVan(TcpVan):
             from . import native as _native_mod
 
             self._copy_pool = _native_mod.shared_copy_pool(n_copy)
+        # PS_SHM_RING=1: same-host peers exchange their WHOLE meta stream
+        # through shared-memory SPSC byte pipes instead of TCP — the
+        # reference's in-process lock-free SPSC queue (spsc_queue.h,
+        # DMLC_LOCKLESS_QUEUE) extended across processes.  Payload bytes
+        # still ride the /dev/shm segments; the pipe replaces the socket,
+        # so per-pair ordering is exactly stream ordering.
+        self._pipe_mode = False
+        self._pipe_bytes = self.env.find_int("PS_SHM_RING_BYTES", 1 << 22)
+        if self.env.find_int("PS_SHM_RING", 0):
+            if self._native is not None:
+                self._pipe_mode = True
+            else:
+                log.warning(
+                    "PS_SHM_RING needs the native core (make -C cpp); "
+                    "staying on sockets"
+                )
+
+    def bind_transport(self, node, max_retry: int) -> int:
+        port = super().bind_transport(node, max_retry)
+        if self._pipe_mode:
+            # Watch for inbound pipes targeting my port.  Glob discovery
+            # (no announce handshake): a booting peer sends ADD_NODE
+            # before anyone knows its identity, so the receiver must find
+            # the pipe by name alone.
+            self._native.pipe_watch(
+                _SHM_DIR, f"pslpipe_{self._pull_ns}_", f"_{port}",
+                self.env.find_int("PS_SHM_RING_IDLE_US", 0),
+            )
+        return port
 
     def connect_transport(self, node) -> None:
         super().connect_transport(node)
         if node.id >= 0:
             self._peer_hosts[node.id] = node.hostname
+            if (
+                self._pipe_mode
+                and node.port
+                and self.my_node.port
+                and node.hostname == self.my_node.hostname
+            ):
+                path = os.path.join(
+                    _SHM_DIR,
+                    f"pslpipe_{self._pull_ns}"
+                    f"_{self.my_node.port}_{node.port}",
+                )
+                try:
+                    self._native.pipe_connect(
+                        node.id, path, self._pipe_bytes
+                    )
+                except OSError as exc:
+                    log.warning(
+                        f"shm pipe to node {node.id} unavailable "
+                        f"({exc!r}); staying on the socket"
+                    )
 
     def _same_host(self, recver: int) -> bool:
         host = self._peer_hosts.get(recver)

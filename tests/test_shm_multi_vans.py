@@ -5,6 +5,7 @@ worker+server moving data through shared memory) and
 tests/run_benchmark.sh's MultiVan mode.
 """
 
+import os
 import threading
 
 import numpy as np
@@ -220,3 +221,104 @@ def test_shm_van_large_payload_rides_copy_pool():
     cluster.start()
     # 2M floats = 8 MB > 1 MB threshold: exercises the pooled path.
     _push_pull_roundtrip(cluster, payload_floats=2 * 1024 * 1024)
+
+
+def test_shm_ring_cluster():
+    """PS_SHM_RING=1: the whole same-host cluster's meta plane rides
+    shared-memory SPSC byte pipes (the cross-process extension of the
+    reference's spsc_queue.h); payloads still ride segments.  Values and
+    ordering must be identical to the socket plane."""
+    import glob
+    import pytest
+
+    from pslite_tpu.vans import native
+
+    if native.load() is None:
+        pytest.skip("native core not built")
+    cluster = LoopbackCluster(
+        num_workers=2, num_servers=2, van_type="shm",
+        env_extra={"PS_SHM_RING": "1"},
+    )
+    cluster.start()
+    ns = cluster.base_env["DMLC_PS_ROOT_PORT"]
+    # The cluster actually created pipes (not silently on sockets).
+    pipes = glob.glob(f"/dev/shm/pslpipe_{ns}_*")
+    assert any(not p.endswith(".lock") for p in pipes), pipes
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        w0 = KVWorker(0, 0, postoffice=cluster.workers[0])
+        w1 = KVWorker(0, 0, postoffice=cluster.workers[1])
+        ranges = cluster.workers[0].get_server_key_ranges()
+        keys = np.array(
+            sorted(r.begin + 2 for r in ranges), dtype=np.uint64
+        )
+        vals = np.random.default_rng(7).normal(
+            size=len(keys) * 4096
+        ).astype(np.float32)
+        # Interleaved pushes from two workers + pulls: exercises ordered
+        # delivery through the pipes under concurrency.
+        for _ in range(5):
+            t0 = w0.push(keys, vals)
+            t1 = w1.push(keys, vals)
+            w0.wait(t0)
+            w1.wait(t1)
+        out = np.zeros_like(vals)
+        w0.wait(w0.pull(keys, out))
+        np.testing.assert_allclose(out, 10 * vals, rtol=1e-5)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+    leftovers = [
+        p for p in glob.glob(f"/dev/shm/pslpipe_{ns}_*")
+        if not p.endswith(".lock")
+    ]
+    assert not leftovers, f"pipes not unlinked: {leftovers}"
+
+
+def test_shm_ring_reclaims_stale_pipe():
+    """A dead run's pipe file (no writer flock) must be reclaimed, not
+    wedge the pair."""
+    import pytest
+
+    from pslite_tpu.vans import native
+
+    if native.load() is None:
+        pytest.skip("native core not built")
+    from pslite_tpu.utils.network import get_available_port
+
+    port = get_available_port()
+    # Plant a stale pipe where the scheduler's port would collide.
+    stale = f"/dev/shm/pslpipe_{port}_{port}_{port}"
+    with open(stale, "wb") as f:
+        f.write(b"\0" * 8192)
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="shm",
+        env_extra={
+            "PS_SHM_RING": "1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        },
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([11], dtype=np.uint64)
+        vals = np.ones(256, np.float32)
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+        if os.path.exists(stale):
+            os.unlink(stale)
