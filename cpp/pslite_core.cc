@@ -176,15 +176,15 @@ class Core {
     return 0;
   }
 
-  int ConnectLocal(int node_id, const char* path) {
+  int ConnectLocal(int node_id, const char* path, int timeout_ms) {
     sockaddr_un addr{};
     if (strlen(path) >= sizeof(addr.sun_path)) return -ENAMETOOLONG;
     int fd = socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd < 0) return -errno;
     addr.sun_family = AF_UNIX;
     strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
-    // Bounded connect (30 s), same invariant as the TCP path: a listener
-    // with a wedged accept loop and full backlog must not stall forever.
+    // Bounded connect, same invariant as the TCP path: a listener with a
+    // wedged accept loop and full backlog must not stall forever.
     int flags = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
     int rc = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
@@ -198,7 +198,7 @@ class Core {
     }
     if (rc < 0 && errno == EINPROGRESS) {
       pollfd pfd{fd, POLLOUT, 0};
-      rc = poll(&pfd, 1, 30000);
+      rc = poll(&pfd, 1, timeout_ms);
       if (rc <= 0) {
         close(fd);
         return rc == 0 ? -ETIMEDOUT : -errno;
@@ -381,7 +381,7 @@ class Core {
     return 0;
   }
 
-  int Connect(int node_id, const char* host, int port) {
+  int Connect(int node_id, const char* host, int port, int timeout_ms) {
     addrinfo hints{};
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -395,15 +395,15 @@ class Core {
       freeaddrinfo(res);
       return -errno;
     }
-    // Bounded connect (30 s): a black-holed peer must not stall the caller
-    // for the kernel's full SYN-retry period.
+    // Bounded connect: a black-holed peer must not stall the caller for
+    // the kernel's full SYN-retry period.
     int flags = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
     int rc = connect(fd, res->ai_addr, res->ai_addrlen);
     freeaddrinfo(res);
     if (rc < 0 && errno == EINPROGRESS) {
       pollfd pfd{fd, POLLOUT, 0};
-      rc = poll(&pfd, 1, 30000);
+      rc = poll(&pfd, 1, timeout_ms);
       if (rc <= 0) {
         close(fd);
         return rc == 0 ? -ETIMEDOUT : -errno;
@@ -1055,8 +1055,9 @@ int psl_bind(void* h, int port, int backlog) {
   return static_cast<Core*>(h)->Bind(port, backlog);
 }
 
-int psl_connect(void* h, int node_id, const char* host, int port) {
-  return static_cast<Core*>(h)->Connect(node_id, host, port);
+int psl_connect(void* h, int node_id, const char* host, int port,
+                int timeout_ms) {
+  return static_cast<Core*>(h)->Connect(node_id, host, port, timeout_ms);
 }
 
 int psl_bind_local(void* h, const char* path, int backlog) {
@@ -1073,8 +1074,9 @@ int psl_pipe_watch(void* h, const char* dir, const char* prefix,
   return static_cast<Core*>(h)->PipeWatch(dir, prefix, suffix, idle_cap_us);
 }
 
-int psl_connect_local(void* h, int node_id, const char* path) {
-  return static_cast<Core*>(h)->ConnectLocal(node_id, path);
+int psl_connect_local(void* h, int node_id, const char* path,
+                      int timeout_ms) {
+  return static_cast<Core*>(h)->ConnectLocal(node_id, path, timeout_ms);
 }
 
 long long psl_send(void* h, int node_id, const uint8_t* meta,

@@ -59,13 +59,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.psl_create.restype = ctypes.c_void_p
     lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     lib.psl_connect.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.psl_bind_local.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int
     ]
     lib.psl_connect_local.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
     ]
     lib.psl_pipe_connect.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64
@@ -159,8 +160,11 @@ class NativeTransport:
             raise OSError(-rc, os.strerror(-rc))
         return rc
 
-    def connect(self, node_id: int, host: str, port: int) -> None:
-        rc = self._lib.psl_connect(self._h, node_id, host.encode(), port)
+    def connect(self, node_id: int, host: str, port: int,
+                timeout_ms: int = 30000) -> None:
+        rc = self._lib.psl_connect(
+            self._h, node_id, host.encode(), port, timeout_ms
+        )
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
 
@@ -170,8 +174,11 @@ class NativeTransport:
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
 
-    def connect_local(self, node_id: int, path: str) -> None:
-        rc = self._lib.psl_connect_local(self._h, node_id, path.encode())
+    def connect_local(self, node_id: int, path: str,
+                      timeout_ms: int = 30000) -> None:
+        rc = self._lib.psl_connect_local(
+            self._h, node_id, path.encode(), timeout_ms
+        )
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
 

@@ -127,8 +127,9 @@ class ShmVan(TcpVan):
             )
         return port
 
-    def connect_transport(self, node) -> None:
-        super().connect_transport(node)
+    def connect_transport(self, node, deadline: float = 60.0,
+                          timeout_s: float = 30.0) -> None:
+        super().connect_transport(node, deadline, timeout_s)
         if node.id >= 0:
             self._peer_hosts[node.id] = node.hostname
             if (

@@ -77,6 +77,12 @@ class TcpVan(Van):
         # DMLC_LOCAL: unix-domain sockets for same-host clusters.
         self._local = bool(self.env.find_int("DMLC_LOCAL", 0))
         self._bound_path: Optional[str] = None
+        # Transport-level reconnect (the UCX van's error-handler redial,
+        # ucx_van.h:291-327 + BYTEPS_UCX_RECONNECT_TMO): a send hitting a
+        # broken connection redials the last-known address once and
+        # retries.  At-least-once on that frame — pair with PS_RESEND for
+        # dedup, exactly like the reference.  -1 disables.
+        self._reconnect_ms = self.env.find_int("PS_RECONNECT_TMO", 100)
 
     # -- transport interface -------------------------------------------------
 
@@ -182,12 +188,14 @@ class TcpVan(Van):
                     pass
                 os.close(lock_fd)
 
-    def _retry_connect(self, connect_once):
+    def _retry_connect(self, connect_once, deadline: float = 60.0):
         """Peers start concurrently; retry until the remote listener is up
         (zmq's async connect gives the reference this for free).  Each
-        attempt is itself bounded to 30 s (python: socket timeout; native:
-        poll-bounded connect in pslite_core.cc)."""
-        deadline, delay = 60.0, 0.05
+        attempt is itself bounded (python: socket timeout; native:
+        poll-bounded connect in pslite_core.cc).  A send-failure redial
+        passes a much smaller deadline and per-attempt timeout — a dead
+        peer must not stall the sender for the full bootstrap budget."""
+        delay = 0.05
         while True:
             try:
                 return connect_once()
@@ -198,26 +206,35 @@ class TcpVan(Van):
                 deadline -= delay
                 delay = min(delay * 2, 1.0)
 
-    def connect_transport(self, node: Node) -> None:
+    def connect_transport(self, node: Node, deadline: float = 60.0,
+                          timeout_s: float = 30.0) -> None:
         if node.id < 0:
             return
         if self._local:
-            self._connect_local(node)
+            self._connect_local(node, deadline, timeout_s)
             return
         if self._native is not None:
             self._retry_connect(
-                lambda: self._native.connect(node.id, node.hostname, node.port)
+                lambda: self._native.connect(
+                    node.id, node.hostname, node.port,
+                    int(timeout_s * 1000),
+                ),
+                deadline,
             )
+            with self._socks_mu:
+                # Remembered for send-failure redial (reconnect path).
+                self._send_addrs[node.id] = (node.hostname, node.port)
             return
         def connect_once():
             s = socket.create_connection((node.hostname, node.port),
-                                         timeout=30)
+                                         timeout=timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return s
 
-        self._dial_and_swap(node, connect_once)
+        self._dial_and_swap(node, connect_once, deadline)
 
-    def _dial_and_swap(self, node: Node, connect_once) -> None:
+    def _dial_and_swap(self, node: Node, connect_once,
+                       deadline: float = 60.0) -> None:
         """Shared pure-python dial sequence: dedup (ADD_NODE broadcasts
         re-issue connects), retry the dial, then swap the peer socket under
         the lock and close any predecessor."""
@@ -225,7 +242,7 @@ class TcpVan(Van):
             if (self._send_addrs.get(node.id) == (node.hostname, node.port)
                     and node.id in self._send_socks):
                 return
-        sock = self._retry_connect(connect_once)
+        sock = self._retry_connect(connect_once, deadline)
         with self._socks_mu:
             old = self._send_socks.pop(node.id, None)
             self._send_socks[node.id] = sock
@@ -236,21 +253,25 @@ class TcpVan(Van):
             except OSError:
                 pass
 
-    def _connect_local(self, node: Node) -> None:
+    def _connect_local(self, node: Node, deadline: float = 60.0,
+                       timeout_s: float = 30.0) -> None:
         path = _local_sock_path(node.port)
         if self._native is not None:
             with self._socks_mu:
                 if self._send_addrs.get(node.id) == (node.hostname, node.port):
                     return
             self._retry_connect(
-                lambda: self._native.connect_local(node.id, path)
+                lambda: self._native.connect_local(
+                    node.id, path, int(timeout_s * 1000)
+                ),
+                deadline,
             )
             with self._socks_mu:
                 self._send_addrs[node.id] = (node.hostname, node.port)
             return
         def connect_once():
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            s.settimeout(30)
+            s.settimeout(timeout_s)
             try:
                 s.connect(path)
             except OSError:
@@ -259,9 +280,53 @@ class TcpVan(Van):
             s.settimeout(None)
             return s
 
-        self._dial_and_swap(node, connect_once)
+        self._dial_and_swap(node, connect_once, deadline)
 
     def send_msg(self, msg: Message) -> int:
+        try:
+            return self._send_msg_once(msg)
+        except OSError as exc:
+            if self._closing or self._reconnect_ms < 0:
+                raise
+            log.warning(
+                f"send to node {msg.meta.recver} failed ({exc!r}); "
+                f"redialing in {self._reconnect_ms} ms"
+            )
+            time.sleep(self._reconnect_ms / 1000.0)
+            if self._closing or not self._redial(msg.meta.recver):
+                raise
+            return self._send_msg_once(msg)
+
+    def _redial(self, recver: int) -> bool:
+        """Drop the broken connection and reconnect to the peer's
+        last-known address (clearing the dedup entries so the connect
+        actually redials)."""
+        with self._socks_mu:
+            addr = self._send_addrs.pop(recver, None)
+            sock = self._send_socks.pop(recver, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if addr is None:
+            return False
+        try:
+            self.connect_transport(
+                Node(id=recver, hostname=addr[0], ports=[addr[1]]),
+                deadline=5.0,
+                timeout_s=3.0,
+            )
+        except OSError:
+            # Peer still down: remember the address so a LATER send can
+            # redial once it recovers (forgetting it would permanently
+            # disable reconnect for this peer).
+            with self._socks_mu:
+                self._send_addrs.setdefault(recver, addr)
+            return False
+        return True
+
+    def _send_msg_once(self, msg: Message) -> int:
         recver = msg.meta.recver
         if self._native is not None:
             meta_buf = wire.pack_meta(msg.meta)

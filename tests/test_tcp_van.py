@@ -196,3 +196,40 @@ def test_dmlc_local_reclaims_stale_socket():
         for s2 in servers:
             s2.stop()
         cluster.finalize()
+
+
+def test_send_failure_redials():
+    """Transport-level reconnect (the UCX van's error-handler redial):
+    a send hitting a broken connection reconnects to the last-known
+    address and retries, invisibly to the app."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="tcp",
+        env_extra={"PS_NATIVE": "0", "PS_RECONNECT_TMO": "10"},
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([7], dtype=np.uint64)
+        vals = np.ones(128, np.float32)
+        w.wait(w.push(keys, vals))
+
+        # Break the worker's connection to the server out from under it.
+        van = cluster.workers[0].van
+        server_id = cluster.servers[0].van.my_node.id
+        with van._socks_mu:
+            broken = van._send_socks[server_id]
+        broken.close()
+
+        # The next push rides the redial path transparently.
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, 2 * vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
