@@ -149,6 +149,9 @@ class KVWorker:
         self._dense_routes: Dict[Tuple[int, int, int], str] = {}
         self._device_results: Dict[int, object] = {}
         self._engine_pool = None  # lazy completion executor (engine path)
+        # Last completion per pinned bucket: the next pinned pull joins it
+        # before donating the previous result (one-outstanding contract).
+        self._pinned_pull_futs: Dict[str, Callable] = {}
 
     @property
     def engine(self):
@@ -276,6 +279,19 @@ class KVWorker:
         ] = name
         return bucket
 
+    def register_pull_buffer(self, name: str):
+        """Pin a persistent device pull buffer for a registered dense
+        bucket (the UCX PinMemory / w_pool_ contract at the app level):
+        every engine ``pull`` for ``name`` then lands in the same HBM
+        buffer (``push_pull`` keeps its own fresh outputs and is NOT
+        pinned).  Back-to-back pinned pulls serialize on the previous
+        completion — the registered-buffer one-outstanding contract.
+        Returns the initial buffer; see
+        ``CollectiveEngine.register_pull_buffer``."""
+        log.check(self.engine is not None,
+                  "register_pull_buffer requires the ici van")
+        return self.engine.register_pull_buffer(name)
+
     def _engine_route(self, keys: np.ndarray, cmd: int = 0,
                       lens=None) -> Optional[str]:
         """Bucket name iff these exact keys are registered and the request
@@ -295,7 +311,8 @@ class KVWorker:
     _MAX_DEVICE_RESULTS = 8
 
     def _engine_dispatch(self, result, out=None, callback=None,
-                         keep_result: bool = False) -> int:
+                         keep_result: bool = False,
+                         fut_out: Optional[list] = None) -> int:
         """Timestamp + async completion for a collective op.
 
         Completion (device done -> host copy -> callback) runs on a
@@ -322,6 +339,8 @@ class KVWorker:
         fut = self._engine_pool.submit(
             self._engine_complete, result, out, callback
         )
+        if fut_out is not None:
+            fut_out.append(fut.result)
         self._customer.add_wait_hook(ts, fut.result)
         return ts
 
@@ -442,9 +461,27 @@ class KVWorker:
                       "compress='int8' requires float32 values")
         route = self._engine_route(keys, cmd, lens)
         if route is not None:
+            pinned = self.engine.pinned_pull_buffer(route) is not None
+            if pinned:
+                # Registered-buffer contract (kv_app.h:210-217 for the
+                # reference's pinned buffers): at most one outstanding
+                # pull per pinned bucket — the next pull donates the
+                # previous result's buffer, so dispatching it while the
+                # completion thread still copies would use-after-donate.
+                prev = self._pinned_pull_futs.get(route)
+                if prev is not None:
+                    prev()
             result = self.engine.pull(route)
-            return self._engine_dispatch(result, out=vals, callback=callback,
-                                         keep_result=True)
+            # keep_result retains device results for get_pulled(); a
+            # pinned result is donated by the NEXT pull, so retaining it
+            # would hand out deleted arrays.
+            holder: list = []
+            ts = self._engine_dispatch(result, out=vals, callback=callback,
+                                       keep_result=not pinned,
+                                       fut_out=holder if pinned else None)
+            if pinned and holder:
+                self._pinned_pull_futs[route] = holder[0]
+            return ts
         ts = self._customer.new_request(SERVER_GROUP)
         zpull = (
             self._zpull_lookup(keys, vals)

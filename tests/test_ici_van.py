@@ -138,3 +138,51 @@ def test_sparse_table_via_worker(cluster):
             ref[idx[w, i]] += grads[w, i]
     for w in range(W):
         np.testing.assert_allclose(out[w], ref[idx[w]], rtol=1e-4, atol=1e-5)
+
+
+def test_worker_pinned_pull_buffer(cluster):
+    """App-level PinMemory: after register_pull_buffer, engine pulls for
+    the bucket land in one persistent device buffer (address identity),
+    while the message-level out= contract is unchanged."""
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(4, dtype=np.uint64)
+    worker.register_dense("pinned", keys, 64)
+    worker.register_pull_buffer("pinned")
+
+    ones = np.ones(4 * 64, dtype=np.float32)
+    W = worker.engine.num_shards
+    grads = np.stack([ones for _ in range(W)])
+    out = np.zeros_like(ones)
+    worker.wait(worker.push(keys, grads))
+    worker.wait(worker.pull(keys, out))
+    np.testing.assert_allclose(out, W * ones)
+
+    def addrs(arr):
+        return sorted(
+            s.data.unsafe_buffer_pointer() for s in arr.addressable_shards
+        )
+
+    a1 = addrs(worker.engine.pinned_pull_buffer("pinned"))
+    out2 = np.zeros_like(ones)
+    worker.wait(worker.pull(keys, out2))
+    np.testing.assert_allclose(out2, W * ones)
+    a2 = addrs(worker.engine.pinned_pull_buffer("pinned"))
+    assert a1 == a2, "pinned pull buffer moved between app-level pulls"
+
+
+def test_worker_pinned_pull_pipelined(cluster):
+    """Back-to-back pinned pulls without wait() must not use-after-donate:
+    the app layer serializes on the previous completion."""
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(2, dtype=np.uint64)
+    worker.register_dense("pin_pipe", keys, 128)
+    worker.register_pull_buffer("pin_pipe")
+    ones = np.ones(2 * 128, dtype=np.float32)
+    W = worker.engine.num_shards
+    worker.wait(worker.push(keys, np.stack([ones] * W)))
+    outs = [np.zeros_like(ones) for _ in range(4)]
+    tss = [worker.pull(keys, o) for o in outs]  # no wait between
+    for ts in tss:
+        worker.wait(ts)
+    for o in outs:
+        np.testing.assert_allclose(o, W * ones)
