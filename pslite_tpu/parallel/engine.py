@@ -1002,11 +1002,12 @@ class CollectiveEngine:
         The usual registered-buffer contract applies: at most one
         outstanding pull per bucket, and the caller must not hold stale
         references across pulls (the old array's buffer is donated)."""
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         bucket = self._buckets[name]
-        buf = jax.device_put(
+        # _place handles multi-process meshes (device_put cannot target
+        # non-addressable devices).
+        buf = self._place(
             np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype)),
             NamedSharding(self.mesh, P(None)),
         )

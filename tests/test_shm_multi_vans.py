@@ -322,3 +322,35 @@ def test_shm_ring_reclaims_stale_pipe():
         cluster.finalize()
         if os.path.exists(stale):
             os.unlink(stale)
+
+
+def test_shm_ring_composes_with_dmlc_local():
+    """All three same-host tiers at once: unix-socket control endpoints
+    (DMLC_LOCAL), shm pipes for the meta stream (PS_SHM_RING), and
+    /dev/shm segments for payloads."""
+    import pytest
+
+    from pslite_tpu.vans import native
+
+    if native.load() is None:
+        pytest.skip("native core not built")
+    import glob
+
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="shm",
+        env_extra={"DMLC_LOCAL": "1", "PS_SHM_RING": "1"},
+    )
+    cluster.start()
+    # Both tiers actually engaged — no silent fallback to TCP.
+    ns = cluster.base_env["DMLC_PS_ROOT_PORT"]
+    pipes = [
+        p for p in glob.glob(f"/dev/shm/pslpipe_{ns}_*")
+        if not p.endswith(".lock")
+    ]
+    assert pipes, "ring pipes not engaged under DMLC_LOCAL"
+    from pslite_tpu.vans.tcp_van import _local_sock_path
+
+    assert os.path.exists(
+        _local_sock_path(cluster.workers[0].van.my_node.port)
+    ), "unix-socket endpoint not engaged"
+    _push_pull_roundtrip(cluster, payload_floats=64 * 1024)
