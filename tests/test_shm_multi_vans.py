@@ -341,16 +341,22 @@ def test_shm_ring_composes_with_dmlc_local():
         env_extra={"DMLC_LOCAL": "1", "PS_SHM_RING": "1"},
     )
     cluster.start()
-    # Both tiers actually engaged — no silent fallback to TCP.
-    ns = cluster.base_env["DMLC_PS_ROOT_PORT"]
-    pipes = [
-        p for p in glob.glob(f"/dev/shm/pslpipe_{ns}_*")
-        if not p.endswith(".lock")
-    ]
-    assert pipes, "ring pipes not engaged under DMLC_LOCAL"
-    from pslite_tpu.vans.tcp_van import _local_sock_path
+    try:
+        # Both tiers actually engaged — no silent fallback to TCP.
+        ns = cluster.base_env["DMLC_PS_ROOT_PORT"]
+        pipes = [
+            p for p in glob.glob(f"/dev/shm/pslpipe_{ns}_*")
+            if not p.endswith(".lock")
+        ]
+        assert pipes, "ring pipes not engaged under DMLC_LOCAL"
+        from pslite_tpu.vans.tcp_van import _local_sock_path
 
-    assert os.path.exists(
-        _local_sock_path(cluster.workers[0].van.my_node.port)
-    ), "unix-socket endpoint not engaged"
+        assert os.path.exists(
+            _local_sock_path(cluster.workers[0].van.my_node.port)
+        ), "unix-socket endpoint not engaged"
+    except BaseException:
+        # _push_pull_roundtrip finalizes internally; a failed engagement
+        # assert must not leak the live cluster and its shm/sock files.
+        cluster.finalize()
+        raise
     _push_pull_roundtrip(cluster, payload_floats=64 * 1024)

@@ -16,6 +16,23 @@ from helpers import LoopbackCluster
 
 
 def test_randomized_push_pull_soak():
+    _run_soak()
+
+
+def test_randomized_soak_over_shm_ring():
+    """The same property net with the whole meta plane on shared-memory
+    SPSC ring pipes — sustained concurrent traffic through the newest
+    transport tier (two workers interleaving against three servers)."""
+    import pytest
+
+    from pslite_tpu.vans import native
+
+    if native.load() is None:
+        pytest.skip("native core not built")
+    _run_soak(van_type="shm", extra={"PS_SHM_RING": "1"}, default_rounds=15)
+
+
+def _run_soak(van_type: str = "loopback", extra=None, default_rounds=30):
     # PS_SOAK_ROUNDS extends the horizon (default keeps CI fast; the
     # bounded tracker makes long horizons safe — see
     # test_customer_tracker_bounded).
@@ -23,9 +40,12 @@ def test_randomized_push_pull_soak():
     # PS_SOAK_PRIORITY=1 additionally soaks the priority send scheduler
     # (random per-request priorities through the van heap).
     prio = bool(int(os.environ.get("PS_SOAK_PRIORITY", "0")))
+    env_extra = dict(extra or {})
+    if prio:
+        env_extra["PS_PRIORITY_SCHED"] = "1"
     cluster = LoopbackCluster(
-        num_workers=2, num_servers=3,
-        env_extra={"PS_PRIORITY_SCHED": "1"} if prio else None,
+        num_workers=2, num_servers=3, van_type=van_type,
+        env_extra=env_extra or None,
     )
     cluster.start()
     servers = []
@@ -55,7 +75,7 @@ def test_randomized_push_pull_soak():
         k = 8  # values per key
         model = {}  # host reference: key -> np.ndarray
 
-        rounds = int(os.environ.get("PS_SOAK_ROUNDS", "30"))
+        rounds = int(os.environ.get("PS_SOAK_ROUNDS", str(default_rounds)))
         for round_idx in range(rounds):
             w = workers[round_idx % 2]
             # Random subset of the pool, sorted (the KV contract).
