@@ -15,22 +15,51 @@ import threading
 from typing import List, Optional
 
 from ..message import Message, Node
+from ..utils import logging as log
 from ..utils.queues import ThreadsafeQueue
 from .tcp_van import TcpVan
 from .van import Van
 
 
-class _Rail(TcpVan):
-    """A TcpVan used purely as a transport (its control plane is unused)."""
+def _rail_class(kind: str):
+    """Rail transport type (PS_MULTI_RAIL_VAN): tcp (default) or shm.
+    The reference's MultiVan composes zmq rails only (multi_van.h:57);
+    shm rails generalize the same routing to the same-host fast path —
+    each rail gets its own segment namespace and (with PS_SHM_RING) its
+    own pipe pair, the multi-channel-per-device UCX pattern
+    (ucx_van.h:938-1006) on host memory."""
+    if kind == "shm":
+        from .shm_van import ShmVan
+
+        class _ShmRail(ShmVan):
+            """Transport-only ShmVan rail (control plane unused)."""
+
+        return _ShmRail
+
+    class _TcpRail(TcpVan):
+        """A TcpVan used purely as a transport (control plane unused)."""
+
+    return _TcpRail
 
 
 class MultiVan(Van):
     def __init__(self, postoffice):
         super().__init__(postoffice)
         self.num_rails = max(postoffice.env.find_int("DMLC_NUM_PORTS", 2), 1)
-        self._rails: List[_Rail] = [
-            _Rail(postoffice) for _ in range(self.num_rails)
+        rail_kind = postoffice.env.find("PS_MULTI_RAIL_VAN", "tcp")
+        log.check(rail_kind in ("tcp", "shm"),
+                  f"unknown rail van {rail_kind!r}")
+        cls = _rail_class(rail_kind)
+        self._rails: List[TcpVan] = [
+            cls(postoffice) for _ in range(self.num_rails)
         ]
+        for i, rail in enumerate(self._rails):
+            if hasattr(rail, "_ns"):
+                # Disjoint per-rail segment namespaces: data for one
+                # (sender, recver, key) round-robins across rails, and
+                # two rails resizing/unlinking ONE shared segment file
+                # under each other's cached mmaps would corrupt payloads.
+                rail._ns = f"{rail._ns}r{i}"
         self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
         self._pumps: List[threading.Thread] = []
         self._rr = itertools.count()
@@ -42,7 +71,12 @@ class MultiVan(Van):
             # extra rails take ephemeral ports.
             want = node.port if i == 0 else 0
             sub = Node(role=node.role, hostname=node.hostname, ports=[want])
-            ports.append(rail.bind_transport(sub, max_retry))
+            port = rail.bind_transport(sub, max_retry)
+            # Rails are transport-only: give each its own identity so
+            # same-host detection (shm rails) and pipe naming work.
+            rail.my_node.hostname = node.hostname
+            rail.my_node.ports = [port]
+            ports.append(port)
         node.ports = ports
         for i, rail in enumerate(self._rails):
             t = threading.Thread(
@@ -63,7 +97,7 @@ class MultiVan(Van):
             )
             rail.connect_transport(sub)
 
-    def _pick_rail(self, msg: Message) -> _Rail:
+    def _pick_rail(self, msg: Message) -> TcpVan:
         if not msg.meta.control.empty():
             return self._rails[0]  # control plane rides rail 0
         dev = msg.meta.src_dev_id
@@ -77,7 +111,7 @@ class MultiVan(Van):
     def recv_msg(self) -> Optional[Message]:
         return self._queue.wait_and_pop()
 
-    def _pump(self, rail: _Rail) -> None:
+    def _pump(self, rail: TcpVan) -> None:
         while True:
             msg = rail.recv_msg()
             if msg is None:
