@@ -1,9 +1,11 @@
-"""Child for the elastic end-to-end test.
+"""Child for the elastic end-to-end tests.
 
-Worker rank 1 crashes (exit 254) after its first push; the launcher's
-keepalive restarts it; the scheduler's recovery path hands it the dead id;
-it pushes again and the cluster finalizes cleanly.  Worker rank 0 polls the
-store until it reflects all three pushes.
+Worker rank 1 crashes (exit 254) after each push until it has crashed
+PS_ELASTIC_CRASHES times (marker file carries the count); the launcher's
+keepalive restarts it each time; the scheduler's recovery path hands it
+the dead id; the final life pushes and finalizes cleanly.  Worker rank 0
+polls the store until it reflects every push (rank0 once + rank1 once
+per life = PS_ELASTIC_CRASHES + 2 total).
 """
 
 import faulthandler
@@ -25,7 +27,15 @@ from pslite_tpu.message import Role
 def main() -> int:
     role = os.environ["DMLC_ROLE"]
     marker = sys.argv[1]
-    if role == "worker" and os.path.exists(marker):
+    # PS_ELASTIC_CRASHES: how many times rank 1 crashes (the marker file
+    # carries the count so each restarted life knows where it is).
+    want = int(os.environ.get("PS_ELASTIC_CRASHES", "1"))
+    crashes = 0
+    if os.path.exists(marker):
+        # Only this script writes the marker; a non-integer is a real
+        # test bug and should raise loudly.
+        crashes = int(open(marker).read().strip() or "0")
+    if role == "worker" and crashes:
         # Recovery run: give the scheduler time to see the old id as dead.
         time.sleep(float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "2")) + 1.5)
     ps.start_ps()
@@ -38,8 +48,9 @@ def main() -> int:
         worker = KVWorker(0, 0)
         keys = np.array([42], dtype=np.uint64)
         worker.wait(worker.push(keys, np.ones(8, dtype=np.float32)))
-        if po.my_rank() == 1 and not os.path.exists(marker):
-            open(marker, "w").close()
+        if po.my_rank() == 1 and crashes < want:
+            with open(marker, "w") as f:
+                f.write(str(crashes + 1))
             os._exit(254)  # crash AFTER push, BEFORE finalize
         if po.is_recovery:
             print("RECOVERED_OK", flush=True)
@@ -48,7 +59,7 @@ def main() -> int:
             deadline = time.time() + 120
             while time.time() < deadline:
                 worker.wait(worker.pull(keys, out))
-                if out[0] >= 3.0:  # rank0 once + rank1 twice
+                if out[0] >= want + 2.0:  # rank0 once + rank1 want+1 times
                     print("POLL_OK", flush=True)
                     break
                 time.sleep(0.5)

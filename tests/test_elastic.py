@@ -5,21 +5,26 @@ Exercises the full reliability chain in one scenario: heartbeats
 (PS_HEARTBEAT_*), scheduler dead-node detection, recovery id inheritance,
 launcher keepalive (exit 254), and continued KV traffic afterwards —
 the reference's recovery story (van.cc:266-332 + dmlc_local.py keepalive)
-driven through real OS processes.
+driven through real OS processes.  crashes=2 re-inherits the dead id
+twice, proving recovery bookkeeping survives repetition.
 """
 
 import os
 import subprocess
 import sys
 
+import pytest
 
-def test_worker_crash_recovery_end_to_end(tmp_path):
+
+@pytest.mark.parametrize("crashes", [1, 2])
+def test_worker_crash_recovery_end_to_end(tmp_path, crashes):
     marker = tmp_path / "crashed"
     child = os.path.join(os.path.dirname(__file__), "elastic_child.py")
     env = dict(
         os.environ,
         PS_HEARTBEAT_INTERVAL="1",
         PS_HEARTBEAT_TIMEOUT="2",
+        PS_ELASTIC_CRASHES=str(crashes),
     )
     proc = subprocess.run(
         [
@@ -28,14 +33,15 @@ def test_worker_crash_recovery_end_to_end(tmp_path):
             sys.executable, child, str(marker),
         ],
         capture_output=True,
-        timeout=300,
+        timeout=300 + 120 * crashes,
         env=env,
         cwd="/root/repo",
     )
     out = proc.stdout.decode() + proc.stderr.decode()
     assert proc.returncode == 0, out[-3000:]
-    assert marker.exists(), "the crash never happened"
-    assert "restarting worker (exit 254)" in out
+    assert marker.read_text().strip() == str(crashes)
+    assert out.count("restarting worker (exit 254)") == crashes
     assert "RECOVERED_OK" in out
     assert "POLL_OK" in out
-    assert out.count("ELASTIC_DONE") == 4  # scheduler, server, 2 workers
+    # Every role's FINAL life finalized cleanly (scheduler, server, 2 workers).
+    assert out.count("ELASTIC_DONE") == 4, out[-3000:]
