@@ -164,6 +164,10 @@ class ShmVan(TcpVan):
                 return seg
             if seg is not None:
                 seg.close(unlink=seg.created)
+                # Drop the entry NOW: if re-creation below raises (e.g.
+                # /dev/shm exhausted), a cached closed segment would
+                # poison every later send for this key.
+                del self._segments[name]
             seg = _Segment(name, size, create)
             self._segments[name] = seg
             return seg
@@ -308,7 +312,16 @@ class ShmVan(TcpVan):
             f"psl_{self._ns}_{m.sender}_{m.recver}_{m.key}"
             f"_{int(m.push)}{int(m.request)}"
         )
-        seg = self._segment(name, total, create=True)
+        try:
+            seg = self._segment(name, total, create=True)
+        except OSError as exc:
+            # /dev/shm exhausted (ENOSPC) or otherwise unusable: deliver
+            # over the socket instead of failing the send.
+            log.warning(
+                f"shm segment {name} unavailable ({exc!r}); "
+                f"sending over the socket"
+            )
+            return super().send_msg(msg)
         off = 0
         for d in msg.data:
             off += self._seg_write(seg, off, d.data)

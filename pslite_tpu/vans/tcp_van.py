@@ -200,6 +200,8 @@ class TcpVan(Van):
             try:
                 return connect_once()
             except OSError:
+                # deadline <= 0 = single-attempt mode (send-failure
+                # redial): fail fast, the NEXT send retries again.
                 if deadline <= 0 or self._closing:
                     raise
                 time.sleep(delay)
@@ -312,9 +314,16 @@ class TcpVan(Van):
         if addr is None:
             return False
         try:
+            # Bounded retry window: long enough to ride out a peer
+            # restarting in place at the same address (the transparent
+            # reconnect the redial exists for), short enough not to wedge
+            # the van-wide send lock on a truly dead peer (heartbeats
+            # own that verdict).  Shutdown sends never get here: the
+            # finalize barrier keeps every peer alive until TERMINATE,
+            # and the self-send rides a real self-connection.
             self.connect_transport(
                 Node(id=recver, hostname=addr[0], ports=[addr[1]]),
-                deadline=5.0,
+                deadline=3.0,
                 timeout_s=3.0,
             )
         except OSError:

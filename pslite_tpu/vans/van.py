@@ -622,18 +622,19 @@ class Van:
                 self.po.on_id_assigned(node)
             if node.id == self.my_node.id or node.role == self.my_node.role:
                 # Never connect worker<->worker or server<->server
-                # (reference: README.md:20).
+                # (reference: README.md:20) — but DO connect to self
+                # (zmq_van.h:150 skips same-role only when it isn't me):
+                # the TERMINATE self-send rides that connection.
                 if node.id != self.my_node.id:
                     continue
             if node.role == Role.SCHEDULER and not self.po.is_scheduler:
                 continue  # already connected during start()
-            if node.id != self.my_node.id:
-                if node.is_recovery:
-                    # A restarted peer begins its sid sequence at 0 again;
-                    # stale per-peer ordering state would stall force-order
-                    # delivery forever.
-                    self._reset_peer_sids(node.id)
-                self.connect(node)
+            if node.id != self.my_node.id and node.is_recovery:
+                # A restarted peer begins its sid sequence at 0 again;
+                # stale per-peer ordering state would stall force-order
+                # delivery forever.
+                self._reset_peer_sids(node.id)
+            self.connect(node)
         log.check(self.my_node.id != EMPTY_ID, "scheduler did not assign my id")
         self.ready.set()
 
