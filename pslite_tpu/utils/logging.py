@@ -46,18 +46,31 @@ def set_verbosity(level: int) -> None:
     _verbosity_override = max(_verbosity_override, level)
 
 
+_env_level = None  # lazily cached; PS_VERBOSE is fixed at process start
+
+
 def verbosity() -> int:
-    try:
-        env_level = int(os.environ.get("PS_VERBOSE", "0"))
-    except ValueError:
-        env_level = 0
-    return max(env_level, _verbosity_override)
+    """Effective level.  The os.environ read is cached — vlog gates sit
+    on the per-message hot path, and PS_VERBOSE only ever arrives in a
+    child's environment before python starts (in-process clusters raise
+    the level via set_verbosity instead)."""
+    global _env_level
+    if _env_level is None:
+        try:
+            _env_level = int(os.environ.get("PS_VERBOSE", "0"))
+        except ValueError:
+            _env_level = 0
+    return max(_env_level, _verbosity_override)
 
 
-def vlog(level: int, msg: str) -> None:
-    """Log ``msg`` when PS_VERBOSE >= level (1=connection, 2=per-message)."""
+def vlog(level: int, msg) -> None:
+    """Log ``msg`` when PS_VERBOSE >= level (1=connection, 2=per-message).
+
+    ``msg`` may be a zero-arg callable: per-message call sites pass
+    ``lambda: f"...{m.debug_string()}"`` so the (expensive) formatting
+    only runs when the level is actually enabled."""
     if verbosity() >= level:
-        _logger.info(msg)
+        _logger.info(msg() if callable(msg) else msg)
 
 
 def info(msg: str) -> None:

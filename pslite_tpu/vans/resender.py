@@ -86,7 +86,7 @@ class Resender:
             if not duplicated:
                 self._acked.add(sig)
         if duplicated:
-            log.vlog(2, f"Duplicated message dropped: {msg.debug_string()}")
+            log.vlog(2, lambda: f"Duplicated message dropped: {msg.debug_string()}")
         return duplicated
 
     def _monitoring(self) -> None:

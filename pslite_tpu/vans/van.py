@@ -275,7 +275,7 @@ class Van:
         self.send_bytes += nbytes
         if msg.meta.control.empty():
             self.profiler.record(msg.meta.key, "send", msg.meta.push)
-        log.vlog(2, f"SEND {msg.debug_string()}")
+        log.vlog(2, lambda: f"SEND {msg.debug_string()}")
         return nbytes
 
     def _priority_sender(self) -> None:
@@ -363,7 +363,7 @@ class Van:
                 continue
             if self.resender is not None and self.resender.add_incoming(msg):
                 continue
-            log.vlog(2, f"RECV {msg.debug_string()}")
+            log.vlog(2, lambda: f"RECV {msg.debug_string()}")
             if ctrl.cmd == Command.TERMINATE:
                 break
             try:
