@@ -445,6 +445,11 @@ class TcpVan(Van):
                 self._queue.push(wire.rebuild_message(meta, bufs))
         except OSError:
             pass
+        except Exception as exc:
+            # Undecodable frame: the stream is corrupt beyond this point
+            # (framing lost) — drop the connection, mirroring the native
+            # core's bad-magic handling.
+            log.warning(f"dropping connection on corrupt frame: {exc!r}")
         finally:
             try:
                 conn.close()

@@ -341,14 +341,29 @@ class Van:
     # -- receive loop --------------------------------------------------------
 
     def _receiving(self) -> None:
+        # Decode-failure budget: +1 per failure, slow decay on success —
+        # interleaved healthy traffic must not indefinitely excuse a
+        # persistently corrupt peer (a plain consecutive counter would
+        # reset on every good frame and never trip on a busy server).
+        error_budget = 0.0
         while not self._stop_event.is_set():
             try:
                 msg = self.recv_msg()
-            except Exception as exc:  # transport torn down under us
+                error_budget = max(0.0, error_budget - 0.01)
+            except Exception as exc:
                 if self._stop_event.is_set():
+                    break  # transport torn down under us
+                # One malformed frame (corrupt peer, truncated meta) must
+                # not kill the pump — drop it and keep receiving.
+                error_budget += 1.0
+                log.warning(
+                    f"recv_msg failed (budget {error_budget:.0f}): {exc!r}"
+                )
+                if error_budget >= 100.0:
+                    log.error("receive pump giving up after repeated "
+                              "decode failures")
                     break
-                log.warning(f"recv_msg failed: {exc!r}")
-                break
+                continue
             if msg is None:
                 break
             self.recv_bytes += msg.meta.data_size
@@ -359,7 +374,7 @@ class Van:
                 and ctrl.cmd != Command.TERMINATE
                 and random.randint(0, 99) < self._drop_rate
             ):
-                log.vlog(1, f"Drop message {msg.debug_string()}")
+                log.vlog(1, lambda: f"Drop message {msg.debug_string()}")
                 continue
             if self.resender is not None and self.resender.add_incoming(msg):
                 continue

@@ -6,6 +6,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
 from pslite_tpu.utils.network import get_available_port
@@ -225,6 +226,59 @@ def test_send_failure_redials():
         broken.close()
 
         # The next push rides the redial path transparently.
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, 2 * vals)
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+@pytest.mark.parametrize("native", ["1", "0"])
+def test_corrupt_frame_does_not_kill_cluster(native):
+    """A malformed frame from a rogue connection must not kill the
+    receive pump (native path: frame dropped; python path: connection
+    dropped) — the cluster keeps serving."""
+    import socket
+    import struct
+    import time
+
+    from pslite_tpu import wire
+
+    if native == "1":
+        from pslite_tpu.vans import native as native_mod
+
+        if native_mod.load() is None:
+            pytest.skip("native core not built — the Van-level continue "
+                        "path would go untested")
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="tcp",
+        env_extra={"PS_NATIVE": native},
+    )
+    cluster.start()
+    servers = []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([2], dtype=np.uint64)
+        vals = np.ones(64, np.float32)
+        w.wait(w.push(keys, vals))
+
+        # Rogue connection injects a well-framed but undecodable meta.
+        port = cluster.servers[0].van.my_node.port
+        rogue = socket.create_connection(("127.0.0.1", port), timeout=10)
+        garbage = b"\xde\xad\xbe\xef" * 4
+        rogue.sendall(
+            struct.pack("<III", wire.MAGIC, len(garbage), 0) + garbage
+        )
+        time.sleep(0.5)  # let the server's pump chew on it
+        rogue.close()
+
+        # The server must still serve KV traffic afterwards.
         w.wait(w.push(keys, vals))
         out = np.zeros_like(vals)
         w.wait(w.pull(keys, out))
