@@ -518,11 +518,18 @@ class KVWorker:
                                          keep_result=True)
         kvs = _as_kvs(keys, vals, lens, priority)
         ts = self._customer.new_request(SERVER_GROUP)
+        # Registered pull buffers apply to the fused round trip too: the
+        # response is transport-delivered into ``outs`` in place
+        # (is_worker_zpull_ covers Pull_ from PushPull as well,
+        # kv_app.h:727-792).
+        zpull = self._zpull_lookup(kvs.keys, outs) if lens is None else None
         with self._mu:
             if callback is not None:
                 self._callbacks[ts] = callback
             self._pull_dst[ts] = (kvs.keys, outs, lens)
-        self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs)
+            if zpull is not None:
+                self._zpull_ts.add(ts)
+        self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs, zpull=zpull)
         return ts
 
     def wait(self, timestamp: int) -> None:

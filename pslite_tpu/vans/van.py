@@ -66,7 +66,10 @@ class Van:
         # Per-peer data-message sequence ids + optional in-order delivery
         # (the UCX van's sid/reorder machinery, ucx_van.h:1032-1039,
         # 1217-1257; enable with PS_FORCE_REQ_ORDER=1).
-        self._force_order = bool(self.env.find_int("PS_FORCE_REQ_ORDER", 0))
+        self._force_order = bool(
+            self.env.find_int("PS_FORCE_REQ_ORDER", 0)
+            or self.env.find_int("BYTEPS_UCX_FORCE_REQ_ORDER", 0)
+        )
         self._send_sids: Dict[int, int] = {}
         self._recv_expected: Dict[int, int] = {}
         self._recv_buffered: Dict[int, Dict[int, Message]] = {}

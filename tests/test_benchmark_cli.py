@@ -65,3 +65,33 @@ def test_stress_patterns_on_cpu_mesh():
     for pattern in PATTERNS:
         gbps = run_pattern(eng, sp, pattern, size_bytes=64 * 1024, iters=2)
         assert gbps > 0, pattern
+
+
+def test_benchmark_cli_recv_buffer_mode():
+    """ENABLE_RECV_BUFFER=1 (test_benchmark.cc:268-320): registered
+    buffers on both sides over the shm van, in-place deliveries counted
+    and non-zero."""
+    import re
+
+    env = dict(os.environ, ENABLE_RECV_BUFFER="1")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "1", "-s", "1", "--van", "shm", "--",
+            sys.executable, "-m", "pslite_tpu.benchmark",
+            "--len", "16384", "--repeat", "4", "--mode", "push_then_pull",
+        ],
+        capture_output=True,
+        timeout=240,
+        env=env,
+        cwd="/root/repo",
+    )
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    assert "CHECK_OK" in out
+    hits = {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(r"(\w*RECV_BUFFER_HITS) (\d+)", out)
+    }
+    assert hits.get("RECV_BUFFER_HITS", 0) > 0, out[-1200:]
+    assert hits.get("SERVER_RECV_BUFFER_HITS", 0) > 0, out[-1200:]
