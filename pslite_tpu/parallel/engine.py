@@ -1097,15 +1097,16 @@ class CollectiveEngine:
         first-push rendezvous after a topology change.
 
         Single-process meshes on both sides (state moves via a host
-        round trip); 1-D layouts only.  Callers' grads arrays must use
-        the NEW worker fan-in after this returns.
+        round trip).  A 2-D engine (``worker_axis``) reshards onto any
+        new mesh carrying both its axes — worker fan-in and server-shard
+        count both recut.  Callers' grads arrays must use the NEW worker
+        fan-in after this returns.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .placement import mesh_is_multiprocess
 
-        log.check(self.worker_axis is None, "reshard is 1-D-mesh only")
         log.check(
             not self._multiprocess and not mesh_is_multiprocess(mesh),
             "reshard requires single-process meshes on both sides",
@@ -1113,6 +1114,14 @@ class CollectiveEngine:
         axis = axis_name or self.axis
         log.check(axis in mesh.axis_names,
                   f"axis {axis!r} not in new mesh")
+        if self.worker_axis is not None:
+            log.check(
+                self.worker_axis in mesh.axis_names,
+                f"worker axis {self.worker_axis!r} not in new mesh "
+                f"(a 2-D engine stays 2-D across reshards)",
+            )
+            log.check(self.worker_axis != axis,
+                      "worker_axis must differ from the kv axis")
         with self._mu:
             names = list(self._buckets)
         ordered = sorted(names)
@@ -1137,7 +1146,11 @@ class CollectiveEngine:
             self.mesh = mesh
             self.axis = axis
             self.num_shards = mesh.shape[axis]
-            self.num_workers = self.num_shards
+            self.num_workers = (
+                mesh.shape[self.worker_axis]
+                if self.worker_axis is not None
+                else self.num_shards
+            )
             self._multiprocess = False
             self._local_shard_count = self.num_shards
             with self._mu:
