@@ -7,6 +7,8 @@ data plane re-architected as jit-compiled XLA collectives over an ICI device
 mesh (the ``ici`` van) and a TCP van for the DCN/control plane.
 """
 
+__version__ = "0.2.0"
+
 from . import base, environment
 from .base import (
     ALL_GROUP,
