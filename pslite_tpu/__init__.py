@@ -7,8 +7,6 @@ data plane re-architected as jit-compiled XLA collectives over an ICI device
 mesh (the ``ici`` van) and a TCP van for the DCN/control plane.
 """
 
-__version__ = "0.2.0"
-
 from . import base, environment
 from .base import (
     ALL_GROUP,
@@ -31,7 +29,7 @@ from .ps import finalize, num_instances, postoffice, start_ps
 from .range import Range
 from .sarray import DeviceType, SArray
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 # Reference-style spellings.
 StartPS = start_ps
