@@ -28,6 +28,10 @@ def create(van_type: str, postoffice):
             from .ici_van import IciTcpVan
 
             return IciTcpVan(postoffice)
+        if van_type in ("ici_shm", "ici+shm"):
+            from .ici_van import IciShmVan
+
+            return IciShmVan(postoffice)
         if van_type == "shm":
             from .shm_van import ShmVan
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from ..utils import logging as log
 from .loopback_van import LoopbackVan
+from .shm_van import ShmVan
 from .tcp_van import TcpVan
 
 
@@ -98,10 +99,12 @@ class _IciDataPlane:
 
             distributed.release()
 
-    def register_recv_buffer(self, sender_id: int, key: int, buffer) -> None:
-        # Donated HBM buffers make delivery-in-place the default on this
-        # van; nothing to pin (SURVEY §5 "RegisterRecvBuffer ⇒ donated HBM").
-        return None
+    # NOTE: no register_recv_buffer here.  Donated HBM buffers make
+    # delivery-in-place the default on the collective path (SURVEY §5
+    # "RegisterRecvBuffer ⇒ donated HBM"), and kv_app treats an absent
+    # van hook as exactly that no-op — while a mixin no-op would shadow
+    # ShmVan's REAL transport hook in IciShmVan's MRO and silently
+    # disable in-place push delivery on its message path.
 
 
 class IciVan(_IciDataPlane, LoopbackVan):
@@ -115,3 +118,11 @@ class IciTcpVan(_IciDataPlane, TcpVan):
     ride TCP between OS processes, while registered dense/sparse traffic
     rides jitted XLA collectives over the (optionally multi-process)
     device mesh."""
+
+
+class IciShmVan(_IciDataPlane, ShmVan):
+    """Collective data plane over the same-host shm control plane:
+    multi-process single-host deployments (the reference's co-located
+    BYTEPS_ENABLE_IPC topology) bootstrap through /dev/shm segments
+    (+ optional PS_SHM_RING pipes) while registered traffic rides the
+    collectives — the IPC analog of the fabric_van nesting."""

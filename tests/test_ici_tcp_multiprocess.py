@@ -11,10 +11,18 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from pslite_tpu.utils.network import get_available_port
 
 
-def test_ici_tcp_two_process_push_pull():
+@pytest.mark.parametrize("van,extra", [
+    ("ici_tcp", {}),
+    # Same-host co-located flavor: bootstrap + message fallback ride
+    # /dev/shm (segments + ring pipes), collectives ride the global mesh.
+    ("ici_shm", {"PS_SHM_RING": "1"}),
+])
+def test_ici_two_process_push_pull(van, extra):
     port = get_available_port()
     child = os.path.join(os.path.dirname(__file__), "ici_tcp_child.py")
     base_env = dict(
@@ -24,9 +32,10 @@ def test_ici_tcp_two_process_push_pull():
         DMLC_PS_ROOT_URI="127.0.0.1",
         DMLC_PS_ROOT_PORT=str(port),
         DMLC_NODE_HOST="127.0.0.1",
-        PS_VAN_TYPE="ici_tcp",
+        PS_VAN_TYPE=van,
         PS_ICI_MULTIHOST="1",
         PS_VERBOSE="1",
+        **extra,
     )
     # The children pin their own platform; scrub any inherited forcing.
     for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
@@ -61,6 +70,10 @@ def test_ici_tcp_two_process_push_pull():
         assert p.returncode == 0, f"child failed:\n{out}"
     worker_outs = [o for o in outputs if "WORKER_OK 24.0" in o]
     assert len(worker_outs) == 2, f"expected 2 worker OKs, got: {outputs}"
+    if extra.get("PS_SHM_RING"):
+        # The ring pipes must actually engage — a native-core fallback
+        # would pass this test on plain sockets, masking pipe regressions.
+        assert not any("staying on sockets" in o for o in outputs), outputs
 
 
 def test_init_distributed_idempotent(monkeypatch):
