@@ -30,7 +30,9 @@ class LoopbackCluster:
         env_extra: Optional[Dict[str, str]] = None,
         van_type: str = "loopback",
     ):
-        if van_type in ("tcp", "shm", "multi"):  # socket-based transports
+        if van_type in (
+            "tcp", "shm", "multi", "ici_tcp", "ici_shm",
+        ):  # socket-based transports
             from pslite_tpu.utils.network import get_available_port
 
             host, port = "127.0.0.1", get_available_port()

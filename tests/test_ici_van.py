@@ -186,3 +186,41 @@ def test_worker_pinned_pull_pipelined(cluster):
         worker.wait(ts)
     for o in outs:
         np.testing.assert_allclose(o, W * ones)
+
+
+def test_ici_shm_single_process_cluster():
+    """PS_VAN_TYPE=ici_shm in one process: shm control plane under the
+    collective data plane — registered buckets ride the engine, message
+    traffic rides /dev/shm."""
+    c = LoopbackCluster(num_workers=1, num_servers=1, van_type="ici_shm")
+    c.start()
+    servers = []
+    try:
+        from pslite_tpu import KVServerDefaultHandle
+
+        srv = KVServer(0, postoffice=c.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=c.workers[0])
+        assert worker.engine is not None
+
+        # Engine path (registered bucket).
+        keys = np.arange(4, dtype=np.uint64)
+        worker.register_dense("g", keys, 32)
+        W = worker.engine.num_shards
+        grads = np.ones((W, 4 * 32), np.float32)
+        outs = np.zeros(4 * 32, np.float32)
+        worker.wait(worker.push_pull(keys, grads, outs))
+        np.testing.assert_allclose(outs, W * np.ones(4 * 32))
+
+        # Message fallback (unregistered keys) rides the shm plane.
+        mkeys = np.array([1 << 40], dtype=np.uint64)
+        mvals = np.ones(64 * 1024, np.float32)  # > PS_SHM_MIN_BYTES
+        worker.wait(worker.push(mkeys, mvals))
+        mout = np.zeros_like(mvals)
+        worker.wait(worker.pull(mkeys, mout))
+        np.testing.assert_allclose(mout, mvals)
+    finally:
+        for s in servers:
+            s.stop()
+        c.finalize()
