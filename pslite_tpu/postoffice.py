@@ -175,6 +175,16 @@ class Postoffice:
             self.barrier(customer_id, ALL_GROUP, instance=True)
         if customer_id == 0:
             self.van.stop()
+            # Stop any still-registered customers: their receive threads
+            # otherwise outlive the node and retain the whole
+            # Postoffice→van→buffer graph (a long-lived host process
+            # cycling clusters would accumulate one thread + its pinned
+            # segments per app the caller forgot to stop —
+            # postoffice.cc:159-176 equivalent teardown).
+            with self._customers_cv:
+                leftover = list(self._customers.values())
+            for cust in leftover:
+                cust.stop()
             if self._exit_callback is not None:
                 self._exit_callback()
 

@@ -31,8 +31,11 @@ class LoopbackCluster:
         van_type: str = "loopback",
     ):
         if van_type in (
-            "tcp", "shm", "multi", "ici_tcp", "ici_shm",
-        ):  # socket-based transports
+            # Socket-based transports, incl. the factory's alias
+            # spellings (pslite_tpu/vans/__init__.py).
+            "tcp", "zmq", "0", "shm", "multi", "multivan",
+            "ici_tcp", "ici+tcp", "xla", "ici_shm", "ici+shm",
+        ):
             from pslite_tpu.utils.network import get_available_port
 
             host, port = "127.0.0.1", get_available_port()

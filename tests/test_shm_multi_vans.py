@@ -399,3 +399,64 @@ def test_multi_van_shm_rails():
         for s in servers:
             s.stop()
         cluster.finalize()
+
+
+def test_repeated_cluster_cycles_leak_free():
+    """A long-lived process repeatedly starting/stopping shm+ring
+    clusters must not leak fds, /dev/shm files, or threads — the
+    framework-hosting pattern (e.g. a trainer re-creating clusters on
+    elastic events)."""
+    import glob
+    import pytest
+
+    from pslite_tpu.vans import native
+
+    if native.load() is None:
+        pytest.skip("native core not built")
+
+    def fd_count():
+        return len(os.listdir("/proc/self/fd"))
+
+    def shm_files():
+        return sorted(
+            p
+            for pat in ("/dev/shm/psl_*", "/dev/shm/pslpipe_*")
+            for p in glob.glob(pat)
+            if not p.endswith(".lock")
+        )
+
+    def run_once():
+        cluster = LoopbackCluster(
+            num_workers=1, num_servers=1, van_type="shm",
+            env_extra={"PS_SHM_RING": "1"},
+        )
+        cluster.start()
+        servers = []
+        try:
+            srv = KVServer(0, postoffice=cluster.servers[0])
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+            w = KVWorker(0, 0, postoffice=cluster.workers[0])
+            keys = np.array([3], dtype=np.uint64)
+            vals = np.ones(64 * 1024, np.float32)
+            w.wait(w.push(keys, vals))
+            out = np.zeros_like(vals)
+            w.wait(w.pull(keys, out))
+            np.testing.assert_allclose(out, vals)
+        finally:
+            for s in servers:
+                s.stop()
+            cluster.finalize()
+
+    run_once()  # warm up lazy singletons (copy pool, logging, ...)
+    fd0, thr0 = fd_count(), threading.active_count()
+    shm0 = shm_files()
+    for _ in range(5):
+        run_once()
+    # Modest slack: the OS may reorder fd numbers; absolute growth is
+    # what leaks show.
+    assert fd_count() <= fd0 + 3, (fd0, fd_count())
+    assert threading.active_count() <= thr0 + 2, (
+        thr0, threading.active_count()
+    )
+    assert shm_files() == shm0, (shm0, shm_files())
