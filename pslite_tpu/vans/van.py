@@ -592,8 +592,17 @@ class Van:
                 ]
                 reply = Message()
                 reply.meta.recver = known_id
+                reply.meta.sender = self.my_node.id
+                reply.meta.timestamp = self.next_timestamp()
                 reply.meta.control = Control(cmd=Command.ADD_NODE, node=roster)
-                self.send(reply)
+                # _dispatch_send + catch, as in the recovery broadcast
+                # below: a transport error here must not kill the
+                # scheduler's receive pump (and send() could re-raise an
+                # unrelated parked _prio_error).
+                try:
+                    self._dispatch_send(reply)
+                except Exception as e:
+                    log.warning(f"roster resend to {known_id} failed: {e}")
                 continue
             timeout = self.env.find_int("PS_HEARTBEAT_TIMEOUT", 0)
             dead = [
@@ -604,7 +613,19 @@ class Van:
             if not dead:
                 log.warning(f"unexpected late ADD_NODE from {node.short_debug()}")
                 continue
-            node.id = dead[0]
+            # With several simultaneous dead nodes of this role, honor the
+            # rejoining node's preferred rank (aux_id) if it names one of
+            # them — reference van.cc:187-225 matches the recovered node
+            # back to its original rank; arbitrary assignment would hand a
+            # restarted worker 0 the key ranges of worker 1.
+            chosen = dead[0]
+            if node.aux_id != EMPTY_ID:
+                preferred = self.po.instance_rank_to_id(
+                    node.role, node.aux_id
+                )
+                if preferred in dead:
+                    chosen = preferred
+            node.id = chosen
             node.is_recovery = True
             log.vlog(1, f"recovering node {node.short_debug()}")
             self._reset_peer_sids(node.id)
@@ -622,9 +643,27 @@ class Van:
             for peer in self._registrations:
                 reply = Message()
                 reply.meta.recver = peer.id
+                reply.meta.sender = self.my_node.id
+                # Fresh timestamp: under PS_RESEND the resender signature
+                # includes it — without one, successive recovery
+                # broadcasts to a peer would hash identical and be
+                # dropped as duplicates.
+                reply.meta.timestamp = self.next_timestamp()
                 payload = roster if peer.id == node.id else [copy.deepcopy(node)]
                 reply.meta.control = Control(cmd=Command.ADD_NODE, node=payload)
-                self.send(reply)
+                # _dispatch_send, not send(): a peer of this roster may
+                # ALSO be dead right now (its endpoint gone) — the send
+                # must not kill the scheduler pump, and the catch must
+                # not consume a parked _prio_error belonging to an
+                # unrelated application send (send() re-raises those).
+                # A falsely-dead peer (slow, not crashed) still gets its
+                # broadcast attempted.
+                try:
+                    self._dispatch_send(reply)
+                except Exception as e:  # a peer died since its last beat
+                    log.warning(
+                        f"recovery broadcast to {peer.id} failed: {e}"
+                    )
 
     def _process_roster(self, msg: Message) -> None:
         """Non-scheduler handling of the scheduler's ADD_NODE broadcast."""

@@ -104,3 +104,65 @@ def test_dead_node_detection_and_recovery():
             po.van.stop()
     except BaseException:
         raise
+
+
+def test_two_dead_nodes_recovery_honors_preferred_rank():
+    """With SEVERAL simultaneous dead nodes of one role, a rejoining node
+    carrying a preferred rank (DMLC_RANK -> aux_id) must inherit THAT
+    dead id, not an arbitrary one — reference van.cc:187-225 matches the
+    recovered node back to its original rank."""
+    cluster = LoopbackCluster(
+        num_workers=1,
+        num_servers=3,
+        env_extra={
+            "PS_HEARTBEAT_INTERVAL": "1",
+            "PS_HEARTBEAT_TIMEOUT": "2",
+        },
+    )
+    cluster.start()
+    victims = []
+    replacements = []
+    try:
+        victims = [
+            po for po in cluster.servers
+            if po.van.my_node.id in (server_rank_to_id(0),
+                                     server_rank_to_id(2))
+        ]
+        for v in victims:
+            v.van.stop()
+        time.sleep(3.5)
+        dead = cluster.scheduler.get_dead_nodes(timeout_s=2)
+        assert server_rank_to_id(0) in dead
+        assert server_rank_to_id(2) in dead
+
+        # The replacement declares it was rank 2: it must take rank 2's
+        # dead id even though rank 0's is also (and "first") available.
+        env = Environment(dict(cluster.base_env,
+                               DMLC_RANK="2",
+                               PS_HEARTBEAT_INTERVAL="1",
+                               PS_HEARTBEAT_TIMEOUT="2"))
+        replacement = Postoffice(Role.SERVER, env=env)
+        replacements.append(replacement)
+        replacement.start(0)
+        assert replacement.van.my_node.id == server_rank_to_id(2)
+        assert replacement.is_recovery
+
+        # A second replacement with no preference falls back to the first
+        # remaining dead id (rank 0).
+        env2 = Environment(dict(cluster.base_env,
+                                PS_HEARTBEAT_INTERVAL="1",
+                                PS_HEARTBEAT_TIMEOUT="2"))
+        replacement2 = Postoffice(Role.SERVER, env=env2)
+        replacements.append(replacement2)
+        replacement2.start(0)
+        assert replacement2.van.my_node.id == server_rank_to_id(0)
+    finally:
+        # Best-effort crash-exit teardown (a finalize barrier would hang
+        # without the victims): stop every van that is still running.
+        for po in replacements + [
+            cluster.scheduler, cluster.workers[0]
+        ] + [s for s in cluster.servers if s not in victims]:
+            try:
+                po.van.stop()
+            except Exception:
+                pass
