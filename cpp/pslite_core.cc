@@ -70,9 +70,22 @@ struct PipeHdr {
   uint64_t size;  // data-region bytes
   alignas(64) std::atomic<uint64_t> head;  // consumed; reader-owned
   alignas(64) std::atomic<uint64_t> tail;  // produced; writer-owned
+  // Reader-liveness heartbeat: CLOCK_MONOTONIC ms, stamped by the reader
+  // at attach and on every liveness tick.  Comparable across processes
+  // (same host by construction).  0 = no reader has ever attached.  The
+  // writer probes it on ring-full waits: a full ring whose reader is not
+  // beating means frames are streaming into the void (reader died,
+  // desynced+blacklisted, or never enabled PS_SHM_RING) — the writer
+  // retires the pipe and falls back to the socket instead of blocking
+  // forever once the ring fills.
+  alignas(64) std::atomic<uint64_t> reader_beat;
 };
 
-constexpr uint32_t kPipeMagic = 0x50535242;  // "PSRB"
+// "PSRC" — bumped from "PSRB" when reader_beat joined the header: an
+// old-binary reader would otherwise attach cleanly, drain frames, and
+// never heartbeat, which a new writer reads as "no reader" and falsely
+// retires the pipe.  Mixed versions now refuse to pair instead.
+constexpr uint32_t kPipeMagic = 0x50535243;
 constexpr size_t kPipeDataOff = 4096;        // header page
 
 struct WritePipe {
@@ -82,6 +95,11 @@ struct WritePipe {
   size_t map_len = 0;
   std::string path;
   std::mutex mu;  // in-process senders serialize whole frames
+  // Set once the writer declares the reader dead (see PipeHdr::
+  // reader_beat); senders bail with -EPIPE and the van falls back to
+  // the socket.  The mapping stays alive in a graveyard until shutdown
+  // so concurrently-blocked senders never touch freed memory.
+  std::atomic<bool> dead{false};
 };
 
 // Per-connection frame reassembly state machine.
@@ -295,6 +313,39 @@ class Core {
     return 0;
   }
 
+  // Take a dead-reader pipe out of service: unroute it (no new senders),
+  // release the writer-liveness flock and unlink the name so a redial
+  // creates a FRESH pipe (fresh inode — the reader's inode blacklist
+  // won't match it), and park the mapping in a graveyard freed at
+  // shutdown (a concurrently-blocked sender may still be reading
+  // p->hdr; it will see p->dead and bail).  Idempotent under races:
+  // only the first retirer acts.
+  void RetirePipe(WritePipe* p) {
+    bool first = false;
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      first = pipes_by_path_.erase(p->path) > 0;
+      for (auto it = pipes_.begin(); it != pipes_.end();) {
+        if (it->second == p) {
+          it = pipes_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      if (first) dead_write_pipes_.push_back(p);
+    }
+    if (first) {
+      p->dead.store(true, std::memory_order_relaxed);
+      close(p->fd);  // releases the writer-liveness LOCK_SH
+      p->fd = -1;
+      unlink(p->path.c_str());
+      fprintf(stderr,
+              "[pslite_core] W shm pipe %s: reader dead or never drained; "
+              "falling back to the socket\n",
+              p->path.c_str());
+    }
+  }
+
   // Reader side: watch a directory for pipes named <prefix>*<suffix>
   // (ours are pslpipe_<ns>_<senderport>_<myport>); the poller attaches
   // them as they appear.  Discovery by scan — no announce handshake —
@@ -339,12 +390,33 @@ class Core {
   // Stream the iovecs into the ring.  Frame atomicity rule: the timeout
   // applies only BEFORE the first byte is committed — once any byte is
   // published, aborting would leave a truncated frame and desync the
-  // stream forever, so from then on this blocks like a socket sendall
-  // (bailing only on shutdown, when the pipe dies with the process).
+  // stream forever, so from then on this blocks like a socket sendall,
+  // bailing on shutdown or on a DEAD READER: a full ring whose reader
+  // has stopped beating (see PipeHdr::reader_beat) will never drain, so
+  // blocking "like a socket" would wedge the sender permanently.  A
+  // dead-reader bail abandons the pipe entirely (-EPIPE; Send() retires
+  // it and falls back to the socket), so the truncated frame is
+  // discarded along with the ring, never parsed.
+  uint64_t ReaderDeadMs() {
+    if (reader_dead_ms_ == 0) {
+      const char* e = getenv("PS_SHM_RING_DEAD_MS");
+      long v = e ? atol(e) : 0;
+      uint64_t ms = v > 0 ? static_cast<uint64_t>(v) : 5000;
+      // Floor well above the reader's beat staleness bound (one
+      // PipeLoop iteration ≈ the idle cap, sub-ms by default): a
+      // threshold at or below the beat cadence would falsely retire
+      // live pipes and silently drop their parked frames.
+      reader_dead_ms_ = ms < 1000 ? 1000 : ms;
+    }
+    return reader_dead_ms_;
+  }
+
   int PipeWriteVec(WritePipe* p, const iovec* iov, size_t cnt) {
+    if (p->dead.load(std::memory_order_relaxed)) return -EPIPE;
     uint64_t tail = p->hdr->tail.load(std::memory_order_relaxed);
     const uint64_t size = p->hdr->size;
     uint64_t slept_us = 0;
+    uint64_t full_since_ms = 0;
     int spins = 0;
     bool committed = false;
     for (size_t i = 0; i < cnt; ++i) {
@@ -357,12 +429,30 @@ class Core {
           // Reader stalled (or not yet attached): stream semantics mean
           // we must wait, not reroute — rerouting would reorder.
           if (stopped_) return -ECANCELED;
+          if (p->dead.load(std::memory_order_relaxed)) return -EPIPE;
           if (++spins < 128) continue;
           timespec ts{0, 50 * 1000};
           nanosleep(&ts, nullptr);
           slept_us += 50;
           if (!committed && slept_us > 60ull * 1000 * 1000) {
             return -ETIMEDOUT;
+          }
+          // Reader-liveness probe (~every 100ms of full-ring waiting).
+          // Inside this wait `head` is by definition frozen (any
+          // advance makes space > 0 and exits), so liveness reduces to
+          // the reader's heartbeat being recent.  The reader beats
+          // every ~1s while attached; 5s of silence on a full ring
+          // means dead, desynced-and-blacklisted, or never attached.
+          if (slept_us % (100 * 1000) == 0) {
+            uint64_t now = NowMs();
+            if (full_since_ms == 0) full_since_ms = now;
+            uint64_t beat =
+                p->hdr->reader_beat.load(std::memory_order_relaxed);
+            uint64_t ref = beat > full_since_ms ? beat : full_since_ms;
+            if (now - ref > ReaderDeadMs()) {
+              p->dead.store(true, std::memory_order_relaxed);
+              return -EPIPE;
+            }
           }
           continue;
         }
@@ -457,7 +547,19 @@ class Core {
     // A connected pipe carries the WHOLE stream for this peer (mixing
     // pipe and socket frames would lose ordering).
     if (pipe != nullptr) {
-      return PipeSendFrame(pipe, meta, meta_len, n_data, data, lens);
+      long long rc = PipeSendFrame(pipe, meta, meta_len, n_data, data, lens);
+      if (rc != -EPIPE) return rc;
+      // Reader declared dead (see PipeWriteVec): retire the pipe and
+      // fall back to the socket connection, which connect_transport
+      // established before the pipe took over routing.  Frames already
+      // committed to the abandoned ring are lost (the resender heals
+      // them under PS_RESEND) — the reference behaves the same when a
+      // transport dies mid-stream.
+      RetirePipe(pipe);
+      std::lock_guard<std::mutex> lk(send_mu_);
+      auto it = send_fds_.find(node_id);
+      if (it == send_fds_.end()) return -EPIPE;
+      fd = it->second;
     }
     uint8_t header[kHeaderSize];
     memcpy(header, &kMagic, 4);
@@ -567,11 +669,22 @@ class Core {
       munmap(reinterpret_cast<void*>(p->hdr), p->map_len);
       close(p->fd);  // releases the writer-liveness LOCK_SH
       unlink(p->path.c_str());
-      unlink((p->path + ".lock").c_str());  // don't pollute /dev/shm
+      // The sibling .lock file stays behind (as the unix-socket path's
+      // do): unlinking it would hand a concurrent locker a different
+      // inode, reopening the reclaim/create race the flock exists to
+      // close.  They are empty files; ReclaimIfDead removes them under
+      // LOCK_EX when it reclaims a name.
       delete p;
     }
     pipes_by_path_.clear();
     pipes_.clear();
+    for (WritePipe* p : dead_write_pipes_) {
+      // Retired at runtime (dead reader): fd closed and name unlinked
+      // then; only the parked mapping remains.
+      munmap(reinterpret_cast<void*>(p->hdr), p->map_len);
+      delete p;
+    }
+    dead_write_pipes_.clear();
     for (auto& kv : send_fds_) close(kv.second);
     send_fds_.clear();
     for (auto& kv : conns_) {
@@ -608,6 +721,12 @@ class Core {
       long long moved = 0;
       for (auto it = rpipes_.begin(); it != rpipes_.end();) {
         ReadPipe* rp = it->second;
+        // Reader heartbeat: tells a blocked writer this ring IS being
+        // drained (see PipeHdr::reader_beat).  Stamped every loop
+        // iteration — liveness, not progress — so its staleness is
+        // bounded by one iteration (≈ the idle-backoff cap), far under
+        // the 1000 ms floor of the writer's dead threshold.
+        rp->hdr->reader_beat.store(NowMs(), std::memory_order_relaxed);
         long long n = PumpPipe(rp);
         if (n > 0) moved += n;
         bool drop = n < 0;
@@ -747,6 +866,7 @@ class Core {
               rp->fd = fd;
               rp->map_len = map_len;
               rp->path = path;
+              hdr->reader_beat.store(NowMs(), std::memory_order_relaxed);
               rpipes_[path] = rp;
               fd = -1;  // owned by rp now
             } else {
@@ -912,6 +1032,11 @@ class Core {
   std::unordered_map<int, int> send_fds_;
   std::unordered_map<int, WritePipe*> pipes_;                  // send_mu_
   std::unordered_map<std::string, WritePipe*> pipes_by_path_;  // send_mu_
+  // Dead-reader pipes parked until shutdown (mapping must outlive any
+  // sender blocked inside PipeWriteVec at retirement time).  send_mu_.
+  std::vector<WritePipe*> dead_write_pipes_;
+  // Lazily read from PS_SHM_RING_DEAD_MS (0 = not yet resolved).
+  std::atomic<uint64_t> reader_dead_ms_{0};
   std::vector<std::array<std::string, 3>> watches_;  // pipe_mu_
   std::unordered_map<std::string, ReadPipe*> rpipes_;  // pipe thread only
   std::unordered_map<std::string, uint64_t> bad_pipes_;  // path -> inode

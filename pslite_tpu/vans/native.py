@@ -15,8 +15,11 @@ import threading
 from typing import List, Optional, Tuple
 
 _LIB_PATHS = [
+    # Source tree: cpp/ build output (make -C cpp).
     os.path.join(os.path.dirname(__file__), "..", "..", "cpp",
                  "libpslite_core.so"),
+    # Installed wheel: the copy `make -C cpp` places inside the package.
+    os.path.join(os.path.dirname(__file__), "..", "libpslite_core.so"),
     "libpslite_core.so",
 ]
 

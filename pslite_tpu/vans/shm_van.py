@@ -103,6 +103,13 @@ class ShmVan(TcpVan):
         # DMLC_LOCKLESS_QUEUE) extended across processes.  Payload bytes
         # still ride the /dev/shm segments; the pipe replaces the socket,
         # so per-pair ordering is exactly stream ordering.
+        #
+        # Asymmetric config (sender rings, receiver doesn't — env
+        # mismatch or watch failure) is survivable: the native writer
+        # probes the reader-liveness heartbeat in the pipe header on
+        # ring-full waits and after PS_SHM_RING_DEAD_MS (default 5000)
+        # of silence retires the pipe and reroutes this peer's stream
+        # to the socket, logging to stderr (tests/test_pipe_fallback.py).
         self._pipe_mode = False
         self._pipe_bytes = self.env.find_int("PS_SHM_RING_BYTES", 1 << 22)
         if self.env.find_int("PS_SHM_RING", 0):
