@@ -1,0 +1,154 @@
+"""Minimal XPlane (.xplane.pb) reader: on-device busy time extraction.
+
+``jax.profiler.trace`` (wrapped by :class:`profiling.device_trace`) dumps
+an XSpace protobuf per host.  Wall-clock benchmarking through the axon
+tunnel is untrustworthy — r02 measured a "goodput" above the chip's
+physical HBM bandwidth because the tunnel elides/pipelines device work —
+so the honest denominator is the DEVICE-side timeline: the union of XLA
+op intervals on the TPU planes.  This module parses exactly the fields
+needed (wire-format protobuf, no protobuf/tensorflow dependency):
+
+    XSpace { repeated XPlane planes = 1; }
+    XPlane { int64 id=1; string name=2; repeated XLine lines=3; }
+    XLine  { int64 id=1; string name=2; int64 timestamp_ns=3;
+             repeated XEvent events=4; }
+    XEvent { int64 metadata_id=1; int64 offset_ps=2; int64 duration_ps=3; }
+
+(Field numbers from tsl/profiler/protobuf/xplane.proto; unknown fields
+are skipped by wire type, so schema growth is tolerated.)
+
+Busy time is computed as the union of [offset, offset+duration] intervals
+per line, then the union across a plane's lines is NOT taken — parallel
+lines (different cores / queues) are summed, matching "device-seconds of
+work" rather than span.  For single-core single-queue runs the two
+definitions coincide.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Tuple
+
+
+def _varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: memoryview) -> Iterator[Tuple[int, int, object]]:
+    """(field_number, wire_type, value) over one message's bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _varint(buf, pos)
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _varint(buf, pos)
+        elif wt == 1:
+            val = buf[pos : pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            val = buf[pos : pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos : pos + 4]
+            pos += 4
+        else:  # groups (3/4): not produced by xplane
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, val
+
+
+def _line_busy_ps(line_buf: memoryview) -> Tuple[str, int]:
+    """(line_name, busy_ps) — busy = union of event intervals."""
+    name = ""
+    intervals: List[Tuple[int, int]] = []
+    for fnum, wt, val in _fields(line_buf):
+        if fnum == 2 and wt == 2:
+            name = bytes(val).decode("utf-8", "replace")
+        elif fnum == 4 and wt == 2:
+            off = dur = 0
+            for efn, ewt, ev in _fields(val):
+                if efn == 2 and ewt == 0:
+                    off = ev
+                elif efn == 3 and ewt == 0:
+                    dur = ev
+            if dur > 0:
+                intervals.append((off, off + dur))
+    if not intervals:
+        return name, 0
+    intervals.sort()
+    busy = 0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            busy += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    busy += cur_e - cur_s
+    return name, busy
+
+
+def plane_busy_ps(path: str) -> Dict[str, Dict[str, int]]:
+    """{plane_name: {line_name: busy_ps}} for one .xplane.pb file."""
+    with open(path, "rb") as fh:
+        space = memoryview(fh.read())
+    out: Dict[str, Dict[str, int]] = {}
+    for fnum, wt, plane in _fields(space):
+        if fnum != 1 or wt != 2:
+            continue
+        pname = ""
+        lines: Dict[str, int] = {}
+        for pfn, pwt, val in _fields(plane):
+            if pfn == 2 and pwt == 2:
+                pname = bytes(val).decode("utf-8", "replace")
+            elif pfn == 3 and pwt == 2:
+                lname, busy = _line_busy_ps(val)
+                if busy:
+                    lines[lname] = lines.get(lname, 0) + busy
+        if lines:
+            out[pname] = lines
+    return out
+
+
+def find_xplane_files(logdir: str) -> List[str]:
+    hits = []
+    for root, _dirs, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".xplane.pb"):
+                hits.append(os.path.join(root, f))
+    return sorted(hits)
+
+
+def device_busy_seconds(logdir: str) -> Dict[str, float]:
+    """Per-device-plane busy seconds summed over that plane's op lines.
+
+    Planes whose name contains "TPU" (e.g. ``/device:TPU:0``) are the
+    accelerator timelines; ``/host:CPU`` planes carry runtime threads and
+    are excluded.  Within a TPU plane, only XLA op lines carry executed
+    kernels; step/framework lines would double-count them, so lines named
+    "Steps" or beginning with "#" metadata are skipped — in practice jax
+    TPU traces carry "XLA Ops" (and sometimes "XLA Modules" which WOULD
+    double-count and is skipped too).
+    """
+    totals: Dict[str, float] = {}
+    for path in find_xplane_files(logdir):
+        for pname, lines in plane_busy_ps(path).items():
+            if "TPU" not in pname or "SparseCore" in pname:
+                continue
+            busy = 0
+            for lname, ps in lines.items():
+                if lname in ("Steps", "XLA Modules", "Framework Ops"):
+                    continue
+                busy += ps
+            if busy:
+                totals[pname] = totals.get(pname, 0.0) + busy / 1e12
+    return totals
