@@ -324,12 +324,19 @@ class Core {
     bool first = false;
     {
       std::lock_guard<std::mutex> lk(send_mu_);
-      first = pipes_by_path_.erase(p->path) > 0;
-      for (auto it = pipes_.begin(); it != pipes_.end();) {
-        if (it->second == p) {
-          it = pipes_.erase(it);
+      // Pointer identity, not path presence: a redial may have already
+      // recreated the SAME path as a fresh pipe — erasing by path alone
+      // would unroute the new generation and double-park p.
+      auto it = pipes_by_path_.find(p->path);
+      if (it != pipes_by_path_.end() && it->second == p) {
+        pipes_by_path_.erase(it);
+        first = true;
+      }
+      for (auto pit = pipes_.begin(); pit != pipes_.end();) {
+        if (pit->second == p) {
+          pit = pipes_.erase(pit);
         } else {
-          ++it;
+          ++pit;
         }
       }
       if (first) dead_write_pipes_.push_back(p);

@@ -80,11 +80,9 @@ class ShmVan(TcpVan):
         self._peer_hosts: Dict[int, str] = {}
         self._min_bytes = self.env.find_int("PS_SHM_MIN_BYTES", 4096)
         self._pull_ns_cache: Optional[str] = None
-        # (sender_id, key) -> pre-registered push receive buffer: the
-        # transport delivers push payloads straight into it (the NIC-DMA
-        # semantics of RegisterRecvBuffer, kv_app.h:396-403) instead of
-        # materializing a fresh array for kv_app to copy from.
-        self._push_recv_bufs: Dict[tuple, np.ndarray] = {}
+        # Registered push recv buffers (_push_recv_bufs) are inherited
+        # from TcpVan; this van's deliver hook reuses the base logic with
+        # _copy_into routed through the native parallel-copy pool.
         # Native parallel-copy pool for multi-MB segment writes — the
         # reference IPC transport's copy-thread-pool
         # (BYTEPS_IPC_COPY_NUM_THREADS=4, rdma_transport.h:570-589).
@@ -352,59 +350,6 @@ class ShmVan(TcpVan):
         # Keep data_size for byte accounting but strip payload from the frame.
         sent = super().send_msg(meta_only)
         return sent + total
-
-    def register_recv_buffer(self, sender_id: int, key: int,
-                             buffer: np.ndarray) -> None:
-        """Transport-level registered push buffer (van.h:114-116 hook):
-        payloads for (sender, key) land in ``buffer`` at delivery."""
-        self._push_recv_bufs[(sender_id, key)] = buffer
-
-    def deliver_data_msg(self, msg: Message) -> None:
-        """Van hook (runs after drop/dedup/ordering): if a registered
-        buffer exists for this push, place the vals payload into it and
-        alias the message's vals SArray to the buffer — in-place
-        delivery at the transport, not a kv_app after-the-fact copy.
-
-        Shares the module's at-most-one-outstanding-message-per
-        (key, direction) contract (see module docstring): a second
-        in-flight push for the same (sender, key) would overwrite the
-        buffer before the handler reads the first — exactly as the
-        reused shm segments (and the reference's registered buffers,
-        kv_app.h:210-217) already require callers to wait() between
-        same-key pushes.
-
-        Compressed pushes are excluded: their wire payload is quantized
-        int8, not the values the registered buffer promises.  Any
-        placement failure delivers the message unpinned rather than
-        disturbing the pump."""
-        m = msg.meta
-        if not (m.push and m.request and m.control.empty()
-                and m.option != OPT_COMPRESS_INT8
-                and len(msg.data) >= 2):
-            return
-        reg = self._push_recv_bufs.get((m.sender, m.key))
-        if reg is None:
-            return
-        try:
-            vals = msg.data[1]
-            flat = reg.reshape(-1).view(np.uint8)
-            arr = np.ascontiguousarray(vals.data)
-            if arr.nbytes > flat.nbytes:
-                log.warning(
-                    f"registered buffer for key {m.key} too small "
-                    f"({flat.nbytes} < {arr.nbytes}); delivering unpinned"
-                )
-                return
-            self._copy_into(flat.ctypes.data, arr)
-            n = arr.nbytes // np.dtype(vals.dtype).itemsize
-            msg.data[1] = SArray(
-                reg.reshape(-1).view(vals.dtype)[:n]
-            )
-        except Exception as exc:  # malformed push: deliver unpinned
-            log.warning(
-                f"registered-buffer delivery failed for key {m.key}: "
-                f"{exc!r}; delivering unpinned"
-            )
 
     def recv_msg(self):
         msg = super().recv_msg()

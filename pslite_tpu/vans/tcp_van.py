@@ -13,6 +13,7 @@ socket loops can be offloaded to it via ``pslite_tpu.vans.native``.
 
 from __future__ import annotations
 
+import ctypes
 import fcntl
 import os
 import random
@@ -26,7 +27,8 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import wire
-from ..message import Message, Node
+from ..message import Message, Node, OPT_COMPRESS_INT8
+from ..sarray import SArray
 from ..utils import logging as log
 from ..utils.queues import ThreadsafeQueue
 from .van import Van
@@ -83,6 +85,12 @@ class TcpVan(Van):
         # retries.  At-least-once on that frame — pair with PS_RESEND for
         # dedup, exactly like the reference.  -1 disables.
         self._reconnect_ms = self.env.find_int("PS_RECONNECT_TMO", 100)
+        # (sender_id, key) -> pre-registered push receive buffer — the
+        # zmq van's registered-buffer recv hook (zmq_van.h:206-218,
+        # 243-263): push payloads for the pair are placed at this
+        # address by the deliver_data_msg hook (both native and
+        # pure-Python receive paths).
+        self._push_recv_bufs: Dict[tuple, np.ndarray] = {}
 
     # -- transport interface -------------------------------------------------
 
@@ -354,6 +362,66 @@ class TcpVan(Van):
             total += len(c) if isinstance(c, bytes) else c.nbytes
         return total
 
+    # -- registered recv buffers (RegisterRecvBuffer, van.h:114-116) ---------
+
+    def register_recv_buffer(self, sender_id: int, key: int,
+                             buffer: np.ndarray) -> None:
+        """Transport-level registered push buffer: payloads for
+        (sender, key) land in ``buffer`` at delivery (after the frame
+        has fully arrived and cleared drop/dedup/ordering).  Callers own
+        the usual at-most-one-outstanding-push-per-(sender, key)
+        contract (kv_app.h:210-217)."""
+        self._push_recv_bufs[(sender_id, key)] = buffer
+
+    def _copy_into(self, dst_addr: int, arr: np.ndarray) -> None:
+        """Placement copy for the hook path; ShmVan overrides with its
+        native parallel-copy pool."""
+        ctypes.memmove(dst_addr, arr.ctypes.data, arr.nbytes)
+
+    def _registered_for(self, meta, n_data: int):
+        """The (sender, key) registered buffer this push should land in,
+        or None.  Compressed pushes are excluded: their wire payload is
+        quantized int8, not the values the buffer promises."""
+        if not (meta.push and meta.request and meta.control.empty()
+                and meta.option != OPT_COMPRESS_INT8 and n_data >= 2):
+            return None
+        return self._push_recv_bufs.get((meta.sender, meta.key))
+
+    def deliver_data_msg(self, msg: Message) -> None:
+        """Van hook (runs after drop/dedup/ordering): place the vals
+        payload of a registered push into its buffer and alias the
+        message's vals SArray to it — in-place delivery at the
+        transport, not a kv_app after-the-fact copy.  No-op when the
+        reader loop already received straight into the buffer.  Any
+        placement failure delivers the message unpinned rather than
+        disturbing the pump."""
+        m = msg.meta
+        reg = self._registered_for(m, len(msg.data))
+        if reg is None:
+            return
+        try:
+            vals = msg.data[1]
+            arr = np.ascontiguousarray(vals.data)
+            if np.shares_memory(arr, reg):
+                return  # reader loop placed it in-line already
+            flat = reg.reshape(-1).view(np.uint8)
+            if arr.nbytes > flat.nbytes:
+                log.warning(
+                    f"registered buffer for key {m.key} too small "
+                    f"({flat.nbytes} < {arr.nbytes}); delivering unpinned"
+                )
+                return
+            self._copy_into(flat.ctypes.data, arr)
+            n = arr.nbytes // np.dtype(vals.dtype).itemsize
+            msg.data[1] = SArray(
+                reg.reshape(-1).view(vals.dtype)[:n]
+            )
+        except Exception as exc:  # malformed push: deliver unpinned
+            log.warning(
+                f"registered-buffer delivery failed for key {m.key}: "
+                f"{exc!r}; delivering unpinned"
+            )
+
     def recv_msg(self) -> Optional[Message]:
         if self._native is not None:
             res = self._native.recv(-1)
@@ -442,6 +510,12 @@ class TcpVan(Van):
                     bufs.append(b)
                 if not ok:
                     break
+                # Registered-buffer placement happens at the
+                # deliver_data_msg hook, AFTER the frame is complete and
+                # has passed drop/dedup/ordering — receiving straight
+                # into the app-visible buffer would let a connection
+                # drop mid-payload tear it (the reference's zmq van also
+                # places after full receipt, zmq_van.h:243-263).
                 self._queue.push(wire.rebuild_message(meta, bufs))
         except OSError:
             pass

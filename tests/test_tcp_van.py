@@ -287,3 +287,55 @@ def test_corrupt_frame_does_not_kill_cluster(native):
         for s in servers:
             s.stop()
         cluster.finalize()
+
+
+@pytest.mark.parametrize("native", ["1", "0"])
+def test_registered_recv_buffer_transport_delivery_tcp(native):
+    """The TCP van delivers registered pushes in place (zmq_van.h:
+    206-218, 243-263 analog): pure-Python readers recv_into the
+    registered buffer directly off the socket; the native path places at
+    the deliver hook.  Either way KVServer.delivered_in_place counts."""
+    from pslite_tpu import KVServer
+
+    if native == "1":
+        from pslite_tpu.vans import native as native_mod
+
+        if native_mod.load() is None:
+            pytest.skip("native core not built")
+    cluster = LoopbackCluster(num_workers=1, num_servers=1,
+                              van_type="tcp",
+                              env_extra={"PS_NATIVE": native})
+    cluster.start()
+    servers = []
+    try:
+        seen = {}
+
+        def handle(meta, data, server):
+            if meta.push:
+                seen["vals"] = data.vals
+            server.response(meta)
+
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(handle)
+        servers.append(srv)
+
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        worker_id = cluster.workers[0].van.my_node.id
+        registered = np.zeros(4096, dtype=np.float32)
+        srv.register_recv_buffer(worker_id, 7, registered)
+
+        vals = np.arange(4096, dtype=np.float32)
+        worker.wait(worker.push(np.array([7], np.uint64), vals))
+        assert "vals" in seen
+        assert np.shares_memory(seen["vals"], registered)
+        np.testing.assert_allclose(registered, vals)
+        assert srv.delivered_in_place == 1, srv.delivered_in_place
+
+        # Second push into the same buffer (segment-reuse contract).
+        worker.wait(worker.push(np.array([7], np.uint64), 2 * vals))
+        np.testing.assert_allclose(registered, 2 * vals)
+        assert srv.delivered_in_place == 2
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
