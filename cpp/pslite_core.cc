@@ -456,7 +456,10 @@ class Core {
             uint64_t beat =
                 p->hdr->reader_beat.load(std::memory_order_relaxed);
             uint64_t ref = beat > full_since_ms ? beat : full_since_ms;
-            if (now - ref > ReaderDeadMs()) {
+            // now > ref guard: a beat stamped between our NowMs() and
+            // the load can make ref exceed now — unsigned subtraction
+            // would underflow and falsely retire a healthy pipe.
+            if (now > ref && now - ref > ReaderDeadMs()) {
               p->dead.store(true, std::memory_order_relaxed);
               return -EPIPE;
             }

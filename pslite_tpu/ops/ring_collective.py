@@ -91,7 +91,8 @@ def ring_chunk_len(total_len: int, num_devices: int, dtype=None,
 
 
 def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
-                 with_ag: bool = True, compress: bool = False):
+                 with_ag: bool = True, compress: bool = False,
+                 mesh_axes=None):
     """Build the unrolled kernel for a static ring size ``n`` with
     ``ndir`` directions (1 = clockwise only, 2 = bidirectional halves).
     ``with_ag=False`` builds the push-only variant: reduce-scatter +
@@ -129,6 +130,17 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
     writes the DEQUANTIZED payload to the pulled output so the
     replicated result is identical everywhere.  The store update itself
     applies to the dequantized sum at full precision.
+
+    ``mesh_axes`` (ordered (name, size) pairs covering the WHOLE mesh)
+    generalizes the ring to one axis of a multi-axis torus: remote DMAs
+    address devices by LOGICAL id = the row-major flat index over the
+    full mesh, so a ring along ``axis_name`` must translate ring
+    positions through the device's coordinates on the other axes.  A
+    (dp=A, kv=B) mesh then runs B independent size-A rings concurrently
+    in ONE kernel launch — per-column sub-rings, the torus analog of
+    the reference's per-device multi-rail contexts
+    (ucx_van.h:938-1006, multi_van.h:173-197).  None = 1-D mesh
+    (identity mapping).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -139,8 +151,23 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
         (send_buf, recv_buf, gchunk, send_sem, recv_sem, cap_sem,
          local_sem) = rest
         d = lax.axis_index(axis_name)
-        right = lax.rem(d + 1, n)
-        left = lax.rem(d + n - 1, n)
+
+        def logical_of(ring_pos):
+            """Flat mesh index of the device at ``ring_pos`` on my ring
+            (my coordinates on every other axis, ring_pos on ours)."""
+            if mesh_axes is None:
+                return ring_pos
+            idx = None
+            for name, size in mesh_axes:
+                coord = (
+                    ring_pos if name == axis_name
+                    else lax.axis_index(name)
+                )
+                idx = coord if idx is None else idx * size + coord
+            return idx
+
+        right = logical_of(lax.rem(d + 1, n))
+        left = logical_of(lax.rem(d + n - 1, n))
         rows = store_ref.shape[0]
         h = rows // ndir
         dirs = range(ndir)
@@ -356,7 +383,8 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
 
 def _ring_call(grads_chunks, store_chunk, handle: Callable,
                axis_name: str, num_devices: int, collective_id,
-               bidir: bool, with_ag: bool, compress: bool = False):
+               bidir: bool, with_ag: bool, compress: bool = False,
+               mesh_axes=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -408,7 +436,7 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
     ]
 
     kernel = _kernel_body(n, axis_name, handle, ndir, with_ag=with_ag,
-                          compress=compress)
+                          compress=compress, mesh_axes=mesh_axes)
     outs = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
@@ -434,7 +462,7 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
 def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                    axis_name: str, num_devices: int,
                    collective_id: int = None, bidir: bool = True,
-                   compress: bool = False):
+                   compress: bool = False, mesh_axes=None):
     """Run the fused RS+update+AG ring inside a shard_map body.
 
     Args (per-device views inside shard_map):
@@ -448,17 +476,20 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                     bidirectional mode it runs once per half-chunk).
       bidir:        split each chunk across both ring directions (both
                     ICI link directions utilized — the default).
+      mesh_axes:    ordered (name, size) pairs of the FULL mesh when the
+                    ring runs along one axis of a multi-axis torus (see
+                    :func:`_kernel_body`); None for a 1-D mesh.
     Returns (new_store_chunk [chunk], pulled [n*chunk]).
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
                       num_devices, collective_id, bidir, with_ag=True,
-                      compress=compress)
+                      compress=compress, mesh_axes=mesh_axes)
 
 
 def ring_push(grads_chunks, store_chunk, handle: Callable,
               axis_name: str, num_devices: int,
               collective_id: int = None, bidir: bool = True,
-              compress: bool = False):
+              compress: bool = False, mesh_axes=None):
     """Push-only ring: reduce-scatter + fused server update, no
     all-gather (the ``ZPush`` leg alone).  Same contract as
     :func:`ring_push_pull`; returns just the new store chunk.
@@ -468,4 +499,4 @@ def ring_push(grads_chunks, store_chunk, handle: Callable,
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
                       num_devices, collective_id, bidir, with_ag=False,
-                      compress=compress)
+                      compress=compress, mesh_axes=mesh_axes)

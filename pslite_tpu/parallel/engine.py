@@ -443,10 +443,20 @@ class CollectiveEngine:
         callable handles are excluded: the kernel applies the handle
         blockwise in VMEM (with tile-padding lanes flowing through it),
         which is only guaranteed sound for the built-in elementwise
-        handles."""
+        handles.
+
+        2-D (worker_axis) meshes run the MULTI-AXIS plane: the fused
+        ring executes the worker reduction + update + re-replication as
+        per-column sub-rings along the worker axis, and the pulled
+        broadcast rides XLA's all_gather on the kv-axis links — both
+        torus axes carry the one push_pull."""
         if self.impl != "pallas":
             return "xla"
-        if self.worker_axis is not None or self.num_shards < 2:
+        ring_n = (
+            self.num_workers if self.worker_axis is not None
+            else self.num_shards
+        )
+        if ring_n < 2:
             return "xla"
         if np.dtype(dtype).itemsize not in (2, 4):
             return "xla"
@@ -481,11 +491,15 @@ class CollectiveEngine:
     def _ring_program_op(self, op: str, padded_len: int, dtype,
                          handle_key) -> Callable:
         compress = self._ring_compress(dtype)
-        key = (f"ring_{op}", padded_len, str(dtype), handle_key, compress)
+        key = (f"ring_{op}", padded_len, str(dtype), handle_key, compress,
+               self.worker_axis)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
             return prog
+        if self.worker_axis is not None:
+            return self._ring_program_op_2d(op, key, padded_len, dtype,
+                                            handle_key, compress)
 
         import jax
         import jax.numpy as jnp
@@ -543,6 +557,88 @@ class CollectiveEngine:
             body,
             mesh=self.mesh,
             in_specs=(P(axis), P(axis, None)),
+            out_specs=out_specs,
+        )
+        jitted = jax.jit(fn, donate_argnums=(0,))
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _ring_program_op_2d(self, op: str, key, padded_len: int, dtype,
+                            handle_key, compress: bool) -> Callable:
+        """Multi-axis (2-D torus) ring data plane — VERDICT r02 #1.
+
+        The worker reduction + server update + dp re-replication run as
+        the fused Pallas ring along the WORKER axis: B independent
+        size-A sub-rings (one per kv column) inside one kernel launch,
+        each doing RS + update-in-VMEM + AG exactly like the 1-D plane.
+        The pulled broadcast then rides XLA's native all_gather over the
+        kv axis — a bare gather with nothing to fuse, which XLA already
+        schedules bidirectionally.  Together the two phases drive both
+        torus axes' links for one push_pull, the TPU analog of the
+        reference spreading one transfer across per-device NICs
+        (multi_van.h:173-197, ucx_van.h:938-1006)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.ring_collective import (
+            derive_collective_id,
+            ring_chunk_len,
+            ring_push_pull,
+        )
+
+        handle = self._handle_fn(
+            self._server_handle if handle_key == "_default" else handle_key
+        )
+        axis = self.axis
+        waxis = self.worker_axis
+        A = self.num_workers
+        B = self.num_shards
+        chunk_kv = padded_len // B  # my kv shard (replicated over dp)
+        ksub = ring_chunk_len(chunk_kv, A, dtype, compress=compress)
+        cid = derive_collective_id(*key)
+        maxes = tuple(
+            (name, self.mesh.shape[name]) for name in self.mesh.axis_names
+        )
+
+        def _updated_shard(store_l, grads_l):
+            """Fused dp-ring: returns my FULL updated kv shard
+            (replicated across the dp column by the ring's AG phase)."""
+            d = lax.axis_index(waxis)
+            g = grads_l[0]
+            s = store_l
+            if A * ksub != chunk_kv:
+                g = jnp.pad(g, (0, A * ksub - chunk_kv))
+                s = jnp.pad(s, (0, A * ksub - chunk_kv))
+            g = g.reshape(A, ksub)
+            s_sub = lax.dynamic_slice(s, (d * ksub,), (ksub,))
+            _, pulled_dp = ring_push_pull(
+                g, s_sub, handle, waxis, A, collective_id=cid,
+                compress=compress, mesh_axes=maxes,
+            )
+            if A * ksub != chunk_kv:
+                pulled_dp = pulled_dp[:chunk_kv]
+            return pulled_dp
+
+        def body_pp(store_l, grads_l):
+            new_store = _updated_shard(store_l, grads_l)
+            pulled = lax.all_gather(new_store, axis, tiled=True)
+            return new_store, pulled
+
+        def body_push(store_l, grads_l):
+            new_store = _updated_shard(store_l, grads_l)
+            return new_store, new_store[:1]
+
+        if op == "push_pull":
+            body, out_specs = body_pp, (P(axis), P(None))
+        else:
+            body, out_specs = body_push, (P(axis), P(axis))
+        fn = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(axis), P(waxis, axis)),
             out_specs=out_specs,
         )
         jitted = jax.jit(fn, donate_argnums=(0,))

@@ -133,11 +133,11 @@ def device_busy_seconds(logdir: str) -> Dict[str, float]:
 
     Planes whose name contains "TPU" (e.g. ``/device:TPU:0``) are the
     accelerator timelines; ``/host:CPU`` planes carry runtime threads and
-    are excluded.  Within a TPU plane, only XLA op lines carry executed
-    kernels; step/framework lines would double-count them, so lines named
-    "Steps" or beginning with "#" metadata are skipped — in practice jax
-    TPU traces carry "XLA Ops" (and sometimes "XLA Modules" which WOULD
-    double-count and is skipped too).
+    are excluded.  Within a TPU plane, ONLY the "XLA Ops" line(s) carry
+    executed kernels — every other line ("Steps", "XLA Modules",
+    "#"-prefixed derived lines, future additions) aggregates or annotates
+    those same intervals and would double-count them, so the filter is an
+    allowlist, not a denylist.
     """
     totals: Dict[str, float] = {}
     for path in find_xplane_files(logdir):
@@ -146,7 +146,7 @@ def device_busy_seconds(logdir: str) -> Dict[str, float]:
                 continue
             busy = 0
             for lname, ps in lines.items():
-                if lname in ("Steps", "XLA Modules", "Framework Ops"):
+                if lname != "XLA Ops":
                     continue
                 busy += ps
             if busy:

@@ -539,3 +539,60 @@ def test_replay_two_axis_mesh():
     for t in range(T):
         acc = acc + seq[t].sum(axis=0)
         np.testing.assert_allclose(pulled[t], acc, rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape,axes", [((2, 4), ("dp", "kv")),
+                                        ((4, 2), ("dp", "kv"))])
+def test_two_axis_ring_kernel_matches_xla(shape, axes):
+    """Multi-axis data plane (VERDICT r02 #1): the fused ring along the
+    worker axis + XLA all_gather along kv must match the pure-XLA 2-D
+    path on a (dp, kv) torus — push_pull, push+pull, and a second step
+    (store donation chain intact)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh(shape, axes)
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 700  # padded + non-tile-aligned sub-chunks
+    rng = np.random.default_rng(41)
+    W = shape[0]
+    grads1 = rng.normal(size=(W, 3 * val_len)).astype(np.float32)
+    grads2 = rng.normal(size=(W, 3 * val_len)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="xla")
+    ref.register_dense("x2", keys, val_len)
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="pallas")
+    eng.register_dense("r2", keys, val_len)
+
+    p_ref = np.asarray(ref.push_pull("x2", grads1))
+    p_ring = np.asarray(eng.push_pull("r2", grads1))
+    np.testing.assert_allclose(p_ring, p_ref, rtol=1e-5, atol=1e-5)
+
+    # push-only keeps the dp-replicated store consistent for a later pull.
+    ref.push("x2", grads2).block_until_ready()
+    eng.push("r2", grads2).block_until_ready()
+    np.testing.assert_allclose(
+        np.asarray(eng.pull("r2")), np.asarray(ref.pull("x2")),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_two_axis_ring_kernel_int8_compress():
+    """int8 wire compression on the 2-D ring: lossy but bounded, and the
+    pulled result must be identical on every device (owner-quantized AG
+    payloads)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="pallas",
+                           wire_compress="int8")
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 4096
+    eng.register_dense("c2", keys, val_len)
+    rng = np.random.default_rng(43)
+    grads = rng.normal(size=(2, 2 * val_len)).astype(np.float32)
+    pulled = np.asarray(eng.push_pull("c2", grads))
+    want = grads.sum(axis=0)
+    # absmax ~3.5, 2 ring hops of int8 quantization: tolerance scales
+    # with amax/127 per hop.
+    tol = 3 * np.abs(grads).max() / 127
+    np.testing.assert_allclose(pulled, want, atol=tol)
