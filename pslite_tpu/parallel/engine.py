@@ -579,48 +579,19 @@ class CollectiveEngine:
         reference spreading one transfer across per-device NICs
         (multi_van.h:173-197, ucx_van.h:938-1006)."""
         import jax
-        import jax.numpy as jnp
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.ring_collective import (
-            derive_collective_id,
-            ring_chunk_len,
-            ring_push_pull,
-        )
+        from ..ops.ring_collective import derive_collective_id
 
         handle = self._handle_fn(
             self._server_handle if handle_key == "_default" else handle_key
         )
         axis = self.axis
-        waxis = self.worker_axis
-        A = self.num_workers
-        B = self.num_shards
-        chunk_kv = padded_len // B  # my kv shard (replicated over dp)
-        ksub = ring_chunk_len(chunk_kv, A, dtype, compress=compress)
         cid = derive_collective_id(*key)
-        maxes = tuple(
-            (name, self.mesh.shape[name]) for name in self.mesh.axis_names
+        _updated_shard = self._ring_2d_shard_fn(
+            handle, padded_len, dtype, compress, cid
         )
-
-        def _updated_shard(store_l, grads_l):
-            """Fused dp-ring: returns my FULL updated kv shard
-            (replicated across the dp column by the ring's AG phase)."""
-            d = lax.axis_index(waxis)
-            g = grads_l[0]
-            s = store_l
-            if A * ksub != chunk_kv:
-                g = jnp.pad(g, (0, A * ksub - chunk_kv))
-                s = jnp.pad(s, (0, A * ksub - chunk_kv))
-            g = g.reshape(A, ksub)
-            s_sub = lax.dynamic_slice(s, (d * ksub,), (ksub,))
-            _, pulled_dp = ring_push_pull(
-                g, s_sub, handle, waxis, A, collective_id=cid,
-                compress=compress, mesh_axes=maxes,
-            )
-            if A * ksub != chunk_kv:
-                pulled_dp = pulled_dp[:chunk_kv]
-            return pulled_dp
 
         def body_pp(store_l, grads_l):
             new_store = _updated_shard(store_l, grads_l)
@@ -638,7 +609,7 @@ class CollectiveEngine:
         fn = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(axis), P(waxis, axis)),
+            in_specs=(P(axis), P(self.worker_axis, axis)),
             out_specs=out_specs,
         )
         jitted = jax.jit(fn, donate_argnums=(0,))
@@ -646,30 +617,73 @@ class CollectiveEngine:
             self._programs[key] = jitted
         return jitted
 
+    def _ring_2d_shard_fn(self, handle, padded_len: int, dtype,
+                          compress: bool, cid: int):
+        """Shard-level body of the 2-D fused data plane: a function
+        ``(store_l, grads_l) -> updated kv shard`` running the dp-axis
+        sub-ring (RS + update-in-VMEM + AG) for use inside a shard_map
+        over the full (dp, kv) mesh.  Shared by the single-bucket and
+        grouped programs."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.ring_collective import ring_chunk_len, ring_push_pull
+
+        waxis = self.worker_axis
+        A = self.num_workers
+        B = self.num_shards
+        chunk_kv = padded_len // B  # my kv shard (replicated over dp)
+        ksub = ring_chunk_len(chunk_kv, A, dtype, compress=compress)
+        maxes = tuple(
+            (name, self.mesh.shape[name]) for name in self.mesh.axis_names
+        )
+
+        def _updated_shard(store_l, grads_l):
+            d = lax.axis_index(waxis)
+            g = grads_l[0]
+            s = store_l
+            if A * ksub != chunk_kv:
+                g = jnp.pad(g, (0, A * ksub - chunk_kv))
+                s = jnp.pad(s, (0, A * ksub - chunk_kv))
+            g = g.reshape(A, ksub)
+            s_sub = lax.dynamic_slice(s, (d * ksub,), (ksub,))
+            _, pulled_dp = ring_push_pull(
+                g, s_sub, handle, waxis, A, collective_id=cid,
+                compress=compress, mesh_axes=maxes,
+            )
+            if A * ksub != chunk_kv:
+                pulled_dp = pulled_dp[:chunk_kv]
+            return pulled_dp
+
+        return _updated_shard
+
     def _stateful_program(self, op: str, key, handle_key: str) -> Callable:
         """Program for the fused-kernel handles: the Pallas optimizer pass
         runs between the reduce-scatter and the all-gather, with store AND
         optimizer state donated (one HBM pass per step, no double
-        buffering)."""
+        buffering).  On a 2-D mesh the worker reduction is the psum over
+        ``worker_axis`` and state lives sharded over kv / replicated over
+        dp, exactly like the store."""
         import jax
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
         n_state, sfn = self._stateful_handle(handle_key)
         axis = self.axis
+        waxis = self.worker_axis
         store_spec = P(axis)
-        grads_spec = P(axis, None)
+        grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
         repl_spec = P(None)
 
         def _push(store_l, *rest):
             state_l, grads_l = rest[:-1], rest[-1]
-            agg = _aggregate(grads_l, axis)
+            agg = _aggregate(grads_l, axis, waxis)
             new_store, new_state = sfn(store_l, tuple(state_l), agg)
             return (new_store, *new_state, new_store[:1])  # token last
 
         def _push_pull(store_l, *rest):
             state_l, grads_l = rest[:-1], rest[-1]
-            agg = _aggregate(grads_l, axis)
+            agg = _aggregate(grads_l, axis, waxis)
             new_store, new_state = sfn(store_l, tuple(state_l), agg)
             pulled = lax.all_gather(new_store, axis, tiled=True)
             return (new_store, *new_state, pulled)
@@ -754,15 +768,48 @@ class CollectiveEngine:
         """Worker rows owned by THIS process on a multi-process mesh."""
         return self._local_shard_count
 
+    def _normalize_host_grads(self, grads, rows, bucket, xp,
+                              steps: bool = False,
+                              row_msg: str = "bad worker dim"):
+        """Coerce a grads array to ``[(T,)? rows, padded]``: dtype cast,
+        broadcast a missing row dim to ``rows``, validate the row count,
+        pad the value tail.  The one definition behind every host/device
+        staging path (1-D/2-D x single/multi-process x single/replay);
+        ``xp`` is np (host staging) or jnp (device staging)."""
+        arr = xp.asarray(grads, dtype=np.dtype(bucket.dtype))
+        want = 3 if steps else 2
+        log.check(arr.ndim in (want - 1, want), "bad grads rank")
+        if arr.ndim == want - 1:
+            if steps:
+                arr = xp.broadcast_to(
+                    arr[:, None, :], (arr.shape[0], rows, arr.shape[1])
+                )
+            else:
+                arr = xp.broadcast_to(arr, (rows, arr.shape[0]))
+        log.check_eq(int(arr.shape[-2]), rows, row_msg)
+        if arr.shape[-1] != bucket.padded_len:
+            log.check_eq(int(arr.shape[-1]), bucket.total_len,
+                         "bad grad len")
+            pad = bucket.padded_len - bucket.total_len
+            pads = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+            arr = xp.pad(arr, pads)
+        return arr
+
     def _prep_grads(self, bucket: DenseBucket, grads):
         """Accept [W, total] (or [total] broadcast) host/device arrays and
         deliver a [W, padded] device array sharded over the worker axis.
 
-        On a multi-process mesh a host array is this PROCESS's
-        contribution: [total] broadcasts to the process's local worker
-        rows, [local, total] maps row-for-row; the global array is
-        assembled with make_array_from_process_local_data (device_put
-        cannot target non-addressable devices)."""
+        Multi-process host-array contracts differ by layout:
+        - 1-D mesh: the host array is this PROCESS's contribution —
+          [total] broadcasts to the process's local worker rows,
+          [local, total] maps row-for-row; the global array is assembled
+          with make_array_from_process_local_data (device_put cannot
+          target non-addressable devices).
+        - 2-D (worker_axis) mesh: the host array is the GLOBAL
+          [W, total] grads and must be IDENTICAL on every process — a
+          process's devices span a rectangle of the (dp, kv) grid, so
+          there is no per-process row ownership to map a local
+          contribution onto."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -784,46 +831,28 @@ class CollectiveEngine:
                     return grads
                 return jax.device_put(grads, sharding)
         if self.worker_axis is not None:
-            arr = jnp.asarray(grads, dtype=bucket.dtype)
-            if arr.ndim == 1:
-                arr = jnp.broadcast_to(arr, (self.num_workers, arr.shape[0]))
-            log.check_eq(int(arr.shape[0]), self.num_workers,
-                         "bad worker dim")
-            if arr.shape[1] != bucket.padded_len:
-                log.check_eq(int(arr.shape[1]), bucket.total_len,
-                             "bad grad len")
-                arr = jnp.pad(
-                    arr, ((0, 0), (0, bucket.padded_len - bucket.total_len))
+            if self._is_multiprocess():
+                arr = self._normalize_host_grads(
+                    grads, self.num_workers, bucket, np
                 )
-            log.check(not self._is_multiprocess(),
-                      "host arrays on a multi-process 2-D mesh are not "
-                      "supported yet; pass pre-sharded jax.Arrays")
+                return self._place(np.ascontiguousarray(arr), sharding)
+            arr = self._normalize_host_grads(
+                grads, self.num_workers, bucket, jnp
+            )
             return jax.device_put(arr, sharding)
         if self._is_multiprocess():
-            arr = np.asarray(grads, dtype=np.dtype(bucket.dtype))
-            local = self._local_shards()
-            if arr.ndim == 1:
-                arr = np.broadcast_to(arr, (local, arr.shape[0]))
-            log.check_eq(int(arr.shape[0]), local,
-                         "bad local worker dim (rows = this process's "
-                         "devices on a multi-process mesh)")
-            if arr.shape[1] != bucket.padded_len:
-                log.check_eq(int(arr.shape[1]), bucket.total_len,
-                             "bad grad len")
-                pad = bucket.padded_len - bucket.total_len
-                arr = np.pad(arr, ((0, 0), (0, pad)))
+            arr = self._normalize_host_grads(
+                grads, self._local_shards(), bucket, np,
+                row_msg="bad local worker dim (rows = this process's "
+                        "devices on a multi-process mesh)",
+            )
             return jax.make_array_from_process_local_data(
                 sharding, np.ascontiguousarray(arr),
                 (self.num_shards, bucket.padded_len),
             )
-        arr = jnp.asarray(grads, dtype=bucket.dtype)
-        if arr.ndim == 1:
-            arr = jnp.broadcast_to(arr, (self.num_shards, arr.shape[0]))
-        log.check_eq(int(arr.shape[0]), self.num_shards, "bad worker dim")
-        if arr.shape[1] != bucket.padded_len:
-            log.check_eq(int(arr.shape[1]), bucket.total_len, "bad grad len")
-            pad = bucket.padded_len - bucket.total_len
-            arr = jnp.pad(arr, ((0, 0), (0, pad)))
+        arr = self._normalize_host_grads(
+            grads, self.num_shards, bucket, jnp
+        )
         return jax.device_put(arr, sharding)
 
     def _observe(self, name: str, op: str, bucket: DenseBucket,
@@ -851,9 +880,6 @@ class CollectiveEngine:
     def _resolve_handle(self, handle: Optional[ServerHandle]):
         resolved = self._server_handle if handle is None else handle
         if self._is_stateful(resolved):
-            log.check(self.worker_axis is None,
-                      "stateful (fused optimizer) handles are not yet "
-                      "supported on 2-D meshes")
             return resolved, resolved  # stateful handles key by full string
         return resolved, ("_default" if handle is None else handle)
 
@@ -940,8 +966,6 @@ class CollectiveEngine:
         log.check(len(names) == len(grads_list), "names/grads mismatch")
         log.check(len(set(names)) == len(names),
                   "duplicate bucket in group (stores are donated)")
-        log.check(self.worker_axis is None,
-                  "push_pull_group is 1-D-mesh only for now")
         resolved, handle_key = self._resolve_handle(handle)
         log.check(not self._is_stateful(resolved),
                   "push_pull_group supports stateless handles only")
@@ -978,14 +1002,19 @@ class CollectiveEngine:
         return [p[: b.total_len] for p, b in zip(pulled, buckets)]
 
     def _group_program(self, shapes_key, handle_key) -> Callable:
-        use_ring = False
-        if self.impl == "pallas" and self.num_shards >= 2 and not callable(
+        # The ring gate is _effective_impl per bucket dtype — the same
+        # resolution the single-bucket path applies (incl. the
+        # multiprocess/off-TPU interpreter restriction, which cannot DMA
+        # across processes).
+        resolved = (
             self._server_handle if handle_key == "_default" else handle_key
-        ):
-            use_ring = all(
-                np.dtype(dt).itemsize in (2, 4) for _, dt in shapes_key
-            )
-        key = ("group_pp", shapes_key, handle_key, use_ring)
+        )
+        use_ring = all(
+            self._effective_impl(dt, resolved) == "pallas"
+            for _, dt in shapes_key
+        )
+        key = ("group_pp", shapes_key, handle_key, use_ring,
+               self.worker_axis)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
@@ -993,15 +1022,17 @@ class CollectiveEngine:
 
         import jax
         import jax.numpy as jnp
+        from jax import lax
         from jax.sharding import PartitionSpec as P
 
         axis = self.axis
+        waxis = self.worker_axis
         handle = self._handle_fn(
             self._server_handle if handle_key == "_default" else handle_key
         )
         k = len(shapes_key)
         store_spec = P(axis)
-        grads_spec = P(axis, None)
+        grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
         repl_spec = P(None)
         n = self.num_shards
 
@@ -1013,6 +1044,15 @@ class CollectiveEngine:
             )
 
             compress = self._ring_compress(dtype)
+            cid = derive_collective_id(*key, i)
+            if waxis is not None:
+                # 2-D: dp sub-ring for this bucket, kv gather for pull.
+                shard_fn = self._ring_2d_shard_fn(
+                    handle, padded_len, dtype, compress, cid
+                )
+                new = shard_fn(store_l, grads_l)
+                pulled = lax.all_gather(new, axis, tiled=True)
+                return new, pulled
             chunk0 = padded_len // n
             kchunk = ring_chunk_len(padded_len, n, dtype,
                                     compress=compress)
@@ -1023,7 +1063,7 @@ class CollectiveEngine:
                 s = jnp.pad(s, (0, kchunk - chunk0))
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n,
-                collective_id=derive_collective_id(*key, i),
+                collective_id=cid,
                 compress=compress,
             )
             if kchunk != chunk0:
@@ -1040,7 +1080,8 @@ class CollectiveEngine:
                     new, out = _ring_one(i, padded_len, dt, store_l,
                                          grads_l)
                 else:
-                    new, out = _rs_update_ag(store_l, grads_l, handle, axis)
+                    new, out = _rs_update_ag(store_l, grads_l, handle,
+                                             axis, waxis)
                 new_stores.append(new)
                 pulled.append(out)
             return (*new_stores, *pulled)
@@ -1072,8 +1113,10 @@ class CollectiveEngine:
           grads_seq: ``[T, total]`` (each step's gradient broadcast to
             every worker) or ``[T, W, total]`` (row per worker per step);
             host arrays on single-process meshes, any layout of
-            ``jax.Array``.  On a multi-process mesh pass ``[T, local,
-            total]`` = this process's worker rows, as in ``push``.
+            ``jax.Array``.  Multi-process host arrays follow
+            ``_prep_grads``'s contracts: 1-D mesh = ``[T, local, total]``
+            (this process's worker rows); 2-D mesh = the GLOBAL
+            ``[T, W, total]``, identical on every process.
           keep: ``"all"`` materializes every step's pulled result
             (returns ``[T, total]``); ``"last"`` returns only the final
             pulled vector ``[total]`` — intermediate all-gathers are
@@ -1140,42 +1183,24 @@ class CollectiveEngine:
                     return grads_seq
                 return jax.device_put(grads_seq, sharding)
         if self._is_multiprocess():
-            log.check(self.worker_axis is None,
-                      "host arrays on a multi-process 2-D mesh are not "
-                      "supported yet; pass pre-sharded jax.Arrays")
-            arr = np.asarray(grads_seq, dtype=np.dtype(bucket.dtype))
-            local = self._local_shards()
-            log.check(arr.ndim in (2, 3), "bad grads_seq rank")
-            if arr.ndim == 2:
-                arr = np.broadcast_to(
-                    arr[:, None, :], (arr.shape[0], local, arr.shape[1])
+            if self.worker_axis is not None:
+                # Same GLOBAL-array contract as _prep_grads' 2-D branch.
+                arr = self._normalize_host_grads(
+                    grads_seq, self.num_workers, bucket, np, steps=True
                 )
-            log.check_eq(int(arr.shape[1]), local,
-                         "bad local worker dim (rows = this process's "
-                         "devices on a multi-process mesh)")
-            if arr.shape[2] != bucket.padded_len:
-                log.check_eq(int(arr.shape[2]), bucket.total_len,
-                             "bad grad len")
-                pad = bucket.padded_len - bucket.total_len
-                arr = np.pad(arr, ((0, 0), (0, 0), (0, pad)))
+                return self._place(np.ascontiguousarray(arr), sharding)
+            arr = self._normalize_host_grads(
+                grads_seq, self._local_shards(), bucket, np, steps=True,
+                row_msg="bad local worker dim (rows = this process's "
+                        "devices on a multi-process mesh)",
+            )
             return jax.make_array_from_process_local_data(
                 sharding, np.ascontiguousarray(arr),
                 (arr.shape[0], self.num_shards, bucket.padded_len),
             )
-        arr = jnp.asarray(grads_seq, dtype=bucket.dtype)
-        log.check(arr.ndim in (2, 3), "bad grads_seq rank")
-        if arr.ndim == 2:
-            arr = jnp.broadcast_to(
-                arr[:, None, :],
-                (arr.shape[0], self.num_workers, arr.shape[1]),
-            )
-        log.check_eq(int(arr.shape[1]), self.num_workers, "bad worker dim")
-        if arr.shape[2] != bucket.padded_len:
-            log.check_eq(int(arr.shape[2]), bucket.total_len, "bad grad len")
-            arr = jnp.pad(
-                arr,
-                ((0, 0), (0, 0), (0, bucket.padded_len - bucket.total_len)),
-            )
+        arr = self._normalize_host_grads(
+            grads_seq, self.num_workers, bucket, jnp, steps=True
+        )
         return jax.device_put(arr, sharding)
 
     def _replay_program(self, steps: int, padded_len: int, dtype,
@@ -1200,8 +1225,6 @@ class CollectiveEngine:
             P(None, axis, None) if waxis is None else P(None, waxis, axis)
         )
         if stateful:
-            # _resolve_handle already refuses stateful handles on 2-D
-            # meshes, so waxis is None here.
             n_state, sfn = self._stateful_handle(handle_key)
 
             def _body(store_l, *rest):
@@ -1209,7 +1232,7 @@ class CollectiveEngine:
 
                 def step(carry, g):
                     store_c, state_c = carry[0], carry[1:]
-                    agg = _aggregate([g], axis)
+                    agg = _aggregate([g], axis, waxis)
                     new_store, new_state = sfn(store_c, tuple(state_c), agg)
                     out = (
                         lax.all_gather(new_store, axis, tiled=True)

@@ -596,3 +596,90 @@ def test_two_axis_ring_kernel_int8_compress():
     # with amax/127 per hop.
     tol = 3 * np.abs(grads).max() / 127
     np.testing.assert_allclose(pulled, want, atol=tol)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_two_axis_stateful_fused_handles(impl):
+    """Stateful (fused optimizer) handles on a 2-D (dp, kv) mesh — the
+    dp-psum aggregation feeding the Pallas optimizer pass, state sharded
+    over kv / replicated over dp.  Must match the 1-D reference engine
+    step for step.  (impl only routes the stateless path; stateful
+    programs are XLA either way — parametrized to prove the resolve
+    logic doesn't mis-route.)"""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    lr, mu = 0.1, 0.9
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl=impl,
+                           server_handle=f"sgd_momentum:{lr},{mu}")
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 100
+    init = np.linspace(1, 2, 3 * val_len).astype(np.float32)
+    eng.register_dense("st2", keys, val_len, init=init)
+    rng = np.random.default_rng(47)
+
+    ref_store = init.copy()
+    ref_mom = np.zeros_like(ref_store)
+    for _ in range(3):
+        grads = rng.normal(size=(2, 3 * val_len)).astype(np.float32)
+        pulled = np.asarray(eng.push_pull("st2", grads))
+        agg = grads.sum(axis=0)
+        ref_mom = mu * ref_mom + agg
+        ref_store = ref_store - lr * ref_mom
+        np.testing.assert_allclose(pulled, ref_store, rtol=2e-5, atol=2e-5)
+
+
+def test_two_axis_adam_replay():
+    """Stateful replay on a 2-D mesh: adam state threaded through the
+    scan with the dp-psum reduction."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 64
+    init = np.linspace(0, 1, 2 * val_len).astype(np.float32)
+    rng = np.random.default_rng(49)
+    T = 3
+    seq = rng.normal(size=(T, 2, 2 * val_len)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh2, worker_axis="dp",
+                           server_handle="adam:0.01")
+    ref.register_dense("ar_ref", keys, val_len, init=init)
+    expected = [np.asarray(ref.push_pull("ar_ref", seq[t]))
+                for t in range(T)]
+
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp",
+                           server_handle="adam:0.01")
+    eng.register_dense("ar", keys, val_len, init=init)
+    pulled = np.asarray(eng.replay("ar", seq))
+    for t in range(T):
+        np.testing.assert_allclose(pulled[t], expected[t],
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_two_axis_push_pull_group(impl):
+    """Grouped dispatch on a 2-D mesh (both impls) must match per-bucket
+    singles — the W != S decoupling now covers the model-step group
+    path."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl=impl)
+    ref = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="xla")
+    rng = np.random.default_rng(51)
+    names, grads_list = [], []
+    for i, val_len in enumerate((40, 700, 256)):
+        name = f"gb{i}"
+        keys = np.arange(2, dtype=np.uint64)
+        eng.register_dense(name, keys, val_len)
+        ref.register_dense(name, keys, val_len)
+        names.append(name)
+        grads_list.append(
+            rng.normal(size=(2, 2 * val_len)).astype(np.float32)
+        )
+    grouped = eng.push_pull_group(names, grads_list)
+    for name, g, out in zip(names, grads_list, grouped):
+        want = np.asarray(ref.push_pull(name, g))
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   rtol=1e-5, atol=1e-5)
