@@ -1431,21 +1431,30 @@ class CollectiveEngine:
         dropped and rebuilt lazily on first touch — exactly like
         first-push rendezvous after a topology change.
 
-        Single-process meshes on both sides (state moves via a host
-        round trip).  A 2-D engine (``worker_axis``) reshards onto any
-        new mesh carrying both its axes — worker fan-in and server-shard
-        count both recut.  Callers' grads arrays must use the NEW worker
+        State moves via a host round trip on either kind of mesh.  On a
+        multi-process mesh (old or new side) reshard is a COLLECTIVE:
+        every participating process must call it with the same new mesh
+        in the same order — the snapshot assembles non-addressable
+        shards with process_allgather and the rebuild scatters through
+        the callback placement path.  (Roster-level recovery keeps the
+        mesh: a replacement inherits the dead node's id and devices, so
+        no reshard fires; this is the SCALE-change tier the launcher or
+        app invokes when the server fleet itself grows or shrinks.)
+
+        A 2-D engine (``worker_axis``) reshards onto any new mesh
+        carrying both its axes — worker fan-in and server-shard count
+        both recut.  Callers' grads arrays must use the NEW worker
         fan-in after this returns.
         """
-        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from .placement import mesh_is_multiprocess
-
-        log.check(
-            not self._multiprocess and not mesh_is_multiprocess(mesh),
-            "reshard requires single-process meshes on both sides",
+        from .placement import (
+            local_shard_count,
+            mesh_is_multiprocess,
+            to_host_global,
         )
+
+        new_multiprocess = mesh_is_multiprocess(mesh)
         axis = axis_name or self.axis
         log.check(axis in mesh.axis_names,
                   f"axis {axis!r} not in new mesh")
@@ -1464,16 +1473,26 @@ class CollectiveEngine:
             self._bucket_mu[n].acquire()
         try:
             # Snapshot all live state to host while every bucket is
-            # quiesced (the donated buffers cannot be in flight).
+            # quiesced (the donated buffers cannot be in flight).  On a
+            # multi-process OLD mesh this is the collective gather leg:
+            # iterate in SORTED order so every process issues the same
+            # allgather sequence regardless of registration order (the
+            # buckets themselves — and their opt-state presence — must
+            # already be symmetric across processes, as all engine
+            # collectives require).
+            old_mp = self._multiprocess
+            names = ordered
             snap = {}
             for n in names:
                 b = self._buckets[n]
-                store = np.asarray(self._stores[n])[: b.total_len].copy()
+                store = to_host_global(
+                    self._stores[n], old_mp
+                )[: b.total_len].copy()
                 opt = None
                 if n in self._opt_states:
                     opt = (
                         self._opt_kinds[n],
-                        [np.asarray(a).copy()
+                        [to_host_global(a, old_mp).copy()
                          for a in self._opt_states[n]],
                     )
                 snap[n] = (b, store, opt)
@@ -1486,8 +1505,11 @@ class CollectiveEngine:
                 if self.worker_axis is not None
                 else self.num_shards
             )
-            self._multiprocess = False
-            self._local_shard_count = self.num_shards
+            self._multiprocess = new_multiprocess
+            self._local_shard_count = (
+                local_shard_count(mesh) if new_multiprocess
+                else self.num_shards
+            )
             with self._mu:
                 self._programs.clear()
             sharding = NamedSharding(mesh, P(axis))
@@ -1495,7 +1517,7 @@ class CollectiveEngine:
             def _repad(flat_host, total, padded, dt):
                 out = np.zeros(padded, dtype=np.dtype(dt))
                 out[:total] = flat_host[:total]
-                return jax.device_put(out, sharding)
+                return self._place(out, sharding)
 
             for n in names:
                 b, store, opt = snap[n]
@@ -1509,7 +1531,7 @@ class CollectiveEngine:
                     # Re-pin on the new mesh: the old pinned buffer's
                     # devices/shape no longer match (a fresh address —
                     # same as re-registering after recovery).
-                    self._pinned_pulls[n] = jax.device_put(
+                    self._pinned_pulls[n] = self._place(
                         np.zeros(b.padded_len, dtype=np.dtype(b.dtype)),
                         NamedSharding(mesh, P(None)),
                     )
@@ -1526,7 +1548,7 @@ class CollectiveEngine:
                     state = (
                         _repad(arrs[0], b.total_len, b.padded_len, b.dtype),
                         _repad(arrs[1], b.total_len, b.padded_len, b.dtype),
-                        jax.device_put(
+                        self._place(
                             np.full(self.num_shards, step, np.float32),
                             sharding,
                         ),

@@ -33,3 +33,19 @@ def place_host_array(mesh, host_arr, sharding, multiprocess=None):
     return jax.make_array_from_callback(
         host_arr.shape, sharding, lambda idx: host_arr[idx]
     )
+
+
+def to_host_global(arr, multiprocess: bool):
+    """The FULL value of a sharded array as a numpy array on THIS host.
+
+    Single-process: a plain device fetch.  Multi-process: a collective —
+    every participating process must call this on the same array in the
+    same order (jax.experimental.multihost_utils.process_allgather
+    assembles the non-addressable shards across hosts)."""
+    import numpy as np
+
+    if not multiprocess:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

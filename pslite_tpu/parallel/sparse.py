@@ -658,13 +658,18 @@ class SparseEngine:
         Rows are de-interleaved to global order on the host, the
         row→shard mapping is recut for the new shard count (global row r
         lives on shard ``r % S`` — the modulo sharding that load-balances
-        skewed key distributions), and programs rebuild lazily."""
-        from .placement import mesh_is_multiprocess
+        skewed key distributions), and programs rebuild lazily.
 
-        log.check(
-            not self._multiprocess and not mesh_is_multiprocess(mesh),
-            "reshard requires single-process meshes on both sides",
+        Multi-process meshes work on either side; reshard is then a
+        COLLECTIVE — every participating process calls it with the same
+        new mesh (see CollectiveEngine.reshard)."""
+        from .placement import (
+            local_shard_count,
+            mesh_is_multiprocess,
+            to_host_global,
         )
+
+        new_multiprocess = mesh_is_multiprocess(mesh)
         axis = axis_name or self.axis
         log.check(axis in mesh.axis_names,
                   f"axis {axis!r} not in new mesh")
@@ -674,10 +679,15 @@ class SparseEngine:
         for n in ordered:
             self._table_mu[n].acquire()
         try:
+            # Sorted iteration: the multi-process snapshot is a sequence
+            # of collectives — every process must issue them in the same
+            # order (see CollectiveEngine.reshard).
+            old_mp = self._multiprocess
+            names = ordered
             snap = {}
             for n in names:
                 t = self._tables[n]
-                host = np.asarray(self._stores[n])
+                host = to_host_global(self._stores[n], old_mp)
                 S, rps = self.num_shards, t.rows_per_shard
                 glob = (
                     host.reshape(S, rps, t.dim)
@@ -687,7 +697,7 @@ class SparseEngine:
                 )
                 acc_glob = None
                 if n in self._acc:
-                    acc_host = np.asarray(self._acc[n])
+                    acc_host = to_host_global(self._acc[n], old_mp)
                     acc_glob = (
                         acc_host.reshape(S, rps).transpose(1, 0)
                         .reshape(-1)[: t.num_rows].copy()
@@ -697,8 +707,11 @@ class SparseEngine:
             self.mesh = mesh
             self.axis = axis
             self.num_shards = mesh.shape[axis]
-            self._multiprocess = False
-            self._local_shard_count = self.num_shards
+            self._multiprocess = new_multiprocess
+            self._local_shard_count = (
+                local_shard_count(mesh) if new_multiprocess
+                else self.num_shards
+            )
             with self._mu:
                 self._programs.clear()
             for n in names:
