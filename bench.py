@@ -368,6 +368,8 @@ def main() -> None:
             fused = None
             bf16 = None
             trace_gbps = None
+            host_trace_gbps = None
+            host_trace_overlap_gbps = None
             emb_ms = None
         else:
             # Median of 5 rounds: single-run numbers through the shared
@@ -410,6 +412,15 @@ def main() -> None:
 
             rn_bytes, rn_dt = rn50(eng, steps=5)
             trace_gbps = rn_bytes / rn_dt / 1e9
+            # Host-origin trace replay: gradients start as host numpy
+            # every step.  Serial staging vs double-buffered staging
+            # (stager thread overlaps transfer with the collectives) —
+            # the comparative pair is tunnel-noise-resistant even when
+            # the absolute numbers are not.
+            hb, hd = rn50(eng, steps=3, host_origin=True, overlap=False)
+            host_trace_gbps = hb / hd / 1e9
+            hb2, hd2 = rn50(eng, steps=3, host_origin=True, overlap=True)
+            host_trace_overlap_gbps = hb2 / hd2 / 1e9
             # Sparse tier: the 1M-key zipf-skewed embedding push/pull —
             # the BASELINE config-5 replay (gather + scatter-add bound).
             from pslite_tpu.models.embedding import replay as emb
@@ -493,6 +504,14 @@ def main() -> None:
                 ),
                 "resnet50_trace_goodput": (
                     round(trace_gbps, 2) if trace_gbps is not None else None
+                ),
+                "resnet50_host_trace_goodput": (
+                    round(host_trace_gbps, 2)
+                    if host_trace_gbps is not None else None
+                ),
+                "resnet50_host_overlap_goodput": (
+                    round(host_trace_overlap_gbps, 2)
+                    if host_trace_overlap_gbps is not None else None
                 ),
                 "embedding_1m_ms_per_step": (
                     round(emb_ms, 1) if emb_ms is not None else None

@@ -75,12 +75,22 @@ def make_buckets(bucket_bytes: int = 4 << 20) -> List[Tuple[str, int]]:
 
 
 def replay(engine, steps: int = 1, bucket_bytes: int = 4 << 20,
-           grouped: bool = True):
+           grouped: bool = True, host_origin: bool = False,
+           overlap: bool = True):
     """Run the ResNet-50 push/pull trace through a CollectiveEngine.
 
     ``grouped=True`` pushes the whole gradient stream as ONE jitted
     program per step (engine.push_pull_group) — one dispatch instead of
     ~35; ``False`` replays bucket-by-bucket (the per-message analog).
+
+    ``host_origin=True`` replays the path real users hit: each bucket's
+    gradient starts as a host numpy array every step (the framework
+    hands the PS CPU tensors).  With ``overlap=True`` the next bucket's
+    host->HBM staging runs on a background thread while the current
+    bucket's collective executes — the pinned-memory/async-RDMA overlap
+    of the reference's host path; ``overlap=False`` stages serially
+    (the baseline the overlap is measured against).
+
     Returns (bytes_moved_per_step, seconds_per_step).
     """
     import time
@@ -91,24 +101,42 @@ def replay(engine, steps: int = 1, bucket_bytes: int = 4 << 20,
 
     buckets = make_buckets(bucket_bytes)
     grads = {}
+    host = {}
     sharding = NamedSharding(engine.mesh, P(engine.axis, None))
     for name, n in buckets:
         engine.register_dense(name, np.arange(1, dtype=np.uint64), n)
         bucket = engine.bucket(name)
-        g = jnp.ones((engine.num_shards, bucket.padded_len), jnp.float32)
-        grads[name] = jax.device_put(g, sharding)
+        if host_origin:
+            host[name] = np.ones(
+                (engine.num_shards, bucket.padded_len), np.float32
+            )
+        else:
+            g = jnp.ones(
+                (engine.num_shards, bucket.padded_len), jnp.float32
+            )
+            grads[name] = jax.device_put(g, sharding)
     names = [name for name, _ in buckets]
-    glist = [grads[n] for n in names]
     # Grouped dispatch supports stateless handles only; engines built
     # with fused optimizer handles fall back to per-bucket replay.
-    grouped = grouped and not engine.handle_is_stateful
+    grouped = grouped and not engine.handle_is_stateful and not host_origin
 
     def one_step():
         if grouped:
-            engine.push_pull_group(names, glist)
-        else:
+            engine.push_pull_group(names, [grads[n] for n in names])
+        elif not host_origin:
             for n in names:
                 engine.push_pull(n, grads[n])
+        elif not overlap:
+            for n in names:
+                engine.push_pull(n, host[n])
+        else:
+            # Double-buffered host staging via the engine's hardened
+            # stream pipeline: bucket i+1's transfer runs on the stager
+            # thread while bucket i's collective dispatches.
+            for _ in engine.push_pull_multi_stream(
+                ((n, host[n]) for n in names), depth=2
+            ):
+                pass
 
     # Warm the executable cache (the rendezvous-equivalent first touch).
     one_step()
