@@ -79,8 +79,9 @@ def test_dead_reader_falls_back_to_socket(dead_ms_env):
         # Payload integrity across the fallback path.
         assert got[1][0] == payload.tobytes()
     finally:
-        writer.stop()
-        reader.stop()
+        for core in (writer, reader):
+            core.stop()
+            core.destroy()  # joins the io/pipe threads (TSAN-clean exit)
         for leftover in (path, path + ".lock"):
             try:
                 os.unlink(leftover)
