@@ -279,6 +279,17 @@ class KVWorker:
         ] = name
         return bucket
 
+    def reshard(self, mesh) -> None:
+        """Coordinated elastic recut of the collective data plane onto a
+        new mesh (every worker of the cluster must call this with the
+        same mesh — see ``_IciDataPlane.reshard_engines``).  Registered
+        bucket/table names stay valid; key ranges are recut and
+        programs rebuild lazily on the next op."""
+        hook = getattr(self.po.van, "reshard_engines", None)
+        log.check(hook is not None,
+                  "reshard requires an ICI van (collective data plane)")
+        hook(mesh, customer_id=self._customer.customer_id)
+
     def register_pull_buffer(self, name: str):
         """Pin a persistent device pull buffer for a registered dense
         bucket (the UCX PinMemory / w_pool_ contract at the app level):

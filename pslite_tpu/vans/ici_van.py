@@ -91,6 +91,43 @@ class _IciDataPlane:
                 profiler=self.profiler,
             )
 
+    def reshard_engines(self, mesh, customer_id: int = 0) -> None:
+        """Cluster-coordinated elastic recut — the roster-level trigger
+        over the engine-level :meth:`CollectiveEngine.reshard`.
+
+        EVERY worker instance of the cluster must call this with the
+        same new mesh (the app's scale decision, e.g. after the
+        launcher grows/shrinks the fleet).  The surrounding
+        WORKER_GROUP barriers quiesce the data plane: no registered
+        dense/sparse op can be in flight anywhere when the collective
+        snapshot/rebuild runs, and no process resumes pushing until
+        every process finished the recut — the elastic analog of the
+        reference re-admitting recovered nodes under a barriered
+        roster update (van.cc:266-332)."""
+        from ..base import WORKER_GROUP
+
+        log.check(self.engine is not None,
+                  "reshard_engines: no engine (worker-only, after start)")
+        # Validate the cheap deterministic invariants BEFORE the first
+        # barrier: a worker failing these would otherwise wedge every
+        # peer at the resume barrier instead of raising visibly.
+        log.check(self.engine.axis in mesh.axis_names,
+                  f"axis {self.engine.axis!r} not in new mesh")
+        if self.engine.worker_axis is not None:
+            log.check(self.engine.worker_axis in mesh.axis_names,
+                      f"worker axis {self.engine.worker_axis!r} not in "
+                      f"new mesh")
+        self.po.barrier(customer_id, WORKER_GROUP)
+        try:
+            self.engine.reshard(mesh)
+            self.sparse_engine.reshard(mesh)
+        finally:
+            # Reach the resume barrier even on failure so peers are
+            # released to observe the error (a mid-recut exception
+            # leaves THIS process failed either way; hanging the whole
+            # cluster would hide it).
+            self.po.barrier(customer_id, WORKER_GROUP)
+
     def stop_transport(self) -> None:
         super().stop_transport()
         if self._dist_lease:

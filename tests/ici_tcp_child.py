@@ -64,6 +64,27 @@ def main() -> None:
         out_sp = np.zeros((4, 1, 4), np.float32)
         kv.wait(kv.pull_sparse("emb", idx, out=out_sp))
         np.testing.assert_allclose(out_sp, 8.0)
+
+        # Coordinated elastic recut over the LIVE cluster: both worker
+        # processes call kv.reshard with the same 4-device mesh (2 from
+        # each process); barriers ride the real TCP control plane, the
+        # collective snapshot rides jax.distributed.  State must
+        # survive and training continue on the new fan-in.
+        from jax.sharding import Mesh
+
+        devs = sorted(jax.devices(),
+                      key=lambda d: (d.process_index, d.id))
+        mesh4 = Mesh(np.array(devs[0:2] + devs[4:6]), ("kv",))
+        kv.reshard(mesh4)
+        assert eng.num_shards == 4, eng.num_shards
+        out2 = np.zeros_like(vals)
+        kv.wait(kv.pull(keys, out2))
+        np.testing.assert_allclose(out2, 24.0)
+        # Flat [total] broadcasts to my (now 2) local worker rows:
+        # sum adds 2*1 + 2*2 = 6 on top of the carried 24.
+        outs3 = np.zeros(4 * val_len, np.float32)
+        kv.wait(kv.push_pull(keys, vals, outs3))
+        np.testing.assert_allclose(outs3, 30.0)
         print(f"WORKER_OK {outs[0]}", flush=True)
     ps.finalize()
     print(f"{role} DONE", flush=True)
