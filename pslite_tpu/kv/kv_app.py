@@ -387,6 +387,25 @@ class KVWorker:
         with self._mu:
             return self._device_results.get(ts)
 
+    def replay(self, name: str, grads_seq, keep: str = "all"):
+        """Fused multi-step push_pull on a registered dense bucket: T
+        steps compiled into ONE program (engine.replay — lax.scan over
+        the donated store).  Returns the pulled results device-resident
+        (``[T, total]`` for keep="all", ``[total]`` for keep="last");
+        np.asarray materializes."""
+        log.check(self.engine is not None,
+                  "replay requires the collective engine (ICI van)")
+        return self.engine.replay(name, grads_seq, keep=keep)
+
+    def push_pull_stream(self, name: str, grads_iter, depth: int = 2):
+        """Host-origin streaming push_pull on a registered dense bucket:
+        host->HBM staging pipelined against the collectives
+        (engine.push_pull_stream).  Yields device-resident results."""
+        log.check(self.engine is not None,
+                  "push_pull_stream requires the collective engine "
+                  "(ICI van)")
+        return self.engine.push_pull_stream(name, grads_iter, depth=depth)
+
     def push_sparse(self, name: str, indices, grads,
                     callback=None) -> int:
         """Sparse push: [W, n] rows + [W, n, d] grads scatter-added into the

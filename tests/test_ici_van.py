@@ -224,3 +224,28 @@ def test_ici_shm_single_process_cluster():
         for s in servers:
             s.stop()
         c.finalize()
+
+
+def test_worker_level_replay_and_stream(cluster):
+    """KVWorker.replay / push_pull_stream surface the engine's
+    dispatch-amortization tiers at the app level."""
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.arange(4, dtype=np.uint64)
+    val_len = 64
+    worker.register_dense("amort", keys, val_len)
+    W = worker.engine.num_shards
+    total = 4 * val_len
+
+    # replay: T fused steps of sum-of-ones == step * W broadcast.
+    T = 3
+    seq = np.ones((T, total), np.float32)
+    pulled = np.asarray(worker.replay("amort", seq))
+    assert pulled.shape == (T, total)
+    for t in range(T):
+        np.testing.assert_allclose(pulled[t], (t + 1) * W)
+
+    # stream continues from the replayed store.
+    outs = [np.asarray(o) for o in
+            worker.push_pull_stream("amort", iter(seq))]
+    assert len(outs) == T
+    np.testing.assert_allclose(outs[-1], 2 * T * W)
