@@ -60,6 +60,26 @@ class DenseBucket:
 ServerHandle = Union[str, Callable]
 
 
+def _pad_ring_chunks(g, s, kchunk: int, chunk0: int):
+    """Pad per-ring-position grads [n, chunk0] and store [chunk0] (or a
+    pre-padded store passed as None) up to the kernel tile chunk."""
+    import jax.numpy as jnp
+
+    if kchunk == chunk0:
+        return g, s
+    g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
+    if s is not None:
+        s = jnp.pad(s, (0, kchunk - chunk0))
+    return g, s
+
+
+def _slice_ring_pulled(pulled, n: int, kchunk: int, chunk0: int):
+    """Drop the kernel tile padding from a pulled [n*kchunk] vector."""
+    if kchunk == chunk0:
+        return pulled
+    return pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
+
+
 def _aggregate(grads_l, axis, worker_axis=None):
     """Worker-reduction of a local grads block — psum_scatter on the 1-D
     colocated layout (reduce+shard in one hop), psum over the worker axis
@@ -248,6 +268,14 @@ class CollectiveEngine:
         return self._buckets[name]
 
     # -- compiled programs ---------------------------------------------------
+
+    def _resolved_handle_fn(self, handle_key) -> Callable:
+        """The handle fn for a program cache key ("_default" resolves to
+        the engine's configured server handle) — the one definition of
+        that sentinel rule."""
+        return self._handle_fn(
+            self._server_handle if handle_key == "_default" else handle_key
+        )
 
     def _handle_fn(self, handle: ServerHandle) -> Callable:
         """Server-side update applied to (store_shard, aggregated_grads)."""
@@ -512,9 +540,7 @@ class CollectiveEngine:
             ring_push_pull,
         )
 
-        handle = self._handle_fn(
-            self._server_handle if handle_key == "_default" else handle_key
-        )
+        handle = self._resolved_handle_fn(handle_key)
         axis = self.axis
         n = self.num_shards
         chunk0 = padded_len // n
@@ -522,12 +548,9 @@ class CollectiveEngine:
         cid = derive_collective_id(*key)
 
         def _padded(store_l, grads_l):
-            g = grads_l[0].reshape(n, chunk0)
-            s = store_l
-            if kchunk != chunk0:
-                g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
-                s = jnp.pad(s, (0, kchunk - chunk0))
-            return g, s
+            return _pad_ring_chunks(
+                grads_l[0].reshape(n, chunk0), store_l, kchunk, chunk0
+            )
 
         def body_pp(store_l, grads_l):
             g, s = _padded(store_l, grads_l)
@@ -537,7 +560,7 @@ class CollectiveEngine:
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
-                pulled = pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
+            pulled = _slice_ring_pulled(pulled, n, kchunk, chunk0)
             return new, pulled
 
         def body_push(store_l, grads_l):
@@ -584,9 +607,7 @@ class CollectiveEngine:
 
         from ..ops.ring_collective import derive_collective_id
 
-        handle = self._handle_fn(
-            self._server_handle if handle_key == "_default" else handle_key
-        )
+        handle = self._resolved_handle_fn(handle_key)
         axis = self.axis
         cid = derive_collective_id(*key)
         _updated_shard = self._ring_2d_shard_fn(
@@ -1027,9 +1048,7 @@ class CollectiveEngine:
 
         axis = self.axis
         waxis = self.worker_axis
-        handle = self._handle_fn(
-            self._server_handle if handle_key == "_default" else handle_key
-        )
+        handle = self._resolved_handle_fn(handle_key)
         k = len(shapes_key)
         store_spec = P(axis)
         grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
@@ -1056,11 +1075,9 @@ class CollectiveEngine:
             chunk0 = padded_len // n
             kchunk = ring_chunk_len(padded_len, n, dtype,
                                     compress=compress)
-            g = grads_l[0].reshape(n, chunk0)
-            s = store_l
-            if kchunk != chunk0:
-                g = jnp.pad(g, ((0, 0), (0, kchunk - chunk0)))
-                s = jnp.pad(s, (0, kchunk - chunk0))
+            g, s = _pad_ring_chunks(
+                grads_l[0].reshape(n, chunk0), store_l, kchunk, chunk0
+            )
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n,
                 collective_id=cid,
@@ -1068,7 +1085,7 @@ class CollectiveEngine:
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
-                pulled = pulled.reshape(n, kchunk)[:, :chunk0].reshape(-1)
+            pulled = _slice_ring_pulled(pulled, n, kchunk, chunk0)
             return new, pulled
 
         def _body(*args):
@@ -1288,13 +1305,34 @@ class CollectiveEngine:
     def _replay_program(self, steps: int, padded_len: int, dtype,
                         handle_key, keep: str, stateful: bool) -> Callable:
         """Jitted T-step scan program; cached per (T, shape, dtype,
-        handle, keep) like every other engine executable."""
+        handle, keep) like every other engine executable.
+
+        Stateless replays on a qualifying pallas config scan the FUSED
+        RING step (the steady-state persistent program: T ring
+        collectives with VMEM updates, one dispatch); everything else
+        scans the XLA collective step."""
+        resolved = (
+            self._server_handle if handle_key == "_default" else handle_key
+        )
+        # Wire compression stays off the replay ring: scanning the
+        # per-hop-requantizing kernel is unvalidatable off-TPU (the
+        # interpreter takes minutes per step) and compounds quantization
+        # error T-fold; compressed configs replay on the XLA step while
+        # their single-step/grouped ops keep the compressed ring.
+        use_ring = (
+            not stateful
+            and self._effective_impl(dtype, resolved) == "pallas"
+            and not self._ring_compress(dtype)
+        )
         key = ("replay", steps, padded_len, str(dtype), handle_key, keep,
-               stateful)
+               stateful, use_ring)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
             return prog
+        if use_ring:
+            return self._replay_ring_program(key, padded_len, dtype,
+                                             handle_key, keep)
 
         import jax
         from jax import lax
@@ -1340,10 +1378,7 @@ class CollectiveEngine:
             )
             jitted = jax.jit(fn, donate_argnums=tuple(range(1 + n_state)))
         else:
-            handle = self._handle_fn(
-                self._server_handle if handle_key == "_default"
-                else handle_key
-            )
+            handle = self._resolved_handle_fn(handle_key)
 
             def _body(store_l, grads_l):
                 # grads_l: [T, 1, padded] (my worker row per step).
@@ -1373,6 +1408,102 @@ class CollectiveEngine:
                 ),
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
+        with self._mu:
+            self._programs[key] = jitted
+        return jitted
+
+    def _replay_ring_program(self, key, padded_len: int, dtype,
+                             handle_key, keep: str) -> Callable:
+        """T-step scan over the FUSED RING step: each iteration runs the
+        ring RS + VMEM update (+ ring AG for keep="all") kernel; the
+        collective_id is safely reused because scan iterations execute
+        sequentially in SPMD lockstep and the kernel drains every
+        semaphore to zero at exit.  keep="last" scans the push-only
+        ring and gathers once at the end (the T×ZPush + pull shape)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.ring_collective import (
+            derive_collective_id,
+            ring_chunk_len,
+            ring_push,
+            ring_push_pull,
+        )
+
+        handle = self._resolved_handle_fn(handle_key)
+        axis = self.axis
+        waxis = self.worker_axis
+        compress = self._ring_compress(dtype)
+        cid = derive_collective_id(*key)
+        store_spec = P(axis)
+
+        if waxis is not None:
+            shard_fn = self._ring_2d_shard_fn(
+                handle, padded_len, dtype, compress, cid
+            )
+
+            def _body(store_l, grads_l):
+                def step(carry, g):
+                    new = shard_fn(carry, g)
+                    out = (
+                        lax.all_gather(new, axis, tiled=True)
+                        if keep == "all" else 0.0
+                    )
+                    return new, out
+
+                new_store, outs = lax.scan(step, store_l, grads_l)
+                if keep == "last":
+                    outs = lax.all_gather(new_store, axis, tiled=True)
+                return new_store, outs
+
+            grads_spec = P(None, waxis, axis)
+        else:
+            n = self.num_shards
+            chunk0 = padded_len // n
+            kchunk = ring_chunk_len(padded_len, n, dtype,
+                                    compress=compress)
+
+            def _body(store_l, grads_l):
+                s = store_l
+                if kchunk != chunk0:
+                    s = jnp.pad(s, (0, kchunk - chunk0))
+
+                def step(carry, g):
+                    gr, _ = _pad_ring_chunks(
+                        g[0].reshape(n, chunk0), None, kchunk, chunk0
+                    )
+                    if keep == "all":
+                        new, pulled = ring_push_pull(
+                            gr, carry, handle, axis, n,
+                            collective_id=cid, compress=compress,
+                        )
+                        return new, _slice_ring_pulled(
+                            pulled, n, kchunk, chunk0
+                        )
+                    new = ring_push(gr, carry, handle, axis, n,
+                                    collective_id=cid, compress=compress)
+                    return new, 0.0
+
+                s, outs = lax.scan(step, s, grads_l)
+                s_out = s[:chunk0] if kchunk != chunk0 else s
+                if keep == "last":
+                    outs = lax.all_gather(s_out, axis, tiled=True)
+                return s_out, outs
+
+            grads_spec = P(None, axis, None)
+
+        fn = shard_map(
+            _body,
+            mesh=self.mesh,
+            in_specs=(store_spec, grads_spec),
+            out_specs=(
+                store_spec,
+                P(None, None) if keep == "all" else P(None),
+            ),
+        )
+        jitted = jax.jit(fn, donate_argnums=(0,))
         with self._mu:
             self._programs[key] = jitted
         return jitted

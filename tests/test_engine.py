@@ -767,3 +767,73 @@ def test_push_pull_stream_overlaps_staging_latency(mesh):
             f"no pipelining at step {i}: pull(i+1)="
             f"{pulled_at[i + 1]:.3f} >= done(i)={done_at[i]:.3f}"
         )
+
+
+@pytest.mark.parametrize("keep", ["all", "last"])
+def test_replay_ring_matches_xla(mesh, keep):
+    """Stateless replay on the pallas impl scans the fused ring step;
+    it must match the XLA-scan replay exactly (1-D mesh)."""
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 300  # padded, non-tile-aligned chunks
+    rng = np.random.default_rng(57)
+    T = 3
+    seq = rng.normal(size=(T, 8, 2 * val_len)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh, impl="xla")
+    ref.register_dense("rr_ref", keys, val_len)
+    want = np.asarray(ref.replay("rr_ref", seq, keep=keep))
+
+    eng = CollectiveEngine(mesh=mesh, impl="pallas")
+    eng.register_dense("rr", keys, val_len)
+    got = np.asarray(eng.replay("rr", seq, keep=keep))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # Stores advanced identically.
+    np.testing.assert_allclose(
+        np.asarray(eng.pull("rr")), np.asarray(ref.pull("rr_ref")),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("keep", ["all", "last"])
+def test_replay_ring_two_axis(keep):
+    """Ring replay on the 2-D torus: dp sub-ring step inside the scan,
+    both keep modes (last = sub-ring pushes + one final kv gather)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 200
+    rng = np.random.default_rng(59)
+    T = 3
+    seq = rng.normal(size=(T, 2, 2 * val_len)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="xla")
+    ref.register_dense("r2_ref", keys, val_len)
+    want = np.asarray(ref.replay("r2_ref", seq, keep=keep))
+
+    eng = CollectiveEngine(mesh=mesh2, worker_axis="dp", impl="pallas")
+    eng.register_dense("r2", keys, val_len)
+    got = np.asarray(eng.replay("r2", seq, keep=keep))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_replay_compressed_config_falls_back_to_xla():
+    """wire_compress engines replay on the XLA step (the compressed ring
+    stays single-step/grouped — see _replay_program): results are exact,
+    not quantized."""
+    mesh1 = default_mesh()
+    eng = CollectiveEngine(mesh=mesh1, impl="pallas",
+                           wire_compress="int8")
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 4096
+    eng.register_dense("rc", keys, val_len)
+    rng = np.random.default_rng(61)
+    T = 2
+    seq = rng.normal(size=(T, 8, 2 * val_len)).astype(np.float32)
+    pulled = np.asarray(eng.replay("rc", seq))
+    acc = np.zeros(2 * val_len, np.float32)
+    for t in range(T):
+        acc = acc + seq[t].sum(axis=0)
+        # Exact (rtol only): the XLA path carries full precision.
+        np.testing.assert_allclose(pulled[t], acc, rtol=1e-5, atol=1e-5)
