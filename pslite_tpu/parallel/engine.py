@@ -175,6 +175,11 @@ class CollectiveEngine:
         self.impl = impl or os.environ.get("PS_ICI_IMPL", "xla")
         log.check(self.impl in ("xla", "pallas"),
                   f"unknown engine impl {self.impl!r}")
+        # Per-step payload threshold for the flat replay slab layout
+        # (see _flat_replay); tunable for tests / unusual chips.
+        self.replay_flat_min_bytes = int(
+            os.environ.get("PS_REPLAY_FLAT_MIN_BYTES", 1 << 20)
+        )
         # Wire compression on the ring data plane (pallas impl only):
         # "int8" quantizes every hop payload with an embedded absmax
         # scale — 4x fewer ICI bytes, lossy (the reference's int8 wire
@@ -388,7 +393,7 @@ class CollectiveEngine:
 
         axis = self.axis
         mesh = self.mesh
-        if op in ("push_st", "push_pull_st"):
+        if op in ("push_st", "push_pull_st", "push_pull_st_zc"):
             return self._stateful_program(op, key, handle_key)
         if op in ("pull", "pull_pinned"):
             handle = None  # pull is read-only; no server update to fuse
@@ -404,6 +409,16 @@ class CollectiveEngine:
         def _push_pull(store_l, grads_l):
             # grads_l: [1, padded]; reduce-scatter across workers => my shard
             return _rs_update_ag(store_l, grads_l, handle, axis, waxis)
+
+        def _push_pull_zc(store_l, grads_l):
+            # In-place pull delivery (kv axis size 1: the gather is the
+            # identity, so the updated store IS the pulled value).  The
+            # copy-free analog of the reference's RegisterRecvBuffer
+            # delivery (rdma_van.h:520-548): without it XLA must give the
+            # second output its own buffer — a full read+write that was
+            # 40% of the headline's device time (r03 verdict, weak #1).
+            agg = _aggregate(grads_l, axis, waxis)
+            return handle(store_l, agg)
 
         def _push(store_l, grads_l):
             agg = _aggregate(grads_l, axis, waxis)
@@ -436,6 +451,14 @@ class CollectiveEngine:
                 mesh=mesh,
                 in_specs=(store_spec, grads_spec),
                 out_specs=(store_spec, repl_spec),
+            )
+            jitted = jax.jit(fn, donate_argnums=(0,))
+        elif op == "push_pull_zc":
+            fn = shard_map(
+                _push_pull_zc,
+                mesh=mesh,
+                in_specs=(store_spec, grads_spec),
+                out_specs=store_spec,
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
         elif op == "push":
@@ -709,13 +732,24 @@ class CollectiveEngine:
             pulled = lax.all_gather(new_store, axis, tiled=True)
             return (new_store, *new_state, pulled)
 
-        body = _push if op == "push_st" else _push_pull
-        tail_spec = store_spec if op == "push_st" else repl_spec
+        def _push_pull_zc(store_l, *rest):
+            # In-place pull delivery: see _program's _push_pull_zc.
+            state_l, grads_l = rest[:-1], rest[-1]
+            agg = _aggregate(grads_l, axis, waxis)
+            new_store, new_state = sfn(store_l, tuple(state_l), agg)
+            return (new_store, *new_state)
+
+        if op == "push_st":
+            body, tails = _push, (store_spec,)
+        elif op == "push_pull_st_zc":
+            body, tails = _push_pull_zc, ()
+        else:
+            body, tails = _push_pull, (repl_spec,)
         fn = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(store_spec, *([store_spec] * n_state), grads_spec),
-            out_specs=(store_spec, *([store_spec] * n_state), tail_spec),
+            out_specs=(store_spec, *([store_spec] * n_state), *tails),
         )
         jitted = jax.jit(fn, donate_argnums=tuple(range(1 + n_state)))
         with self._mu:
@@ -904,40 +938,76 @@ class CollectiveEngine:
             return resolved, resolved  # stateful handles key by full string
         return resolved, ("_default" if handle is None else handle)
 
-    def push_pull(self, name: str, grads, handle: Optional[ServerHandle] = None):
+    def _zc_pull_eligible(self, dtype, resolved) -> bool:
+        """Whether in-place pull delivery can serve this config: the kv
+        axis has size 1 (the all-gather is the identity, so the updated
+        store IS the pulled value — and ``padded_len == total_len``), and
+        the data plane is the XLA path (the ring kernel needs >=2 ring
+        devices and defines its own output layout).  Mirrors the
+        reference's RegisterRecvBuffer: in-place delivery happens where
+        the transport allows it, transparently copied elsewhere."""
+        if self.num_shards != 1:
+            return False
+        if self._is_stateful(resolved):
+            return True
+        return self._effective_impl(dtype, resolved) == "xla"
+
+    def push_pull(self, name: str, grads, handle: Optional[ServerHandle] = None,
+                  zero_copy: bool = False):
         """Fused push+aggregate+update+pull; returns the replicated pulled
-        array (async).  The benchmark hot path (SURVEY §3.2)."""
+        array (async).  The benchmark hot path (SURVEY §3.2).
+
+        ``zero_copy=True`` requests in-place pull delivery: where the
+        topology allows it (see :meth:`_zc_pull_eligible`) the returned
+        array ALIASES the bucket store — zero extra HBM traffic, but it
+        is invalidated by the bucket's next mutating op (the next push
+        donates the buffer; stale holders raise on use rather than read
+        torn data).  Same caller contract as the reference's
+        RegisterRecvBuffer pulls (the next pull overwrites the registered
+        buffer in place).  Configs the in-place path cannot serve fall
+        back to the copying path transparently."""
         t0 = time.perf_counter()
         bucket = self._buckets[name]
         resolved, handle_key = self._resolve_handle(handle)
+        zc = zero_copy and self._zc_pull_eligible(bucket.dtype, resolved)
         g = self._prep_grads(bucket, grads)
         if self._is_stateful(resolved):
             prog = self._program(
-                "push_pull_st", bucket.padded_len, bucket.dtype, handle_key
+                "push_pull_st_zc" if zc else "push_pull_st",
+                bucket.padded_len, bucket.dtype, handle_key
             )
             with self._bucket_mu[name]:
                 self._ensure_opt_state(name, resolved, bucket)
                 outs = prog(
                     self._stores[name], *self._opt_states[name], g
                 )
+                n_state = len(self._opt_states[name])
                 self._stores[name] = outs[0]
-                self._opt_states[name] = tuple(outs[1:-1])
-                pulled = outs[-1]
+                self._opt_states[name] = tuple(outs[1:1 + n_state])
+                pulled = outs[0] if zc else outs[-1]
             self._observe(name, "push_pull", bucket, t0)
-            return pulled[: bucket.total_len]
+            return pulled if zc else pulled[: bucket.total_len]
         if self._effective_impl(bucket.dtype, resolved) == "pallas":
             prog = self._ring_program(
                 bucket.padded_len, bucket.dtype, handle_key
+            )
+        elif zc:
+            prog = self._program(
+                "push_pull_zc", bucket.padded_len, bucket.dtype, handle_key
             )
         else:
             prog = self._program(
                 "push_pull", bucket.padded_len, bucket.dtype, handle_key
             )
         with self._bucket_mu[name]:
-            new_store, pulled = prog(self._stores[name], g)
+            if zc:
+                new_store = prog(self._stores[name], g)
+                pulled = new_store
+            else:
+                new_store, pulled = prog(self._stores[name], g)
             self._stores[name] = new_store
         self._observe(name, "push_pull", bucket, t0)
-        return pulled[: bucket.total_len]
+        return pulled if zc else pulled[: bucket.total_len]
 
     def push(self, name: str, grads, handle: Optional[ServerHandle] = None):
         t0 = time.perf_counter()
@@ -1117,7 +1187,7 @@ class CollectiveEngine:
     # -- fused multi-step replay --------------------------------------------
 
     def replay(self, name: str, grads_seq, handle: Optional[ServerHandle] = None,
-               keep: str = "all"):
+               keep: str = "all", zero_copy: bool = False):
         """Run T consecutive ``push_pull`` steps as ONE jitted program —
         a ``lax.scan`` over the donated store (and optimizer state for
         stateful handles), so the per-op Python+dispatch cost (~50-100 µs,
@@ -1139,33 +1209,47 @@ class CollectiveEngine:
             pulled vector ``[total]`` — intermediate all-gathers are
             dead code XLA removes, making it the fused form of
             T×ZPush + one pull.
+          zero_copy: with ``keep="last"`` on a zc-eligible config (see
+            :meth:`push_pull`), skip the final gather and return the
+            store itself — invalidated by the bucket's next mutating op.
         """
         log.check(keep in ("all", "last"), f"bad keep {keep!r}")
         t0 = time.perf_counter()
         bucket = self._buckets[name]
         resolved, handle_key = self._resolve_handle(handle)
-        g = self._prep_grads_seq(bucket, grads_seq)
-        steps = int(g.shape[0])
-        if self._is_stateful(resolved):
+        stateful = self._is_stateful(resolved)
+        zc = (zero_copy and keep == "last"
+              and self._zc_pull_eligible(bucket.dtype, resolved))
+        steps = int(np.shape(grads_seq)[0])
+        flat = self._flat_replay(
+            bucket.padded_len, bucket.dtype, handle_key, stateful, steps
+        )
+        g = self._prep_grads_seq(bucket, grads_seq, flat=flat)
+        if stateful:
             prog = self._replay_program(
                 steps, bucket.padded_len, bucket.dtype, handle_key, keep,
-                stateful=True,
+                stateful=True, zero_copy=zc,
             )
             with self._bucket_mu[name]:
                 self._ensure_opt_state(name, resolved, bucket)
                 outs = prog(
                     self._stores[name], *self._opt_states[name], g
                 )
+                n_state = len(self._opt_states[name])
                 self._stores[name] = outs[0]
-                self._opt_states[name] = tuple(outs[1:-1])
-                pulled = outs[-1]
+                self._opt_states[name] = tuple(outs[1:1 + n_state])
+                pulled = outs[0] if zc else outs[-1]
         else:
             prog = self._replay_program(
                 steps, bucket.padded_len, bucket.dtype, handle_key, keep,
-                stateful=False,
+                stateful=False, zero_copy=zc,
             )
             with self._bucket_mu[name]:
-                new_store, pulled = prog(self._stores[name], g)
+                if zc:
+                    new_store = prog(self._stores[name], g)
+                    pulled = new_store
+                else:
+                    new_store, pulled = prog(self._stores[name], g)
                 self._stores[name] = new_store
         payload = bucket.total_len * np.dtype(bucket.dtype).itemsize
         with self._counter_mu:
@@ -1177,6 +1261,8 @@ class CollectiveEngine:
             dur_us = int((time.perf_counter() - t0) * 1e6)
             nbytes = payload * (steps + (steps if keep == "all" else 1))
             self.profiler.record_engine(name, "replay", nbytes, dur_us)
+        if zc:
+            return pulled  # aliases the store; padded == total on zc configs
         if keep == "all":
             return pulled[:, : bucket.total_len]
         return pulled[: bucket.total_len]
@@ -1263,13 +1349,61 @@ class CollectiveEngine:
                 pass
             t.join(timeout=30)
 
-    def _prep_grads_seq(self, bucket: DenseBucket, grads_seq):
+    def _prep_grads_seq(self, bucket: DenseBucket, grads_seq,
+                        flat: bool = False):
         """[T, W, padded] device array sharded like the grads of T
-        stacked push calls (leading step axis replicated)."""
+        stacked push calls (leading step axis replicated) — or, with
+        ``flat=True`` (1-D layouts only, see :meth:`_flat_replay`), the
+        slab layout ``[W, T*padded]`` where worker w's T steps are one
+        contiguous run."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if flat:
+            log.check(self.worker_axis is None,
+                      "flat replay layout is 1-D only")
+            sharding = NamedSharding(self.mesh, P(self.axis, None))
+
+            def _to_slab(arr, xp):
+                # [T, rows, padded] -> [rows, T*padded]; note shape[1] is
+                # read from the PRE-swap array (the row count).  No
+                # pre-slabbed fast path: a [W, T*padded] slab is
+                # indistinguishable from a broadcast [T, total] whenever
+                # T == W and total % padded == 0, and guessing wrong
+                # silently collapses T steps into one.
+                rows = arr.shape[1]
+                arr = xp.swapaxes(arr, 0, 1)
+                if xp is np:
+                    arr = np.ascontiguousarray(arr)
+                return arr.reshape(rows, -1)
+
+            if self._is_multiprocess():
+                arr = self._normalize_host_grads(
+                    grads_seq, self._local_shards(), bucket, np, steps=True,
+                    row_msg="bad local worker dim (rows = this process's "
+                            "devices on a multi-process mesh)",
+                )
+                arr = _to_slab(arr, np)
+                return jax.make_array_from_process_local_data(
+                    sharding, arr,
+                    (self.num_shards, arr.shape[1]),
+                )
+            if isinstance(grads_seq, jax.Array):
+                # Device arrays must relayout on device (tiled 2-D rows
+                # are physically interleaved; slabs need contiguity).
+                arr = self._normalize_host_grads(
+                    grads_seq, self.num_shards, bucket, jnp, steps=True
+                )
+                return jax.device_put(_to_slab(arr, jnp), sharding)
+            # Host arrays: build the slab layout host-side (free views
+            # for W=1, one transpose copy otherwise) so the device sees
+            # ONE transfer and ZERO relayout copies — the relayouts were
+            # ~68% of the replay's device time when done on device.
+            arr = self._normalize_host_grads(
+                grads_seq, self.num_shards, bucket, np, steps=True
+            )
+            return jax.device_put(_to_slab(arr, np), sharding)
         if self.worker_axis is not None:
             sharding = NamedSharding(
                 self.mesh, P(None, self.worker_axis, self.axis)
@@ -1302,30 +1436,66 @@ class CollectiveEngine:
         )
         return jax.device_put(arr, sharding)
 
+    def _replay_use_ring(self, dtype, handle_key, stateful: bool) -> bool:
+        """Whether a replay scans the fused ring step.  Wire compression
+        stays off the replay ring: scanning the per-hop-requantizing
+        kernel is unvalidatable off-TPU (the interpreter takes minutes
+        per step) and compounds quantization error T-fold; compressed
+        configs replay on the XLA step while their single-step/grouped
+        ops keep the compressed ring."""
+        resolved = (
+            self._server_handle if handle_key == "_default" else handle_key
+        )
+        return (
+            not stateful
+            and self._effective_impl(dtype, resolved) == "pallas"
+            and not self._ring_compress(dtype)
+        )
+
+    def _flat_replay(self, padded_len: int, dtype, handle_key,
+                     stateful: bool, steps: int) -> bool:
+        """Whether the replay sequence uses the FLAT slab layout
+        ``[W, T*padded]`` (each worker's T steps contiguous) instead of
+        the stacked ``[T, W, padded]``.
+
+        The stacked form makes XLA slice step t out of a sublane-tiled
+        ``[T, padded]`` block — a strided read that measured ~190 GB/s on
+        a 685 GB/s chip and caused the r03 16MB replay cliff (112 vs 314
+        GB/s at 1MB) — plus two full relayout copies of the whole
+        sequence on entry.  Flat slabs make each step an aligned
+        contiguous ``dynamic_slice`` that fuses with the update (measured
+        ~674 GB/s at 16MB).  Below ~1MB per step XLA's software pipelining
+        of the stacked layout wins instead (it stages slices into VMEM
+        ahead of use), so small buckets keep the stacked form."""
+        return (
+            not stateful
+            and self.worker_axis is None
+            and not self._replay_use_ring(dtype, handle_key, stateful)
+            and padded_len * np.dtype(dtype).itemsize
+            >= self.replay_flat_min_bytes
+            # Slab offsets are int32 inside the scan; a slab at or over
+            # 2^31 elements would wrap (dynamic_slice clamps silently).
+            and steps * padded_len < (1 << 31)
+        )
+
     def _replay_program(self, steps: int, padded_len: int, dtype,
-                        handle_key, keep: str, stateful: bool) -> Callable:
+                        handle_key, keep: str, stateful: bool,
+                        zero_copy: bool = False) -> Callable:
         """Jitted T-step scan program; cached per (T, shape, dtype,
         handle, keep) like every other engine executable.
 
         Stateless replays on a qualifying pallas config scan the FUSED
         RING step (the steady-state persistent program: T ring
         collectives with VMEM updates, one dispatch); everything else
-        scans the XLA collective step."""
-        resolved = (
-            self._server_handle if handle_key == "_default" else handle_key
-        )
-        # Wire compression stays off the replay ring: scanning the
-        # per-hop-requantizing kernel is unvalidatable off-TPU (the
-        # interpreter takes minutes per step) and compounds quantization
-        # error T-fold; compressed configs replay on the XLA step while
-        # their single-step/grouped ops keep the compressed ring.
-        use_ring = (
-            not stateful
-            and self._effective_impl(dtype, resolved) == "pallas"
-            and not self._ring_compress(dtype)
-        )
+        scans the XLA collective step.  ``zero_copy`` (only meaningful
+        with ``keep="last"`` on a zc-eligible config, see
+        :meth:`_zc_pull_eligible`) skips the final all-gather and returns
+        the store as the pulled value."""
+        use_ring = self._replay_use_ring(dtype, handle_key, stateful)
+        flat = self._flat_replay(padded_len, dtype, handle_key, stateful,
+                                 steps)
         key = ("replay", steps, padded_len, str(dtype), handle_key, keep,
-               stateful, use_ring)
+               stateful, use_ring, flat, zero_copy)
         with self._mu:
             prog = self._programs.get(key)
         if prog is not None:
@@ -1364,48 +1534,81 @@ class CollectiveEngine:
                     step, (store_l, *state_l), grads_l[:, 0]
                 )
                 if keep == "last":
+                    if zero_copy:
+                        return carry
                     outs = lax.all_gather(carry[0], axis, tiled=True)
                 return (*carry, outs)
 
+            tails = () if (keep == "last" and zero_copy) else (
+                (P(None, None),) if keep == "all" else (P(None),)
+            )
             fn = shard_map(
                 _body,
                 mesh=self.mesh,
                 in_specs=(store_spec, *([store_spec] * n_state), grads_spec),
-                out_specs=(
-                    store_spec, *([store_spec] * n_state),
-                    P(None, None) if keep == "all" else P(None),
-                ),
+                out_specs=(store_spec, *([store_spec] * n_state), *tails),
             )
             jitted = jax.jit(fn, donate_argnums=tuple(range(1 + n_state)))
         else:
+            import jax.numpy as jnp
+
             handle = self._resolved_handle_fn(handle_key)
 
-            def _body(store_l, grads_l):
-                # grads_l: [T, 1, padded] (my worker row per step).
-                def step(carry, g):
-                    agg = _aggregate([g], axis, waxis)
-                    new_store = handle(carry, agg)
-                    out = (
-                        lax.all_gather(new_store, axis, tiled=True)
-                        if keep == "all" else 0.0
-                    )
-                    return new_store, out
+            def _step_out(new_store):
+                if keep == "all":
+                    return lax.all_gather(new_store, axis, tiled=True)
+                return 0.0
 
-                new_store, outs = lax.scan(
-                    step, store_l, grads_l[:, 0]
-                )
+            def _finish(new_store, outs):
                 if keep == "last":
+                    if zero_copy:
+                        return new_store
                     outs = lax.all_gather(new_store, axis, tiled=True)
                 return new_store, outs
 
+            if flat:
+                def _body(store_l, grads_l):
+                    # grads_l: [1, T*padded] — my T slabs, contiguous, so
+                    # each step is an aligned dynamic_slice that fuses
+                    # with the update (see _flat_replay).
+                    seq = grads_l[0]
+
+                    def step(carry, t):
+                        g = lax.dynamic_slice(
+                            seq, (t * padded_len,), (padded_len,)
+                        )
+                        new_store = handle(carry, _aggregate([g], axis, waxis))
+                        return new_store, _step_out(new_store)
+
+                    new_store, outs = lax.scan(
+                        step, store_l, jnp.arange(steps, dtype=jnp.int32)
+                    )
+                    return _finish(new_store, outs)
+
+                grads_in_spec = P(axis, None)
+            else:
+                def _body(store_l, grads_l):
+                    # grads_l: [T, 1, padded] (my worker row per step).
+                    def step(carry, g):
+                        new_store = handle(carry, _aggregate([g], axis, waxis))
+                        return new_store, _step_out(new_store)
+
+                    new_store, outs = lax.scan(step, store_l, grads_l[:, 0])
+                    return _finish(new_store, outs)
+
+                grads_in_spec = grads_spec
+
+            if keep == "last" and zero_copy:
+                out_specs = store_spec
+            elif keep == "all":
+                out_specs = (store_spec, P(None, None))
+            else:
+                out_specs = (store_spec, P(None))
             fn = shard_map(
                 _body,
                 mesh=self.mesh,
-                in_specs=(store_spec, grads_spec),
-                out_specs=(
-                    store_spec,
-                    P(None, None) if keep == "all" else P(None),
-                ),
+                in_specs=(store_spec, grads_in_spec),
+                out_specs=out_specs,
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
         with self._mu:

@@ -119,6 +119,85 @@ def plane_busy_ps(path: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+def _line_op_ps(line_buf: memoryview) -> Tuple[str, Dict[int, int]]:
+    """(line_name, {metadata_id: summed duration_ps}) for one XLine."""
+    name = ""
+    per_md: Dict[int, int] = {}
+    for fnum, wt, val in _fields(line_buf):
+        if fnum == 2 and wt == 2:
+            name = bytes(val).decode("utf-8", "replace")
+        elif fnum == 4 and wt == 2:
+            md = dur = 0
+            for efn, ewt, ev in _fields(val):
+                if efn == 1 and ewt == 0:
+                    md = ev
+                elif efn == 3 and ewt == 0:
+                    dur = ev
+            if dur > 0:
+                per_md[md] = per_md.get(md, 0) + dur
+    return name, per_md
+
+
+def plane_op_ps(path: str) -> Dict[str, Dict[str, int]]:
+    """{plane_name: {op_name: total duration_ps}} over "XLA Ops" lines.
+
+    Op names come from the plane's event_metadata map (XPlane field 4:
+    map<int64, XEventMetadata>, XEventMetadata{id=1, name=2}).  Durations
+    are SUMMED per op (not interval-unioned): the per-op split is a
+    where-does-the-time-go diagnostic, so overlap within one op name is
+    attributed to it in full.
+    """
+    with open(path, "rb") as fh:
+        space = memoryview(fh.read())
+    out: Dict[str, Dict[str, int]] = {}
+    for fnum, wt, plane in _fields(space):
+        if fnum != 1 or wt != 2:
+            continue
+        pname = ""
+        md_names: Dict[int, str] = {}
+        op_lines: List[Dict[int, int]] = []
+        for pfn, pwt, val in _fields(plane):
+            if pfn == 2 and pwt == 2:
+                pname = bytes(val).decode("utf-8", "replace")
+            elif pfn == 3 and pwt == 2:
+                lname, per_md = _line_op_ps(val)
+                if lname == "XLA Ops" and per_md:
+                    op_lines.append(per_md)
+            elif pfn == 4 and pwt == 2:
+                mid = 0
+                mname = ""
+                for mfn, mwt, mv in _fields(val):
+                    if mfn == 1 and mwt == 0:
+                        mid = mv
+                    elif mfn == 2 and mwt == 2:
+                        for efn, ewt, ev in _fields(mv):
+                            if efn == 2 and ewt == 2:
+                                mname = bytes(ev).decode("utf-8", "replace")
+                md_names[mid] = mname
+        if not op_lines:
+            continue
+        ops: Dict[str, int] = {}
+        for per_md in op_lines:
+            for mid, ps in per_md.items():
+                nm = md_names.get(mid, f"metadata_{mid}")
+                ops[nm] = ops.get(nm, 0) + ps
+        out[pname] = ops
+    return out
+
+
+def device_op_seconds(logdir: str) -> Dict[str, float]:
+    """{op_name: device-seconds} summed over all TPU planes in a trace
+    dir — the op-level complement of :func:`device_busy_seconds`."""
+    totals: Dict[str, float] = {}
+    for path in find_xplane_files(logdir):
+        for pname, ops in plane_op_ps(path).items():
+            if "TPU" not in pname or "SparseCore" in pname:
+                continue
+            for nm, ps in ops.items():
+                totals[nm] = totals.get(nm, 0.0) + ps / 1e12
+    return totals
+
+
 def find_xplane_files(logdir: str) -> List[str]:
     hits = []
     for root, _dirs, files in os.walk(logdir):

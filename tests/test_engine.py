@@ -837,3 +837,127 @@ def test_replay_compressed_config_falls_back_to_xla():
         acc = acc + seq[t].sum(axis=0)
         # Exact (rtol only): the XLA path carries full precision.
         np.testing.assert_allclose(pulled[t], acc, rtol=1e-5, atol=1e-5)
+
+
+def test_push_pull_zero_copy_single_device():
+    """In-place pull delivery on a degenerate gather (kv axis size 1):
+    values match the copying path, the returned array IS the store, and
+    the next mutating op invalidates stale holders (the reference's
+    RegisterRecvBuffer contract: the next pull overwrites the registered
+    buffer in place, rdma_van.h:520-548)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh1 = make_mesh((1,), ("kv",))
+    keys = np.arange(3, dtype=np.uint64)
+    rng = np.random.default_rng(71)
+    g1 = rng.normal(size=(1, 300)).astype(np.float32)
+    g2 = rng.normal(size=(1, 300)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh1)
+    ref.register_dense("zr", keys, 100)
+    exp1 = np.asarray(ref.push_pull("zr", g1))
+    exp2 = np.asarray(ref.push_pull("zr", g2))
+
+    eng = CollectiveEngine(mesh=mesh1)
+    eng.register_dense("zc", keys, 100)
+    out1 = eng.push_pull("zc", g1, zero_copy=True)
+    assert out1 is eng._stores["zc"]  # aliases, no gather copy
+    np.testing.assert_allclose(np.asarray(out1), exp1, rtol=1e-5)
+    out2 = eng.push_pull("zc", g2, zero_copy=True)
+    np.testing.assert_allclose(np.asarray(out2), exp2, rtol=1e-5)
+    # out1's buffer was donated into the second step: stale holders see
+    # a deleted array (clear error), never torn data.
+    assert out1.is_deleted()
+
+
+def test_push_pull_zero_copy_falls_back_multi_device(mesh):
+    """On a real multi-shard gather zero_copy degrades to the copying
+    path: correct values, prior results stay live."""
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("zf", keys, 64)
+    ones = np.ones((8, 128), dtype=np.float32)
+    out1 = eng.push_pull("zf", ones, zero_copy=True)
+    out2 = eng.push_pull("zf", ones, zero_copy=True)
+    np.testing.assert_allclose(np.asarray(out1), 8 * np.ones(128))
+    np.testing.assert_allclose(np.asarray(out2), 16 * np.ones(128))
+    assert not out1.is_deleted()
+
+
+def test_push_pull_zero_copy_stateful():
+    """Stateful handles ride the same in-place delivery."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh1 = make_mesh((1,), ("kv",))
+    keys = np.arange(2, dtype=np.uint64)
+    init = np.linspace(0, 1, 128).astype(np.float32)
+    rng = np.random.default_rng(73)
+    seq = rng.normal(size=(3, 1, 128)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh1, server_handle="adam:0.01")
+    ref.register_dense("sr", keys, 64, init=init)
+    eng = CollectiveEngine(mesh=mesh1, server_handle="adam:0.01")
+    eng.register_dense("sz", keys, 64, init=init)
+    for t in range(3):
+        exp = np.asarray(ref.push_pull("sr", seq[t]))
+        got = eng.push_pull("sz", seq[t], zero_copy=True)
+        assert got is eng._stores["sz"]
+        np.testing.assert_allclose(np.asarray(got), exp,
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_replay_flat_slab_matches_sequential(mesh):
+    """The flat [W, T*padded] slab layout (large per-step payloads, see
+    _flat_replay) must reproduce the stacked layout's numerics for every
+    keep mode and input form."""
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 100
+    rng = np.random.default_rng(75)
+    W, T = 8, 4
+    seq = rng.normal(size=(T, W, 3 * val_len)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh)
+    ref.register_dense("fr", keys, val_len)
+    expected = [np.asarray(ref.push_pull("fr", seq[t])) for t in range(T)]
+
+    eng = CollectiveEngine(mesh=mesh)
+    eng.replay_flat_min_bytes = 4  # force the slab layout on tiny buckets
+    eng.register_dense("ff", keys, val_len)
+    assert eng._flat_replay(eng.bucket("ff").padded_len, np.float32,
+                            "_default", False, 4)
+    pulled = np.asarray(eng.replay("ff", seq))
+    assert pulled.shape == (T, 3 * val_len)
+    for t in range(T):
+        np.testing.assert_allclose(pulled[t], expected[t], rtol=1e-5)
+
+    # keep="last" + broadcast [T, total] form on a fresh engine.
+    eng2 = CollectiveEngine(mesh=mesh)
+    eng2.replay_flat_min_bytes = 4
+    eng2.register_dense("fb", keys, val_len)
+    bseq = np.ones((5, 3 * val_len), dtype=np.float32)
+    out = np.asarray(eng2.replay("fb", bseq, keep="last"))
+    np.testing.assert_allclose(out, 5 * 8 * np.ones(300, np.float32))
+
+
+def test_replay_zero_copy_last_single_device():
+    """replay(keep='last', zero_copy=True) on a 1-device mesh skips the
+    final gather: result aliases the store and matches T sequential
+    steps."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh1 = make_mesh((1,), ("kv",))
+    keys = np.arange(2, dtype=np.uint64)
+    rng = np.random.default_rng(77)
+    T = 4
+    seq = rng.normal(size=(T, 1, 128)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh1)
+    ref.register_dense("zl_ref", keys, 64)
+    for t in range(T):
+        exp = np.asarray(ref.push_pull("zl_ref", seq[t]))
+
+    eng = CollectiveEngine(mesh=mesh1)
+    eng.register_dense("zl", keys, 64)
+    out = eng.replay("zl", seq, keep="last", zero_copy=True)
+    assert out is eng._stores["zl"]
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-5)
