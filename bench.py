@@ -2,18 +2,35 @@
 
 Mirrors the reference's ``tests/test_benchmark`` PUSH_PULL mode
 (test_benchmark.cc:388-396): goodput counts application payload bytes
-(push + pull) per wall-clock second, over the default dense workload
-(40 keys x 1 MB, repeat-timed).  Runs on whatever accelerator JAX exposes
-(the real TPU chip under the driver; do NOT set JAX_PLATFORMS=cpu here).
+(push + pull) per second, over the default dense workload (40 keys x
+1 MB, repeat-timed).  Runs on whatever accelerator JAX exposes (the real
+TPU chip under the driver; do NOT set JAX_PLATFORMS=cpu here).
+
+Timing basis — every field is labeled by suffix:
+- ``*_device`` / the headline ``value``: goodput over XPlane
+  device-seconds (the union of XLA-op intervals on the TPU timeline).
+  The ONLY basis the repo trusts: wall clock through the axon tunnel
+  swings 20-50x between elision and serialization regimes (r02 recorded
+  a "goodput" above the chip's physical HBM bandwidth; r03 recorded
+  0.4% of it for identical code).
+- ``*_wall``: host wall clock, recorded for continuity and labeled
+  untrustworthy under the tunnel (``wall_unreliable``).
+
+The headline runs with ``zero_copy=True`` (in-place pull delivery — the
+returned array aliases the store, the reference's RegisterRecvBuffer
+contract); ``headline_copy_pull_device`` records the copying path.  The
+``impl`` object records which data plane produced the numbers
+(PS_ICI_IMPL resolution — the ring kernel needs >=2 ring devices, so
+single-chip numbers are always the XLA path).
 
 Honesty notes (single chip):
 - On a 1-device mesh ``psum_scatter``/``all_gather`` degenerate to local
   HBM ops — the headline is an HBM/dispatch benchmark, NOT an ICI
-  benchmark.  We therefore report the detected chip model, an estimated
-  HBM-bandwidth utilization, and keep ``vs_baseline`` (normalized against
-  0.7 x 100 GB/s = 70 GB/s/chip, the driver's >=70%-of-ICI-line-rate bar)
-  clearly labeled as an ICI-budget ratio the single-chip path never
-  traverses.
+  benchmark.  ``vs_baseline`` (normalized against 0.7 x 100 GB/s =
+  70 GB/s/chip, the driver's >=70%-of-ICI-line-rate bar) is an
+  ICI-budget ratio the single-chip path never traverses;
+  ``hbm_util_vs_measured`` (headline traffic = 3x payload/iter vs the
+  device-basis triad peak) is the honest single-chip measure.
 - The reference publishes no absolute numbers (BASELINE.json
   "published": {}).
 
@@ -93,16 +110,79 @@ def _hbm_estimate(device_kind: str) -> float | None:
     return None
 
 
+def _device_busy(run) -> float | None:
+    """MEAN per-device busy seconds of the TPU work in ``run()`` (XPlane).
+
+    The honest denominator under the axon tunnel: the device-side
+    timeline cannot be elided.  The mean across device planes (not the
+    sum) keeps bytes/busy dimensionally identical to bytes/elapsed — on
+    an n-chip mesh the chips work concurrently, so summing their busy
+    time would deflate goodput by ~n exactly when the wall number
+    doesn't.  Returns None when no TPU plane shows up (CPU smoke)."""
+    import shutil
+    import tempfile
+
+    from pslite_tpu.utils import xplane
+    from pslite_tpu.utils.profiling import device_trace
+
+    d = tempfile.mkdtemp(prefix="psbench_xp_")
+    try:
+        # Engine/loop errors must PROPAGATE (main turns them into the
+        # parseable error line) — only the XPlane parse is best-effort.
+        # A silently-swallowed mid-loop failure would publish a
+        # plausible-looking number computed from incomplete work.
+        with device_trace(d):
+            run()
+        try:
+            busy = xplane.device_busy_seconds(d)
+        except Exception:  # noqa: BLE001 - parsing is best-effort
+            return None
+        if not busy:
+            return None
+        return sum(busy.values()) / len(busy)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _traced(run) -> tuple[float | None, float]:
+    """(device_busy_seconds | None, wall_seconds) of ONE traced run —
+    both clocks from the same execution.  Wall is timed around run()
+    ALONE (inside the trace context): profiler start/stop, XSpace
+    parsing, and tempdir teardown stay out of every *_wall field."""
+    wall = {}
+
+    def wrapped():
+        t0 = time.perf_counter()
+        run()
+        wall["s"] = time.perf_counter() - t0
+
+    busy = _device_busy(wrapped)
+    return busy, wall["s"]
+
+
+def _dual_measure(store: dict):
+    """A ``measure`` hook (models/resnet_trace.replay contract) that
+    returns device-busy seconds AND records the loop's wall seconds in
+    ``store["wall"]`` — both clocks from one execution, so the heavy
+    model workloads run once instead of once per basis."""
+
+    def m(loop):
+        busy, wall = _traced(loop)
+        store["wall"] = wall
+        return busy
+
+    return m
+
+
 def _hbm_peak_measured(iters: int = 50) -> tuple[float, float | None]:
     """Practical HBM peak (GB/s) via a chained donated triad
     (s = s*a + g, 64 MB, traffic = read s + read g + write s = 3x).
 
-    Returns (wall_peak, device_peak): the wall number shares the engine
-    loop's measurement path (donated chain, host clock) but inherits
-    every tunnel distortion in BOTH directions — r02 saw a 9.8 TB/s
-    "triad" (elision), r03 a 108 GB/s one (round-trip dominated).  The
-    device peak comes from the XPlane trace of the same loop and is the
-    apples-to-apples denominator for a device-time headline."""
+    Returns (wall_peak, device_peak): the wall number inherits every
+    tunnel distortion in BOTH directions — r02 saw a 9.8 TB/s "triad"
+    (elision), r03 a 108 GB/s one (round-trip dominated).  The device
+    peak comes from the XPlane trace of the same loop and is the
+    apples-to-apples denominator for the device-time headline."""
     import jax
     import jax.numpy as jnp
 
@@ -131,103 +211,17 @@ def _hbm_peak_measured(iters: int = 50) -> tuple[float, float | None]:
     return wall, dev
 
 
-def _device_busy(run) -> float | None:
-    """MEAN per-device busy seconds of the TPU work in ``run()`` (XPlane).
-
-    The honest denominator under the axon tunnel: r02's wall-clock
-    headline exceeded the chip's physical HBM bandwidth because the
-    tunnel elides/pipelines device work; the device-side timeline cannot
-    be elided.  The mean across device planes (not the sum) keeps
-    bytes/busy dimensionally identical to the wall-clock bytes/elapsed —
-    on an n-chip mesh the chips work concurrently, so summing their busy
-    time would deflate goodput by ~n exactly when the wall number
-    doesn't.  Returns None when no TPU plane shows up (CPU smoke)."""
-    import shutil
-    import tempfile
-
-    from pslite_tpu.utils import xplane
-    from pslite_tpu.utils.profiling import device_trace
-
-    d = tempfile.mkdtemp(prefix="psbench_xp_")
-    try:
-        with device_trace(d):
-            run()
-        busy = xplane.device_busy_seconds(d)
-        if not busy:
-            return None
-        return sum(busy.values()) / len(busy)
-    except Exception:  # noqa: BLE001 - tracing is best-effort
-        return None
-    finally:
-        shutil.rmtree(d, ignore_errors=True)
-
-
-def _measure_device(eng, name: str, iters: int, handle=None
-                    ) -> float | None:
-    """Device-time goodput (GB/s) of the already-warm bucket ``name``
-    (input built exactly as _measure builds it)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    bucket = eng.bucket(name)
-    inp = jax.device_put(
-        jnp.ones((eng.num_shards, bucket.padded_len), bucket.dtype),
-        NamedSharding(eng.mesh, P(eng.axis, None)),
-    )
-
-    def run():
-        for _ in range(iters):
-            out = eng.push_pull(name, inp, handle=handle)
-        out.block_until_ready()
-
-    busy = _device_busy(run)
-    if not busy:
-        return None
-    payload = bucket.total_len * np.dtype(bucket.dtype).itemsize
-    return 2 * payload * iters / busy / 1e9
-
-
-def _measure_replay(eng, name: str, num_keys: int, val_len: int,
-                    steps: int) -> tuple[float, float | None]:
-    """(wall, device) goodput GB/s of ONE fused T-step replay program —
-    the dispatch-amortized form of the 1-key sweep (VERDICT r02 #2: the
-    sub-1MB sweep was 38-680x off the headline purely on per-op
-    dispatch overhead)."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    keys = np.arange(num_keys, dtype=np.uint64)
-    eng.register_dense(name, keys, val_len)
-    payload = num_keys * val_len * 4
-    seq = jnp.ones((steps, num_keys * val_len), jnp.float32)
-    out = eng.replay(name, seq, keep="last")  # compile
-    out.block_until_ready()
-    t0 = time.perf_counter()
-    out = eng.replay(name, seq, keep="last")
-    out.block_until_ready()
-    wall = 2 * payload * steps / (time.perf_counter() - t0) / 1e9
-
-    def run():
-        eng.replay(name, seq, keep="last").block_until_ready()
-
-    busy = _device_busy(run)
-    dev = 2 * payload * steps / busy / 1e9 if busy else None
-    return wall, dev
-
-
 def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
-             host_grads: bool = False, handle=None, dtype=None) -> float:
-    """Goodput (GB/s) of iterated push_pull on one registered bucket.
+             host_grads: bool = False, handle=None, dtype=None,
+             zero_copy: bool = False) -> tuple[float, float | None]:
+    """(wall_gbps, device_gbps | None) of iterated push_pull on one
+    registered bucket, both clocks from the same traced loop.
 
     ``host_grads=True`` measures the message-origin path real users hit:
     the host->HBM ``device_put`` of a (persistent) host numpy buffer runs
-    inside the timed loop (round-1 bench only ever timed pre-sharded
-    device arrays).  Allocation of fresh host arrays is NOT included.
-    ``dtype`` (default float32) sets the bucket dtype; goodput counts
-    actual payload bytes, so bf16 buckets move half the bytes per
-    element."""
+    inside the timed loop.  ``dtype`` (default float32) sets the bucket
+    dtype; goodput counts actual payload bytes.  ``zero_copy`` requests
+    in-place pull delivery (engine.push_pull docs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -250,15 +244,55 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
         )
     # Warmup: compile + first-touch (the rendezvous equivalent).
     for _ in range(3):
-        out = eng.push_pull(name, inp, handle=handle)
+        out = eng.push_pull(name, inp, handle=handle, zero_copy=zero_copy)
     out.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = eng.push_pull(name, inp, handle=handle)
-    out.block_until_ready()
-    elapsed = time.perf_counter() - t0
+
+    def run():
+        out = None
+        for _ in range(iters):
+            out = eng.push_pull(name, inp, handle=handle,
+                                zero_copy=zero_copy)
+        out.block_until_ready()
+
+    busy, wall = _traced(run)
     payload = num_keys * val_len * itemsize  # bytes per direction
-    return 2 * payload * iters / elapsed / 1e9  # push + pull
+    moved = 2 * payload * iters  # push + pull
+    return (moved / wall / 1e9,
+            moved / busy / 1e9 if busy else None)
+
+
+def _measure_replay(eng, name: str, num_keys: int, val_len: int,
+                    steps: int) -> tuple[float, float | None]:
+    """(wall, device) goodput GB/s of ONE fused T-step replay program —
+    the dispatch-amortized form of the 1-key sweep (VERDICT r02 #2: the
+    sub-1MB sweep was 38-680x off the headline purely on per-op
+    dispatch overhead).  The sequence is staged from host numpy (the
+    slab layout builds host-side with zero device relayout copies) and
+    the pull is zero-copy — wall time therefore includes the host->HBM
+    staging; device time is the scan program itself."""
+    import numpy as np
+
+    keys = np.arange(num_keys, dtype=np.uint64)
+    eng.register_dense(name, keys, val_len)
+    payload = num_keys * val_len * 4
+    seq = np.ones((steps, num_keys * val_len), np.float32)
+    out = eng.replay(name, seq, keep="last", zero_copy=True)  # compile
+    out.block_until_ready()
+
+    def run():
+        eng.replay(name, seq, keep="last",
+                   zero_copy=True).block_until_ready()
+
+    busy, wall = _traced(run)
+    moved = 2 * payload * steps
+    return (moved / wall / 1e9,
+            moved / busy / 1e9 if busy else None)
+
+
+def _sparse_engine(eng):
+    from pslite_tpu.parallel.sparse import SparseEngine
+
+    return SparseEngine(eng.mesh, eng.axis)
 
 
 _emit_mu = threading.Lock()
@@ -299,7 +333,7 @@ def main() -> None:
     # The probe only covers its own subprocess; the tunnel can still flap
     # before the in-process backend init below, which would hang forever
     # (un-catchable).  A watchdog guarantees one parseable line regardless.
-    deadline = int(os.environ.get("PS_BENCH_TIMEOUT_S", "900"))
+    deadline = int(os.environ.get("PS_BENCH_TIMEOUT_S", "1500"))
 
     def _watchdog_fire():
         _emit(_error_line(
@@ -323,71 +357,89 @@ def main() -> None:
 
             jax.config.update("jax_platforms", explicit)
 
+        import jax.numpy as jnp
+        import numpy as np
+
         from pslite_tpu.parallel.engine import CollectiveEngine
 
         eng = CollectiveEngine()
+        # Which data plane produces these numbers (VERDICT r03 weak #7:
+        # nothing in the JSON said the headline was the XLA path).  The
+        # zero-copy flag reflects what the engine will actually DO for
+        # the headline config — on a multi-shard mesh the in-place
+        # delivery silently degrades to the copying path.
+        zc_headline = eng._zc_pull_eligible(jnp.float32, "sum")
+        impl = {
+            "configured": eng.impl,
+            "effective": eng._effective_impl(jnp.float32, "sum"),
+            "zero_copy_pull": zc_headline,
+        }
         # Reference sweep 1KB..64MB per key (test.sh / README.md:123-135);
         # headline config: 40 keys x 1MB (test_benchmark.cc:407-414).
         # PS_BENCH_QUICK=1 shrinks everything (CI smoke on CPU).
         sizes = (1 << 10, 64 << 10) if quick else (
             1 << 10, 64 << 10, 1 << 20, 16 << 20, 64 << 20
         )
-        sweep = {}
+        # Per-op dispatch sweep (one push_pull per iteration, the
+        # ZPush/ZPull analog), wall + device from the same loop.
+        sweep_wall, sweep_dev = {}, {}
         for size in sizes:
             label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
             iters = 2 if quick else max(
-                4, min(60, (256 << 20) // max(size, 1 << 20))
+                4, min(30, (256 << 20) // max(size, 1 << 20))
             )
-            sweep[label] = round(
-                _measure(eng, f"sweep_{size}", 1, size // 4, iters), 2
-            )
+            w, d = _measure(eng, f"sweep_{size}", 1, size // 4, iters,
+                            zero_copy=True)
+            sweep_wall[label] = round(w, 2)
+            if d is not None:
+                sweep_dev[label] = round(d, 2)
         # Dispatch-amortized sweep: the same 1-key buckets through ONE
-        # fused T-step replay program (lax.scan over the donated store).
-        # Wall and device-time goodput both reported; T scaled so each
-        # program moves ~64MB of payload.
-        sweep_replay = {}
-        sweep_replay_dev = {}
+        # fused T-step replay program (lax.scan over the donated store);
+        # T scaled so each program moves >=64MB of payload.
+        sweep_replay_wall, sweep_replay_dev = {}, {}
         for size in sizes:
-            if size > 16 << 20:
-                continue  # replay wins are a small-message story
             label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
             steps = 4 if quick else max(8, min(256, (64 << 20) // size))
-            wall, dev = _measure_replay(
+            w, d = _measure_replay(
                 eng, f"replay_{size}", 1, size // 4, steps
             )
-            sweep_replay[label] = round(wall, 2)
-            if dev is not None:
-                sweep_replay_dev[label] = round(dev, 2)
+            sweep_replay_wall[label] = round(w, 2)
+            if d is not None:
+                sweep_replay_dev[label] = round(d, 2)
         if quick:
-            headline = _measure(eng, "bench", 4, (64 << 10) // 4, 2)
+            headline_wall, headline_dev = _measure(
+                eng, "bench", 4, (64 << 10) // 4, 2, zero_copy=True
+            )
             headline_cfg = "4x64KB quick"
-            host_path = _measure(
+            headline_copy_dev = None
+            host_wall, host_dev = _measure(
                 eng, "bench_host", 4, (64 << 10) // 4, 2, host_grads=True
             )
-            headline_dev = None
-            fused = None
-            bf16 = None
-            trace_gbps = None
-            host_trace_gbps = None
-            host_trace_overlap_gbps = None
-            emb_ms = None
+            fused = bf16 = None
+            rn = {}
+            emb_wall_ms = emb_dev_ms = None
+            stress = {}
         else:
-            # Median of 5 rounds: single-run numbers through the shared
-            # tunnel vary up to ~2x between invocations (r02 observed
-            # 531 vs 1144 GB/s); the driver records whatever one
-            # invocation prints.
-            iters = 30
-            runs = sorted(
-                _measure(eng, "bench", 40, (1 << 20) // 4, iters)
-                for _ in range(5)
-            )
-            headline = runs[2]
             headline_cfg = "40x1MB"
-            # Device-time headline: the same loop traced, goodput over
-            # XLA-op device-seconds — the number wall clock cannot
-            # inflate (VERDICT r02 #3).
-            headline_dev = _measure_device(eng, "bench", iters)
-            host_path = _measure(
+            iters = 30
+            # Median of 3 traced runs, keyed on the DEVICE number (the
+            # basis the median is meant to guard — wall medians would
+            # let a straggler trace with a middling wall time through).
+            runs = sorted(
+                (_measure(eng, "bench", 40, (1 << 20) // 4, iters,
+                          zero_copy=True)
+                 for _ in range(3)),
+                key=lambda wd: (wd[1] is None, wd[1] or 0.0, wd[0]),
+            )
+            headline_wall, headline_dev = runs[1]
+            # The copying pull path (zero_copy=False): XLA gives the
+            # gathered output its own buffer — the contract for callers
+            # who hold pulled results across steps.
+            _, headline_copy_dev = _measure(
+                eng, "bench_copy", 40, (1 << 20) // 4, iters,
+                zero_copy=False,
+            )
+            host_wall, host_dev = _measure(
                 eng, "bench_host", 40, (1 << 20) // 4, 8, host_grads=True
             )
             # Fused Pallas optimizer pass (sgd+momentum) between the
@@ -395,41 +447,60 @@ def main() -> None:
             # loop (kv_app.h:430-452) as one HBM pass.
             fused = _measure(
                 eng, "bench_fused", 40, (1 << 20) // 4, 8,
-                handle="sgd_momentum:0.01,0.9",
+                handle="sgd_momentum:0.01,0.9", zero_copy=True,
             )
             # bf16 buckets: same element count as the headline, half the
             # bytes — the TPU-native dtype for gradient exchange.
-            import jax.numpy as _jnp
-
             bf16 = _measure(
                 eng, "bench_bf16", 40, (1 << 20) // 4, 8,
-                dtype=_jnp.bfloat16,
+                dtype=jnp.bfloat16, zero_copy=True,
             )
             # Model-shaped workload: the ResNet-50 gradient trace
             # (~205 MB/step in ~35 size-bucketed tensors) as one grouped
-            # dispatch per step — the BASELINE config-4 replay.
+            # dispatch per step — the BASELINE config-4 replay.  One
+            # execution per workload, both clocks (_dual_measure).
             from pslite_tpu.models.resnet_trace import replay as rn50
 
-            rn_bytes, rn_dt = rn50(eng, steps=5)
-            trace_gbps = rn_bytes / rn_dt / 1e9
+            rn = {}
+            clocks = {}
+            rn_bytes, rn_dt = rn50(eng, steps=5,
+                                   measure=_dual_measure(clocks))
+            rn["wall"] = rn_bytes / (clocks["wall"] / 5) / 1e9
+            if rn_dt:
+                rn["device"] = rn_bytes / rn_dt / 1e9
             # Host-origin trace replay: gradients start as host numpy
-            # every step.  Serial staging vs double-buffered staging
-            # (stager thread overlaps transfer with the collectives) —
-            # the comparative pair is tunnel-noise-resistant even when
-            # the absolute numbers are not.
-            hb, hd = rn50(eng, steps=3, host_origin=True, overlap=False)
-            host_trace_gbps = hb / hd / 1e9
+            # every step; serial vs double-buffered staging.  Device
+            # basis shows the collective cost alone (staging is
+            # host-side); the wall pair carries the overlap comparison.
+            clocks = {}
+            hb, hd = rn50(eng, steps=3, host_origin=True, overlap=False,
+                          measure=_dual_measure(clocks))
+            rn["host_wall"] = hb / (clocks["wall"] / 3) / 1e9
+            if hd:
+                rn["host_device"] = hb / hd / 1e9
             hb2, hd2 = rn50(eng, steps=3, host_origin=True, overlap=True)
-            host_trace_overlap_gbps = hb2 / hd2 / 1e9
+            rn["host_overlap_wall"] = hb2 / hd2 / 1e9
             # Sparse tier: the 1M-key zipf-skewed embedding push/pull —
             # the BASELINE config-5 replay (gather + scatter-add bound).
             from pslite_tpu.models.embedding import replay as emb
 
-            from pslite_tpu.parallel.sparse import SparseEngine
+            se = _sparse_engine(eng)
+            clocks = {}
+            emb_bytes, emb_dt = emb(se, steps=5,
+                                    measure=_dual_measure(clocks))
+            emb_wall_ms = clocks["wall"] / 5 * 1e3
+            emb_dev_ms = emb_dt * 1e3 if emb_dt else None
+            # The reference's stress patterns (test_benchmark_stress.cc:
+            # 271-279: 30.72MB tensors), device basis (VERDICT r03 #8).
+            from pslite_tpu.stress import run_pattern
 
-            se = SparseEngine(eng.mesh, eng.axis)
-            emb_bytes, emb_dt = emb(se, steps=5)
-            emb_ms = emb_dt * 1e3
+            stress = {}
+            for pattern in ("dense", "gather", "scatter", "datascatter"):
+                gbps = run_pattern(eng, se, pattern, 30_720_000, 8,
+                                   measure=_device_busy)
+                if gbps:
+                    # Gbps -> GB/s to match every other field.
+                    stress[pattern] = round(gbps / 8.0, 2)
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
         hbm_spec = _hbm_estimate(probe.get("device_kind", ""))
@@ -440,20 +511,15 @@ def main() -> None:
             except Exception:  # noqa: BLE001 - calibration is best-effort
                 pass
         # The HEADLINE is device-time goodput when a TPU trace is
-        # available: goodput over XLA-op device-seconds, which the
-        # tunnel cannot elide (r02's wall clock "exceeded" the chip's
-        # physical HBM bandwidth).  Wall clock is demoted to the
-        # secondary wallclock_goodput field.
-        value = headline_dev if headline_dev is not None else headline
+        # available — the number wall clock cannot inflate.
+        value = headline_dev if headline_dev is not None else headline_wall
         basis = "device-time" if headline_dev is not None else "wall-clock"
-        # HBM traffic of the fused 1-device step: read grads + read
-        # store + write store (outputs alias) = 3 x payload per iter;
-        # goodput GB/s = 2 x payload / s, so traffic = 1.5 x goodput.
-        # Utilizations are derived from the headline VALUE vs the public
-        # spec and vs a triad peak measured on the SAME basis — mixing a
-        # device-time headline with a wall-clock peak would compare two
-        # different clocks (the tunnel distorts wall in both directions:
-        # r02's triad read 9.8 TB/s, r03's 108 GB/s).
+        # HBM traffic of the zero-copy fused 1-device step: read grads +
+        # read store + write store (in place) = exactly 3 x payload per
+        # iter; goodput GB/s = 2 x payload / s, so traffic = 1.5 x
+        # goodput.  Utilizations compare the headline VALUE against the
+        # public spec and against a triad peak measured on the SAME
+        # basis (mixing clocks would compare two different regimes).
         hbm_peak = hbm_peak_dev if basis == "device-time" else hbm_peak_wall
         hbm_util = round(1.5 * value / hbm_spec, 3) if hbm_spec else None
         hbm_util_meas = (
@@ -461,8 +527,7 @@ def main() -> None:
         )
         # The suspect guard applies to whatever basis produced the
         # value: device-time utilizations > 1 would mean the trace is
-        # wrong; wall-clock ones mean the tunnel elided work.  The
-        # wall-clock peak calibration only taints a wall-clock headline.
+        # wrong; wall-clock ones mean the tunnel elided work.
         timing_suspect = (
             basis == "wall-clock" and bool(hbm_peak_wall) and (
                 (hbm_spec is not None and hbm_peak_wall > 1.5 * hbm_spec)
@@ -482,40 +547,76 @@ def main() -> None:
             {
                 "metric": (
                     f"dense push-pull goodput ({headline_cfg}, "
-                    f"fused RS+update+AG, {basis})"
+                    f"fused RS+update+AG, "
+                    f"{'zero-copy' if zc_headline else 'copy'} pull, "
+                    f"{basis})"
                 ),
                 "value": round(value, 2),
                 "unit": "GB/s/chip",
                 "vs_baseline": round(value / baseline, 3),
                 "timing_basis": basis,
-                "wallclock_goodput": round(headline, 2),
+                "wall_unreliable": True,
+                "impl": impl,
+                "wallclock_goodput": round(headline_wall, 2),
+                "headline_copy_pull_device": (
+                    round(headline_copy_dev, 2)
+                    if headline_copy_dev is not None else None
+                ),
                 "platform": probe.get("platform"),
                 "device_kind": probe.get("device_kind"),
                 "n_devices": probe.get("n"),
-                "sweep_1key": sweep,
-                "sweep_1key_replay": sweep_replay,
+                "sweep_1key_wall": sweep_wall,
+                "sweep_1key_device": sweep_dev,
+                "sweep_1key_replay_wall": sweep_replay_wall,
                 "sweep_1key_replay_device": sweep_replay_dev,
-                "host_origin_goodput": round(host_path, 2),
-                "bf16_goodput": (
-                    round(bf16, 2) if bf16 is not None else None
+                "host_origin_goodput_wall": round(host_wall, 2),
+                "host_origin_goodput_device": (
+                    round(host_dev, 2) if host_dev is not None else None
                 ),
-                "fused_sgdm_goodput": (
-                    round(fused, 2) if fused is not None else None
+                "bf16_goodput_wall": (
+                    round(bf16[0], 2) if bf16 else None
                 ),
-                "resnet50_trace_goodput": (
-                    round(trace_gbps, 2) if trace_gbps is not None else None
+                "bf16_goodput_device": (
+                    round(bf16[1], 2)
+                    if bf16 and bf16[1] is not None else None
                 ),
-                "resnet50_host_trace_goodput": (
-                    round(host_trace_gbps, 2)
-                    if host_trace_gbps is not None else None
+                "fused_sgdm_goodput_wall": (
+                    round(fused[0], 2) if fused else None
                 ),
-                "resnet50_host_overlap_goodput": (
-                    round(host_trace_overlap_gbps, 2)
-                    if host_trace_overlap_gbps is not None else None
+                "fused_sgdm_goodput_device": (
+                    round(fused[1], 2)
+                    if fused and fused[1] is not None else None
                 ),
-                "embedding_1m_ms_per_step": (
-                    round(emb_ms, 1) if emb_ms is not None else None
+                "resnet50_trace_wall": (
+                    round(rn["wall"], 2) if "wall" in rn else None
                 ),
+                "resnet50_trace_device": (
+                    round(rn["device"], 2) if "device" in rn else None
+                ),
+                "resnet50_host_trace_wall": (
+                    round(rn["host_wall"], 2)
+                    if "host_wall" in rn else None
+                ),
+                "resnet50_host_trace_device": (
+                    round(rn["host_device"], 2)
+                    if "host_device" in rn else None
+                ),
+                "resnet50_host_overlap_wall": (
+                    round(rn["host_overlap_wall"], 2)
+                    if "host_overlap_wall" in rn else None
+                ),
+                "embedding_1m_ms_per_step_wall": (
+                    round(emb_wall_ms, 1)
+                    if emb_wall_ms is not None else None
+                ),
+                "embedding_1m_ms_per_step_device": (
+                    round(emb_dev_ms, 2)
+                    if emb_dev_ms is not None else None
+                ),
+                "stress_dense_device": stress.get("dense"),
+                "stress_gather_device": stress.get("gather"),
+                "stress_scatter_device": stress.get("scatter"),
+                "stress_datascatter_device": stress.get("datascatter"),
                 "hbm_util_vs_spec": hbm_util,
                 "hbm_util_vs_measured": hbm_util_meas,
                 "hbm_peak_measured": (
@@ -533,7 +634,8 @@ def main() -> None:
                     "single-chip: collectives degenerate to HBM-local ops; "
                     "vs_baseline is an ICI-budget ratio the 1-device path "
                     "does not traverse — hbm_util_vs_* are the honest "
-                    "single-chip measures"
+                    "single-chip measures; *_wall fields are tunnel-"
+                    "distorted (see wall_unreliable); stress_* are GB/s"
                     + suspect_note
                 ) if single_chip else "multi-chip ICI path" + suspect_note,
             }

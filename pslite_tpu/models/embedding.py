@@ -18,8 +18,11 @@ def skewed_indices(num_rows: int, workers: int, batch: int, seed: int = 0,
 
 
 def replay(sparse_engine, num_rows: int = 1 << 20, dim: int = 64,
-           batch: int = 4096, steps: int = 1, seed: int = 0):
-    """Returns (bytes_moved_per_step, seconds_per_step)."""
+           batch: int = 4096, steps: int = 1, seed: int = 0,
+           measure=None):
+    """Returns (bytes_moved_per_step, seconds_per_step).  ``measure``
+    swaps the clock (see resnet_trace.replay); with it, dt may be None
+    when the requested basis is unavailable."""
     import time
 
     name = f"emb_{num_rows}_{dim}"
@@ -33,12 +36,16 @@ def replay(sparse_engine, num_rows: int = 1 << 20, dim: int = 64,
     out = sparse_engine.pull(name, idx)
     out.block_until_ready()  # warm the executable cache
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        sparse_engine.push(name, idx, grads)
-        out = sparse_engine.pull(name, idx)
-    out.block_until_ready()
-    sparse_engine.block(name)
-    dt = (time.perf_counter() - t0) / max(steps, 1)
+    def loop():
+        for _ in range(steps):
+            sparse_engine.push(name, idx, grads)
+            out = sparse_engine.pull(name, idx)
+        out.block_until_ready()
+        sparse_engine.block(name)
+
+    from ..utils.profiling import clocked
+
+    elapsed = clocked(loop, measure)
+    dt = elapsed / max(steps, 1) if elapsed is not None else None
     step_bytes = 2 * 4 * W * batch * dim  # push + pull payload
     return step_bytes, dt

@@ -51,6 +51,11 @@ _TILE = _LANES * _SUBLANES  # minimum chunk granularity (fp32 elements)
 
 
 def _use_interpret() -> bool:
+    """Default interpret decision when the caller does not say: follow
+    the process default backend.  Callers who know the TARGET mesh (the
+    engine) pass ``interpret`` explicitly instead — an AOT compile-only
+    TPU mesh must get real Mosaic lowering even from a CPU-default
+    process, and the CPU interpreter must not be selected for it."""
     return jax.default_backend() != "tpu"
 
 
@@ -384,7 +389,7 @@ def _kernel_body(n: int, axis_name: str, handle: Callable, ndir: int,
 def _ring_call(grads_chunks, store_chunk, handle: Callable,
                axis_name: str, num_devices: int, collective_id,
                bidir: bool, with_ag: bool, compress: bool = False,
-               mesh_axes=None):
+               mesh_axes=None, interpret=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -451,7 +456,8 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
         ),
         interpret=(
             pltpu.InterpretParams(dma_execution_mode="eager")
-            if _use_interpret() else False
+            if (_use_interpret() if interpret is None else interpret)
+            else False
         ),
     )(g2, s2)
     if with_ag:
@@ -462,7 +468,8 @@ def _ring_call(grads_chunks, store_chunk, handle: Callable,
 def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
                    axis_name: str, num_devices: int,
                    collective_id: int = None, bidir: bool = True,
-                   compress: bool = False, mesh_axes=None):
+                   compress: bool = False, mesh_axes=None,
+                   interpret=None):
     """Run the fused RS+update+AG ring inside a shard_map body.
 
     Args (per-device views inside shard_map):
@@ -483,13 +490,15 @@ def ring_push_pull(grads_chunks, store_chunk, handle: Callable,
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
                       num_devices, collective_id, bidir, with_ag=True,
-                      compress=compress, mesh_axes=mesh_axes)
+                      compress=compress, mesh_axes=mesh_axes,
+                      interpret=interpret)
 
 
 def ring_push(grads_chunks, store_chunk, handle: Callable,
               axis_name: str, num_devices: int,
               collective_id: int = None, bidir: bool = True,
-              compress: bool = False, mesh_axes=None):
+              compress: bool = False, mesh_axes=None,
+              interpret=None):
     """Push-only ring: reduce-scatter + fused server update, no
     all-gather (the ``ZPush`` leg alone).  Same contract as
     :func:`ring_push_pull`; returns just the new store chunk.
@@ -499,4 +508,5 @@ def ring_push(grads_chunks, store_chunk, handle: Callable,
     """
     return _ring_call(grads_chunks, store_chunk, handle, axis_name,
                       num_devices, collective_id, bidir, with_ag=False,
-                      compress=compress, mesh_axes=mesh_axes)
+                      compress=compress, mesh_axes=mesh_axes,
+                      interpret=interpret)

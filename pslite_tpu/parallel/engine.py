@@ -168,6 +168,13 @@ class CollectiveEngine:
         )
         # Fixed at construction; cached off the hot path.
         self._multiprocess = mesh_is_multiprocess(self.mesh)
+        self._mesh_platform = next(
+            iter(self.mesh.devices.flat)
+        ).platform
+        # Ring kernels interpret (CPU Pallas interpreter) iff the MESH
+        # is not TPU — AOT topology meshes compile real Mosaic even from
+        # a CPU-default process (see ring_collective._use_interpret).
+        self._ring_interpret = self._mesh_platform != "tpu"
         self._local_shard_count = (
             local_shard_count(self.mesh) if self._multiprocess
             else self.num_shards
@@ -515,10 +522,13 @@ class CollectiveEngine:
             return "xla"
         if self._multiprocess:
             # Real multi-host TPU rings ride ICI fine, but the off-TPU
-            # interpreter cannot DMA to another process's devices.
-            import jax
-
-            if jax.default_backend() != "tpu":
+            # interpreter cannot DMA to another process's devices.  The
+            # MESH's platform decides, not the process default backend:
+            # an AOT compile-only TPU mesh (jax.experimental.topologies)
+            # must select the kernel even when this process defaults to
+            # CPU, and a multi-process CPU mesh must not select it even
+            # under a TPU-default process.
+            if self._mesh_platform != "tpu":
                 return "xla"
         return "pallas"
 
@@ -569,6 +579,7 @@ class CollectiveEngine:
         chunk0 = padded_len // n
         kchunk = ring_chunk_len(padded_len, n, dtype, compress=compress)
         cid = derive_collective_id(*key)
+        interp = self._ring_interpret
 
         def _padded(store_l, grads_l):
             return _pad_ring_chunks(
@@ -579,7 +590,7 @@ class CollectiveEngine:
             g, s = _padded(store_l, grads_l)
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n, collective_id=cid,
-                compress=compress,
+                compress=compress, interpret=interp,
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
@@ -589,7 +600,7 @@ class CollectiveEngine:
         def body_push(store_l, grads_l):
             g, s = _padded(store_l, grads_l)
             new = ring_push(g, s, handle, axis, n, collective_id=cid,
-                            compress=compress)
+                            compress=compress, interpret=interp)
             if kchunk != chunk0:
                 new = new[:chunk0]
             # Completion token, same contract as the XLA push program.
@@ -676,6 +687,7 @@ class CollectiveEngine:
         waxis = self.worker_axis
         A = self.num_workers
         B = self.num_shards
+        interp = self._ring_interpret
         chunk_kv = padded_len // B  # my kv shard (replicated over dp)
         ksub = ring_chunk_len(chunk_kv, A, dtype, compress=compress)
         maxes = tuple(
@@ -693,7 +705,7 @@ class CollectiveEngine:
             s_sub = lax.dynamic_slice(s, (d * ksub,), (ksub,))
             _, pulled_dp = ring_push_pull(
                 g, s_sub, handle, waxis, A, collective_id=cid,
-                compress=compress, mesh_axes=maxes,
+                compress=compress, mesh_axes=maxes, interpret=interp,
             )
             if A * ksub != chunk_kv:
                 pulled_dp = pulled_dp[:chunk_kv]
@@ -1124,6 +1136,7 @@ class CollectiveEngine:
         grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
         repl_spec = P(None)
         n = self.num_shards
+        interp = self._ring_interpret
 
         def _ring_one(i, padded_len, dtype, store_l, grads_l):
             from ..ops.ring_collective import (
@@ -1151,7 +1164,7 @@ class CollectiveEngine:
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n,
                 collective_id=cid,
-                compress=compress,
+                compress=compress, interpret=interp,
             )
             if kchunk != chunk0:
                 new = new[:chunk0]
@@ -1640,6 +1653,7 @@ class CollectiveEngine:
         waxis = self.worker_axis
         compress = self._ring_compress(dtype)
         cid = derive_collective_id(*key)
+        interp = self._ring_interpret
         store_spec = P(axis)
 
         if waxis is not None:
@@ -1681,12 +1695,14 @@ class CollectiveEngine:
                         new, pulled = ring_push_pull(
                             gr, carry, handle, axis, n,
                             collective_id=cid, compress=compress,
+                            interpret=interp,
                         )
                         return new, _slice_ring_pulled(
                             pulled, n, kchunk, chunk0
                         )
                     new = ring_push(gr, carry, handle, axis, n,
-                                    collective_id=cid, compress=compress)
+                                    collective_id=cid, compress=compress,
+                                    interpret=interp)
                     return new, 0.0
 
                 s, outs = lax.scan(step, s, grads_l)

@@ -21,7 +21,6 @@ from __future__ import annotations
 import argparse
 import os
 import threading
-import time
 
 import numpy as np
 
@@ -29,15 +28,27 @@ PATTERNS = ("dense", "gather", "scatter", "datascatter")
 
 
 def run_pattern(engine, sparse_engine, pattern: str, size_bytes: int,
-                iters: int) -> float:
-    """Returns application goodput in Gbps for the pattern."""
+                iters: int, measure=None) -> float:
+    """Returns application goodput in Gbps for the pattern.
+
+    ``measure(loop) -> seconds | None`` swaps the clock (e.g. XPlane
+    device-busy seconds — see models/resnet_trace.replay); returns 0.0
+    when that basis is unavailable."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from .utils.profiling import clocked
+
     W = engine.num_shards
     n = max(size_bytes // 4, W)
     name = f"stress_{pattern}_{size_bytes}"
+
+    def timed(loop):
+        # None (basis unavailable) maps to goodput 0.0 at the return
+        # sites — never to a fake elapsed time, which would turn into
+        # an astronomically large published goodput.
+        return clocked(loop, measure)
 
     if pattern == "datascatter":
         dim = 128
@@ -52,13 +63,17 @@ def run_pattern(engine, sparse_engine, pattern: str, size_bytes: int,
         grads = np.ones((W, batch, dim), np.float32)
         sparse_engine.push(table, idx, grads)  # warm
         sparse_engine.block(table)
-        t0 = time.perf_counter_ns()
-        for _ in range(iters):
-            sparse_engine.push(table, idx, grads)
-        sparse_engine.block(table)
-        elapsed = time.perf_counter_ns() - t0
+
+        def loop():
+            for _ in range(iters):
+                sparse_engine.push(table, idx, grads)
+            sparse_engine.block(table)
+
+        elapsed = timed(loop)
+        if not elapsed:
+            return 0.0
         moved = 4 * W * batch * dim * iters
-        return 8.0 * moved / max(elapsed, 1)
+        return 8.0 * moved / (elapsed * 1e9)
 
     if name not in engine._buckets:
         engine.register_dense(name, np.arange(1, dtype=np.uint64), n)
@@ -76,13 +91,18 @@ def run_pattern(engine, sparse_engine, pattern: str, size_bytes: int,
     op = ops[pattern]
     out = op()  # warm / compile
     out.block_until_ready()
-    t0 = time.perf_counter_ns()
-    for _ in range(iters):
-        out = op()
-    out.block_until_ready()
-    elapsed = time.perf_counter_ns() - t0
+
+    def loop():
+        out = None
+        for _ in range(iters):
+            out = op()
+        out.block_until_ready()
+
+    elapsed = timed(loop)
+    if not elapsed:
+        return 0.0
     per_iter = n * 4 * (2 if pattern == "dense" else 1)
-    return 8.0 * per_iter * iters / max(elapsed, 1)
+    return 8.0 * per_iter * iters / (elapsed * 1e9)
 
 
 def main(argv=None) -> int:

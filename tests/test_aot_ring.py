@@ -1,0 +1,54 @@
+"""The fused ring kernel must pass REAL-TPU Mosaic lowering, not just
+the CPU interpreter (r03 verdict, missing #1).
+
+``jax.experimental.topologies`` provides compile-only AOT device sets
+for named TPU topologies; lowering + compiling the engine's ring
+program against one runs the same Mosaic pipeline a real v5e-8 slice
+would, with no chips.  Skips (not fails) when the topology client is
+unavailable (no libtpu / no compile service) — tools/aot_ring_compile.py
+is the full sweep whose committed report is docs/AOT_RING.json.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def v5e8_mesh():
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    try:
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4"
+        )
+    except Exception as exc:  # noqa: BLE001 - environment, not code
+        pytest.skip(f"TPU AOT topology unavailable: {exc!r}")
+    return Mesh(np.array(topo.devices).reshape(8), ("kv",))
+
+
+def test_ring_kernel_compiles_for_real_v5e(v5e8_mesh):
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pslite_tpu.parallel.engine import CollectiveEngine
+
+    eng = CollectiveEngine(mesh=v5e8_mesh, impl="pallas")
+    assert eng._effective_impl(jnp.float32, "sum") == "pallas"
+    padded = 8 * 65536
+    prog = eng._ring_program(padded, jnp.float32, "_default")
+    store = jax.ShapeDtypeStruct(
+        (padded,), jnp.float32, sharding=NamedSharding(v5e8_mesh, P("kv"))
+    )
+    grads = jax.ShapeDtypeStruct(
+        (8, padded), jnp.float32,
+        sharding=NamedSharding(v5e8_mesh, P("kv", None)),
+    )
+    lowered = prog.lower(store, grads)
+    # The kernel must actually be in the program (Mosaic custom call),
+    # not silently replaced by an XLA fallback.
+    assert "tpu_custom_call" in lowered.as_text()
+    compiled = lowered.compile()  # full Mosaic + XLA pipeline
+    assert compiled.as_text()

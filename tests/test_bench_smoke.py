@@ -15,8 +15,11 @@ def test_measure_helper_runs():
     from pslite_tpu.parallel.engine import CollectiveEngine
 
     eng = CollectiveEngine()
-    goodput = bench._measure(eng, "smoke", num_keys=2, val_len=1024, iters=2)
-    assert goodput > 0
+    wall, dev = bench._measure(
+        eng, "smoke", num_keys=2, val_len=1024, iters=2
+    )
+    assert wall > 0
+    assert dev is None  # CPU mesh: no TPU plane in the trace
 
 
 def test_bench_cli_contract():

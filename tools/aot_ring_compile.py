@@ -1,0 +1,164 @@
+"""AOT-compile the fused Pallas ring kernel for real multi-chip TPU
+topologies — no chips required.
+
+The bench environment exposes ONE physical chip, and the ring kernel
+needs >=2 ring devices — so every real-TPU benchmark number is the XLA
+path and the kernel itself had only ever run under the CPU interpreter
+(r03 verdict, missing #1).  Mosaic lowering for real hardware is a
+different compiler path from the interpreter; this tool exercises it:
+``jax.experimental.topologies`` builds an AOT device set for a named
+TPU topology, the engine builds its ring programs against a mesh of
+those devices, and ``.lower().compile()`` runs the full
+Mosaic+XLA pipeline.  Execution stays out of reach without hardware;
+compilation does not.
+
+Writes a machine-checkable report to docs/AOT_RING.json (and a human
+summary to stdout).  Configs cover every kernel variant the engine can
+select: bidirectional f32/bf16, int8 wire compression, push-only,
+2-D multi-axis (dp sub-rings + kv gather), and the fused replay scan.
+
+Usage: python tools/aot_ring_compile.py [--topology v5e:2x4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
+    """Lower + compile one ring program against the AOT mesh; returns a
+    result row (mosaic presence, compile seconds, executable size)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = eng.axis
+    waxis = eng.worker_axis
+    store_spec = NamedSharding(mesh, P(axis))
+    if waxis is None:
+        grads_spec = NamedSharding(mesh, P(axis, None))
+        rows = eng.num_shards
+    else:
+        grads_spec = NamedSharding(mesh, P(waxis, axis))
+        rows = eng.num_workers
+
+    store_sds = jax.ShapeDtypeStruct((padded,), dtype, sharding=store_spec)
+    if kind == "replay":
+        prog = eng._replay_program(steps, padded, dtype, "_default",
+                                   keep="last", stateful=False)
+        seq_spec = NamedSharding(mesh, P(None, axis, None))
+        args = (store_sds,
+                jax.ShapeDtypeStruct((steps, rows, padded), dtype,
+                                     sharding=seq_spec))
+    elif kind == "push":
+        prog = eng._ring_program_op("push", padded, dtype, "_default")
+        args = (store_sds,
+                jax.ShapeDtypeStruct((rows, padded), dtype,
+                                     sharding=grads_spec))
+    else:  # push_pull
+        prog = eng._ring_program(padded, dtype, "_default")
+        args = (store_sds,
+                jax.ShapeDtypeStruct((rows, padded), dtype,
+                                     sharding=grads_spec))
+
+    t0 = time.perf_counter()
+    lowered = prog.lower(*args)
+    hlo = lowered.as_text()
+    mosaic = "tpu_custom_call" in hlo
+    compiled = lowered.compile()
+    dt = time.perf_counter() - t0
+    return {
+        "mosaic_custom_call": mosaic,
+        "compile_seconds": round(dt, 1),
+        "hlo_bytes": len(hlo),
+        "executable_text_bytes": len(compiled.as_text()),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="v5e:2x4",
+                    help="jax.experimental.topologies name")
+    ap.add_argument("--out", default="docs/AOT_RING.json")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    from pslite_tpu.parallel.engine import CollectiveEngine
+
+    report = {
+        "topology": args.topology,
+        "jax_version": jax.__version__,
+        "configs": {},
+    }
+    try:
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name=args.topology
+        )
+    except Exception as exc:  # noqa: BLE001 - record the exact blocker
+        report["error"] = f"topology unavailable: {exc!r}"
+        print(json.dumps(report, indent=1))
+        return 1
+
+    devs = np.array(topo.devices)
+    n = devs.size
+    mesh1 = Mesh(devs.reshape(n), ("kv",))
+    eng1 = CollectiveEngine(mesh=mesh1, impl="pallas")
+    engc = CollectiveEngine(mesh=mesh1, impl="pallas", wire_compress="int8")
+    mesh2 = Mesh(devs.reshape(n // 2, 2), ("dp", "kv"))
+    eng2 = CollectiveEngine(mesh=mesh2, impl="pallas", worker_axis="dp")
+
+    padded = n * 65536  # 2MB f32 per bucket at n=8
+    configs = [
+        ("push_pull_f32_bidir", eng1, mesh1, "push_pull", padded,
+         jnp.float32, 0),
+        ("push_pull_bf16", eng1, mesh1, "push_pull", padded,
+         jnp.bfloat16, 0),
+        ("push_pull_int8_wire", engc, mesh1, "push_pull", padded,
+         jnp.float32, 0),
+        ("push_only", eng1, mesh1, "push", padded, jnp.float32, 0),
+        ("multi_axis_2d", eng2, mesh2, "push_pull", padded,
+         jnp.float32, 0),
+        ("replay_scan_T4", eng1, mesh1, "replay", padded, jnp.float32, 4),
+    ]
+    ok = True
+    for name, eng, mesh, kind, plen, dtype, steps in configs:
+        impl = eng._effective_impl(dtype, "sum")
+        if impl != "pallas":
+            report["configs"][name] = {"error": f"gate says {impl}"}
+            ok = False
+            continue
+        try:
+            report["configs"][name] = _compile_one(
+                eng, mesh, kind, plen, dtype, steps
+            )
+            if not report["configs"][name]["mosaic_custom_call"]:
+                ok = False
+        except Exception as exc:  # noqa: BLE001 - record per-config
+            report["configs"][name] = {
+                "error": f"{type(exc).__name__}: {exc}"[:500]
+            }
+            ok = False
+    report["all_ok"] = ok
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), args.out)
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(report, indent=1))
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,11 @@ def main() -> None:
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
+    if not busy:
+        print(f"wall {wall * 1e3:.1f} ms — no TPU plane in the trace "
+              f"(CPU-only backend or profiler failure); op breakdown "
+              f"needs a TPU timeline")
+        return
     total_busy = sum(busy.values()) / max(len(busy), 1)
     print(f"wall {wall * 1e3:.1f} ms   device busy {total_busy * 1e3:.1f} ms"
           f"   goodput {moved / total_busy / 1e9:.1f} GB/s (device)"
