@@ -387,6 +387,15 @@ class KVWorker:
         with self._mu:
             return self._device_results.get(ts)
 
+    def coalescer(self, handle=None, **kw):
+        """Coalescing async dispatcher over the worker's collective
+        engine: per-op ``push_pull(name, grads)`` tickets micro-batch
+        into ONE grouped program per window (the dispatch-amortized form
+        of N concurrent ZPushes; see parallel/coalesce.py)."""
+        log.check(self.engine is not None,
+                  "coalescer requires the collective engine (ICI van)")
+        return self.engine.coalescer(handle=handle, **kw)
+
     def replay(self, name: str, grads_seq, keep: str = "all"):
         """Fused multi-step push_pull on a registered dense bucket: T
         steps compiled into ONE program (engine.replay — lax.scan over

@@ -3,9 +3,11 @@ tables, and sequence-parallel primitives."""
 
 from .mesh import default_mesh, make_mesh
 from .engine import CollectiveEngine, DenseBucket
+from .coalesce import CoalescingDispatcher
 from .pipeline import pipeline_apply, pipeline_loss, stack_layers
 
 __all__ = [
+    "CoalescingDispatcher",
     "CollectiveEngine",
     "DenseBucket",
     "default_mesh",

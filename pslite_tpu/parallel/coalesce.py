@@ -1,0 +1,198 @@
+"""Coalescing async dispatcher: concurrently-issued per-op pushes/pulls
+micro-batch into ONE grouped program per window.
+
+The PS contract allows arbitrary async ZPush/ZPull at any moment
+(`include/ps/kv_app.h:218-247` — issue, keep working, Wait later).  The
+engine's per-op path pays ~50-100 µs Python+dispatch per call, which
+dominates small buckets (the 1KB per-op sweep runs ~100x off the
+headline).  ``push_pull_group`` fixes it for callers who ALREADY hold a
+list of buckets; this dispatcher fixes it for callers who issue ops one
+at a time from one or many threads: ops enqueue into a short window
+(default 200 µs, tunable) and a drain thread dispatches each window as
+one :meth:`CollectiveEngine.push_pull_group` program — N concurrent
+small ops cost ~1 dispatch.
+
+The async contract is unchanged: :meth:`push_pull` returns a
+:class:`Ticket` immediately; ``ticket.result()`` (or ``wait()``) blocks
+until the batched dispatch has run and returns the pulled array.
+Waiting on an op whose window has not drained yet flushes it first —
+a lone op never stalls for the window timer.
+
+Ordering: ops on DIFFERENT buckets may be reordered into one program
+(they are independent — the reference gives the same freedom to
+per-key server queues, kv_app.h's per-key timestamps).  Ops on the SAME
+bucket preserve issue order: a window holding a duplicate bucket splits
+into consecutive sub-batches (grouped stores are donated, so one
+program cannot consume a bucket twice).
+
+Reference analog: the reference converges per-key traffic through
+per-connection send queues that batch at the transport (zmq_van.h
+multipart sends); here the batching happens at program-dispatch level,
+which is where the TPU path pays its per-op cost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..utils import logging as log
+
+
+class Ticket:
+    """Async handle for one coalesced op (the ZPush/ZPull timestamp
+    analog).  ``result()`` blocks until the op's window has dispatched
+    and returns the pulled array (push ops return the completion
+    token); exceptions from the batched dispatch re-raise here."""
+
+    __slots__ = ("_disp", "_done", "_value", "_error")
+
+    def __init__(self, disp: "CoalescingDispatcher"):
+        self._disp = disp
+        self._done = threading.Event()
+        self._value = None
+        self._error = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.is_set():
+            self._disp.flush()
+            if not self._done.wait(timeout):
+                raise TimeoutError("coalesced op not dispatched in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class CoalescingDispatcher:
+    """Micro-batching front end over one :class:`CollectiveEngine`.
+
+    One dispatcher per (engine, handle): the window groups ops that can
+    legally share a grouped program, and the handle is part of that
+    program, so mixed handles need separate dispatchers (same rule as
+    ``push_pull_group``).  Stateless handles only.
+    """
+
+    def __init__(self, engine, handle=None, max_pending: int = 64,
+                 window_us: int = 200):
+        resolved, _ = engine._resolve_handle(handle)
+        log.check(not engine._is_stateful(resolved),
+                  "coalescing supports stateless handles only "
+                  "(the grouped program's constraint)")
+        self._eng = engine
+        self._handle = handle
+        self._max_pending = max_pending
+        self._window_s = window_us / 1e6
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._queue: list = []  # [(name, grads, Ticket)]
+        self._flush_now = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._drain, name="ps-coalesce", daemon=True
+        )
+        self._thread.start()
+
+    # -- public surface ------------------------------------------------------
+
+    def push_pull(self, name: str, grads) -> Ticket:
+        """Enqueue one fused push+pull on a registered dense bucket;
+        returns immediately.  An unknown bucket fails ONLY this ticket
+        (per-op independence, kv_app.h's per-key timestamps) — it must
+        not reach the grouped dispatch, where one bad name would poison
+        the whole sub-batch's tickets."""
+        t = Ticket(self)
+        if name not in self._eng._buckets:
+            t._error = KeyError(name)
+            t._done.set()
+            return t
+        with self._cv:
+            log.check(not self._closed, "dispatcher closed")
+            self._queue.append((name, grads, t))
+            if len(self._queue) >= self._max_pending:
+                self._flush_now = True
+            self._cv.notify()
+        return t
+
+    def flush(self) -> None:
+        """Dispatch the current window without waiting for the timer."""
+        with self._cv:
+            self._flush_now = True
+            self._cv.notify()
+
+    def close(self) -> None:
+        """Flush and stop the drain thread (idempotent)."""
+        with self._cv:
+            self._closed = True
+            self._flush_now = True
+            self._cv.notify()
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- drain ---------------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                # Straggler window: give concurrent issuers a beat to
+                # join the batch — unless someone is already waiting
+                # (flush) or the batch is full.  Looped against a
+                # monotonic deadline: every enqueue notifies the cv, so
+                # a single wait would wake (and close the window) on
+                # the SECOND op, fragmenting batches.
+                if not self._flush_now:
+                    deadline = time.monotonic() + self._window_s
+                    while not self._flush_now and not self._closed:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                batch = self._queue
+                self._queue = []
+                self._flush_now = False
+            self._dispatch(batch)
+
+    def _dispatch(self, batch) -> None:
+        # Same-bucket ops preserve issue order: split the window into
+        # consecutive duplicate-free sub-batches.
+        sub: list = []
+        seen: set = set()
+        for item in batch:
+            if item[0] in seen:
+                self._run(sub)
+                sub, seen = [], set()
+            sub.append(item)
+            seen.add(item[0])
+        if sub:
+            self._run(sub)
+
+    def _run(self, sub) -> None:
+        try:
+            if len(sub) == 1:
+                name, grads, t = sub[0]
+                outs = [self._eng.push_pull(name, grads,
+                                            handle=self._handle)]
+            else:
+                outs = self._eng.push_pull_group(
+                    [s[0] for s in sub], [s[1] for s in sub],
+                    handle=self._handle,
+                )
+            for (_, _, t), out in zip(sub, outs):
+                t._value = out
+                t._done.set()
+        except Exception as exc:  # noqa: BLE001 - deliver to waiters
+            for _, _, t in sub:
+                t._error = exc
+                t._done.set()

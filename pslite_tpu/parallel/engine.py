@@ -1057,6 +1057,15 @@ class CollectiveEngine:
         # donated by the next push, so it must not escape).
         return token
 
+    def coalescer(self, handle: Optional[ServerHandle] = None, **kw):
+        """A :class:`~pslite_tpu.parallel.coalesce.CoalescingDispatcher`
+        over this engine: concurrently-issued per-op push_pulls
+        micro-batch into grouped programs (the async ZPush/ZPull
+        amortization — see the module docstring)."""
+        from .coalesce import CoalescingDispatcher
+
+        return CoalescingDispatcher(self, handle=handle, **kw)
+
     def push_pull_group(self, names, grads_list,
                         handle: Optional[ServerHandle] = None):
         """Fused push_pull over SEVERAL buckets in ONE jitted program —

@@ -1,0 +1,173 @@
+"""CoalescingDispatcher: concurrently-issued per-op ops batch into one
+grouped program, with the async ZPush/Wait contract unchanged
+(include/ps/kv_app.h:218-247 — issue any time, Wait later)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu.parallel import CoalescingDispatcher, CollectiveEngine, \
+    default_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return default_mesh()
+
+
+def _register(eng, names, val_len=64):
+    for n in names:
+        eng.register_dense(n, np.arange(2, dtype=np.uint64), val_len)
+
+
+def test_coalesced_matches_per_op(mesh):
+    """Results and final stores equal the sequential per-op path."""
+    names = [f"c{i}" for i in range(6)]
+    rng = np.random.default_rng(81)
+    grads = {n: rng.normal(size=(8, 128)).astype(np.float32)
+             for n in names}
+
+    ref = CollectiveEngine(mesh=mesh)
+    _register(ref, names)
+    expected = {n: np.asarray(ref.push_pull(n, grads[n])) for n in names}
+
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, names)
+    with eng.coalescer(window_us=50_000) as disp:
+        tickets = {n: disp.push_pull(n, grads[n]) for n in names}
+        for n in names:
+            np.testing.assert_allclose(
+                np.asarray(tickets[n].result()), expected[n], rtol=1e-5
+            )
+
+
+def test_window_groups_into_one_dispatch(mesh):
+    """Ops enqueued inside one window run as ONE grouped program."""
+    names = [f"g{i}" for i in range(8)]
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, names)
+    calls = []
+    orig = eng.push_pull_group
+
+    def counting(ns, gs, handle=None):
+        calls.append(list(ns))
+        return orig(ns, gs, handle=handle)
+
+    eng.push_pull_group = counting
+    ones = np.ones((8, 128), np.float32)
+    # Long window so every enqueue lands before the drain wakes; the
+    # first result() flushes.
+    with eng.coalescer(window_us=200_000) as disp:
+        tickets = [disp.push_pull(n, ones) for n in names]
+        for t in tickets:
+            t.result()
+    assert calls == [names]
+
+
+def test_same_bucket_preserves_order(mesh):
+    """Duplicate buckets in a window split into sequential sub-batches:
+    the first ticket sees only op1's effect, the second sees both."""
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, ["dup"])
+    ones = np.ones((8, 128), np.float32)
+    with eng.coalescer(window_us=200_000) as disp:
+        t1 = disp.push_pull("dup", ones)
+        t2 = disp.push_pull("dup", 2 * ones)
+        # sum over 8 workers: op1 adds 8, op2 adds 16 more.
+        np.testing.assert_allclose(np.asarray(t1.result()),
+                                   8 * np.ones(128))
+        np.testing.assert_allclose(np.asarray(t2.result()),
+                                   24 * np.ones(128))
+
+
+def test_concurrent_issuers(mesh):
+    """Ops issued from many threads all complete with correct values."""
+    names = [f"t{i}" for i in range(8)]
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, names)
+    results = {}
+    errs = []
+
+    with eng.coalescer(window_us=1_000) as disp:
+        def issue(n, scale):
+            try:
+                t = disp.push_pull(
+                    n, scale * np.ones((8, 128), np.float32)
+                )
+                results[n] = np.asarray(t.result())
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=issue, args=(n, i + 1))
+            for i, n in enumerate(names)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    assert not errs
+    for i, n in enumerate(names):
+        np.testing.assert_allclose(results[n],
+                                   8 * (i + 1) * np.ones(128))
+
+
+def test_error_delivery(mesh):
+    """A bad op fails ITS ticket with the original exception."""
+    eng = CollectiveEngine(mesh=mesh)
+    with eng.coalescer() as disp:
+        t = disp.push_pull("never_registered", np.ones(4, np.float32))
+        with pytest.raises(KeyError):
+            t.result()
+
+
+def test_stateful_handle_rejected(mesh):
+    eng = CollectiveEngine(mesh=mesh, server_handle="adam:0.01")
+    with pytest.raises(Exception):
+        eng.coalescer()
+
+
+def test_trickled_ops_share_one_window(mesh):
+    """Ops arriving one by one WITHIN the window still coalesce into a
+    single grouped dispatch — the window must not close on the second
+    enqueue's cv notify."""
+    import time as _time
+
+    names = [f"w{i}" for i in range(5)]
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, names)
+    calls = []
+    orig = eng.push_pull_group
+
+    def counting(ns, gs, handle=None):
+        calls.append(list(ns))
+        return orig(ns, gs, handle=handle)
+
+    eng.push_pull_group = counting
+    ones = np.ones((8, 128), np.float32)
+    with eng.coalescer(window_us=500_000) as disp:
+        tickets = []
+        for n in names:
+            tickets.append(disp.push_pull(n, ones))
+            _time.sleep(0.01)  # trickle well inside the 500ms window
+        for t in tickets:
+            t.result()
+    assert calls == [names]
+
+
+def test_bad_op_does_not_poison_batchmates(mesh):
+    """An unknown bucket fails only ITS ticket; a valid op in the same
+    window still completes."""
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, ["good"])
+    ones = np.ones((8, 128), np.float32)
+    with eng.coalescer(window_us=200_000) as disp:
+        t_bad = disp.push_pull("missing", ones)
+        t_good = disp.push_pull("good", ones)
+        np.testing.assert_allclose(np.asarray(t_good.result()),
+                                   8 * np.ones(128))
+        with pytest.raises(KeyError):
+            t_bad.result()
