@@ -151,16 +151,36 @@ class CollectiveEngine:
 
         from .placement import local_shard_count, mesh_is_multiprocess
 
+        if isinstance(axis_name, (tuple, list)):
+            # MULTI-AXIS kv plane (>=3-D torus with worker_axis): the
+            # store shards over the PRODUCT of these axes
+            # (P(("kv1","kv2"))) and the pulled broadcast gathers over
+            # both — with the fused dp sub-rings, one push_pull then
+            # drives all three torus axes' links (the reference's 32
+            # ports/devices per node, message.h:66-134, ucx_van.h:938-
+            # 1006; v5p pods are 3-D tori).
+            axis_name = tuple(axis_name)
+            log.check(len(axis_name) >= 1, "empty kv axis tuple")
+            for a in axis_name:
+                log.check(a in (mesh.axis_names if mesh is not None
+                                else ()),
+                          f"kv axis {a!r} not in mesh (tuple axes "
+                          f"require an explicit mesh)")
         self.mesh = mesh if mesh is not None else default_mesh(axis_name)
         self.axis = axis_name
         self.worker_axis = worker_axis
+        kv_axes = (
+            axis_name if isinstance(axis_name, tuple) else (axis_name,)
+        )
         if worker_axis is not None:
             log.check(worker_axis in self.mesh.axis_names,
                       f"worker axis {worker_axis!r} not in mesh")
-            log.check(worker_axis != axis_name,
+            log.check(worker_axis not in kv_axes,
                       "worker_axis must differ from the kv axis (leave it "
                       "None for the 1-D colocated layout)")
-        self.num_shards = self.mesh.shape[axis_name]
+        self.num_shards = int(
+            np.prod([self.mesh.shape[a] for a in kv_axes])
+        )
         # Worker fan-in rows of the grads array.
         self.num_workers = (
             self.mesh.shape[worker_axis] if worker_axis is not None
@@ -509,6 +529,10 @@ class CollectiveEngine:
         broadcast rides XLA's all_gather on the kv-axis links — both
         torus axes carry the one push_pull."""
         if self.impl != "pallas":
+            return "xla"
+        if self.worker_axis is None and isinstance(self.axis, tuple):
+            # A composite kv axis has no single ring dimension; the
+            # multi-axis plane needs worker_axis sub-rings.
             return "xla"
         ring_n = (
             self.num_workers if self.worker_axis is not None
@@ -1897,15 +1921,19 @@ class CollectiveEngine:
 
         new_multiprocess = mesh_is_multiprocess(mesh)
         axis = axis_name or self.axis
-        log.check(axis in mesh.axis_names,
-                  f"axis {axis!r} not in new mesh")
+        if isinstance(axis, (tuple, list)):
+            axis = tuple(axis)
+        kv_axes = axis if isinstance(axis, tuple) else (axis,)
+        for a in kv_axes:
+            log.check(a in mesh.axis_names,
+                      f"kv axis {a!r} not in new mesh")
         if self.worker_axis is not None:
             log.check(
                 self.worker_axis in mesh.axis_names,
                 f"worker axis {self.worker_axis!r} not in new mesh "
                 f"(a 2-D engine stays 2-D across reshards)",
             )
-            log.check(self.worker_axis != axis,
+            log.check(self.worker_axis not in kv_axes,
                       "worker_axis must differ from the kv axis")
         with self._mu:
             names = list(self._buckets)
@@ -1940,7 +1968,9 @@ class CollectiveEngine:
 
             self.mesh = mesh
             self.axis = axis
-            self.num_shards = mesh.shape[axis]
+            self.num_shards = int(
+                np.prod([mesh.shape[a] for a in kv_axes])
+            )
             self.num_workers = (
                 mesh.shape[self.worker_axis]
                 if self.worker_axis is not None

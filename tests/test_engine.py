@@ -961,3 +961,102 @@ def test_replay_zero_copy_last_single_device():
     out = eng.replay("zl", seq, keep="last", zero_copy=True)
     assert out is eng._stores["zl"]
     np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-5)
+
+
+def test_three_axis_torus_parity():
+    """3-D torus (dp, kv1, kv2): store sharded over BOTH kv axes, fused
+    dp sub-rings (ring positions translate through three axes'
+    coordinates), pulled broadcast gathered over both kv axes — ring
+    matches XLA (VERDICT r03 missing #4)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh3 = make_mesh((2, 2, 2), ("dp", "kv1", "kv2"))
+    keys = np.arange(3, dtype=np.uint64)
+    val_len = 101  # total 303: not divisible by 4 -> padding path
+    rng = np.random.default_rng(91)
+    g = rng.normal(size=(2, 303)).astype(np.float32)
+
+    outs = {}
+    for impl in ("xla", "pallas"):
+        eng = CollectiveEngine(mesh=mesh3, axis_name=("kv1", "kv2"),
+                               worker_axis="dp", impl=impl)
+        assert eng.num_shards == 4
+        assert eng._effective_impl(np.float32, "sum") == impl
+        eng.register_dense("t3", keys, val_len)
+        assert eng.bucket("t3").padded_len > eng.bucket("t3").total_len
+        outs[impl] = np.asarray(eng.push_pull("t3", g))
+        np.testing.assert_allclose(outs[impl], g.sum(axis=0),
+                                   rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(outs["pallas"], outs["xla"],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_three_axis_torus_stateful_and_replay():
+    """Stateful handles + replay on the 3-D torus match a 1-D reference
+    engine step for step."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh3 = make_mesh((2, 2, 2), ("dp", "kv1", "kv2"))
+    mesh1 = default_mesh()
+    keys = np.arange(2, dtype=np.uint64)
+    rng = np.random.default_rng(93)
+    T = 3
+    # 1-D reference: 8 workers; 3-D: 2 workers — use grads that sum the
+    # same: each of the 2 dp rows carries 4x the base row.
+    base = rng.normal(size=(T, 128)).astype(np.float32)
+    seq3 = np.stack([np.stack([4 * b, 4 * b]) for b in base])  # [T,2,128]
+    seq1 = np.stack([np.stack([b] * 8) for b in base])         # [T,8,128]
+
+    ref = CollectiveEngine(mesh=mesh1, server_handle="adam:0.01")
+    ref.register_dense("r1", keys, 64)
+    eng = CollectiveEngine(mesh=mesh3, axis_name=("kv1", "kv2"),
+                           worker_axis="dp", server_handle="adam:0.01")
+    eng.register_dense("r3", keys, 64)
+    exp = np.asarray(ref.replay("r1", seq1, keep="last"))
+    got = np.asarray(eng.replay("r3", seq3, keep="last"))
+    np.testing.assert_allclose(got, exp, rtol=2e-5, atol=2e-5)
+
+
+def test_tuple_axis_without_worker_axis_colocated():
+    """A composite kv axis with no worker axis: the 1-D colocated
+    semantics hold (workers = product of the axes) and the ring gate
+    falls back to XLA (no single ring dimension)."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh3 = make_mesh((2, 4), ("kv1", "kv2"))
+    eng = CollectiveEngine(mesh=mesh3, axis_name=("kv1", "kv2"),
+                           impl="pallas")
+    assert eng.num_shards == 8
+    assert eng._effective_impl(np.float32, "sum") == "xla"
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("c2", keys, 64)
+    rng = np.random.default_rng(95)
+    g = rng.normal(size=(8, 128)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(eng.push_pull("c2", g)), g.sum(axis=0), rtol=1e-5
+    )
+
+
+def test_three_axis_torus_reshard():
+    """The elastic tier handles composite kv axes: a (2,2,2)-torus
+    engine reshards onto a 1-D mesh and back without losing state."""
+    from pslite_tpu.parallel.mesh import make_mesh
+
+    mesh3 = make_mesh((2, 2, 2), ("dp", "kv1", "kv2"))
+    eng = CollectiveEngine(mesh=mesh3, axis_name=("kv1", "kv2"),
+                           worker_axis="dp")
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("rs3", keys, 64)
+    ones = np.ones((2, 128), np.float32)
+    eng.push_pull("rs3", ones)  # store = 2
+
+    mesh2 = make_mesh((2, 4), ("dp", "kv"))
+    eng.reshard(mesh2, axis_name="kv")
+    assert eng.num_shards == 4
+    np.testing.assert_allclose(np.asarray(eng.pull("rs3"))[:128],
+                               2 * np.ones(128))
+    eng.reshard(mesh3, axis_name=("kv1", "kv2"))
+    assert eng.num_shards == 4
+    np.testing.assert_allclose(
+        np.asarray(eng.push_pull("rs3", ones)), 4 * np.ones(128)
+    )

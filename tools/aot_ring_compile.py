@@ -15,7 +15,8 @@ compilation does not.
 Writes a machine-checkable report to docs/AOT_RING.json (and a human
 summary to stdout).  Configs cover every kernel variant the engine can
 select: bidirectional f32/bf16, int8 wire compression, push-only,
-2-D multi-axis (dp sub-rings + kv gather), and the fused replay scan.
+2-D multi-axis (dp sub-rings + kv gather), the 3-D torus (dp sub-rings
++ two-axis kv gather), and the fused replay scan.
 
 Usage: python tools/aot_ring_compile.py [--topology v5e:2x4]
 """
@@ -118,6 +119,9 @@ def main() -> int:
     engc = CollectiveEngine(mesh=mesh1, impl="pallas", wire_compress="int8")
     mesh2 = Mesh(devs.reshape(n // 2, 2), ("dp", "kv"))
     eng2 = CollectiveEngine(mesh=mesh2, impl="pallas", worker_axis="dp")
+    mesh3 = Mesh(devs.reshape(2, 2, n // 4), ("dp", "kv1", "kv2"))
+    eng3 = CollectiveEngine(mesh=mesh3, axis_name=("kv1", "kv2"),
+                            worker_axis="dp", impl="pallas")
 
     padded = n * 65536  # 2MB f32 per bucket at n=8
     configs = [
@@ -129,6 +133,8 @@ def main() -> int:
          jnp.float32, 0),
         ("push_only", eng1, mesh1, "push", padded, jnp.float32, 0),
         ("multi_axis_2d", eng2, mesh2, "push_pull", padded,
+         jnp.float32, 0),
+        ("multi_axis_3d_torus", eng3, mesh3, "push_pull", padded,
          jnp.float32, 0),
         ("replay_scan_T4", eng1, mesh1, "replay", padded, jnp.float32, 4),
     ]
