@@ -27,6 +27,7 @@ address" contract (test_benchmark.cc:169-181) maps to donated device buffers
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -1884,7 +1885,9 @@ class CollectiveEngine:
 
     def reshard(self, mesh, axis_name: Optional[str] = None) -> None:
         """Re-lay every registered bucket (store + optimizer state) onto
-        a new mesh — the engine-side ELASTIC tier.
+        a new mesh — the engine-side ELASTIC tier.  See
+        :meth:`reshard_staged` for the stage/commit split that
+        coordinated multi-engine recuts use for pair atomicity.
 
         The reference's recovery path re-admits a node into the same
         roster under the dead node's id (van.cc:266-332); on the
@@ -1911,6 +1914,22 @@ class CollectiveEngine:
         both recut.  Callers' grads arrays must use the NEW worker
         fan-in after this returns.
         """
+        with self.reshard_staged(mesh, axis_name) as commit:
+            commit()
+
+    @contextlib.contextmanager
+    def reshard_staged(self, mesh, axis_name: Optional[str] = None):
+        """Stage a recut and yield its zero-failure commit closure.
+
+        The snapshot + new-mesh placements (everything that can fail,
+        including the multi-process collectives) run on entry; the
+        yielded ``commit()`` performs plain field/dict assignments only.
+        A coordinated multi-engine recut stages EVERY engine first and
+        only then commits them all, so a failure in any engine's staging
+        aborts the whole group with every engine untouched — the
+        pair-level crash-consistency contract of
+        ``reshard_engines`` (tests/test_reshard_crash.py).  Bucket locks
+        are held until the context exits."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .placement import (
@@ -1966,65 +1985,105 @@ class CollectiveEngine:
                     )
                 snap[n] = (b, store, opt)
 
-            self.mesh = mesh
-            self.axis = axis
-            self.num_shards = int(
+            # STAGE: build every new placement against the NEW mesh
+            # without touching engine state.  Any failure in this block
+            # aborts with the engine fully on the OLD mesh — a crashed
+            # or failed recut must never leave torn stores (the
+            # crash-consistency contract of the cluster-coordinated
+            # reshard; reference analog: recovery tolerates death at
+            # any moment, van.cc:266-332).
+            from .placement import place_host_array
+
+            new_num_shards = int(
                 np.prod([mesh.shape[a] for a in kv_axes])
             )
-            self.num_workers = (
+            new_num_workers = (
                 mesh.shape[self.worker_axis]
                 if self.worker_axis is not None
-                else self.num_shards
+                else new_num_shards
             )
-            self._multiprocess = new_multiprocess
-            self._local_shard_count = (
-                local_shard_count(mesh) if new_multiprocess
-                else self.num_shards
-            )
-            with self._mu:
-                self._programs.clear()
             sharding = NamedSharding(mesh, P(axis))
+
+            def _nplace(host_arr, shard_spec):
+                return place_host_array(
+                    mesh, host_arr, shard_spec, new_multiprocess
+                )
 
             def _repad(flat_host, total, padded, dt):
                 out = np.zeros(padded, dtype=np.dtype(dt))
                 out[:total] = flat_host[:total]
-                return self._place(out, sharding)
+                return _nplace(out, sharding)
 
+            staged = {}
             for n in names:
                 b, store, opt = snap[n]
-                b.padded_len = (
-                    -(-b.total_len // self.num_shards) * self.num_shards
+                padded = (
+                    -(-b.total_len // new_num_shards) * new_num_shards
                 )
-                self._stores[n] = _repad(
-                    store, b.total_len, b.padded_len, b.dtype
-                )
+                entry = {
+                    "padded": padded,
+                    "store": _repad(store, b.total_len, padded, b.dtype),
+                }
                 if n in self._pinned_pulls:
                     # Re-pin on the new mesh: the old pinned buffer's
                     # devices/shape no longer match (a fresh address —
                     # same as re-registering after recovery).
-                    self._pinned_pulls[n] = self._place(
-                        np.zeros(b.padded_len, dtype=np.dtype(b.dtype)),
+                    entry["pinned"] = _nplace(
+                        np.zeros(padded, dtype=np.dtype(b.dtype)),
                         NamedSharding(mesh, P(None)),
                     )
-                if opt is None:
-                    self._opt_states.pop(n, None)
-                    self._opt_kinds.pop(n, None)
-                    continue
-                kind, arrs = opt
-                if kind in ("sgd_momentum", "adagrad"):
-                    state = (_repad(arrs[0], b.total_len, b.padded_len,
-                                    b.dtype),)
-                else:  # adam: m, v, per-shard step counter
-                    step = float(arrs[2][0]) if len(arrs[2]) else 0.0
-                    state = (
-                        _repad(arrs[0], b.total_len, b.padded_len, b.dtype),
-                        _repad(arrs[1], b.total_len, b.padded_len, b.dtype),
-                        self._place(
-                            np.full(self.num_shards, step, np.float32),
-                            sharding,
-                        ),
-                    )
-                self._opt_states[n] = state
+                if opt is not None:
+                    kind, arrs = opt
+                    if kind in ("sgd_momentum", "adagrad"):
+                        state = (
+                            _repad(arrs[0], b.total_len, padded, b.dtype),
+                        )
+                    else:  # adam: m, v, per-shard step counter
+                        step = float(arrs[2][0]) if len(arrs[2]) else 0.0
+                        state = (
+                            _repad(arrs[0], b.total_len, padded, b.dtype),
+                            _repad(arrs[1], b.total_len, padded, b.dtype),
+                            _nplace(
+                                np.full(new_num_shards, step, np.float32),
+                                sharding,
+                            ),
+                        )
+                    entry["opt"] = state
+                staged[n] = entry
+
+            # COMMIT closure: plain field/dict assignments only —
+            # cannot fail partway, so observers see the old mesh or the
+            # new one, never a mixture.
+            def commit() -> None:
+                self.mesh = mesh
+                self.axis = axis
+                self.num_shards = new_num_shards
+                self.num_workers = new_num_workers
+                self._multiprocess = new_multiprocess
+                self._mesh_platform = next(
+                    iter(mesh.devices.flat)
+                ).platform
+                self._ring_interpret = self._mesh_platform != "tpu"
+                self._local_shard_count = (
+                    local_shard_count(mesh) if new_multiprocess
+                    else new_num_shards
+                )
+                with self._mu:
+                    self._programs.clear()
+                for n in names:
+                    b = snap[n][0]
+                    entry = staged[n]
+                    b.padded_len = entry["padded"]
+                    self._stores[n] = entry["store"]
+                    if "pinned" in entry:
+                        self._pinned_pulls[n] = entry["pinned"]
+                    if "opt" in entry:
+                        self._opt_states[n] = entry["opt"]
+                    else:
+                        self._opt_states.pop(n, None)
+                        self._opt_kinds.pop(n, None)
+
+            yield commit
         finally:
             for n in reversed(ordered):
                 self._bucket_mu[n].release()

@@ -23,6 +23,7 @@ BASELINE.md config 5) then load-balance across shards by construction.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass
@@ -42,6 +43,24 @@ class SparseTable:
     rows_per_shard: int
     dtype: object
 
+
+
+def _interleave_rows(glob, num_rows: int, rps: int, S: int, dtype):
+    """Global-order rows -> the sharded store layout: global row r
+    lives on shard r % S at local row r // S.  ``glob`` is [num_rows]
+    or [num_rows, dim]; returns the flat interleaved array of
+    rps*S (x dim) entries.  The ONE definition of the layout —
+    register_sparse init, reshard stores, and reshard accumulators all
+    route through it (pull correctness depends on them agreeing)."""
+    glob = np.asarray(glob, dtype=np.dtype(dtype))
+    shape = (rps * S,) + glob.shape[1:]
+    arr = np.zeros(shape, dtype=np.dtype(dtype))
+    arr[:num_rows] = glob
+    if arr.ndim == 1:
+        return arr.reshape(rps, S).transpose(1, 0).reshape(-1)
+    return arr.reshape(rps, S, -1).transpose(1, 0, 2).reshape(
+        -1, arr.shape[1]
+    )
 
 
 def _agg_rows(axis, S, R, dtype, dim, idx_l, grads_l):
@@ -137,15 +156,11 @@ class SparseEngine:
         table = SparseTable(name, num_rows, dim, rows_per_shard, dtype)
         sharding = NamedSharding(self.mesh, P(self.axis, None))
         if init is not None:
-            arr = np.zeros((rows_per_shard * self.num_shards, dim),
-                           dtype=np.dtype(dtype))
-            # Global row r lives on shard r % S at local row r // S: fill by
-            # interleaving so restore/init round-trips with pull.
-            arr[: num_rows] = np.asarray(init, dtype=np.dtype(dtype))
-            arr = arr.reshape(rows_per_shard, self.num_shards, dim).transpose(
-                1, 0, 2
-            ).reshape(-1, dim)
-            store = self._place(arr, sharding)
+            store = self._place(
+                _interleave_rows(init, num_rows, rows_per_shard,
+                                 self.num_shards, dtype),
+                sharding,
+            )
         elif self._is_multiprocess():
             store = self._place(
                 np.zeros((rows_per_shard * self.num_shards, dim),
@@ -653,7 +668,8 @@ class SparseEngine:
 
     def reshard(self, mesh, axis_name: Optional[str] = None) -> None:
         """Re-lay every registered table onto a new mesh — the sparse
-        half of the engine elastic tier (see CollectiveEngine.reshard).
+        half of the engine elastic tier (see CollectiveEngine.reshard
+        and reshard_staged for the pair-atomicity split).
 
         Rows are de-interleaved to global order on the host, the
         row→shard mapping is recut for the new shard count (global row r
@@ -663,6 +679,15 @@ class SparseEngine:
         Multi-process meshes work on either side; reshard is then a
         COLLECTIVE — every participating process calls it with the same
         new mesh (see CollectiveEngine.reshard)."""
+        with self.reshard_staged(mesh, axis_name) as commit:
+            commit()
+
+    @contextlib.contextmanager
+    def reshard_staged(self, mesh, axis_name: Optional[str] = None):
+        """Stage a table recut and yield its zero-failure commit
+        closure — same contract as CollectiveEngine.reshard_staged
+        (everything fallible on entry, commit is assignments only,
+        table locks held until exit)."""
         from .placement import (
             local_shard_count,
             mesh_is_multiprocess,
@@ -704,36 +729,62 @@ class SparseEngine:
                     )
                 snap[n] = (t, glob, acc_glob)
 
-            self.mesh = mesh
-            self.axis = axis
-            self.num_shards = mesh.shape[axis]
-            self._multiprocess = new_multiprocess
-            self._local_shard_count = (
-                local_shard_count(mesh) if new_multiprocess
-                else self.num_shards
-            )
-            with self._mu:
-                self._programs.clear()
+            # STAGE: build every new placement against the NEW mesh
+            # without touching engine state — a failed recut aborts with
+            # every table intact on the old mesh (crash-consistency, see
+            # CollectiveEngine.reshard's staged commit).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .placement import place_host_array
+
+            new_num_shards = mesh.shape[axis]
+            row_sharding = NamedSharding(mesh, P(axis, None))
+            acc_sharding = NamedSharding(mesh, P(axis))
+            staged = {}
             for n in names:
                 t, glob, acc_glob = snap[n]
-                # register_sparse re-interleaves init rows for the new
-                # shard count and replaces the table/store in place.
-                self.register_sparse(
-                    n, t.num_rows, t.dim, dtype=t.dtype, init=glob
+                rps = -(-t.num_rows // new_num_shards)
+                store = place_host_array(
+                    mesh,
+                    _interleave_rows(glob, t.num_rows, rps,
+                                     new_num_shards, t.dtype),
+                    row_sharding, new_multiprocess,
                 )
+                acc = None
                 if acc_glob is not None:
-                    from jax.sharding import NamedSharding, PartitionSpec as P
-
-                    t2 = self._tables[n]
-                    S2, rps2 = self.num_shards, t2.rows_per_shard
-                    arr = np.zeros(rps2 * S2, np.float32)
-                    arr[: t2.num_rows] = acc_glob
-                    arr = arr.reshape(rps2, S2).transpose(1, 0).reshape(-1)
-                    # Direct placement: reshard already holds the table
-                    # locks set_acc_array would re-acquire.
-                    self._acc[n] = self._place(
-                        arr, NamedSharding(self.mesh, P(self.axis))
+                    acc = place_host_array(
+                        mesh,
+                        _interleave_rows(acc_glob, t.num_rows, rps,
+                                         new_num_shards, np.float32),
+                        acc_sharding, new_multiprocess,
                     )
+                staged[n] = (
+                    SparseTable(n, t.num_rows, t.dim, rps, t.dtype),
+                    store,
+                    acc,
+                )
+
+            # COMMIT closure: plain assignments only — never a torn
+            # table set.
+            def commit() -> None:
+                self.mesh = mesh
+                self.axis = axis
+                self.num_shards = new_num_shards
+                self._multiprocess = new_multiprocess
+                self._local_shard_count = (
+                    local_shard_count(mesh) if new_multiprocess
+                    else new_num_shards
+                )
+                with self._mu:
+                    self._programs.clear()
+                    for n in names:
+                        table, store, acc = staged[n]
+                        self._tables[n] = table
+                        self._stores[n] = store
+                        if acc is not None:
+                            self._acc[n] = acc
+
+            yield commit
         finally:
             for n in reversed(ordered):
                 self._table_mu[n].release()

@@ -194,10 +194,18 @@ class Postoffice:
     # -- barriers ------------------------------------------------------------
 
     def barrier(
-        self, customer_id: int, group: int = ALL_GROUP, instance: bool = False
+        self, customer_id: int, group: int = ALL_GROUP,
+        instance: bool = False, timeout_s: Optional[float] = None,
     ) -> None:
         """Block until every member of ``group`` reaches the barrier
-        (reference: postoffice.cc:224-250)."""
+        (reference: postoffice.cc:224-250).
+
+        ``timeout_s`` bounds the wait (None = forever, the reference
+        default): a member that died before reaching the barrier would
+        otherwise wedge every peer.  On expiry raises CheckError; the
+        caller must treat the cluster as degraded — a late release for
+        THIS barrier may still arrive, so no further barrier should be
+        issued until recovery re-establishes the roster."""
         members = self.get_node_ids(group)
         if len(members) <= 1:
             return
@@ -205,7 +213,18 @@ class Postoffice:
             self._barrier_done = False
         self.van.request_barrier(group, instance)
         with self._barrier_cv:
-            self._barrier_cv.wait_for(lambda: self._barrier_done)
+            ok = self._barrier_cv.wait_for(
+                lambda: self._barrier_done, timeout_s
+            )
+        if not ok:
+            # Withdraw the pending request so the stale count cannot
+            # release a FUTURE barrier early for the surviving peers
+            # (best-effort: a release already in flight wins the race,
+            # in which case the peers passed and only this caller
+            # treats the sync as failed — still safe, still degraded).
+            self.van.cancel_barrier(group, instance)
+        log.check(ok, f"barrier(group={group}) timed out after "
+                      f"{timeout_s}s — peer dead before the barrier?")
 
     def manage(self, msg: Message) -> None:
         """Handle barrier responses (reference: postoffice.cc:270-283)."""

@@ -103,7 +103,32 @@ class _IciDataPlane:
         snapshot/rebuild runs, and no process resumes pushing until
         every process finished the recut — the elastic analog of the
         reference re-admitting recovered nodes under a barriered
-        roster update (van.cc:266-332)."""
+        roster update (van.cc:266-332).
+
+        CRASH SEMANTICS (a peer may die at any moment,
+        tests/test_reshard_crash.py; barrier timeout via
+        ``PS_RESHARD_TMO_S``, default 900, 0 = wait forever):
+
+        - death BEFORE the entry barrier: survivors time out at the
+          entry barrier and abort with their engines UNTOUCHED on the
+          old mesh (nothing has run yet).
+        - failure DURING the recut (including a peer death surfacing as
+          a collective error): BOTH engines stage first and only then
+          commit (reshard_staged), so the exception propagates with the
+          dense AND sparse engines together fully on the old mesh —
+          stores are never torn and the pair never diverges.  (A peer
+          dying INSIDE a jax.distributed collective is bounded by jax's
+          own collective timeout; the resulting error takes this same
+          abort path.)
+        - death AFTER the recut, before the resume barrier: the
+          collective phase completed, so every SURVIVOR holds the same
+          committed new-mesh state; the resume-barrier timeout raises
+          to report the cluster degraded.  Recovery (keepalive restart
+          + rejoin) re-admits the dead rank; further barriers must wait
+          for it (see Postoffice.barrier's timeout caveat).
+        """
+        import os
+
         from ..base import WORKER_GROUP
 
         log.check(self.engine is not None,
@@ -111,22 +136,45 @@ class _IciDataPlane:
         # Validate the cheap deterministic invariants BEFORE the first
         # barrier: a worker failing these would otherwise wedge every
         # peer at the resume barrier instead of raising visibly.
-        log.check(self.engine.axis in mesh.axis_names,
-                  f"axis {self.engine.axis!r} not in new mesh")
+        kv_axes = (
+            self.engine.axis if isinstance(self.engine.axis, tuple)
+            else (self.engine.axis,)
+        )
+        for a in kv_axes:
+            log.check(a in mesh.axis_names,
+                      f"kv axis {a!r} not in new mesh")
         if self.engine.worker_axis is not None:
             log.check(self.engine.worker_axis in mesh.axis_names,
                       f"worker axis {self.engine.worker_axis!r} not in "
                       f"new mesh")
-        self.po.barrier(customer_id, WORKER_GROUP)
+        tmo = float(os.environ.get("PS_RESHARD_TMO_S", "900")) or None
+        self.po.barrier(customer_id, WORKER_GROUP, timeout_s=tmo)
+        done = False
         try:
-            self.engine.reshard(mesh)
-            self.sparse_engine.reshard(mesh)
+            # Stage BOTH engines (everything fallible, including the
+            # multi-process collectives), then commit both — a failure
+            # in either staging aborts with the pair untouched.
+            with self.engine.reshard_staged(mesh) as commit_dense, \
+                    self.sparse_engine.reshard_staged(mesh) as commit_sp:
+                commit_dense()
+                commit_sp()
+            done = True
         finally:
             # Reach the resume barrier even on failure so peers are
             # released to observe the error (a mid-recut exception
             # leaves THIS process failed either way; hanging the whole
             # cluster would hide it).
-            self.po.barrier(customer_id, WORKER_GROUP)
+            try:
+                self.po.barrier(customer_id, WORKER_GROUP, timeout_s=tmo)
+            except Exception:  # noqa: BLE001 - degraded-cluster report
+                if done:
+                    raise log.CheckError(
+                        "reshard completed on this process but a peer "
+                        "did not reach the resume barrier — cluster "
+                        "degraded; recover the dead rank before further "
+                        "collective ops"
+                    ) from None
+                # Recut already failed: let the original error win.
 
     def stop_transport(self) -> None:
         super().stop_transport()

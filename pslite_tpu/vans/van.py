@@ -697,10 +697,31 @@ class Van:
 
     # -- barriers ------------------------------------------------------------
 
+    # meta.option value marking a barrier REQUEST as a cancellation: a
+    # peer that timed out withdraws its pending request so the stale
+    # count cannot release a future barrier early for others (see
+    # Postoffice.barrier's timeout contract).
+    BARRIER_CANCEL_OPT = 0x5ca1
+
     def request_barrier(self, group: int, instance: bool) -> None:
         msg = Message()
         msg.meta.recver = SCHEDULER_ID
         msg.meta.request = True
+        msg.meta.control = Control(
+            cmd=Command.INSTANCE_BARRIER if instance else Command.BARRIER,
+            barrier_group=group,
+        )
+        msg.meta.timestamp = self.next_timestamp()
+        self.send(msg)
+
+    def cancel_barrier(self, group: int, instance: bool) -> None:
+        """Withdraw this node's pending barrier request (after a
+        timeout).  Best-effort: if the scheduler already released the
+        barrier, the cancel is a no-op there."""
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.option = self.BARRIER_CANCEL_OPT
         msg.meta.control = Control(
             cmd=Command.INSTANCE_BARRIER if instance else Command.BARRIER,
             barrier_group=group,
@@ -726,6 +747,13 @@ class Van:
             group = msg.meta.control.barrier_group
             key = (group, instance)
             senders = self._barrier_senders.setdefault(key, set())
+            if msg.meta.option == self.BARRIER_CANCEL_OPT:
+                # A timed-out peer withdraws: its stale request must not
+                # release a future barrier early for the others.
+                senders.discard(msg.meta.sender)
+                log.vlog(1, f"barrier(group={group}) cancel from "
+                            f"{msg.meta.sender}")
+                return
             senders.add(msg.meta.sender)
             # Instance barriers count every instance; group barriers count
             # distinct group members (reference: van.cc:351-426).  The
