@@ -91,12 +91,23 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
 
 
 def save_engine(engine, path: str, sparse_engine=None) -> None:
-    """Snapshot every dense bucket (and sparse table) to ``path``."""
+    """Snapshot every dense bucket (and sparse table) to ``path``.
+
+    FLEET-SIZE PORTABLE (format v2): everything is saved in GLOBAL
+    logical layout — dense stores and vector optimizer states sliced to
+    ``total_len`` (no shard padding), the adam step counter as a scalar,
+    sparse tables and accumulators de-interleaved to global row order —
+    so a checkpoint written by an 8-shard engine restores into a
+    4-shard (or any-shard) engine: the elastic keepalive-restart story
+    (save → exit 254 → restart with a different fleet → restore).
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     arrays: Dict[str, np.ndarray] = {}
-    meta = {"dense": {}, "sparse": {}, "opt": {}}
+    meta = {"version": 2, "dense": {}, "sparse": {}, "opt": {}}
     for name, bucket in engine._buckets.items():
-        arrays[f"dense/{name}"] = np.asarray(engine.store_array(name))
+        arrays[f"dense/{name}"] = np.asarray(
+            engine.store_array(name)
+        )[: bucket.total_len]
         meta["dense"][name] = {
             "keys": bucket.keys.tolist(),
             "val_len": bucket.val_len,
@@ -107,11 +118,22 @@ def save_engine(engine, path: str, sparse_engine=None) -> None:
             kind, states = opt
             meta["opt"][name] = {"kind": kind, "n": len(states)}
             for i, s in enumerate(states):
-                arrays[f"opt/{name}/{i}"] = np.asarray(s)
+                host = np.asarray(s)
+                if kind == "adam" and i == 2:
+                    # Per-shard step counter -> one scalar (identical on
+                    # every shard by construction).
+                    host = host.reshape(-1)[:1]
+                else:
+                    host = host[: bucket.total_len]
+                arrays[f"opt/{name}/{i}"] = host
     if sparse_engine is not None:
+        from .parallel.sparse import _deinterleave_rows
+
         for name, table in sparse_engine._tables.items():
-            arrays[f"sparse/{name}"] = np.asarray(
-                sparse_engine.store_array(name)
+            S, rps = sparse_engine.num_shards, table.rows_per_shard
+            arrays[f"sparse/{name}"] = _deinterleave_rows(
+                np.asarray(sparse_engine.store_array(name)),
+                table.num_rows, rps, S,
             )
             meta["sparse"][name] = {
                 "num_rows": table.num_rows,
@@ -119,8 +141,9 @@ def save_engine(engine, path: str, sparse_engine=None) -> None:
                 "has_acc": name in sparse_engine._acc,
             }
             if name in sparse_engine._acc:
-                arrays[f"sparse_acc/{name}"] = np.asarray(
-                    sparse_engine.acc_array(name)
+                arrays[f"sparse_acc/{name}"] = _deinterleave_rows(
+                    np.asarray(sparse_engine.acc_array(name)),
+                    table.num_rows, rps, S,
                 )
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
@@ -133,12 +156,17 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
 
     Buckets must already be registered (register_dense/register_sparse) so
     shapes, shardings, and compiled programs match — the same contract as
-    the reference's first-touch registration.
+    the reference's first-touch registration.  The restoring engine's
+    shard count may differ from the saver's (format v2 saves global
+    layouts; see save_engine).  v1 checkpoints (pre-r04: padded dense
+    stores, shard-interleaved tables) restore onto same-shard-count
+    engines only.
     """
     if not path.endswith(".npz"):
         path = path + ".npz"
     data = np.load(path)
     meta = json.loads(bytes(data["__meta__"]).decode())
+    v2 = meta.get("version", 1) >= 2
     for name in meta["dense"]:
         log.check(name in engine._buckets,
                   f"bucket {name!r} not registered before restore")
@@ -150,9 +178,13 @@ def restore_engine(engine, path: str, sparse_engine=None) -> None:
         )
     if sparse_engine is not None:
         for name, info in meta["sparse"].items():
-            sparse_engine.set_store_array(name, data[f"sparse/{name}"])
+            sparse_engine.set_store_array(
+                name, data[f"sparse/{name}"], global_rows=v2
+            )
             if info.get("has_acc"):
-                sparse_engine.set_acc_array(name, data[f"sparse_acc/{name}"])
+                sparse_engine.set_acc_array(
+                    name, data[f"sparse_acc/{name}"], global_rows=v2
+                )
 
 
 class AsyncEngineCheckpointer:

@@ -831,15 +831,41 @@ class CollectiveEngine:
             )
 
     def set_opt_state(self, name: str, kind: str, values) -> None:
-        """Restore optimizer state (checkpoint resume)."""
+        """Restore optimizer state (checkpoint resume).
+
+        Fleet-size portable: vector states may arrive de-padded
+        (``total_len``, the v2 checkpoint layout) and are re-padded for
+        THIS engine's shard count; the adam step counter may arrive as
+        any length (a v2 scalar or an old per-shard vector) and is
+        re-broadcast to ``num_shards`` entries — so state saved on an
+        8-shard fleet restores onto 4 shards and vice versa."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         log.check(name in self._buckets, f"bucket {name!r} not registered")
+        bucket = self._buckets[name]
         sharding = NamedSharding(self.mesh, P(self.axis))
-        placed = tuple(
-            self._place(np.ascontiguousarray(np.asarray(v)), sharding)
-            for v in values
-        )
+        norm = []
+        for i, v in enumerate(values):
+            arr = np.ascontiguousarray(np.asarray(v))
+            if kind == "adam" and i == 2:
+                step = float(arr.reshape(-1)[0]) if arr.size else 0.0
+                arr = np.full(self.num_shards, step, np.float32)
+            else:
+                # Reject mismatched vectors HERE, not steps later as an
+                # opaque XLA shape error (e.g. a v1 checkpoint's
+                # other-fleet padding: neither total nor this padded).
+                log.check(
+                    arr.size in (bucket.total_len, bucket.padded_len),
+                    f"bad optimizer state length {arr.size} for bucket "
+                    f"{name!r} (want {bucket.total_len} or "
+                    f"{bucket.padded_len})",
+                )
+                if arr.size == bucket.total_len != bucket.padded_len:
+                    out = np.zeros(bucket.padded_len, arr.dtype)
+                    out[: bucket.total_len] = arr.reshape(-1)
+                    arr = out
+            norm.append(arr)
+        placed = tuple(self._place(a, sharding) for a in norm)
         with self._bucket_mu[name]:
             self._opt_states[name] = placed
             self._opt_kinds[name] = kind

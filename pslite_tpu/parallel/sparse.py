@@ -63,6 +63,21 @@ def _interleave_rows(glob, num_rows: int, rps: int, S: int, dtype):
     )
 
 
+def _deinterleave_rows(inter, num_rows: int, rps: int, S: int):
+    """Inverse of :func:`_interleave_rows`: the sharded store layout
+    back to global row order ([num_rows] or [num_rows, dim]).  Same
+    one-definition rule — checkpoint saves and reshard snapshots route
+    through it."""
+    inter = np.asarray(inter)
+    if inter.ndim == 1:
+        return inter.reshape(S, rps).transpose(1, 0).reshape(
+            -1
+        )[:num_rows].copy()
+    return inter.reshape(S, rps, -1).transpose(1, 0, 2).reshape(
+        -1, inter.shape[1]
+    )[:num_rows].copy()
+
+
 def _agg_rows(axis, S, R, dtype, dim, idx_l, grads_l):
     """Per-shard aggregate gradient G [R, d]: all-gather every worker's
     (indices, grads), keep rows this shard owns (global row r lives on
@@ -344,13 +359,22 @@ class SparseEngine:
             log.check(name in self._acc, f"no accumulator for {name!r}")
             return jnp.copy(self._acc[name])
 
-    def set_acc_array(self, name: str, value) -> None:
+    def set_acc_array(self, name: str, value,
+                      global_rows: bool = False) -> None:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         table = self._tables[name]
         expected = (table.rows_per_shard * self.num_shards,)
         sharding = NamedSharding(self.mesh, P(self.axis))
+        if global_rows and not isinstance(value, jax.Array):
+            host = np.asarray(value, np.float32)
+            log.check_eq(tuple(host.shape), (table.num_rows,),
+                         "bad global-rows accumulator shape")
+            value = _interleave_rows(
+                host, table.num_rows, table.rows_per_shard,
+                self.num_shards, np.float32,
+            )
         if isinstance(value, jax.Array):
             # Sharded restores (multi-host): assign directly, same
             # contract as set_store_array.
@@ -638,9 +662,13 @@ class SparseEngine:
             with self._table_mu[n]:
                 self._stores[n].block_until_ready()
 
-    def set_store_array(self, name: str, value) -> None:
-        """Restore a table (checkpoint resume).  Host arrays must already be
-        in the shard-interleaved layout ``store_array`` exposes; sharded
+    def set_store_array(self, name: str, value,
+                        global_rows: bool = False) -> None:
+        """Restore a table (checkpoint resume).  ``global_rows=True``
+        accepts the fleet-size-portable GLOBAL row order ([num_rows,
+        dim], the v2 checkpoint layout) and interleaves it for THIS
+        engine's shard count; otherwise host arrays must already be in
+        the shard-interleaved layout ``store_array`` exposes.  Sharded
         ``jax.Array``s (multi-host restores) are assigned directly."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -649,6 +677,14 @@ class SparseEngine:
         table = self._tables[name]
         expected = (table.rows_per_shard * self.num_shards, table.dim)
         sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if global_rows and not isinstance(value, jax.Array):
+            host = np.asarray(value)
+            log.check_eq(tuple(host.shape), (table.num_rows, table.dim),
+                         "bad global-rows restore shape")
+            value = _interleave_rows(
+                host, table.num_rows, table.rows_per_shard,
+                self.num_shards, table.dtype,
+            )
         if isinstance(value, jax.Array):
             equivalent = value.sharding == sharding or (
                 hasattr(value.sharding, "is_equivalent_to")
@@ -714,18 +750,12 @@ class SparseEngine:
                 t = self._tables[n]
                 host = to_host_global(self._stores[n], old_mp)
                 S, rps = self.num_shards, t.rows_per_shard
-                glob = (
-                    host.reshape(S, rps, t.dim)
-                    .transpose(1, 0, 2)
-                    .reshape(-1, t.dim)[: t.num_rows]
-                    .copy()
-                )
+                glob = _deinterleave_rows(host, t.num_rows, rps, S)
                 acc_glob = None
                 if n in self._acc:
-                    acc_host = to_host_global(self._acc[n], old_mp)
-                    acc_glob = (
-                        acc_host.reshape(S, rps).transpose(1, 0)
-                        .reshape(-1)[: t.num_rows].copy()
+                    acc_glob = _deinterleave_rows(
+                        to_host_global(self._acc[n], old_mp),
+                        t.num_rows, rps, S,
                     )
                 snap[n] = (t, glob, acc_glob)
 

@@ -221,8 +221,13 @@ class Postoffice:
             # release a FUTURE barrier early for the surviving peers
             # (best-effort: a release already in flight wins the race,
             # in which case the peers passed and only this caller
-            # treats the sync as failed — still safe, still degraded).
-            self.van.cancel_barrier(group, instance)
+            # treats the sync as failed — still safe, still degraded;
+            # an unreachable scheduler must not mask the timeout
+            # diagnostic below).
+            try:
+                self.van.cancel_barrier(group, instance)
+            except Exception:  # noqa: BLE001 - best-effort withdrawal
+                pass
         log.check(ok, f"barrier(group={group}) timed out after "
                       f"{timeout_s}s — peer dead before the barrier?")
 

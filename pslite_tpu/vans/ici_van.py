@@ -114,12 +114,14 @@ class _IciDataPlane:
           old mesh (nothing has run yet).
         - failure DURING the recut (including a peer death surfacing as
           a collective error): BOTH engines stage first and only then
-          commit (reshard_staged), so the exception propagates with the
-          dense AND sparse engines together fully on the old mesh —
-          stores are never torn and the pair never diverges.  (A peer
-          dying INSIDE a jax.distributed collective is bounded by jax's
-          own collective timeout; the resulting error takes this same
-          abort path.)
+          commit (reshard_staged), gated by a COMMIT BARRIER between
+          staging and commit — a process whose staging failed never
+          joins it, so its peers time out, abort their staged state,
+          and the WHOLE CLUSTER stays together on the old mesh (no
+          cross-process mesh divergence).  Stores are never torn and
+          the engine pair never diverges.  (A peer dying INSIDE a
+          jax.distributed collective is bounded by jax's own collective
+          timeout; the resulting error takes this same abort path.)
         - death AFTER the recut, before the resume barrier: the
           collective phase completed, so every SURVIVOR holds the same
           committed new-mesh state; the resume-barrier timeout raises
@@ -152,10 +154,21 @@ class _IciDataPlane:
         done = False
         try:
             # Stage BOTH engines (everything fallible, including the
-            # multi-process collectives), then commit both — a failure
-            # in either staging aborts with the pair untouched.
+            # multi-process collectives), pass the COMMIT BARRIER (so a
+            # peer whose staging failed aborts the whole cluster — its
+            # absence times the barrier out inside the with-blocks,
+            # which then unwind WITHOUT committing), then commit both.
             with self.engine.reshard_staged(mesh) as commit_dense, \
                     self.sparse_engine.reshard_staged(mesh) as commit_sp:
+                try:
+                    self.po.barrier(customer_id, WORKER_GROUP,
+                                    timeout_s=tmo)
+                except log.CheckError:
+                    raise log.CheckError(
+                        "a peer failed to stage the recut (commit "
+                        "barrier timeout) — aborted together on the "
+                        "old mesh"
+                    ) from None
                 commit_dense()
                 commit_sp()
             done = True

@@ -1,0 +1,126 @@
+"""Elastic restart across fleet sizes (VERDICT r03 missing #6): a
+checkpoint saved by an 8-shard engine restores into a 4-shard engine —
+stores, adam state, sparse tables + adagrad accumulators — and training
+continues as if uninterrupted.  The end-to-end leg drives the keepalive
+launcher (exit 254 -> restart -> smaller fleet) via
+examples/elastic_restart.py."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu import checkpoint
+from pslite_tpu.parallel import CollectiveEngine, default_mesh
+from pslite_tpu.parallel.mesh import make_mesh
+from pslite_tpu.parallel.sparse import SparseEngine
+
+
+def _build(mesh, total=100, rows=13, dim=4):
+    eng = CollectiveEngine(mesh=mesh, server_handle="adam:0.01")
+    se = SparseEngine(mesh)
+    eng.register_dense("w", np.arange(1, dtype=np.uint64), total)
+    se.register_sparse("emb", rows, dim)
+    return eng, se
+
+
+def _step(eng, se, step, total=100, rows=13, dim=4):
+    W = eng.num_shards
+    g = np.random.default_rng(50 + step).normal(size=total).astype(
+        np.float32
+    )
+    eng.push_pull("w", np.tile(g / W, (W, 1)))
+    rng = np.random.default_rng(80 + step)
+    idx = np.zeros((W, 5), np.int32)
+    gr = np.zeros((W, 5, dim), np.float32)
+    idx[0] = rng.integers(0, rows, size=5).astype(np.int32)
+    gr[0] = rng.normal(size=(5, dim)).astype(np.float32)
+    se.push("emb", idx, gr, handle="row_adagrad:0.1,1e-8")
+    se.block("emb")
+
+
+def test_restore_onto_half_fleet_matches_uninterrupted(tmp_path):
+    """8-shard save -> 4-shard restore: final state equals a run that
+    never restarted.  total=100 makes the shard padding DIFFER between
+    the two fleets (104 vs 100), exercising the de-padded v2 layout."""
+    mesh8, mesh4 = default_mesh(), make_mesh((4,), ("kv",))
+
+    ref_eng, ref_se = _build(mesh8)
+    for s in range(4):
+        _step(ref_eng, ref_se, s)
+    want = np.asarray(ref_eng.pull("w"))
+    want_rows = np.asarray(
+        ref_se.pull("emb", np.tile(np.arange(13, dtype=np.int32), (8, 1)))
+    )[0]
+    want_acc = np.asarray(ref_se.acc_array("emb"))
+
+    eng8, se8 = _build(mesh8)
+    for s in range(2):
+        _step(eng8, se8, s)
+    path = str(tmp_path / "elastic_shrink")
+    checkpoint.save_engine(eng8, path, sparse_engine=se8)
+
+    eng4, se4 = _build(mesh4)
+    checkpoint.restore_engine(eng4, path, sparse_engine=se4)
+    for s in range(2, 4):
+        _step(eng4, se4, s)
+    np.testing.assert_allclose(np.asarray(eng4.pull("w")), want,
+                               rtol=1e-5, atol=1e-5)
+    got_rows = np.asarray(
+        se4.pull("emb", np.tile(np.arange(13, dtype=np.int32), (4, 1)))
+    )[0]
+    np.testing.assert_allclose(got_rows, want_rows, rtol=1e-5, atol=1e-5)
+    # Accumulator state carried: 4-shard interleave of the same rows.
+    acc4 = np.asarray(se4.acc_array("emb"))
+    t4 = se4.table("emb")
+    deint = acc4.reshape(4, t4.rows_per_shard).transpose(1, 0).reshape(
+        -1
+    )[:13]
+    deint8 = want_acc.reshape(8, 2).transpose(1, 0).reshape(-1)[:13]
+    np.testing.assert_allclose(deint, deint8, rtol=1e-5, atol=1e-5)
+
+
+def test_restore_onto_larger_fleet(tmp_path):
+    """The portable layout also grows: 4-shard save -> 8-shard restore."""
+    mesh8, mesh4 = default_mesh(), make_mesh((4,), ("kv",))
+    eng4, se4 = _build(mesh4)
+    for s in range(2):
+        _step(eng4, se4, s)
+    before = np.asarray(eng4.pull("w"))
+    path = str(tmp_path / "elastic_grow")
+    checkpoint.save_engine(eng4, path, sparse_engine=se4)
+
+    eng8, se8 = _build(mesh8)
+    checkpoint.restore_engine(eng8, path, sparse_engine=se8)
+    np.testing.assert_allclose(np.asarray(eng8.pull("w")), before,
+                               rtol=1e-6)
+
+
+def test_keepalive_restart_into_half_fleet(tmp_path):
+    """END-TO-END: examples/elastic_restart.py under the keepalive
+    launcher — save at 8 shards, exit 254, restart, restore at 4
+    shards, verify against the uninterrupted host recurrence."""
+    ck = str(tmp_path / "elastic_ck")
+    example = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "elastic_restart.py")
+    env = dict(os.environ, PS_CKPT=ck)
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        env.pop(var, None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pslite_tpu.tracker.local",
+            "-n", "0", "-s", "0", "--", sys.executable, example,
+        ],
+        capture_output=True,
+        timeout=300,
+        cwd="/root/repo",
+        env=env,
+    )
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, (out + proc.stderr.decode())[-2000:]
+    assert "saved 2-step checkpoint from 8 shards" in out, out[-1500:]
+    assert "ELASTIC_RESTART_OK restored onto 4 shards" in out, out[-1500:]

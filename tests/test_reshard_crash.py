@@ -113,10 +113,10 @@ def test_sparse_recut_failure_is_atomic(monkeypatch):
     )
 
 
-def test_resume_barrier_death_reports_degraded_committed_state():
-    """A peer dying between the recut and the resume barrier: this
-    process's recut has COMMITTED (new mesh, consistent stores) and the
-    op raises the degraded-cluster error."""
+def _barrier_death_cluster(dying_call: int, expect_match: str,
+                           expect_new_mesh: bool):
+    """Drive KVWorker.reshard with the Nth barrier raising a timeout
+    (barrier order: 1=entry, 2=commit, 3=resume)."""
     from tests.helpers import LoopbackCluster
 
     from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
@@ -142,17 +142,17 @@ def test_resume_barrier_death_reports_degraded_committed_state():
 
         def dying_barrier(*a, **kw):
             state["n"] += 1
-            if state["n"] == 2:  # the resume barrier
+            if state["n"] == dying_call:
                 raise CheckError("barrier timed out (injected death)")
             return real_barrier(*a, **kw)
 
         po.barrier = dying_barrier
         new_mesh = make_mesh((W // 2,), ("kv",))
-        with pytest.raises(CheckError, match="degraded"):
+        with pytest.raises(CheckError, match=expect_match):
             worker.reshard(new_mesh)
         po.barrier = real_barrier
-        # Recut committed: new mesh, state carried.
-        assert eng.num_shards == W // 2
+        assert eng.num_shards == (W // 2 if expect_new_mesh else W)
+        # Stores carried either way.
         out2 = np.zeros(32, np.float32)
         worker.wait(worker.pull(keys, out2))
         np.testing.assert_allclose(out2, outs)
@@ -160,6 +160,20 @@ def test_resume_barrier_death_reports_degraded_committed_state():
         for s in servers:
             s.stop()
         c.finalize()
+
+
+def test_commit_barrier_death_aborts_together_on_old_mesh():
+    """A peer that fails STAGING never joins the commit barrier: the
+    survivors' commit-barrier timeout aborts their staged state, so the
+    whole cluster stays on the old mesh together."""
+    _barrier_death_cluster(2, "aborted together", expect_new_mesh=False)
+
+
+def test_resume_barrier_death_reports_degraded_committed_state():
+    """A peer dying between the commit and the resume barrier: this
+    process's recut has COMMITTED (new mesh, consistent stores) and the
+    op raises the degraded-cluster error."""
+    _barrier_death_cluster(3, "degraded", expect_new_mesh=True)
 
 
 def test_peer_death_before_entry_barrier():
