@@ -237,6 +237,16 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
     if host_grads:
         inp = np.ones((eng.num_shards, bucket.padded_len),
                       np.dtype(dtype))
+    elif (zero_copy and eng.num_shards == 1
+          and eng.worker_axis is None
+          and not eng._is_stateful(eng._resolve_handle(handle)[0])):
+        # The degenerate zero-copy program takes grads FLAT (rank
+        # squeezes relayout packed dtypes at ~47 GB/s — engine
+        # _prep_grads_flat docs); pass the preferred form.
+        inp = jax.device_put(
+            jnp.ones((bucket.padded_len,), dtype),
+            NamedSharding(eng.mesh, P(eng.axis)),
+        )
     else:
         inp = jax.device_put(
             jnp.ones((eng.num_shards, bucket.padded_len), dtype),

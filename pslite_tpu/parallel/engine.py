@@ -438,6 +438,13 @@ class CollectiveEngine:
             # grads_l: [1, padded]; reduce-scatter across workers => my shard
             return _rs_update_ag(store_l, grads_l, handle, axis, waxis)
 
+        # The degenerate 1-worker zero-copy program takes grads FLAT
+        # [padded]: squeezing [1, padded] inside the program forces a
+        # rank-changing relayout that runs at ~47 GB/s for packed
+        # dtypes (bf16's (2,128)(2,1) tiling; measured 73% of the zc
+        # step's device time) — f32 only escapes it by bitcast luck.
+        flat_zc = self.num_shards == 1 and waxis is None
+
         def _push_pull_zc(store_l, grads_l):
             # In-place pull delivery (kv axis size 1: the gather is the
             # identity, so the updated store IS the pulled value).  The
@@ -445,6 +452,8 @@ class CollectiveEngine:
             # delivery (rdma_van.h:520-548): without it XLA must give the
             # second output its own buffer — a full read+write that was
             # 40% of the headline's device time (r03 verdict, weak #1).
+            if flat_zc:
+                return handle(store_l, grads_l)
             agg = _aggregate(grads_l, axis, waxis)
             return handle(store_l, agg)
 
@@ -485,7 +494,8 @@ class CollectiveEngine:
             fn = shard_map(
                 _push_pull_zc,
                 mesh=mesh,
-                in_specs=(store_spec, grads_spec),
+                in_specs=(store_spec,
+                          store_spec if flat_zc else grads_spec),
                 out_specs=store_spec,
             )
             jitted = jax.jit(fn, donate_argnums=(0,))
@@ -913,6 +923,38 @@ class CollectiveEngine:
             arr = xp.pad(arr, pads)
         return arr
 
+    def _prep_grads_flat(self, bucket: DenseBucket, grads):
+        """``[padded]`` FLAT grads for the degenerate 1-worker zero-copy
+        program (see ``_push_pull_zc``'s flat_zc note): host arrays
+        flatten for free; device ``[1, padded]`` arrays pay one reshape
+        per call (a bitcast for f32, a relayout copy for packed dtypes
+        — pass flat device arrays on the hot path)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        if isinstance(grads, jax.Array):
+            # Same worker-dim discipline as _prep_grads: a (2, N/2)
+            # array must fail loud, not silently flatten into one
+            # concatenated gradient.
+            log.check(grads.ndim in (1, 2), "bad grads rank")
+            if grads.ndim == 2:
+                log.check_eq(int(grads.shape[0]), 1, "bad worker dim")
+                g = grads.reshape(-1)
+            else:
+                g = grads
+            if int(g.shape[0]) == bucket.padded_len:
+                if g.sharding == sharding:
+                    return g
+                return jax.device_put(g, sharding)
+            # Unpadded device arrays fall through to host normalization
+            # (padded == total on every zc-eligible config, so this is
+            # only reachable for malformed lengths, which it rejects).
+        arr = self._normalize_host_grads(grads, 1, bucket, np)
+        return jax.device_put(
+            np.ascontiguousarray(arr).reshape(-1), sharding
+        )
+
     def _prep_grads(self, bucket: DenseBucket, grads):
         """Accept [W, total] (or [total] broadcast) host/device arrays and
         deliver a [W, padded] device array sharded over the worker axis.
@@ -1033,7 +1075,10 @@ class CollectiveEngine:
         bucket = self._buckets[name]
         resolved, handle_key = self._resolve_handle(handle)
         zc = zero_copy and self._zc_pull_eligible(bucket.dtype, resolved)
-        g = self._prep_grads(bucket, grads)
+        flat_zc = (zc and not self._is_stateful(resolved)
+                   and self.worker_axis is None)
+        g = (self._prep_grads_flat(bucket, grads) if flat_zc
+             else self._prep_grads(bucket, grads))
         if self._is_stateful(resolved):
             prog = self._program(
                 "push_pull_st_zc" if zc else "push_pull_st",

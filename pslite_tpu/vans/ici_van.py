@@ -158,8 +158,10 @@ class _IciDataPlane:
             # peer whose staging failed aborts the whole cluster — its
             # absence times the barrier out inside the with-blocks,
             # which then unwind WITHOUT committing), then commit both.
+            staged = False
             with self.engine.reshard_staged(mesh) as commit_dense, \
                     self.sparse_engine.reshard_staged(mesh) as commit_sp:
+                staged = True
                 try:
                     self.po.barrier(customer_id, WORKER_GROUP,
                                     timeout_s=tmo)
@@ -173,21 +175,28 @@ class _IciDataPlane:
                 commit_sp()
             done = True
         finally:
-            # Reach the resume barrier even on failure so peers are
-            # released to observe the error (a mid-recut exception
-            # leaves THIS process failed either way; hanging the whole
-            # cluster would hide it).
-            try:
-                self.po.barrier(customer_id, WORKER_GROUP, timeout_s=tmo)
-            except Exception:  # noqa: BLE001 - degraded-cluster report
-                if done:
-                    raise log.CheckError(
-                        "reshard completed on this process but a peer "
-                        "did not reach the resume barrier — cluster "
-                        "degraded; recover the dead rank before further "
-                        "collective ops"
-                    ) from None
-                # Recut already failed: let the original error win.
+            # A process whose STAGING failed goes SILENT: barrier rounds
+            # are anonymous counts, so issuing any further request would
+            # land in the same round as the survivors' commit barrier
+            # and release it — committing them onto the new mesh while
+            # this process aborts (cross-process divergence).  Peers
+            # detect the silence by timeout at the commit barrier and
+            # abort together; they then time out at THIS resume barrier
+            # too, where the commit-abort error (done=False) wins.
+            if staged:
+                try:
+                    self.po.barrier(customer_id, WORKER_GROUP,
+                                    timeout_s=tmo)
+                except Exception:  # noqa: BLE001 - degraded report
+                    if done:
+                        raise log.CheckError(
+                            "reshard completed on this process but a "
+                            "peer did not reach the resume barrier — "
+                            "cluster degraded; recover the dead rank "
+                            "before further collective ops"
+                        ) from None
+                    # Recut already aborted: the commit-barrier error
+                    # propagating from the try block wins.
 
     def stop_transport(self) -> None:
         super().stop_transport()

@@ -36,11 +36,28 @@ def main() -> None:
         kv.wait(kv.push_pull(keys, vals, outs))
         np.testing.assert_allclose(outs, 12.0)
 
-        if rank == 1:
+        mode = os.environ.get("PS_CRASH_MODE", "exit_before")
+        if rank == 1 and mode == "exit_before":
             # DIE before the coordinated reshard: no barrier request
             # ever reaches the scheduler from this worker.
             sys.stdout.flush()
             os._exit(42)
+        if rank == 1 and mode == "stage_fail":
+            # Fail rank 1's STAGING (at the first new-mesh placement —
+            # AFTER the collective snapshot legs both ranks run, so the
+            # survivor reaches the commit barrier rather than a jax
+            # collective): rank 1 must raise fast and go SILENT, never
+            # releasing the survivors' commit barrier with a stray
+            # resume request.
+            from pslite_tpu.parallel import placement
+
+            real = placement.place_host_array
+
+            def fail_first(*a, **kw):
+                placement.place_host_array = real
+                raise RuntimeError("injected staging failure")
+
+            placement.place_host_array = fail_first
 
         from jax.sharding import Mesh
 
@@ -52,7 +69,7 @@ def main() -> None:
             kv.reshard(mesh4)  # PS_RESHARD_TMO_S set by the parent
             print("CRASH_FAIL reshard succeeded with a dead peer",
                   flush=True)
-        except Exception as exc:  # noqa: BLE001 - the expected timeout
+        except Exception as exc:  # noqa: BLE001 - the expected abort
             ok = (
                 eng.num_shards == 8
                 and eng.bucket("g").padded_len == old_padded
@@ -61,8 +78,8 @@ def main() -> None:
             # everywhere) — reads of addressable shards are local.
             for s in eng._stores["g"].addressable_shards:
                 ok = ok and np.allclose(np.asarray(s.data), 12.0)
-            print(f"CRASH_OK untouched={ok} {type(exc).__name__}",
-                  flush=True)
+            print(f"CRASH_OK rank={rank} untouched={ok} "
+                  f"{type(exc).__name__}", flush=True)
         # Skip finalize: the cluster is degraded by design (dead peer);
         # finalize's ALL_GROUP barrier would wedge.
         sys.stdout.flush()

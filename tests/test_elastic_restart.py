@@ -105,8 +105,8 @@ def test_keepalive_restart_into_half_fleet(tmp_path):
     launcher — save at 8 shards, exit 254, restart, restore at 4
     shards, verify against the uninterrupted host recurrence."""
     ck = str(tmp_path / "elastic_ck")
-    example = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "examples", "elastic_restart.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    example = os.path.join(repo_root, "examples", "elastic_restart.py")
     env = dict(os.environ, PS_CKPT=ck)
     for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
         env.pop(var, None)
@@ -117,7 +117,7 @@ def test_keepalive_restart_into_half_fleet(tmp_path):
         ],
         capture_output=True,
         timeout=300,
-        cwd="/root/repo",
+        cwd=repo_root,
         env=env,
     )
     out = proc.stdout.decode()

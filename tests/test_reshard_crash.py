@@ -176,9 +176,9 @@ def test_resume_barrier_death_reports_degraded_committed_state():
     _barrier_death_cluster(3, "degraded", expect_new_mesh=True)
 
 
-def test_peer_death_before_entry_barrier():
-    """LIVE 2-process cluster: worker 1 dies before calling reshard;
-    worker 0 times out at the entry barrier and aborts untouched."""
+def _live_crash_cluster(mode: str, rank1_rc: int, timeout0: int):
+    """Drive the 2-process crash child in ``mode``; returns
+    (worker0_out, worker1_out, rank1_returncode)."""
     from pslite_tpu.utils.network import get_available_port
 
     port = get_available_port()
@@ -194,6 +194,7 @@ def test_peer_death_before_entry_barrier():
         PS_VAN_TYPE="ici_tcp",
         PS_ICI_MULTIHOST="1",
         PS_RESHARD_TMO_S="10",
+        PS_CRASH_MODE=mode,
     )
     for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
         base_env.pop(var, None)
@@ -215,17 +216,36 @@ def test_peer_death_before_entry_barrier():
     # Worker 0 (procs[2]) carries the assertion; scheduler/server stay
     # up by design (the cluster is degraded, never finalized).
     try:
-        out0, _ = procs[2].communicate(timeout=420)
-        out1, _ = procs[3].communicate(timeout=60)
-    except subprocess.TimeoutExpired:
-        raise
+        out0, _ = procs[2].communicate(timeout=timeout0)
+        out1, _ = procs[3].communicate(timeout=120)
     finally:
         for p in procs:
             p.kill()
-    text0 = out0.decode()
-    assert procs[3].returncode == 42, out1.decode()[-800:]
-    assert "CRASH_OK untouched=True" in text0, text0[-1500:]
-    assert "CRASH_FAIL" not in text0, text0[-1500:]
+    return out0.decode(), out1.decode(), procs[3].returncode
+
+
+def test_peer_death_before_entry_barrier():
+    """LIVE 2-process cluster: worker 1 dies before calling reshard;
+    worker 0 times out at the entry barrier and aborts untouched."""
+    out0, out1, rc1 = _live_crash_cluster("exit_before", 42, 420)
+    assert rc1 == 42, out1[-800:]
+    assert "CRASH_OK rank=0 untouched=True" in out0, out0[-1500:]
+    assert "CRASH_FAIL" not in out0, out0[-1500:]
+
+
+def test_peer_staging_failure_aborts_cluster_together():
+    """LIVE 2-process cluster: worker 1's STAGING fails (after the
+    collective snapshot legs) and goes silent; worker 0 times out at
+    the COMMIT barrier and aborts — both ranks end on the old mesh
+    (no cross-process mesh divergence; the failed rank must not
+    release the survivor's commit barrier with a stray resume
+    request)."""
+    out0, out1, rc1 = _live_crash_cluster("stage_fail", 0, 480)
+    assert rc1 == 0, out1[-800:]
+    assert "CRASH_OK rank=1 untouched=True RuntimeError" in out1, \
+        out1[-1500:]
+    assert "CRASH_OK rank=0 untouched=True" in out0, out0[-1500:]
+    assert "CRASH_FAIL" not in out0 + out1, (out0 + out1)[-1500:]
 
 
 def test_pair_atomicity_dense_and_sparse(monkeypatch):
