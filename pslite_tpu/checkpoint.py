@@ -38,7 +38,9 @@ def save_engine_orbax(engine, path: str, sparse_engine=None) -> None:
         state["dense"][name] = engine.store_array(name)
     if sparse_engine is not None:
         for name in sparse_engine._tables:
-            state["sparse"][name] = sparse_engine.store_array(name)
+            # RAW physical (lane-packed) stores: orbax saves sharded
+            # arrays verbatim against store_spec targets.
+            state["sparse"][name] = sparse_engine.store_raw(name)
             # ALWAYS save an accumulator (zeros when the table never saw
             # an adagrad push): the restore target can then be built from
             # registration alone, with no save/restore structure
@@ -62,7 +64,32 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     for name in engine._buckets:
         target["dense"][name] = engine.store_spec(name)
     if sparse_engine is not None:
+        # The saver's PHYSICAL table layout depends on history: a
+        # lane-packed table demotes to the unpacked layout on its first
+        # adagrad push (SparseTable.pack).  Match the restore target to
+        # the saved shape — if the checkpoint holds the unpacked form
+        # of a currently-packed table, demote it before targeting.
+        try:
+            with ocp.StandardCheckpointer() as _mc:
+                saved_md = _mc.metadata(os.path.abspath(path))
+            saved_md = getattr(saved_md, "item_metadata", saved_md)
+        except Exception:  # noqa: BLE001 - metadata probe is best-effort
+            saved_md = None
         for name in sparse_engine._tables:
+            t = sparse_engine._tables[name]
+            if t.pack > 1 and saved_md is not None:
+                try:
+                    saved_shape = tuple(
+                        saved_md["sparse"][name].shape
+                    )
+                except Exception:  # noqa: BLE001
+                    saved_shape = None
+                unpacked = (
+                    t.rows_per_shard * sparse_engine.num_shards, t.dim
+                )
+                if saved_shape == unpacked:
+                    with sparse_engine._table_mu[name]:
+                        sparse_engine._ensure_unpacked(name)
             target["sparse"][name] = sparse_engine.store_spec(name)
             # Mirror of save: every registered table has an acc entry in
             # the checkpoint, so target it unconditionally (no

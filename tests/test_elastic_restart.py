@@ -74,13 +74,15 @@ def test_restore_onto_half_fleet_matches_uninterrupted(tmp_path):
         se4.pull("emb", np.tile(np.arange(13, dtype=np.int32), (4, 1)))
     )[0]
     np.testing.assert_allclose(got_rows, want_rows, rtol=1e-5, atol=1e-5)
-    # Accumulator state carried: 4-shard interleave of the same rows.
+    # Accumulator state carried: same global rows on either fleet.
+    from pslite_tpu.parallel.sparse import _deinterleave_rows
+
     acc4 = np.asarray(se4.acc_array("emb"))
-    t4 = se4.table("emb")
-    deint = acc4.reshape(4, t4.rows_per_shard).transpose(1, 0).reshape(
-        -1
-    )[:13]
-    deint8 = want_acc.reshape(8, 2).transpose(1, 0).reshape(-1)[:13]
+    deint = _deinterleave_rows(acc4, 13, se4.table("emb").rows_per_shard,
+                               4)
+    deint8 = _deinterleave_rows(
+        want_acc, 13, ref_se.table("emb").rows_per_shard, 8
+    )
     np.testing.assert_allclose(deint, deint8, rtol=1e-5, atol=1e-5)
 
 
