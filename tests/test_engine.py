@@ -1060,3 +1060,33 @@ def test_three_axis_torus_reshard():
     np.testing.assert_allclose(
         np.asarray(eng.push_pull("rs3", ones)), 4 * np.ones(128)
     )
+
+
+def test_replay_flat_odd_step_count(mesh):
+    """Non-power-of-two T exercises the unrolled bulk + tail split of
+    the flat replay scan (both keep modes match sequential steps)."""
+    keys = np.arange(2, dtype=np.uint64)
+    val_len = 64
+    rng = np.random.default_rng(97)
+    T = 7  # bulk 4 + tail 3 at U=4 (min-bytes lowered below)
+    seq = rng.normal(size=(T, 8, 128)).astype(np.float32)
+
+    ref = CollectiveEngine(mesh=mesh)
+    ref.register_dense("od_ref", keys, val_len)
+    expected = [np.asarray(ref.push_pull("od_ref", seq[t]))
+                for t in range(T)]
+
+    eng = CollectiveEngine(mesh=mesh)
+    eng.replay_flat_min_bytes = 4
+    eng.register_dense("od", keys, val_len)
+    assert eng._replay_unroll(eng.bucket("od").padded_len,
+                              np.float32, T) == 4
+    pulled = np.asarray(eng.replay("od", seq))
+    for t in range(T):
+        np.testing.assert_allclose(pulled[t], expected[t], rtol=1e-5)
+
+    eng2 = CollectiveEngine(mesh=mesh)
+    eng2.replay_flat_min_bytes = 4
+    eng2.register_dense("od2", keys, val_len)
+    out = np.asarray(eng2.replay("od2", seq, keep="last"))
+    np.testing.assert_allclose(out, expected[-1], rtol=1e-5)
