@@ -128,11 +128,27 @@ def _device_busy(run) -> float | None:
     d = tempfile.mkdtemp(prefix="psbench_xp_")
     try:
         # Engine/loop errors must PROPAGATE (main turns them into the
-        # parseable error line) — only the XPlane parse is best-effort.
-        # A silently-swallowed mid-loop failure would publish a
-        # plausible-looking number computed from incomplete work.
-        with device_trace(d):
+        # parseable error line) — a silently-swallowed mid-loop failure
+        # would publish a plausible number computed from incomplete
+        # work.  PROFILER start/stop and the XPlane parse stay
+        # best-effort: a flaky trace must degrade this measurement to
+        # its wall number, not abort the whole bench.
+        ctx = device_trace(d)
+        traced = True
+        try:
+            ctx.__enter__()
+        except Exception:  # noqa: BLE001 - profiler is best-effort
+            traced = False
+        try:
             run()
+        finally:
+            if traced:
+                try:
+                    ctx.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    traced = False
+        if not traced:
+            return None
         try:
             busy = xplane.device_busy_seconds(d)
         except Exception:  # noqa: BLE001 - parsing is best-effort
@@ -237,9 +253,7 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
     if host_grads:
         inp = np.ones((eng.num_shards, bucket.padded_len),
                       np.dtype(dtype))
-    elif (zero_copy and eng.num_shards == 1
-          and eng.worker_axis is None
-          and not eng._is_stateful(eng._resolve_handle(handle)[0])):
+    elif zero_copy and eng.flat_zc_eligible(handle):
         # The degenerate zero-copy program takes grads FLAT (rank
         # squeezes relayout packed dtypes at ~47 GB/s — engine
         # _prep_grads_flat docs); pass the preferred form.

@@ -77,19 +77,33 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
             saved_md = None
         for name in sparse_engine._tables:
             t = sparse_engine._tables[name]
-            if t.pack > 1 and saved_md is not None:
+            saved_shape = None
+            if saved_md is not None:
                 try:
-                    saved_shape = tuple(
-                        saved_md["sparse"][name].shape
-                    )
+                    saved_shape = tuple(saved_md["sparse"][name].shape)
                 except Exception:  # noqa: BLE001
                     saved_shape = None
-                unpacked = (
-                    t.rows_per_shard * sparse_engine.num_shards, t.dim
+            unpacked = (
+                t.rows_per_shard * sparse_engine.num_shards, t.dim
+            )
+            if t.pack > 1 and saved_shape == unpacked:
+                with sparse_engine._table_mu[name]:
+                    sparse_engine._ensure_unpacked(name)
+            elif t.pack == 1 and saved_shape is not None \
+                    and saved_shape != unpacked:
+                # The inverse mismatch — a lane-packed save restored
+                # into a since-demoted table — cannot be repaired here
+                # (re-packing a demoted table is not supported); fail
+                # with the cause instead of an opaque orbax shape error.
+                raise log.CheckError(
+                    f"orbax checkpoint for table {name!r} holds the "
+                    f"lane-packed layout {saved_shape} but the live "
+                    f"table was demoted to the unpacked layout "
+                    f"{unpacked} (a row_adagrad push demotes) — "
+                    f"restore before the first adagrad push, or use "
+                    f"the npz checkpoint path (fleet-portable global "
+                    f"layout)"
                 )
-                if saved_shape == unpacked:
-                    with sparse_engine._table_mu[name]:
-                        sparse_engine._ensure_unpacked(name)
             target["sparse"][name] = sparse_engine.store_spec(name)
             # Mirror of save: every registered table has an acc entry in
             # the checkpoint, so target it unconditionally (no

@@ -118,10 +118,15 @@ class CoalescingDispatcher:
         return t
 
     def flush(self) -> None:
-        """Dispatch the current window without waiting for the timer."""
+        """Dispatch the current window without waiting for the timer.
+        A no-op when nothing is pending — setting the flag with an
+        empty queue would leak into the NEXT window and dispatch it
+        prematurely (fragmenting the batch the window exists to
+        build)."""
         with self._cv:
-            self._flush_now = True
-            self._cv.notify()
+            if self._queue:
+                self._flush_now = True
+                self._cv.notify()
 
     def close(self) -> None:
         """Flush and stop the drain thread (idempotent)."""

@@ -1057,6 +1057,17 @@ class CollectiveEngine:
             return True
         return self._effective_impl(dtype, resolved) == "xla"
 
+    def flat_zc_eligible(self, handle: Optional[ServerHandle] = None
+                         ) -> bool:
+        """Whether a zero-copy push_pull for ``handle`` takes the FLAT
+        grads program (callers that pre-build device inputs should then
+        pass [padded] 1-D arrays — see _prep_grads_flat).  The ONE
+        definition bench and callers share with push_pull's routing."""
+        resolved, _ = self._resolve_handle(handle)
+        return (self.num_shards == 1
+                and not self._is_stateful(resolved)
+                and self.worker_axis is None)
+
     def push_pull(self, name: str, grads, handle: Optional[ServerHandle] = None,
                   zero_copy: bool = False):
         """Fused push+aggregate+update+pull; returns the replicated pulled
@@ -1075,8 +1086,7 @@ class CollectiveEngine:
         bucket = self._buckets[name]
         resolved, handle_key = self._resolve_handle(handle)
         zc = zero_copy and self._zc_pull_eligible(bucket.dtype, resolved)
-        flat_zc = (zc and not self._is_stateful(resolved)
-                   and self.worker_axis is None)
+        flat_zc = zc and self.flat_zc_eligible(handle)
         g = (self._prep_grads_flat(bucket, grads) if flat_zc
              else self._prep_grads(bucket, grads))
         if self._is_stateful(resolved):

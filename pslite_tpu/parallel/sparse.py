@@ -114,6 +114,26 @@ def _unpack_host(phys, rps: int, S: int, pack: int, dim: int):
     ).reshape(S * rps, dim)
 
 
+def _store_out_format(store, mesh, axis):
+    """Output Format pinning a program's donated store output to the
+    LIVE store's committed layout (left alone, XLA commits the scatter
+    output in a different layout than the pull program wants and every
+    pull pays a full-table transpose).  The ONE definition the single
+    and group program builders share; falls back to a plain
+    NamedSharding when the layout API is unavailable."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax.experimental.layout import Format
+
+        fmt = getattr(store, "format", None)
+        if fmt is not None and fmt.layout is not None:
+            return Format(fmt.layout, NamedSharding(mesh, P(axis, None)))
+    except Exception:  # noqa: BLE001 - layout API is optional
+        pass
+    return NamedSharding(mesh, P(axis, None))
+
+
 def _scatter_rows(axis, S, R, pack, dim, store_l, idx_l, grads_l):
     """Sum-handle push: scatter-add the owned rows DIRECTLY into the
     donated (possibly packed) store.  The dense _agg_rows form reads +
@@ -297,29 +317,15 @@ class SparseEngine:
         pack = table.pack
         dim = table.dim
 
-        # Pin the store's OUTPUT layout to its live committed layout:
-        # left alone, XLA commits the scatter program's donated output
-        # in a different layout than the placement chose, and every
-        # subsequent pull pays a full-table transpose copy (7.5 ms of
-        # the 1M-row embedding step).  Inputs stay AUTO (jit refuses
+        # Pin the store's OUTPUT layout to its live committed layout
+        # (see _store_out_format).  Inputs stay AUTO (jit refuses
         # mismatched explicit input layouts instead of relayouting);
         # pinning only the output makes the layout a fixed point from
         # the first push onward, and the pull program then compiles
         # against that stable layout with no transpose.
-        def _store_out_fmt():
-            try:
-                from jax.experimental.layout import Format
-
-                fmt = getattr(self._stores[table.name], "format", None)
-                if fmt is not None and fmt.layout is not None:
-                    return Format(
-                        fmt.layout, NamedSharding(self.mesh, P(axis, None))
-                    )
-            except Exception:  # noqa: BLE001 - layout API is optional
-                pass
-            return NamedSharding(self.mesh, P(axis, None))
-
-        store_fmt = _store_out_fmt()
+        store_fmt = _store_out_format(
+            self._stores[table.name], self.mesh, axis
+        )
 
         def _sh(spec):
             return NamedSharding(self.mesh, spec)
@@ -631,24 +637,10 @@ class SparseEngine:
 
         from jax.sharding import NamedSharding
 
-        def _fmt(name):
-            # Same output-layout pin as the single-table programs: the
-            # donated scatter output must keep the store's committed
-            # layout or every later pull pays a full-table transpose.
-            try:
-                from jax.experimental.layout import Format
-
-                fmt = getattr(self._stores[name], "format", None)
-                if fmt is not None and fmt.layout is not None:
-                    return Format(
-                        fmt.layout,
-                        NamedSharding(self.mesh, P(axis, None)),
-                    )
-            except Exception:  # noqa: BLE001 - layout API is optional
-                pass
-            return NamedSharding(self.mesh, P(axis, None))
-
-        store_fmts = tuple(_fmt(t.name) for t in tables)
+        store_fmts = tuple(
+            _store_out_format(self._stores[t.name], self.mesh, axis)
+            for t in tables
+        )
         tok_sh = NamedSharding(self.mesh, P(axis, None))
         acc_sh = NamedSharding(self.mesh, P(axis))
 
@@ -917,6 +909,19 @@ class SparseEngine:
                     self._stores[name] = value
                 return
         host = np.asarray(value)
+        if (tuple(host.shape) != expected
+                and host.ndim == 2 and host.shape[1] == table.dim
+                and host.shape[0] % S == 0
+                and host.shape[0] >= table.num_rows):
+            # COMPAT: interleaved layouts from engines whose
+            # rows_per_shard differed (pre-lane-packing v1 checkpoints
+            # were not rounded to the pack factor): de-interleave with
+            # the SAVER's rps, re-interleave with ours.
+            old_rps = host.shape[0] // S
+            host = _interleave_rows(
+                _deinterleave_rows(host, table.num_rows, old_rps, S),
+                table.num_rows, table.rows_per_shard, S, table.dtype,
+            )
         log.check_eq(tuple(host.shape), expected, "bad restore shape")
         placed = self._place(
             _pack_host(host, table.rows_per_shard, S, table.pack,

@@ -67,11 +67,11 @@ def main() -> None:
         moved = 2 * payload * args.iters
     else:
         seq = np.ones((args.steps, bucket.total_len), np.float32)
-        eng.replay("prof", seq, keep="last",
+        eng.replay("prof", seq, handle=args.handle, keep="last",
                    zero_copy=args.zero_copy).block_until_ready()
 
         def run():
-            eng.replay("prof", seq, keep="last",
+            eng.replay("prof", seq, handle=args.handle, keep="last",
                        zero_copy=args.zero_copy).block_until_ready()
 
         moved = 2 * payload * args.steps
