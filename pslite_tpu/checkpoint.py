@@ -64,11 +64,12 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
     for name in engine._buckets:
         target["dense"][name] = engine.store_spec(name)
     if sparse_engine is not None:
-        # The saver's PHYSICAL table layout depends on history: a
-        # lane-packed table demotes to the unpacked layout on its first
-        # adagrad push (SparseTable.pack).  Match the restore target to
-        # the saved shape — if the checkpoint holds the unpacked form
-        # of a currently-packed table, demote it before targeting.
+        # The saver's PHYSICAL table layout can differ from a fresh
+        # registration's: demotion-era checkpoints (adagrad pushes used
+        # to demote packed tables) hold unpacked stores.  Match the
+        # restore target to the saved shape — if the checkpoint holds
+        # the unpacked form of a currently-packed table, demote it
+        # before targeting.
         try:
             with ocp.StandardCheckpointer() as _mc:
                 saved_md = _mc.metadata(os.path.abspath(path))
@@ -87,22 +88,25 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
                 t.rows_per_shard * sparse_engine.num_shards, t.dim
             )
             if t.pack > 1 and saved_shape == unpacked:
+                # COMPAT: checkpoints from the demotion era (adagrad
+                # pushes used to demote packed tables to the unpacked
+                # layout) hold unpacked stores; demote the live table
+                # so the restore target matches.
                 with sparse_engine._table_mu[name]:
                     sparse_engine._ensure_unpacked(name)
             elif t.pack == 1 and saved_shape is not None \
                     and saved_shape != unpacked:
-                # The inverse mismatch — a lane-packed save restored
-                # into a since-demoted table — cannot be repaired here
-                # (re-packing a demoted table is not supported); fail
-                # with the cause instead of an opaque orbax shape error.
+                # The inverse mismatch (a lane-packed save restored
+                # into an unpacked-layout table) cannot be repaired
+                # here; fail with the cause instead of an opaque orbax
+                # shape error.
                 raise log.CheckError(
-                    f"orbax checkpoint for table {name!r} holds the "
-                    f"lane-packed layout {saved_shape} but the live "
-                    f"table was demoted to the unpacked layout "
-                    f"{unpacked} (a row_adagrad push demotes) — "
-                    f"restore before the first adagrad push, or use "
-                    f"the npz checkpoint path (fleet-portable global "
-                    f"layout)"
+                    f"orbax checkpoint for table {name!r} holds a "
+                    f"different physical layout {saved_shape} than the "
+                    f"live table's {unpacked} (different lane packing, "
+                    f"shard count, or rows_per_shard) — orbax restores "
+                    f"are same-fleet/same-layout; use the npz "
+                    f"checkpoint path (fleet-portable global layout)"
                 )
             target["sparse"][name] = sparse_engine.store_spec(name)
             # Mirror of save: every registered table has an acc entry in

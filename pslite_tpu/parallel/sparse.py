@@ -50,7 +50,7 @@ class SparseTable:
     # embedding step).  Packing to full 128-lane rows makes row-major
     # canonical for BOTH ops: measured 2.0 -> 0.35 ms/step.  pack == 1
     # means unpacked (dim >= 128, dim not dividing 128, or a table
-    # demoted for the dense-aggregate adagrad path).
+    # demoted by the orbax demotion-era checkpoint compat shim).
     pack: int = 1
 
     @property
@@ -136,7 +136,7 @@ def _store_out_format(store, mesh, axis):
 
 def _scatter_rows(axis, S, R, pack, dim, store_l, idx_l, grads_l):
     """Sum-handle push: scatter-add the owned rows DIRECTLY into the
-    donated (possibly packed) store.  The dense _agg_rows form reads +
+    donated (possibly packed) store.  A dense-aggregate form reads +
     writes the whole table per push (768MB of traffic for a 4096-row
     update on the 1M-row workload); this touches only the updated rows.
     Unowned rows map out of bounds and mode="drop" discards them.
@@ -162,37 +162,85 @@ def _scatter_rows(axis, S, R, pack, dim, store_l, idx_l, grads_l):
     return store_l.at[phys].add(packed, mode="drop")
 
 
-def _agg_rows(axis, S, R, dtype, dim, idx_l, grads_l):
-    """Per-shard aggregate gradient G [R, d]: all-gather every worker's
-    (indices, grads), keep rows this shard owns (global row r lives on
-    shard r % S at local row r // S; unowned rows scatter into the R dump
-    slot), scatter-add.  Shared by the single-table and group programs —
-    change ownership/scatter semantics HERE only."""
-    from jax import lax
-    import jax.numpy as jnp
-
-    all_idx = lax.all_gather(idx_l[0], axis, tiled=True)  # [W*n]
-    all_g = lax.all_gather(grads_l[0], axis, tiled=True)  # [W*n, d]
-    my = lax.axis_index(axis)
-    owned = (all_idx % S) == my
-    local_rows = jnp.where(owned, all_idx // S, R)  # R = dump slot
-    padded = jnp.zeros((R + 1, dim), dtype)
-    padded = padded.at[local_rows].add(
-        jnp.where(owned[:, None], all_g, 0)
-    )
-    return padded[:R]
-
-
 def _adagrad_rows(store_l, acc_l, G, lr, eps):
-    """Row-wise Adagrad on the aggregated gradient (the DLRM-standard
-    embedding update): acc += mean(G^2, rows); row -= lr*G/(sqrt+eps).
-    Untouched rows see G == 0 and are unchanged.  Shared single/group."""
+    """Row-wise Adagrad on a DENSE aggregated gradient [R, d] (the
+    DLRM-standard embedding update): acc += mean(G^2, rows); row -=
+    lr*G/(sqrt+eps).  Untouched rows see G == 0 and are unchanged.
+    Kept as the REFERENCE recurrence the sparse form below must match
+    (tests assert parity); production paths use _adagrad_sparse."""
     import jax.numpy as jnp
 
     acc_new = acc_l + jnp.mean(G.astype(jnp.float32) ** 2, axis=1)
     step = (lr * G.astype(jnp.float32)
             / (jnp.sqrt(acc_new)[:, None] + eps))
     return store_l - step.astype(store_l.dtype), acc_new
+
+
+def _adagrad_sparse(axis, S, R, pack, dim, store_l, acc_l, idx_l,
+                    grads_l, lr, eps):
+    """Row-wise Adagrad WITHOUT the dense [R, d] aggregate: the dense
+    form reads+writes the whole table per push (a full-table pass even
+    for a 4096-row batch) and cannot serve the lane-packed layout.
+    Here duplicates are combined by a SEGMENT SUM over the sorted
+    gathered indices (O(batch) workspaces, exact same per-row G as the
+    dense form), the accumulator rows are gathered/updated/scattered
+    1-D, and the store step scatter-adds through the packed layout —
+    identical numerics to _adagrad_rows on the touched rows, untouched
+    rows never read or written."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    all_idx = lax.all_gather(idx_l[0], axis, tiled=True)   # [m]
+    all_g = lax.all_gather(grads_l[0], axis, tiled=True)   # [m, d]
+    my = lax.axis_index(axis)
+    owned = (all_idx % S) == my
+    local = jnp.where(owned, all_idx // S, R)  # R = sentinel (dropped)
+    m = all_idx.shape[0]
+
+    # Segment-sum duplicates: sort by local row, one segment per unique
+    # row (sentinel rows sort last into their own segments).
+    order = jnp.argsort(local)
+    sr = local[order]
+    sg = jnp.where(owned[order][:, None], all_g[order], 0)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sr[1:] != sr[:-1]]
+    )
+    seg = jnp.cumsum(first) - 1                            # [m]
+    G_seg = jnp.zeros((m, sg.shape[1]), sg.dtype).at[seg].add(sg)
+    # Row of each segment (slots beyond the unique count stay at the
+    # sentinel and scatter harmlessly via drop/zero-G).
+    row_seg = jnp.full((m,), R, jnp.int32).at[seg].set(
+        sr.astype(jnp.int32)
+    )
+    valid = row_seg < R
+
+    # Accumulator: gather the touched rows, apply, scatter back (1-D
+    # logical rows — independent of the store's lane packing).
+    acc_rows = acc_l[jnp.where(valid, row_seg, 0)]
+    g2 = jnp.mean(G_seg.astype(jnp.float32) ** 2, axis=1)
+    acc_new_rows = acc_rows + g2
+    new_acc = acc_l.at[jnp.where(valid, row_seg, R)].set(
+        acc_new_rows, mode="drop"
+    )
+    step = (lr * G_seg.astype(jnp.float32)
+            / (jnp.sqrt(acc_new_rows)[:, None] + eps))
+    step = jnp.where(valid[:, None], step, 0).astype(store_l.dtype)
+
+    # Store: scatter-subtract the step through the (packed) layout.
+    if pack == 1:
+        new_store = store_l.at[jnp.where(valid, row_seg, R)].add(
+            -step, mode="drop"
+        )
+    else:
+        phys = jnp.where(valid, row_seg // pack, R // pack)
+        slot = (row_seg % pack).astype(jnp.int32)
+        onehot = (slot[:, None]
+                  == jnp.arange(pack, dtype=jnp.int32)[None])
+        packed = (onehot[:, :, None] * (-step)[:, None, :]).reshape(
+            m, pack * dim
+        )
+        new_store = store_l.at[phys].add(packed, mode="drop")
+    return new_store, new_acc
 
 
 def _pull_rows(axis, S, store_l, idx_l, pack: int = 1, dim: int = None):
@@ -342,11 +390,13 @@ class SparseEngine:
         def _push_row_adagrad(store_l, acc_l, idx_l, grads_l, lr, eps):
             # Sync-PS optimizer semantics (kv_app.h:430-452 as one fused
             # program); lr/eps arrive as traced scalars, so per-step
-            # schedules reuse ONE compiled program.
-            G = _agg_rows(
-                axis, S, R, store_l.dtype, store_l.shape[1], idx_l, grads_l
+            # schedules reuse ONE compiled program.  Segment-sum form:
+            # O(batch) work and packed-layout compatible (no dense
+            # [R, d] aggregate, no full-table pass, no demotion).
+            new, acc_new = _adagrad_sparse(
+                axis, S, R, pack, dim, store_l, acc_l, idx_l, grads_l,
+                lr, eps,
             )
-            new, acc_new = _adagrad_rows(store_l, acc_l, G, lr, eps)
             return new, acc_new, new[:1, :1]
 
         def _pull(store_l, idx_l):
@@ -525,12 +575,11 @@ class SparseEngine:
 
     def _ensure_unpacked(self, name: str) -> None:
         """Demote a lane-packed table to the unpacked layout (one-time
-        host round trip) — the dense-aggregate adagrad path computes a
-        full [R, d] logical gradient and per-row accumulators, which
-        the packed physical layout does not serve.  Collective on
-        multi-process meshes (handle choice must already be symmetric
-        across processes, like every engine op).  Call with the table
-        lock HELD."""
+        host round trip).  COMPAT SHIM only: adagrad once required the
+        unpacked layout (the dense-aggregate era) and orbax checkpoints
+        saved then hold unpacked stores; restore_engine_orbax demotes a
+        packed table to match.  Collective on multi-process meshes.
+        Call with the table lock HELD."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .placement import to_host_global
@@ -579,8 +628,8 @@ class SparseEngine:
         batch = int(idx.shape[1])
         if handle is None:
             with self._table_mu[name]:
-                # Program selection reads table.pack, which a concurrent
-                # adagrad demotion mutates — resolve it under the lock.
+                # Program selection reads table.pack, which the orbax
+                # compat shim can mutate — resolve it under the lock.
                 prog = self._sparse_program("push", table, batch)
                 new_store, token = prog(self._stores[name], idx, g)
                 self._stores[name] = new_store
@@ -589,9 +638,6 @@ class SparseEngine:
 
             _, (lr, eps) = self._parse_handle(handle)
             with self._table_mu[name]:
-                # The dense-aggregate adagrad path needs the unpacked
-                # layout; demote once (program key tracks table.pack).
-                self._ensure_unpacked(name)
                 prog = self._sparse_program("push_row_adagrad", table,
                                             batch)
                 self._ensure_acc(name, table)
@@ -675,9 +721,10 @@ class SparseEngine:
                 lr, eps = args[4 * k], args[4 * k + 1]
                 new_s, new_a = [], []
                 for i, (s, a) in enumerate(zip(stores, accs)):
-                    G = _agg_rows(axis, S, Rs[i], s.dtype, s.shape[1],
-                                  idxs[i], grads[i])
-                    n2, a2 = _adagrad_rows(s, a, G, lr, eps)
+                    n2, a2 = _adagrad_sparse(
+                        axis, S, Rs[i], packs[i], dims[i], s, a,
+                        idxs[i], grads[i], lr, eps,
+                    )
                     new_s.append(n2)
                     new_a.append(a2)
                 return (*new_s, *new_a, new_s[0][:1, :1])
@@ -755,10 +802,6 @@ class SparseEngine:
                 import jax.numpy as jnp
 
                 _, (lr, eps) = self._parse_handle(handle)
-                for n in names:
-                    # Dense-aggregate adagrad needs the unpacked layout
-                    # (program key tracks pack).
-                    self._ensure_unpacked(n)
                 prog = self._sparse_group_program(
                     "push_row_adagrad", tables, batches
                 )
@@ -830,9 +873,9 @@ class SparseEngine:
 
         with self._table_mu[name]:
             t = self._tables[name]
-            # Capture layout metadata WITH the snapshot: a concurrent
-            # adagrad demotion would otherwise change t.pack between
-            # the copy and the unpack.
+            # Capture layout metadata WITH the snapshot so a concurrent
+            # pack change (orbax compat shim) cannot desynchronize the
+            # copy from its unpack.
             pack, rps = t.pack, t.rows_per_shard
             host = np.asarray(jnp.copy(self._stores[name]))
         return _unpack_host(host, rps, self.num_shards, pack, t.dim)
@@ -909,17 +952,19 @@ class SparseEngine:
                     self._stores[name] = value
                 return
         host = np.asarray(value)
+        unrounded_rps = -(-table.num_rows // S)
         if (tuple(host.shape) != expected
                 and host.ndim == 2 and host.shape[1] == table.dim
-                and host.shape[0] % S == 0
-                and host.shape[0] >= table.num_rows):
-            # COMPAT: interleaved layouts from engines whose
-            # rows_per_shard differed (pre-lane-packing v1 checkpoints
-            # were not rounded to the pack factor): de-interleave with
-            # the SAVER's rps, re-interleave with ours.
-            old_rps = host.shape[0] // S
+                and host.shape[0] == unrounded_rps * S):
+            # COMPAT, narrowly: a v1 checkpoint from an engine with the
+            # SAME shard count whose rows_per_shard was the plain
+            # ceil(num_rows/S) (pre-lane-packing rounding).  The shape
+            # alone cannot distinguish other shard counts (v1 meta has
+            # no num_shards), so only this exact size re-interleaves —
+            # anything else still fails loud below.
             host = _interleave_rows(
-                _deinterleave_rows(host, table.num_rows, old_rps, S),
+                _deinterleave_rows(host, table.num_rows, unrounded_rps,
+                                   S),
                 table.num_rows, table.rows_per_shard, S, table.dtype,
             )
         log.check_eq(tuple(host.shape), expected, "bad restore shape")
