@@ -31,6 +31,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The AOT topology client compiles LOCALLY (libtpu compile-only) — the
+# axon tunnel is not needed, and letting the axon backend initialize
+# would HANG this tool whenever the tunnel is down.  Pin CPU via the
+# shared counter-measure helper (kept in sync with the sitecustomize).
+from pslite_tpu.utils.platform_pin import pin_cpu
+
+pin_cpu(1)
+
 
 def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
     """Lower + compile one ring program against the AOT mesh; returns a
