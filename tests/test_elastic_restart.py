@@ -126,3 +126,37 @@ def test_keepalive_restart_into_half_fleet(tmp_path):
     assert proc.returncode == 0, (out + proc.stderr.decode())[-2000:]
     assert "saved 2-step checkpoint from 8 shards" in out, out[-1500:]
     assert "ELASTIC_RESTART_OK restored onto 4 shards" in out, out[-1500:]
+
+
+def test_v1_same_fleet_rps_rounding_compat():
+    """A v1-era interleaved table (rows_per_shard = plain ceil(rows/S),
+    before lane-pack rounding) restores onto a same-shard-count engine;
+    any OTHER interleaved size still fails loud (the shape cannot
+    identify the saver's shard count)."""
+    from pslite_tpu.parallel.sparse import (
+        SparseEngine,
+        _interleave_rows,
+    )
+    from pslite_tpu.utils.logging import CheckError
+
+    rows, dim, S = 13, 4, 8
+    mesh8 = default_mesh()
+    se = SparseEngine(mesh8)
+    se.register_sparse("v1", rows, dim)
+    # v1 layout: unrounded rps = ceil(13/8) = 2 (today's is 32).
+    glob = np.arange(rows * dim, dtype=np.float32).reshape(rows, dim)
+    v1_host = _interleave_rows(glob, rows, 2, S, np.float32)
+    assert v1_host.shape == (16, dim)
+    se.set_store_array("v1", v1_host)
+    got = np.asarray(
+        se.pull("v1", np.tile(np.arange(rows, dtype=np.int32), (S, 1)))
+    )[0]
+    np.testing.assert_allclose(got, glob)
+
+    # An interleaved array of any OTHER size must not be silently
+    # re-interpreted.  (A same-SIZE layout from a different fleet —
+    # e.g. S=4/rps=4 also giving 16 rows — is inherently
+    # indistinguishable by shape; v1 meta carries no shard count.)
+    other = _interleave_rows(glob, rows, 5, 4, np.float32)  # 20 rows
+    with pytest.raises(CheckError, match="bad restore shape"):
+        se.set_store_array("v1", other)
