@@ -323,6 +323,14 @@ _emit_mu = threading.Lock()
 _emitted = False
 
 
+def _mark(section: str) -> None:
+    """Progress stamp on STDERR (stdout carries exactly one JSON line):
+    a watchdog-timeout or driver-kill then shows WHERE the run stalled
+    (the r04 tunnel outage produced timeouts with no trace)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {section}",
+          file=sys.stderr, flush=True)
+
+
 def _emit(obj: dict) -> None:
     """Print the ONE result line (idempotent: watchdog vs main race)."""
     global _emitted
@@ -386,6 +394,7 @@ def main() -> None:
 
         from pslite_tpu.parallel.engine import CollectiveEngine
 
+        _mark("engine init")
         eng = CollectiveEngine()
         # Which data plane produces these numbers (VERDICT r03 weak #7:
         # nothing in the JSON said the headline was the XLA path).  The
@@ -406,6 +415,7 @@ def main() -> None:
         )
         # Per-op dispatch sweep (one push_pull per iteration, the
         # ZPush/ZPull analog), wall + device from the same loop.
+        _mark("per-op sweep")
         sweep_wall, sweep_dev = {}, {}
         for size in sizes:
             label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
@@ -420,6 +430,7 @@ def main() -> None:
         # Dispatch-amortized sweep: the same 1-key buckets through ONE
         # fused T-step replay program (lax.scan over the donated store);
         # T scaled so each program moves >=64MB of payload.
+        _mark("replay sweep")
         sweep_replay_wall, sweep_replay_dev = {}, {}
         for size in sizes:
             label = f"{size >> 20}MB" if size >= 1 << 20 else f"{size >> 10}KB"
@@ -445,6 +456,7 @@ def main() -> None:
             stress = {}
             coalesced_wall = coalesced_dev = None
         else:
+            _mark("headline")
             headline_cfg = "40x1MB"
             iters = 30
             # Median of 3 traced runs, keyed on the DEVICE number (the
@@ -491,6 +503,7 @@ def main() -> None:
             # (~205 MB/step in ~35 size-bucketed tensors) as one grouped
             # dispatch per step — the BASELINE config-4 replay.  One
             # execution per workload, both clocks (_dual_measure).
+            _mark("model workloads")
             from pslite_tpu.models.resnet_trace import replay as rn50
 
             rn = {}
@@ -526,6 +539,7 @@ def main() -> None:
             # 64KB per-op push_pulls through the micro-batching
             # dispatcher — the async ZPush/Wait contract, ~1 grouped
             # dispatch per window instead of 32.
+            _mark("coalesced leg")
             import jax as _jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -559,6 +573,7 @@ def main() -> None:
             )
             # The reference's stress patterns (test_benchmark_stress.cc:
             # 271-279: 30.72MB tensors), device basis (VERDICT r03 #8).
+            _mark("stress legs")
             from pslite_tpu.stress import run_pattern
 
             stress = {}
@@ -571,6 +586,7 @@ def main() -> None:
 
         single_chip = probe.get("n", 1) == 1 or eng.num_shards == 1
         hbm_spec = _hbm_estimate(probe.get("device_kind", ""))
+        _mark("hbm peak calibration")
         hbm_peak_wall = hbm_peak_dev = None
         if not quick:
             try:
