@@ -617,8 +617,11 @@ class CollectiveEngine:
         interp = self._ring_interpret
 
         def _padded(store_l, grads_l):
+            # grads_l: my FLAT row [padded] (see _prep_grads_ring — the
+            # flat parameter keeps 2-byte dtypes packed; a (1, padded)
+            # block would sublane-pad to 2x the bytes).
             return _pad_ring_chunks(
-                grads_l[0].reshape(n, chunk0), store_l, kchunk, chunk0
+                grads_l.reshape(n, chunk0), store_l, kchunk, chunk0
             )
 
         def body_pp(store_l, grads_l):
@@ -648,7 +651,7 @@ class CollectiveEngine:
         fn = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(axis), P(axis, None)),
+            in_specs=(P(axis), P(axis)),
             out_specs=out_specs,
         )
         jitted = jax.jit(fn, donate_argnums=(0,))
@@ -851,11 +854,35 @@ class CollectiveEngine:
         8-shard fleet restores onto 4 shards and vice versa."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        import jax
+
         log.check(name in self._buckets, f"bucket {name!r} not registered")
         bucket = self._buckets[name]
         sharding = NamedSharding(self.mesh, P(self.axis))
         norm = []
+        placed_device = {}
         for i, v in enumerate(values):
+            if isinstance(v, jax.Array) and not (kind == "adam" and i == 2):
+                # Fleet-portable DEVICE restore (orbax v2): logical
+                # vectors pad+reshard on device, no host fetch.
+                import jax.numpy as jnp
+
+                log.check(
+                    v.size in (bucket.total_len, bucket.padded_len),
+                    f"bad optimizer state length {v.size} for bucket "
+                    f"{name!r} (want {bucket.total_len} or "
+                    f"{bucket.padded_len})",
+                )
+                if v.size == bucket.total_len != bucket.padded_len:
+                    v = jnp.pad(
+                        v.reshape(-1),
+                        (0, bucket.padded_len - bucket.total_len),
+                    )
+                placed_device[i] = jax.device_put(
+                    v.reshape(-1), sharding
+                )
+                norm.append(None)
+                continue
             arr = np.ascontiguousarray(np.asarray(v))
             if kind == "adam" and i == 2:
                 step = float(arr.reshape(-1)[0]) if arr.size else 0.0
@@ -875,7 +902,10 @@ class CollectiveEngine:
                     out[: bucket.total_len] = arr.reshape(-1)
                     arr = out
             norm.append(arr)
-        placed = tuple(self._place(a, sharding) for a in norm)
+        placed = tuple(
+            placed_device[i] if a is None else self._place(a, sharding)
+            for i, a in enumerate(norm)
+        )
         with self._bucket_mu[name]:
             self._opt_states[name] = placed
             self._opt_kinds[name] = kind
@@ -951,6 +981,52 @@ class CollectiveEngine:
             # (padded == total on every zc-eligible config, so this is
             # only reachable for malformed lengths, which it rejects).
         arr = self._normalize_host_grads(grads, 1, bucket, np)
+        return jax.device_put(
+            np.ascontiguousarray(arr).reshape(-1), sharding
+        )
+
+    def _prep_grads_ring(self, bucket: DenseBucket, grads):
+        """``[W*padded]`` FLAT grads, sharded ``P(axis)``, for the
+        single-bucket 1-D fused ring programs.
+
+        Why flat: the ``[W, padded]`` form gives each device a
+        ``(1, padded)`` parameter block, and TPU tiled layouts pad the
+        sublane dim — ``T(2,128)`` for 2-byte dtypes stores (and reads)
+        TWICE the bytes for a bf16 grads operand (caught by
+        tools/aot_ring_compile.py's memory cross-check; f32's
+        ``T(1,128)`` happens to be packed).  The flat form is the same
+        bits per device (row-major row d == device d's flat slice) but
+        always lays out packed.  Host arrays flatten for free; a
+        ``[W, padded]`` device array pays one relayout per call (pass
+        flat device arrays on the hot path, as with _prep_grads_flat).
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        W = self.num_shards
+        flat_len = W * bucket.padded_len
+        if isinstance(grads, jax.Array):
+            if grads.ndim == 2 and int(grads.shape[1]) == bucket.padded_len:
+                log.check_eq(int(grads.shape[0]), W, "bad worker dim")
+                return jax.device_put(grads.reshape(-1), sharding)
+            if grads.ndim == 1 and int(grads.shape[0]) == flat_len:
+                if grads.sharding == sharding:
+                    return grads
+                return jax.device_put(grads, sharding)
+            # Unpadded / broadcast forms fall through to host staging.
+        if self._is_multiprocess():
+            arr = self._normalize_host_grads(
+                grads, self._local_shards(), bucket, np,
+                row_msg="bad local worker dim (rows = this process's "
+                        "devices on a multi-process mesh)",
+            )
+            return jax.make_array_from_process_local_data(
+                sharding,
+                np.ascontiguousarray(arr).reshape(-1),
+                (flat_len,),
+            )
+        arr = self._normalize_host_grads(grads, W, bucket, np)
         return jax.device_put(
             np.ascontiguousarray(arr).reshape(-1), sharding
         )
@@ -1057,6 +1133,20 @@ class CollectiveEngine:
             return True
         return self._effective_impl(dtype, resolved) == "xla"
 
+    def flat_ring_eligible(self, dtype, handle: Optional[ServerHandle] = None
+                           ) -> bool:
+        """Whether ``push_pull``/``push`` for this config routes to the
+        1-D fused ring programs, which take FLAT ``[W*padded]`` grads
+        (``_prep_grads_ring``) — hot-path callers holding device arrays
+        should pre-build that layout to skip the per-call relayout.
+        The ONE definition the op routing and benchmarks share."""
+        resolved, _ = self._resolve_handle(handle)
+        return (
+            not self._is_stateful(resolved)
+            and self.worker_axis is None
+            and self._effective_impl(dtype, resolved) == "pallas"
+        )
+
     def flat_zc_eligible(self, handle: Optional[ServerHandle] = None
                          ) -> bool:
         """Whether a zero-copy push_pull for ``handle`` takes the FLAT
@@ -1087,8 +1177,13 @@ class CollectiveEngine:
         resolved, handle_key = self._resolve_handle(handle)
         zc = zero_copy and self._zc_pull_eligible(bucket.dtype, resolved)
         flat_zc = zc and self.flat_zc_eligible(handle)
-        g = (self._prep_grads_flat(bucket, grads) if flat_zc
-             else self._prep_grads(bucket, grads))
+        ring_1d = self.flat_ring_eligible(bucket.dtype, handle)
+        if flat_zc:
+            g = self._prep_grads_flat(bucket, grads)
+        elif ring_1d:
+            g = self._prep_grads_ring(bucket, grads)
+        else:
+            g = self._prep_grads(bucket, grads)
         if self._is_stateful(resolved):
             prog = self._program(
                 "push_pull_st_zc" if zc else "push_pull_st",
@@ -1131,7 +1226,9 @@ class CollectiveEngine:
         t0 = time.perf_counter()
         bucket = self._buckets[name]
         resolved, handle_key = self._resolve_handle(handle)
-        g = self._prep_grads(bucket, grads)
+        ring_1d = self.flat_ring_eligible(bucket.dtype, handle)
+        g = (self._prep_grads_ring(bucket, grads) if ring_1d
+             else self._prep_grads(bucket, grads))
         if self._is_stateful(resolved):
             prog = self._program(
                 "push_st", bucket.padded_len, bucket.dtype, handle_key
@@ -2000,17 +2097,24 @@ class CollectiveEngine:
         bucket = self._buckets[name]
         sharding = NamedSharding(self.mesh, P(self.axis))
         if isinstance(value, jax.Array):
-            equivalent = value.sharding == sharding or (
-                hasattr(value.sharding, "is_equivalent_to")
-                and value.sharding.is_equivalent_to(sharding, value.ndim)
-            )
-            if equivalent:
-                log.check_eq(tuple(value.shape), (bucket.padded_len,),
-                             "bad restore shape")
+            if (tuple(value.shape) == (bucket.total_len,)
+                    and bucket.total_len != bucket.padded_len):
+                # Fleet-portable DEVICE restore (orbax v2): a global
+                # LOGICAL array saved by any shard count — pad to THIS
+                # engine's padded length and reshard, all device-side
+                # (multi-host arrays are not host-fetchable).
+                import jax.numpy as jnp
+
+                value = jnp.pad(
+                    value.astype(bucket.dtype),
+                    (0, bucket.padded_len - bucket.total_len),
+                )
+            if tuple(value.shape) == (bucket.padded_len,):
                 log.check_eq(value.dtype, np.dtype(bucket.dtype),
                              "bad restore dtype")
+                placed = jax.device_put(value, sharding)
                 with self._bucket_mu[name]:
-                    self._stores[name] = value
+                    self._stores[name] = placed
                 return
         arr = np.zeros(bucket.padded_len, dtype=np.dtype(bucket.dtype))
         flat = np.asarray(value).reshape(-1)

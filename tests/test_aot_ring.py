@@ -42,9 +42,12 @@ def test_ring_kernel_compiles_for_real_v5e(v5e8_mesh):
     store = jax.ShapeDtypeStruct(
         (padded,), jnp.float32, sharding=NamedSharding(v5e8_mesh, P("kv"))
     )
+    # FLAT grads: the 1-D ring program's parameter form (a (1, padded)
+    # per-device block would sublane-pad 2-byte dtypes to 2x the bytes
+    # — engine._prep_grads_ring).
     grads = jax.ShapeDtypeStruct(
-        (8, padded), jnp.float32,
-        sharding=NamedSharding(v5e8_mesh, P("kv", None)),
+        (8 * padded,), jnp.float32,
+        sharding=NamedSharding(v5e8_mesh, P("kv")),
     )
     lowered = prog.lower(store, grads)
     # The kernel must actually be in the program (Mosaic custom call),

@@ -18,6 +18,17 @@ select: bidirectional f32/bf16, int8 wire compression, push-only,
 2-D multi-axis (dp sub-rings + kv gather), the 3-D torus (dp sub-rings
 + two-axis kv gather), and the fused replay scan.
 
+Beyond compilation (r04 verdict, missing #3 — evidence short of
+execution), each row records:
+- XLA's cost-model bytes-accessed and memory-assignment breakdown
+  (argument/output/alias/temp/peak bytes) for the compiled executable;
+- the kernel's analytic byte model (HBM traffic, ICI wire bytes, VMEM
+  scratch) with an exact cross-check of the argument/output totals —
+  ``model_args_match`` gates ``all_ok``;
+- executable serialization: payload size, plus a reload attempt against
+  the topology client (needs a real TPU runtime; the error is recorded
+  verbatim on a chipless box).
+
 Usage: python tools/aot_ring_compile.py [--topology v5e:2x4]
 """
 
@@ -40,6 +51,112 @@ from pslite_tpu.utils.platform_pin import pin_cpu
 pin_cpu(1)
 
 
+def _traffic_model(n: int, padded: int, dtype, compress: bool,
+                   with_ag: bool) -> dict:
+    """Analytic per-device byte model of the 1-D ring kernel — the
+    numbers the XLA memory analysis must be consistent with (VERDICT
+    r04 missing #3: cheaper hardware evidence than execution).
+
+    Derivation (ops/ring_collective.py kernel body, bidirectional):
+      HBM: grads staged once per chunk (n chunks), store read + updated
+      store write (1 chunk each), pulled replicate written (n chunks,
+      with_ag only) -> (2n+2) * chunk_bytes  [(n+2) push-only].
+      ICI: 2(n-1) hop steps (n-1 RS + n-1 AG; n-1 push-only), each hop
+      sending both half-chunks = one comm buffer's bytes (int8 wire
+      sends int8 payload + one bitcast f32 scale tile per half).
+      VMEM scratch: send_buf + 2 recv slots + gchunk staging per
+      direction, plus the store/out_store VMEM residents.
+    """
+    import jax.numpy as jnp
+
+    from pslite_tpu.ops.ring_collective import _LANES, _SUBLANES, \
+        ring_chunk_len
+
+    ndir = 2
+    itemsize = jnp.dtype(dtype).itemsize
+    comm_itemsize = 1 if compress else itemsize
+    chunk = ring_chunk_len(padded, n, dtype=dtype, bidir=True,
+                           compress=compress)
+    rows = chunk // _LANES
+    h = rows // ndir
+    comm_rows = h + 4 * _SUBLANES if compress else h
+    chunk_bytes = chunk * itemsize
+    hops = 2 * (n - 1) if with_ag else (n - 1)
+    comm_buf_bytes = ndir * comm_rows * _LANES * comm_itemsize
+    return {
+        "chunk_elems": chunk,
+        "hbm_bytes_per_device": (
+            (2 * n + 2 if with_ag else n + 2) * chunk_bytes
+        ),
+        "ici_bytes_per_device": hops * comm_buf_bytes,
+        "vmem_scratch_bytes": (
+            comm_buf_bytes * 3  # send_buf + 2 recv slots
+            + ndir * h * _LANES * itemsize  # gchunk
+            + 2 * rows * _LANES * itemsize  # store + out_store residents
+        ),
+        "argument_bytes": n * chunk * itemsize + chunk * itemsize,
+        "output_bytes": (
+            chunk * itemsize + (n * chunk * itemsize if with_ag else 0)
+        ),
+    }
+
+
+def _analyses(compiled) -> dict:
+    """XLA's own numbers for one compiled executable: cost-model bytes
+    accessed and the memory-assignment breakdown."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca:
+            out["xla_bytes_accessed"] = ca.get("bytes accessed")
+            if ca.get("flops"):
+                out["xla_flops"] = ca.get("flops")
+    except Exception as exc:  # noqa: BLE001 - record, don't fail the row
+        out["cost_analysis_error"] = repr(exc)[:200]
+    try:
+        ma = compiled.memory_analysis()
+        out["memory"] = {
+            "argument_bytes": ma.argument_size_in_bytes,
+            "output_bytes": ma.output_size_in_bytes,
+            "alias_bytes": ma.alias_size_in_bytes,
+            "temp_bytes": ma.temp_size_in_bytes,
+            "peak_bytes": ma.peak_memory_in_bytes,
+            "generated_code_bytes": ma.generated_code_size_in_bytes,
+        }
+    except Exception as exc:  # noqa: BLE001
+        out["memory_analysis_error"] = repr(exc)[:200]
+    return out
+
+
+def _serialize_roundtrip(compiled, devices) -> dict:
+    """Persist + reload evidence: serialize the executable (proves the
+    compiled artifact is a deployable object, the reference's
+    rendezvous-cache persistence analog) and attempt
+    deserialize_and_load against the topology client.  Reload needs a
+    real TPU runtime — on this chipless box the attempt's exact error
+    is recorded rather than hidden."""
+    out = {}
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        out["serialized_bytes"] = len(payload)
+        try:
+            client = getattr(devices[0], "client", None)
+            se.deserialize_and_load(
+                payload, in_tree, out_tree,
+                backend=client,
+                execution_devices=list(devices),
+            )
+            out["reload"] = "ok"
+        except Exception as exc:  # noqa: BLE001
+            out["reload"] = f"unavailable: {exc!r}"[:300]
+    except Exception as exc:  # noqa: BLE001
+        out["serialize_error"] = repr(exc)[:300]
+    return out
+
+
 def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
     """Lower + compile one ring program against the AOT mesh; returns a
     result row (mosaic presence, compile seconds, executable size)."""
@@ -51,10 +168,17 @@ def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
     waxis = eng.worker_axis
     store_spec = NamedSharding(mesh, P(axis))
     if waxis is None:
-        grads_spec = NamedSharding(mesh, P(axis, None))
+        # 1-D single-bucket ring programs take FLAT grads (see
+        # engine._prep_grads_ring: the (1, padded) per-device block
+        # would sublane-pad 2-byte dtypes to 2x the HBM bytes).
+        grads_sds = jax.ShapeDtypeStruct(
+            (eng.num_shards * padded,), dtype,
+            sharding=NamedSharding(mesh, P(axis)))
         rows = eng.num_shards
     else:
-        grads_spec = NamedSharding(mesh, P(waxis, axis))
+        grads_sds = jax.ShapeDtypeStruct(
+            (eng.num_workers, padded), dtype,
+            sharding=NamedSharding(mesh, P(waxis, axis)))
         rows = eng.num_workers
 
     store_sds = jax.ShapeDtypeStruct((padded,), dtype, sharding=store_spec)
@@ -67,14 +191,10 @@ def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
                                      sharding=seq_spec))
     elif kind == "push":
         prog = eng._ring_program_op("push", padded, dtype, "_default")
-        args = (store_sds,
-                jax.ShapeDtypeStruct((rows, padded), dtype,
-                                     sharding=grads_spec))
+        args = (store_sds, grads_sds)
     else:  # push_pull
         prog = eng._ring_program(padded, dtype, "_default")
-        args = (store_sds,
-                jax.ShapeDtypeStruct((rows, padded), dtype,
-                                     sharding=grads_spec))
+        args = (store_sds, grads_sds)
 
     t0 = time.perf_counter()
     lowered = prog.lower(*args)
@@ -82,12 +202,15 @@ def _compile_one(eng, mesh, kind: str, padded: int, dtype, steps: int = 0):
     mosaic = "tpu_custom_call" in hlo
     compiled = lowered.compile()
     dt = time.perf_counter() - t0
-    return {
+    row = {
         "mosaic_custom_call": mosaic,
         "compile_seconds": round(dt, 1),
         "hlo_bytes": len(hlo),
         "executable_text_bytes": len(compiled.as_text()),
     }
+    row.update(_analyses(compiled))
+    row.update(_serialize_roundtrip(compiled, list(mesh.devices.flat)))
+    return row
 
 
 def main() -> int:
@@ -132,32 +255,54 @@ def main() -> int:
                             worker_axis="dp", impl="pallas")
 
     padded = n * 65536  # 2MB f32 per bucket at n=8
+    # (name, eng, mesh, kind, padded, dtype, steps, model_kwargs) —
+    # model_kwargs=None for variants whose byte model is not the plain
+    # 1-D ring (multi-axis runs sub-rings per column; replay re-enters
+    # the ring T times with the store VMEM-resident between steps).
     configs = [
         ("push_pull_f32_bidir", eng1, mesh1, "push_pull", padded,
-         jnp.float32, 0),
+         jnp.float32, 0, {"compress": False, "with_ag": True}),
         ("push_pull_bf16", eng1, mesh1, "push_pull", padded,
-         jnp.bfloat16, 0),
+         jnp.bfloat16, 0, {"compress": False, "with_ag": True}),
         ("push_pull_int8_wire", engc, mesh1, "push_pull", padded,
-         jnp.float32, 0),
-        ("push_only", eng1, mesh1, "push", padded, jnp.float32, 0),
+         jnp.float32, 0, {"compress": True, "with_ag": True}),
+        ("push_only", eng1, mesh1, "push", padded, jnp.float32, 0,
+         {"compress": False, "with_ag": False}),
         ("multi_axis_2d", eng2, mesh2, "push_pull", padded,
-         jnp.float32, 0),
+         jnp.float32, 0, None),
         ("multi_axis_3d_torus", eng3, mesh3, "push_pull", padded,
-         jnp.float32, 0),
-        ("replay_scan_T4", eng1, mesh1, "replay", padded, jnp.float32, 4),
+         jnp.float32, 0, None),
+        ("replay_scan_T4", eng1, mesh1, "replay", padded, jnp.float32,
+         4, None),
     ]
     ok = True
-    for name, eng, mesh, kind, plen, dtype, steps in configs:
+    for name, eng, mesh, kind, plen, dtype, steps, model_kw in configs:
         impl = eng._effective_impl(dtype, "sum")
         if impl != "pallas":
             report["configs"][name] = {"error": f"gate says {impl}"}
             ok = False
             continue
         try:
-            report["configs"][name] = _compile_one(
-                eng, mesh, kind, plen, dtype, steps
-            )
-            if not report["configs"][name]["mosaic_custom_call"]:
+            row = _compile_one(eng, mesh, kind, plen, dtype, steps)
+            if model_kw is not None:
+                model = _traffic_model(n, plen, dtype, **model_kw)
+                row["model"] = model
+                mem = row.get("memory")
+                if mem:
+                    # The argument/output byte totals are EXACT claims
+                    # of the kernel's interface model; XLA adds only a
+                    # small tuple/alignment overhead.  A mismatch means
+                    # the model (or the kernel's layouts) is wrong.
+                    row["model_args_match"] = (
+                        abs(mem["argument_bytes"]
+                            - model["argument_bytes"]) <= 4096
+                        and abs(mem["output_bytes"]
+                                - model["output_bytes"]) <= 4096
+                    )
+                    if not row["model_args_match"]:
+                        ok = False
+            report["configs"][name] = row
+            if not row["mosaic_custom_call"]:
                 ok = False
         except Exception as exc:  # noqa: BLE001 - record per-config
             report["configs"][name] = {
