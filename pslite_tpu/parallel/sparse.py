@@ -546,6 +546,20 @@ class SparseEngine:
         table = self._tables[name]
         expected = (table.rows_per_shard * self.num_shards,)
         sharding = NamedSharding(self.mesh, P(self.axis))
+        if global_rows and isinstance(value, jax.Array):
+            # Device-side global restore (see set_store_array).
+            import jax.numpy as jnp
+
+            S, rps = self.num_shards, table.rows_per_shard
+            log.check_eq(tuple(value.shape), (table.num_rows,),
+                         "bad global-rows accumulator shape")
+            v = jnp.pad(value.astype(np.float32),
+                        (0, rps * S - table.num_rows))
+            inter = v.reshape(rps, S).transpose(1, 0).reshape(-1)
+            placed = jax.device_put(inter, sharding)
+            with self._table_mu[name]:
+                self._acc[name] = placed
+            return
         if global_rows and not isinstance(value, jax.Array):
             host = np.asarray(value, np.float32)
             log.check_eq(tuple(host.shape), (table.num_rows,),
@@ -882,12 +896,46 @@ class SparseEngine:
 
     def store_raw(self, name: str):
         """A consistent snapshot of the PHYSICAL sharded store (the
-        lane-packed layout, matching :meth:`store_spec`) — what sharded
-        checkpoint backends (orbax) save and restore verbatim."""
+        lane-packed layout, matching :meth:`store_spec`) — what
+        legacy-format orbax checkpoints saved and restore verbatim."""
         import jax.numpy as jnp
 
         with self._table_mu[name]:
             return jnp.copy(self._stores[name])
+
+    def store_global_device(self, name: str):
+        """The GLOBAL logical table ``[num_rows, dim]`` as a DEVICE
+        computation (no host fetch — multi-host safe): unpack the lane
+        packing and de-interleave the shard layout with pure
+        reshape/transpose ops, the jnp mirror of
+        :func:`_deinterleave_rows`.  This is what the fleet-size-portable
+        orbax checkpoint (v2) saves: a logical array any shard count can
+        restore."""
+        import jax.numpy as jnp
+
+        with self._table_mu[name]:
+            t = self._tables[name]
+            S, rps, pack, dim = (self.num_shards, t.rows_per_shard,
+                                 t.pack, t.dim)
+            num_rows = t.num_rows
+            store = jnp.copy(self._stores[name])
+        # Unpack ([phys*S, pack*dim] -> per-shard rows) and de-interleave
+        # in one reshape/transpose chain.
+        return store.reshape(S, rps, dim).transpose(1, 0, 2).reshape(
+            rps * S, dim
+        )[:num_rows]
+
+    def acc_global_device(self, name: str):
+        """GLOBAL logical Adagrad accumulator ``[num_rows]``, device-side
+        (see :meth:`store_global_device`)."""
+        import jax.numpy as jnp
+
+        with self._table_mu[name]:
+            t = self._tables[name]
+            log.check(name in self._acc, f"no accumulator for {name!r}")
+            S, rps = self.num_shards, t.rows_per_shard
+            acc = jnp.copy(self._acc[name])
+        return acc.reshape(S, rps).transpose(1, 0).reshape(-1)[:t.num_rows]
 
     def store_spec(self, name: str):
         """Shape/dtype/sharding of a table without copying it (restore
@@ -932,6 +980,25 @@ class SparseEngine:
         expected = (table.rows_per_shard * S, table.dim)
         phys_expected = (table.phys_rows * S, table.pack * table.dim)
         sharding = NamedSharding(self.mesh, P(self.axis, None))
+        if global_rows and isinstance(value, jax.Array):
+            # Fleet-portable DEVICE restore (orbax v2): interleave +
+            # re-pack on device — the jnp mirror of _interleave_rows +
+            # _pack_host, multi-host safe (no host fetch).
+            import jax.numpy as jnp
+
+            log.check_eq(tuple(value.shape), (table.num_rows, table.dim),
+                         "bad global-rows restore shape")
+            rps, dim, pack = table.rows_per_shard, table.dim, table.pack
+            v = jnp.pad(
+                value.astype(table.dtype),
+                ((0, rps * S - table.num_rows), (0, 0)),
+            )
+            inter = v.reshape(rps, S, dim).transpose(1, 0, 2)
+            phys = inter.reshape(S * table.phys_rows, pack * dim)
+            placed = jax.device_put(phys, sharding)
+            with self._table_mu[name]:
+                self._stores[name] = placed
+            return
         if global_rows and not isinstance(value, jax.Array):
             host = np.asarray(value)
             log.check_eq(tuple(host.shape), (table.num_rows, table.dim),
