@@ -273,6 +273,13 @@ def _measure(eng, name: str, num_keys: int, val_len: int, iters: int,
             jnp.ones((bucket.padded_len,), dtype),
             NamedSharding(eng.mesh, P(eng.axis)),
         )
+    elif eng.flat_ring_eligible(dtype, handle):
+        # The 1-D ring programs take grads FLAT [W*padded] — passing
+        # the 2-D rows would relayout per call INSIDE the timed loop.
+        inp = jax.device_put(
+            jnp.ones((eng.num_shards * bucket.padded_len,), dtype),
+            NamedSharding(eng.mesh, P(eng.axis)),
+        )
     else:
         inp = jax.device_put(
             jnp.ones((eng.num_shards, bucket.padded_len), dtype),
@@ -323,6 +330,61 @@ def _measure_replay(eng, name: str, num_keys: int, val_len: int,
     moved = 2 * payload * steps
     return (moved / wall / 1e9,
             moved / busy / 1e9 if busy else None)
+
+
+def _latency_samples(eng, name: str, num_keys: int, val_len: int,
+                     samples: int, zero_copy: bool = True):
+    """Per-op completion latencies (µs) of INDIVIDUALLY-awaited
+    push_pull calls — the reference's latency regime (one Wait per
+    round, test_benchmark.cc:393) as opposed to :func:`_measure`'s
+    pipelined loop, whose per-iteration time hides dispatch latency
+    behind device queuing.  Returns (wall_us_list, device_us_mean|None);
+    the device mean is the op's on-chip occupancy, the floor the
+    dispatch path adds its overhead to."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    keys = np.arange(num_keys, dtype=np.uint64)
+    eng.register_dense(name, keys, val_len)
+    bucket = eng.bucket(name)
+    if zero_copy and eng.flat_zc_eligible(None):
+        inp = jax.device_put(
+            jnp.ones((bucket.padded_len,), jnp.float32),
+            NamedSharding(eng.mesh, P(eng.axis)),
+        )
+    elif eng.flat_ring_eligible(jnp.float32, None):
+        # Flat [W*padded]: the ring programs' native layout (_measure).
+        inp = jax.device_put(
+            jnp.ones((eng.num_shards * bucket.padded_len,), jnp.float32),
+            NamedSharding(eng.mesh, P(eng.axis)),
+        )
+    else:
+        inp = jax.device_put(
+            jnp.ones((eng.num_shards, bucket.padded_len), jnp.float32),
+            NamedSharding(eng.mesh, P(eng.axis, None)),
+        )
+    for _ in range(3):
+        eng.push_pull(name, inp, zero_copy=zero_copy).block_until_ready()
+    lats: list[float] = []
+
+    def run():
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            eng.push_pull(name, inp,
+                          zero_copy=zero_copy).block_until_ready()
+            lats.append((time.perf_counter() - t0) * 1e6)
+
+    busy = _device_busy(run)
+    return lats, (busy / samples * 1e6 if busy else None)
+
+
+def _pctls(lats) -> tuple[float, float]:
+    import numpy as np
+
+    a = np.asarray(lats)
+    return (float(np.percentile(a, 50)), float(np.percentile(a, 99)))
 
 
 def _sparse_engine(eng):
@@ -776,6 +838,108 @@ def main() -> None:
                     out[f"stress_{pattern}_device"] = round(gbps / 8.0, 2)
             return out
 
+        def sec_latency():
+            # Latency regime (VERDICT r04 weak #5): the reference
+            # reports ns/key alongside goodput (test_benchmark.cc:393)
+            # — bandwidth parity with unknown latency is half a claim.
+            # Every sample is an individually-awaited round trip; wall
+            # clock, so tunnel-distorted (wall_unreliable), with the
+            # device occupancy mean as the tunnel-proof floor.
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            out: dict = {}
+            nk, vl = (4, (64 << 10) // 4) if quick else (40, (1 << 20) // 4)
+            n = 5 if quick else 30
+            out["latency_headline_cfg"] = (
+                "4x64KB quick" if quick else "40x1MB")
+            lats, dev_us = _latency_samples(eng, "lat_headline", nk, vl, n)
+            p50, p99 = _pctls(lats)
+            out["latency_headline_p50_us"] = round(p50, 1)
+            out["latency_headline_p99_us"] = round(p99, 1)
+            # The reference's exact metric: avg round latency / total
+            # keys, in ns (test_benchmark.cc:393).
+            out["latency_headline_ns_per_key"] = round(p50 * 1e3 / nk, 1)
+            if dev_us is not None:
+                out["latency_headline_device_us"] = round(dev_us, 1)
+            if quick:
+                return out
+            # Small-op regime: 1 key x 64KB, where dispatch dominates.
+            lats, dev_us = _latency_samples(
+                eng, "lat_64kb", 1, (64 << 10) // 4, 50)
+            p50, p99 = _pctls(lats)
+            out["latency_64kb_p50_us"] = round(p50, 1)
+            out["latency_64kb_p99_us"] = round(p99, 1)
+            if dev_us is not None:
+                out["latency_64kb_device_us"] = round(dev_us, 1)
+            # Coalescer tax: the same 64KB op through the dispatcher —
+            # the flush path (caller waits immediately) and the
+            # idle-close path (fire-and-forget; includes the adaptive
+            # window cost, the trade VERDICT r04 weak #5 wanted priced).
+            ksz = (64 << 10) // 4
+            np_keys = np.arange(1, dtype=np.uint64)
+            eng.register_dense("lat_co", np_keys, ksz)
+            co_in = _jax.device_put(
+                jnp.ones((eng.num_shards, ksz), jnp.float32),
+                NamedSharding(eng.mesh, P(eng.axis, None)),
+            )
+            with eng.coalescer() as disp:
+                disp.push_pull("lat_co", co_in).result().block_until_ready()
+                flush_l, idle_l = [], []
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    disp.push_pull(
+                        "lat_co", co_in).result().block_until_ready()
+                    flush_l.append((time.perf_counter() - t0) * 1e6)
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    tk = disp.push_pull("lat_co", co_in)
+                    tk.wait(10.0)
+                    tk.result().block_until_ready()
+                    idle_l.append((time.perf_counter() - t0) * 1e6)
+            p50, p99 = _pctls(flush_l)
+            out["latency_coalesced_flush_p50_us"] = round(p50, 1)
+            out["latency_coalesced_flush_p99_us"] = round(p99, 1)
+            p50, p99 = _pctls(idle_l)
+            out["latency_coalesced_idle_p50_us"] = round(p50, 1)
+            out["latency_coalesced_idle_p99_us"] = round(p99, 1)
+            # Batch completion: 32 concurrent 64KB ops -> ALL done.
+            bnames = [f"lat_cob_{i}" for i in range(32)]
+            for nm in bnames:
+                eng.register_dense(nm, np_keys, ksz)
+            with eng.coalescer(window_us=2_000) as disp:
+                for t in [disp.push_pull(nm, co_in) for nm in bnames]:
+                    t.result().block_until_ready()
+                batch_l = []
+                for _ in range(10):
+                    t0 = time.perf_counter()
+                    ts = [disp.push_pull(nm, co_in) for nm in bnames]
+                    for t in ts:
+                        t.result()
+                    ts[-1].result().block_until_ready()
+                    batch_l.append((time.perf_counter() - t0) * 1e6)
+            p50, p99 = _pctls(batch_l)
+            out["latency_coalesced_batch32_p50_us"] = round(p50, 1)
+            out["latency_coalesced_batch32_p99_us"] = round(p99, 1)
+            # Replay per-step latency: the scan program's amortized cost
+            # per PS step (the dispatch-free regime's floor).
+            steps = 64
+            eng.register_dense("lat_replay", np_keys, (1 << 20) // 4)
+            seq = np.ones((steps, (1 << 20) // 4), np.float32)
+            eng.replay("lat_replay", seq, keep="last",
+                       zero_copy=True).block_until_ready()
+
+            def run():
+                eng.replay("lat_replay", seq, keep="last",
+                           zero_copy=True).block_until_ready()
+
+            busy, wall = _traced(run)
+            out["latency_replay_step_wall_us"] = round(wall / steps * 1e6, 1)
+            if busy:
+                out["latency_replay_step_device_us"] = round(
+                    busy / steps * 1e6, 1)
+            return out
+
         def sec_hbm_peak():
             wall, dev = _hbm_peak_measured()
             st["hbm_peak_wall"], st["hbm_peak_dev"] = wall, dev
@@ -787,6 +951,7 @@ def main() -> None:
         if quick:
             headline_ok = rec.run("headline", sec_headline_quick)
             rec.run("host_origin", sec_host_origin)
+            rec.run("latency", sec_latency)
         else:
             headline_ok = rec.run("headline", sec_headline)
             rec.run("copy_pull", sec_copy_pull)
@@ -795,6 +960,7 @@ def main() -> None:
             rec.run("resnet", sec_resnet)
             rec.run("embedding", sec_embedding)
             rec.run("coalesced", sec_coalesced)
+            rec.run("latency", sec_latency)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)
 

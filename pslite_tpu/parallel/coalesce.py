@@ -12,6 +12,13 @@ at a time from one or many threads: ops enqueue into a short window
 one :meth:`CollectiveEngine.push_pull_group` program — N concurrent
 small ops cost ~1 dispatch.
 
+The window is ADAPTIVE: ``window_us`` is only the hard cap — the batch
+dispatches as soon as no new op has arrived for ``idle_us`` (default
+window/10, floored at 20 µs).  A burst of concurrent ops still
+coalesces (enqueue gaps are far below the idle threshold) while a lone
+op stops paying the full window: its worst-case added latency is the
+idle gap, not the cap.  ``idle_us=0`` restores the fixed window.
+
 The async contract is unchanged: :meth:`push_pull` returns a
 :class:`Ticket` immediately; ``ticket.result()`` (or ``wait()``) blocks
 until the batched dispatch has run and returns the pulled array.
@@ -57,6 +64,14 @@ class Ticket:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the op's window dispatches ON ITS OWN (hard cap
+        or adaptive idle close) — unlike :meth:`result`, does NOT flush.
+        Returns whether the op completed.  This is the probe for the
+        dispatcher's intrinsic latency: result() measures the flush
+        path, wait() measures what a fire-and-forget caller pays."""
+        return self._done.wait(timeout)
+
     def result(self, timeout: Optional[float] = None):
         if not self._done.is_set():
             self._disp.flush()
@@ -77,7 +92,7 @@ class CoalescingDispatcher:
     """
 
     def __init__(self, engine, handle=None, max_pending: int = 64,
-                 window_us: int = 200):
+                 window_us: int = 200, idle_us: Optional[int] = None):
         resolved, _ = engine._resolve_handle(handle)
         log.check(not engine._is_stateful(resolved),
                   "coalescing supports stateless handles only "
@@ -86,6 +101,18 @@ class CoalescingDispatcher:
         self._handle = handle
         self._max_pending = max_pending
         self._window_s = window_us / 1e6
+        # Adaptive close (VERDICT r04 weak #5: the fixed window bought
+        # bandwidth with an unmeasured latency tax): ``window_us`` is
+        # the HARD cap, but the window also closes as soon as no new op
+        # has arrived for ``idle_us`` — a burst still batches (issuing
+        # threads enqueue back-to-back, gaps far below idle_us) while a
+        # trickle stops paying the full window on every op.  Default
+        # idle gap: window/10, floored at 20 µs (cv-wakeup resolution).
+        # ``idle_us=0`` disables the early close (always wait the cap).
+        if idle_us is None:
+            idle_us = max(20, window_us // 10)
+        self._idle_s = idle_us / 1e6
+        self._last_enq = 0.0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._queue: list = []  # [(name, grads, Ticket)]
@@ -112,6 +139,7 @@ class CoalescingDispatcher:
         with self._cv:
             log.check(not self._closed, "dispatcher closed")
             self._queue.append((name, grads, t))
+            self._last_enq = time.monotonic()
             if len(self._queue) >= self._max_pending:
                 self._flush_now = True
             self._cv.notify()
@@ -156,11 +184,19 @@ class CoalescingDispatcher:
                 # (flush) or the batch is full.  Looped against a
                 # monotonic deadline: every enqueue notifies the cv, so
                 # a single wait would wake (and close the window) on
-                # the SECOND op, fragmenting batches.
+                # the SECOND op, fragmenting batches.  The window closes
+                # at the HARD cap, or earlier once the queue has gone
+                # idle_us without a new arrival (adaptive close).
                 if not self._flush_now:
-                    deadline = time.monotonic() + self._window_s
+                    hard = time.monotonic() + self._window_s
                     while not self._flush_now and not self._closed:
-                        remaining = deadline - time.monotonic()
+                        now = time.monotonic()
+                        deadline = hard
+                        if self._idle_s > 0:
+                            deadline = min(
+                                hard, self._last_enq + self._idle_s
+                            )
+                        remaining = deadline - now
                         if remaining <= 0:
                             break
                         self._cv.wait(remaining)

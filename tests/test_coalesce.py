@@ -158,6 +158,37 @@ def test_trickled_ops_share_one_window(mesh):
     assert calls == [names]
 
 
+def test_adaptive_idle_close_beats_hard_window(mesh):
+    """A lone op dispatches at the idle close (~window/10), far before
+    the hard cap — without any flush from the caller."""
+    import time as _time
+
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, ["solo"])
+    # Hard cap 4s, idle close 400ms: completing in well under 2s proves
+    # the idle close fired (generous margins for the 1-vCPU box).
+    with eng.coalescer(window_us=4_000_000) as disp:
+        t0 = _time.monotonic()
+        t = disp.push_pull("solo", np.ones((8, 128), np.float32))
+        assert t.wait(timeout=3.0), "op never dispatched"
+        assert _time.monotonic() - t0 < 2.0, \
+            "idle close did not fire before the hard window"
+
+
+def test_idle_zero_restores_fixed_window(mesh):
+    """idle_us=0 disables the early close: a lone unflushed op stays
+    pending until the hard window elapses."""
+    eng = CollectiveEngine(mesh=mesh)
+    _register(eng, ["fixed"])
+    with eng.coalescer(window_us=3_000_000, idle_us=0) as disp:
+        t = disp.push_pull("fixed", np.ones((8, 128), np.float32))
+        # Well inside the 3s hard window: must still be pending.
+        assert not t.wait(timeout=0.5)
+        # result() flushes — the op completes without waiting out the cap.
+        np.testing.assert_allclose(np.asarray(t.result()),
+                                   8 * np.ones(128))
+
+
 def test_bad_op_does_not_poison_batchmates(mesh):
     """An unknown bucket fails only ITS ticket; a valid op in the same
     window still completes."""
