@@ -88,13 +88,17 @@ class ShmVan(TcpVan):
         # (BYTEPS_IPC_COPY_NUM_THREADS=4, rdma_transport.h:570-589).
         # Process-wide and process-lived: co-located vans share it, and a
         # van shutting down can never free it under a peer's in-flight
-        # copy.  PS_NATIVE gating rode in via self._native (TcpVan).
+        # copy.  Gated on library AVAILABILITY (load() honors
+        # PS_NATIVE=0), not on TcpVan's core-count auto-select: the pool
+        # only engages on multi-MB copies and has no per-message handoff
+        # cost, so it is harmless on single-core (PARITY 3b).
         self._copy_pool = None
         n_copy = self.env.find_int("PS_SHM_COPY_THREADS", 4)
-        if n_copy > 0 and self._native is not None:
+        if n_copy > 0:
             from . import native as _native_mod
 
-            self._copy_pool = _native_mod.shared_copy_pool(n_copy)
+            if _native_mod.load() is not None:
+                self._copy_pool = _native_mod.shared_copy_pool(n_copy)
         # PS_SHM_RING=1: same-host peers exchange their WHOLE meta stream
         # through shared-memory SPSC byte pipes instead of TCP — the
         # reference's in-process lock-free SPSC queue (spsc_queue.h,
@@ -111,6 +115,15 @@ class ShmVan(TcpVan):
         self._pipe_mode = False
         self._pipe_bytes = self.env.find_int("PS_SHM_RING_BYTES", 1 << 22)
         if self.env.find_int("PS_SHM_RING", 0):
+            if self._native is None:
+                # Ring pipes ARE the native meta plane — asking for them
+                # is an explicit opt-in that overrides the core-count
+                # auto-select (which only judges the TCP offload's
+                # per-message handoffs).
+                from . import native as _native_mod
+
+                if _native_mod.load() is not None:
+                    self._native = _native_mod.NativeTransport()
             if self._native is not None:
                 self._pipe_mode = True
             else:

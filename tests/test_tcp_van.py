@@ -14,6 +14,28 @@ from pslite_tpu.utils.network import get_available_port
 from helpers import LoopbackCluster
 
 
+@pytest.mark.parametrize("cores,expect_native", [(1, False), (4, True)])
+def test_native_auto_select_by_core_count(monkeypatch, cores,
+                                          expect_native):
+    """Default PS_NATIVE=auto picks the winner for the host: pure
+    Python on single-core (PARITY 2b: the GIL-free io threads lose
+    1.3-1.9x with no spare core), the native core when cores allow."""
+    from pslite_tpu.vans import native as native_mod
+
+    if native_mod.load() is None:
+        pytest.skip("native core not built")
+    monkeypatch.setattr("pslite_tpu.vans.tcp_van.os.sched_getaffinity",
+                        lambda pid: set(range(cores)))
+    cluster = LoopbackCluster(num_workers=1, num_servers=1,
+                              van_type="tcp")
+    cluster.start()
+    try:
+        van = cluster.servers[0].van
+        assert (van._native is not None) == expect_native
+    finally:
+        cluster.finalize()
+
+
 def test_tcp_cluster_in_process():
     cluster = LoopbackCluster(num_workers=2, num_servers=2, van_type="tcp")
     cluster.start()
