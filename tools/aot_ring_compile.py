@@ -101,6 +101,34 @@ def _traffic_model(n: int, padded: int, dtype, compress: bool,
     }
 
 
+def _iface_model(kind: str, kv_n: int, padded: int, itemsize: int,
+                 steps: int = 0) -> dict:
+    """PER-DEVICE argument/output byte model from the program
+    INTERFACE alone, for the variants whose internal traffic model is
+    not the plain 1-D ring (multi-axis sub-rings, replay scan):
+    - store arg/out: my kv shard, padded/kv_n elems.
+    - grads arg: my worker row restricted to my kv shard (multi-axis)
+      or the full T-step slab of my rows (replay: seq is P(None, kv,
+      None), so each device holds steps x padded elements).
+    - pulled out: replicated, padded elems.
+    Interface-only (no HBM/ICI traffic claim), but still an exact,
+    non-circular cross-check of XLA's memory assignment."""
+    store = padded // kv_n * itemsize
+    if kind == "multi":
+        return {
+            "argument_bytes": store + padded // kv_n * itemsize,
+            "output_bytes": store + padded * itemsize,
+            "interface_only": True,
+        }
+    if kind == "replay":
+        return {
+            "argument_bytes": store + steps * padded * itemsize,
+            "output_bytes": store + padded * itemsize,
+            "interface_only": True,
+        }
+    raise ValueError(kind)
+
+
 def _analyses(compiled) -> dict:
     """XLA's own numbers for one compiled executable: cost-model bytes
     accessed and the memory-assignment breakdown."""
@@ -269,11 +297,11 @@ def main() -> int:
         ("push_only", eng1, mesh1, "push", padded, jnp.float32, 0,
          {"compress": False, "with_ag": False}),
         ("multi_axis_2d", eng2, mesh2, "push_pull", padded,
-         jnp.float32, 0, None),
+         jnp.float32, 0, "iface:multi"),
         ("multi_axis_3d_torus", eng3, mesh3, "push_pull", padded,
-         jnp.float32, 0, None),
+         jnp.float32, 0, "iface:multi"),
         ("replay_scan_T4", eng1, mesh1, "replay", padded, jnp.float32,
-         4, None),
+         4, "iface:replay"),
     ]
     ok = True
     for name, eng, mesh, kind, plen, dtype, steps, model_kw in configs:
@@ -285,7 +313,13 @@ def main() -> int:
         try:
             row = _compile_one(eng, mesh, kind, plen, dtype, steps)
             if model_kw is not None:
-                model = _traffic_model(n, plen, dtype, **model_kw)
+                if isinstance(model_kw, str):  # "iface:<kind>"
+                    model = _iface_model(
+                        model_kw.split(":")[1], eng.num_shards, plen,
+                        jnp.dtype(dtype).itemsize, steps,
+                    )
+                else:
+                    model = _traffic_model(n, plen, dtype, **model_kw)
                 row["model"] = model
                 mem = row.get("memory")
                 if mem:
