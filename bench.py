@@ -940,6 +940,49 @@ def main() -> None:
                     busy / steps * 1e6, 1)
             return out
 
+        def sec_van_latency():
+            # The SOCKET vans' per-key latency — the reference's exact
+            # reporting regime (test_benchmark.cc:393: goodput + "ns per
+            # key" from a real worker/server message loop).  Runs a
+            # 1w+1s cluster per van over localhost via the launcher;
+            # host-side only, so it is TUNNEL-INDEPENDENT (children are
+            # pinned to the CPU backend the way the unit suite pins).
+            import re
+
+            out = {}
+            for van in ("tcp", "shm"):
+                cmd = [
+                    sys.executable, "-m", "pslite_tpu.tracker.local",
+                    "-n", "1", "-s", "1", "--van", van, "--",
+                    sys.executable, "-m", "pslite_tpu.benchmark",
+                    "--len", "65536",
+                    "--repeat", "4" if quick else "10",
+                    "--mode", "push_pull",
+                ]
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           PALLAS_AXON_POOL_IPS="")
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=600,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    env=env,
+                )
+                lats = sorted(
+                    float(m) for m in re.findall(
+                        r"avg latency ([0-9.]+) us/key", r.stdout)
+                )
+                gbps = [
+                    float(m) for m in re.findall(
+                        r": ([0-9.]+) Gbps", r.stdout)
+                ]
+                if lats:
+                    out[f"van_{van}_us_per_key_p50"] = round(
+                        lats[len(lats) // 2], 3)
+                    out[f"van_{van}_us_per_key_worst"] = round(
+                        lats[-1], 3)
+                if gbps:
+                    out[f"van_{van}_gbps"] = round(max(gbps), 3)
+            return out
+
         def sec_hbm_peak():
             wall, dev = _hbm_peak_measured()
             st["hbm_peak_wall"], st["hbm_peak_dev"] = wall, dev
@@ -961,6 +1004,7 @@ def main() -> None:
             rec.run("embedding", sec_embedding)
             rec.run("coalesced", sec_coalesced)
             rec.run("latency", sec_latency)
+            rec.run("van_latency", sec_van_latency)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)
 
