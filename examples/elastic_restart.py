@@ -109,18 +109,29 @@ def main() -> int:
     from jax.sharding import Mesh
 
     ckpt = os.environ.get("PS_CKPT", "/tmp/pslite_elastic_restart_ck")
+    # Both fleet-portable backends drive the same loop (PS_CKPT_BACKEND
+    # = npz | orbax): orbax saves a directory, npz a file.
+    backend = os.environ.get("PS_CKPT_BACKEND", "npz")
+    if backend == "orbax":
+        ck_exists = os.path.isdir(ckpt)
+        save = checkpoint.save_engine_orbax
+        restore = checkpoint.restore_engine_orbax
+    else:
+        ck_exists = os.path.exists(ckpt + ".npz")
+        save = checkpoint.save_engine
+        restore = checkpoint.restore_engine
     devs = jax.devices()
-    if not os.path.exists(ckpt + ".npz"):
+    if not ck_exists:
         # FIRST incarnation: the full 8-shard fleet, half the run.
         eng, se = _build(Mesh(np.array(devs), ("kv",)))
         _train(eng, se, range(0, 2))
-        checkpoint.save_engine(eng, ckpt, sparse_engine=se)
+        save(eng, ckpt, sparse_engine=se)
         print(f"saved 2-step checkpoint from {eng.num_shards} shards; "
               f"exiting 254 for the keepalive restart", flush=True)
         return 254
     # SECOND incarnation: HALF the fleet (4 shards), restore, finish.
     eng, se = _build(Mesh(np.array(devs[: len(devs) // 2]), ("kv",)))
-    checkpoint.restore_engine(eng, ckpt, sparse_engine=se)
+    restore(eng, ckpt, sparse_engine=se)
     _train(eng, se, range(2, STEPS))
     store, table = _host_model()
     got = np.asarray(eng.pull("w"))

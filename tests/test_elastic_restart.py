@@ -102,14 +102,20 @@ def test_restore_onto_larger_fleet(tmp_path):
                                rtol=1e-6)
 
 
-def test_keepalive_restart_into_half_fleet(tmp_path):
+@pytest.mark.parametrize("backend", ["npz", "orbax"])
+def test_keepalive_restart_into_half_fleet(tmp_path, backend):
     """END-TO-END: examples/elastic_restart.py under the keepalive
     launcher — save at 8 shards, exit 254, restart, restore at 4
-    shards, verify against the uninterrupted host recurrence."""
+    shards, verify against the uninterrupted host recurrence.  Both
+    fleet-portable checkpoint backends drive the same loop (the orbax
+    one closes r04 weak #7: multi-host-capable saves that restore into
+    a different fleet)."""
+    if backend == "orbax" and not checkpoint.have_orbax():
+        pytest.skip("orbax not installed")
     ck = str(tmp_path / "elastic_ck")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     example = os.path.join(repo_root, "examples", "elastic_restart.py")
-    env = dict(os.environ, PS_CKPT=ck)
+    env = dict(os.environ, PS_CKPT=ck, PS_CKPT_BACKEND=backend)
     for var in ("JAX_PLATFORMS", "XLA_FLAGS"):
         env.pop(var, None)
     proc = subprocess.run(
