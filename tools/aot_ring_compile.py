@@ -343,6 +343,60 @@ def main() -> int:
                 "error": f"{type(exc).__name__}: {exc}"[:500]
             }
             ok = False
+    # Scale evidence: the same kernels at a 16-chip topology (full
+    # v5e-16 rings / a pod-shaped 3-D torus) — compile-only, like the
+    # 8-chip sweep, but proving the unrolled ring schedule and the
+    # multi-axis translation lower at twice the ring size.
+    try:
+        topo16 = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:4x4"
+        )
+        d16 = np.array(topo16.devices)
+        m16 = Mesh(d16.reshape(16), ("kv",))
+        e16 = CollectiveEngine(mesh=m16, impl="pallas")
+        m16_3d = Mesh(d16.reshape(2, 2, 4), ("dp", "kv1", "kv2"))
+        e16_3d = CollectiveEngine(mesh=m16_3d, axis_name=("kv1", "kv2"),
+                                  worker_axis="dp", impl="pallas")
+        p16 = 16 * 65536
+        report["scale_16chip"] = {}
+        for name, eng, mesh, kind, model_kw in (
+            ("push_pull_f32_n16", e16, m16, "push_pull",
+             {"compress": False, "with_ag": True}),
+            ("torus_3d_2x2x4", e16_3d, m16_3d, "push_pull",
+             "iface:multi"),
+        ):
+            try:
+                row = _compile_one(eng, mesh, kind, p16, jnp.float32, 0)
+                if isinstance(model_kw, str):
+                    model = _iface_model(
+                        model_kw.split(":")[1], eng.num_shards, p16,
+                        4, 0,
+                    )
+                else:
+                    model = _traffic_model(16, p16, jnp.float32,
+                                           **model_kw)
+                row["model"] = model
+                mem = row.get("memory")
+                if mem:
+                    row["model_args_match"] = (
+                        abs(mem["argument_bytes"]
+                            - model["argument_bytes"]) <= 4096
+                        and abs(mem["output_bytes"]
+                                - model["output_bytes"]) <= 4096
+                    )
+                    if not row["model_args_match"]:
+                        ok = False
+                report["scale_16chip"][name] = row
+                if not row["mosaic_custom_call"]:
+                    ok = False
+            except Exception as exc:  # noqa: BLE001
+                report["scale_16chip"][name] = {
+                    "error": f"{type(exc).__name__}: {exc}"[:500]
+                }
+                ok = False
+    except Exception as exc:  # noqa: BLE001 - scale topology optional
+        report["scale_16chip"] = {"error": f"topology: {exc!r}"[:300]}
+
     report["all_ok"] = ok
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), args.out)
