@@ -1286,9 +1286,15 @@ class CollectiveEngine:
                   "push_pull_group supports stateless handles only")
         t0 = time.perf_counter()
         buckets = [self._buckets[n] for n in names]
-        gs = [
-            self._prep_grads(b, g) for b, g in zip(buckets, grads_list)
-        ]
+        # MUST mirror _group_program's use_ring resolution: the grouped
+        # 1-D ring program takes each bucket's grads FLAT (same sublane
+        # -pad rationale as _prep_grads_ring).
+        group_flat = self.worker_axis is None and all(
+            self._effective_impl(b.dtype, resolved) == "pallas"
+            for b in buckets
+        )
+        prep = self._prep_grads_ring if group_flat else self._prep_grads
+        gs = [prep(b, g) for b, g in zip(buckets, grads_list)]
         prog = self._group_program(
             tuple((b.padded_len, str(np.dtype(b.dtype))) for b in buckets),
             handle_key,
@@ -1345,7 +1351,15 @@ class CollectiveEngine:
         handle = self._resolved_handle_fn(handle_key)
         k = len(shapes_key)
         store_spec = P(axis)
-        grads_spec = P(axis, None) if waxis is None else P(waxis, axis)
+        # 1-D ring groups take each bucket's grads FLAT [W*padded]
+        # (packed layout for 2-byte dtypes — _prep_grads_ring); the XLA
+        # and 2-D paths keep the row form.
+        if waxis is not None:
+            grads_spec = P(waxis, axis)
+        elif use_ring:
+            grads_spec = P(axis)
+        else:
+            grads_spec = P(axis, None)
         repl_spec = P(None)
         n = self.num_shards
         interp = self._ring_interpret
@@ -1370,8 +1384,9 @@ class CollectiveEngine:
             chunk0 = padded_len // n
             kchunk = ring_chunk_len(padded_len, n, dtype,
                                     compress=compress)
+            # grads_l: my FLAT row [padded] (grads_spec P(axis)).
             g, s = _pad_ring_chunks(
-                grads_l[0].reshape(n, chunk0), store_l, kchunk, chunk0
+                grads_l.reshape(n, chunk0), store_l, kchunk, chunk0
             )
             new, pulled = ring_push_pull(
                 g, s, handle, axis, n,
