@@ -22,6 +22,42 @@ def test_measure_helper_runs():
     assert dev is None  # CPU mesh: no TPU plane in the trace
 
 
+def test_latency_samples_helper():
+    """_latency_samples (full-mode-only path: the driver is otherwise
+    its first executor) returns per-op wall latencies; no device mean
+    on the CPU mesh."""
+    import bench
+    from pslite_tpu.parallel.engine import CollectiveEngine
+
+    eng = CollectiveEngine()
+    lats, dev_us = bench._latency_samples(eng, "lat_smoke", 2, 1024, 3)
+    assert len(lats) == 3 and all(l > 0 for l in lats)
+    assert dev_us is None
+    p50, p99 = bench._pctls(lats)
+    assert p50 <= p99
+
+
+def test_van_latency_harness():
+    """The van_latency section's exact harness (full-mode-only): a
+    1w+1s tcp cluster through the launcher must yield a parseable
+    us-per-key line."""
+    import os
+    import re
+
+    cmd = [
+        sys.executable, "-m", "pslite_tpu.tracker.local",
+        "-n", "1", "-s", "1", "--van", "tcp", "--",
+        sys.executable, "-m", "pslite_tpu.benchmark",
+        "--len", "65536", "--repeat", "2", "--mode", "push_pull",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=300, cwd="/root/repo", env=env)
+    assert out.returncode == 0, out.stderr[-1500:]
+    lats = re.findall(r"avg latency ([0-9.]+) us/key", out.stdout)
+    assert lats and float(lats[0]) > 0, out.stdout[-800:]
+
+
 def test_recorder_retry_and_partial(tmp_path):
     """_Recorder.run retries a flapping section, records a persistent
     failure in sections_failed, and keeps the on-disk record valid."""
