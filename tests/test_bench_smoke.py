@@ -140,18 +140,21 @@ def test_bench_kill9_leaves_valid_partial(tmp_path):
     assert snap["error"]
 
 
-def test_bench_cli_contract():
+def test_bench_cli_contract(tmp_path):
     import os
 
     # Force the child onto CPU: the axon sitecustomize would otherwise put
     # bench.py on the real TPU tunnel, coupling the unit suite to tunnel
     # health (JAX_PLATFORMS alone is overridden programmatically, so also
-    # disable the axon registration).
+    # disable the axon registration).  The partial record goes to a temp
+    # path: the repo-root default must stay reserved for REAL bench runs
+    # (a stale quick-smoke partial there could be mistaken for evidence).
     env = dict(
         os.environ,
         PS_BENCH_QUICK="1",
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
+        PS_BENCH_PARTIAL=str(tmp_path / "partial.json"),
     )
     out = subprocess.run(
         [sys.executable, "bench.py"],
