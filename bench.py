@@ -569,6 +569,15 @@ def main() -> None:
     deadline = int(os.environ.get("PS_BENCH_TIMEOUT_S", "1500"))
 
     def _watchdog_fire():
+        # A fire racing the main thread's final drop/flush/emit must
+        # not taint the on-disk record with a timeout that didn't
+        # happen: once the success (or error) line is out on stdout,
+        # the watchdog stands down.  (main() also cancels the timer
+        # BEFORE its final drop/flush/emit; this check covers a fire
+        # already in flight when cancel ran.)
+        with _emit_mu:
+            if _emitted:
+                return
         rec.merge({"error": (
             f"bench exceeded {deadline}s (backend hang after successful "
             f"probe — tunnel flapped mid-run?); partial results attached"
@@ -991,10 +1000,31 @@ def main() -> None:
                 "hbm_peak_device": round(dev, 1) if dev else None,
             }
 
+        def sec_send_lanes():
+            # Per-peer send-lane overlap (the fan-out serialization the
+            # lane scheduler removed): N stub peers, each charging a
+            # fixed per-message transport delay.  Serialized dispatch
+            # (PS_SEND_LANES=0, the old van-wide-lock regime) costs
+            # ~N*delay per round; lanes cost ~delay.  Pure host-side —
+            # no backend, no sockets — so it prices the Van scheduler
+            # itself, tunnel-independent.
+            from pslite_tpu.benchmark import fanout_wall_times
+
+            n_peers, delay_s, rounds = 8, 0.010, 3
+            laned, serial = fanout_wall_times(n_peers, delay_s, rounds)
+            return {
+                "send_lanes_fanout_peers": n_peers,
+                "send_lanes_per_msg_delay_ms": delay_s * 1e3,
+                "send_lanes_laned_ms": round(laned * 1e3, 2),
+                "send_lanes_serialized_ms": round(serial * 1e3, 2),
+                "send_lanes_overlap_x": round(serial / max(laned, 1e-9), 2),
+            }
+
         if quick:
             headline_ok = rec.run("headline", sec_headline_quick)
             rec.run("host_origin", sec_host_origin)
             rec.run("latency", sec_latency)
+            rec.run("send_lanes", sec_send_lanes)
         else:
             headline_ok = rec.run("headline", sec_headline)
             rec.run("copy_pull", sec_copy_pull)
@@ -1005,6 +1035,7 @@ def main() -> None:
             rec.run("coalesced", sec_coalesced)
             rec.run("latency", sec_latency)
             rec.run("van_latency", sec_van_latency)
+            rec.run("send_lanes", sec_send_lanes)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)
 
@@ -1084,6 +1115,10 @@ def main() -> None:
         # A completed run is not an errored run: drop the in-progress
         # error marker BEFORE the final flush so the on-disk record and
         # the stdout line agree ('"error" in record' means failure).
+        # The watchdog is cancelled FIRST: a timer firing between the
+        # drop and the emit would re-merge a timeout error onto disk
+        # while stdout carries the success line.
+        watchdog.cancel()
         rec.drop("error")
         rec.flush()
         _emit(rec.snapshot())

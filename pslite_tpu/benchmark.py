@@ -171,6 +171,58 @@ def run_worker(args) -> None:
         print(f"RECV_BUFFER_HITS {worker.zpull_hits}", flush=True)
 
 
+def fanout_wall_times(n_peers: int, delay_s: float,
+                      rounds: int = 1) -> tuple:
+    """Wall times of an N-peer data fan-out over a stub transport whose
+    ``send_msg`` costs ``delay_s`` per message: ``(laned, serialized)``
+    seconds (best of ``rounds``).
+
+    Prices the Van's per-peer send-lane scheduler ALONE — no sockets,
+    no backend, no scheduler bootstrap.  The serialized number replays
+    the identical sends with ``PS_SEND_LANES=0``, the pre-lane
+    one-message-at-a-time regime (what the old van-wide send lock
+    enforced), so ``serialized / laned`` is the fan-out overlap factor.
+    """
+    from .environment import Environment
+    from .message import Message
+    from .vans.van import Van
+
+    class _StubPo:
+        def __init__(self, env):
+            self.env = env
+
+        @staticmethod
+        def role_str() -> str:
+            return "bench"
+
+    class _SleepWireVan(Van):
+        def send_msg(self, msg) -> int:
+            time.sleep(delay_s)
+            return msg.meta.data_size
+
+    def _run(lanes: bool) -> float:
+        van = _SleepWireVan(_StubPo(Environment(
+            {"PS_SEND_LANES": "1" if lanes else "0"}
+        )))
+        best = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for peer in range(n_peers):
+                m = Message()
+                m.meta.sender = 1
+                m.meta.recver = peer
+                van.send(m)
+            van._drain_send_lanes(timeout_s=60.0)
+            wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+            van._lane_stop = False  # re-arm lanes for the next round
+            van._lane_abort = False
+        van.profiler.close()
+        return best
+
+    return _run(True), _run(False)
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A

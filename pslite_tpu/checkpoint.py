@@ -175,8 +175,20 @@ def restore_engine_orbax(engine, path: str, sparse_engine=None) -> None:
         with ocp.StandardCheckpointer() as _mc:
             saved_md = _mc.metadata(os.path.abspath(path))
         saved_md = getattr(saved_md, "item_metadata", saved_md)
-    except Exception:  # noqa: BLE001 - metadata probe is best-effort
+    except Exception as exc:  # noqa: BLE001 - metadata probe is best-effort
+        # The probe decides v2 (fleet-portable global layout) vs legacy
+        # (physical layout, same-fleet only).  When it fails we fall
+        # into the legacy path BLIND — correct for real legacy
+        # checkpoints, but a v2 checkpoint restored this way dies later
+        # in opaque orbax shape errors.  Say so up front.
         saved_md = None
+        log.warning(
+            f"could not determine checkpoint format for {path!r} "
+            f"(orbax metadata probe failed: {exc!r}); assuming the "
+            f"LEGACY physical layout — if this checkpoint was saved in "
+            f"the v2 fleet-portable layout, the restore below will "
+            f"fail with shape/sharding errors"
+        )
     if saved_md is not None:
         try:
             saved_md["format_v2"]  # KeyError on legacy checkpoints

@@ -48,6 +48,25 @@ def distributed_options(env=None) -> Dict[str, object]:
     }
 
 
+def is_initialized() -> bool:
+    """Version-compat probe: ``jax.distributed.is_initialized`` only
+    exists on newer jax; older releases (e.g. 0.4.37) expose nothing
+    public, so fall back to the global client the initialize call
+    assigns.  Without this shim every multi-host worker died with an
+    AttributeError before jax.distributed ever initialized."""
+    import jax
+
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 - private API moved: assume down
+        return False
+
+
 def _initialize_or_unwind(opts) -> None:
     """jax.distributed.initialize with half-init cleanup: jax assigns its
     global client BEFORE connecting, so a connect failure (coordinator
@@ -81,10 +100,9 @@ def acquire(env=None) -> bool:
     env = env or environment.get()
     if env.find_int("DMLC_NUM_WORKER", 1) <= 1:
         return False
-    import jax
 
     with _mu:
-        if not jax.distributed.is_initialized():
+        if not is_initialized():
             opts = distributed_options(env)
             _initialize_or_unwind(opts)
             # Recorded only after a successful initialize.
@@ -137,10 +155,9 @@ def init_distributed(env=None) -> Optional[Dict[str, object]]:
     env = env or environment.get()
     if env.find_int("DMLC_NUM_WORKER", 1) <= 1:
         return None
-    import jax
 
     with _mu:
-        if jax.distributed.is_initialized():
+        if is_initialized():
             return None
         opts = distributed_options(env)
         _initialize_or_unwind(opts)

@@ -867,6 +867,14 @@ class CollectiveEngine:
                 # vectors pad+reshard on device, no host fetch.
                 import jax.numpy as jnp
 
+                # Mirror set_store_array's dense dtype check: a slot of
+                # the wrong dtype (bucket re-registered differently than
+                # at save time) must fail HERE, not steps later as an
+                # opaque XLA dtype error inside the fused update.
+                log.check_eq(
+                    np.dtype(v.dtype), np.dtype(bucket.dtype),
+                    f"bad opt restore dtype for bucket {name!r}",
+                )
                 log.check(
                     v.size in (bucket.total_len, bucket.padded_len),
                     f"bad optimizer state length {v.size} for bucket "

@@ -1,18 +1,24 @@
-"""Thread-safe queue used by vans and customers.
+"""Thread-safe queues used by vans and customers.
 
-Equivalent of the reference's ``ThreadsafeQueue``
+``ThreadsafeQueue`` is the equivalent of the reference's
 (``include/ps/internal/threadsafe_queue.h:18-118``): a mutex+condvar MPMC
 queue, with an optional busy-poll mode (``DMLC_LOCKLESS_QUEUE`` /
 ``DMLC_POLLING_IN_NANOSECOND``) that trades CPU for latency on the hot
 receive path.
+
+``LaneQueue`` backs the van's per-peer send lanes: a max-priority heap
+that is FIFO within a priority level, with the drain/stop handshake the
+lane scheduler needs (the owner supplies scheduler-wide stop/abort
+predicates at pop time so one decision governs every lane).
 """
 
 from __future__ import annotations
 
 import collections
+import heapq
 import threading
 import time
-from typing import Deque, Generic, Optional, TypeVar
+from typing import Callable, Deque, Generic, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -58,3 +64,79 @@ class ThreadsafeQueue(Generic[T]):
     def __len__(self) -> int:
         with self._mu:
             return len(self._q)
+
+
+class LaneQueue(Generic[T]):
+    """Priority queue for one send lane: highest priority first, FIFO
+    within a priority level (heap ordered by ``(-priority, seq)``; the
+    unique seq also keeps the heap from ever comparing items).
+
+    The consumer loop is ``pop`` → work → ``done``; ``inflight`` covers
+    the window between the two so ``wait_idle`` cannot report a drained
+    lane while its last item is still being dispatched.
+    """
+
+    def __init__(self):
+        self.cv = threading.Condition()
+        self._heap: List[Tuple[int, int, T]] = []
+        self._seq = 0
+        self._inflight = False
+
+    def push(self, priority: int, item: T,
+             unless: Optional[Callable[[], bool]] = None) -> bool:
+        """Enqueue ``item``; returns False (nothing queued) when the
+        ``unless`` predicate holds — checked under the lock, so a
+        concurrent drain retiring the consumer cannot strand the item."""
+        with self.cv:
+            if unless is not None and unless():
+                return False
+            heapq.heappush(self._heap, (-priority, self._seq, item))
+            self._seq += 1
+            self.cv.notify()
+            return True
+
+    def pop(self, stopping: Callable[[], bool],
+            aborting: Callable[[], bool]) -> Tuple[Optional[T], int]:
+        """Blocking pop.  Returns ``(item, 0)`` normally; ``(None, n)``
+        when the consumer must exit — with ``n`` the number of queued
+        items discarded by an abort (0 on a clean drained stop)."""
+        with self.cv:
+            while True:
+                if aborting():
+                    dropped = len(self._heap)
+                    self._heap.clear()
+                    self.cv.notify_all()
+                    return None, dropped
+                if self._heap:
+                    _, _, item = heapq.heappop(self._heap)
+                    self._inflight = True
+                    return item, 0
+                if stopping():
+                    return None, 0
+                self.cv.wait()
+
+    def done(self) -> None:
+        """Mark the popped item dispatched; wakes ``wait_idle`` waiters
+        when the lane went idle."""
+        with self.cv:
+            self._inflight = False
+            if not self._heap:
+                self.cv.notify_all()
+
+    def wait_idle(self, deadline: float) -> bool:
+        """Block until the lane is empty AND nothing is in flight (or
+        ``time.monotonic()`` passes ``deadline``); True when idle."""
+        with self.cv:
+            while ((self._heap or self._inflight)
+                   and time.monotonic() < deadline):
+                self.cv.wait(timeout=0.1)
+            return not (self._heap or self._inflight)
+
+    def wake(self) -> None:
+        """Nudge the consumer to re-check its stop/abort predicates."""
+        with self.cv:
+            self.cv.notify_all()
+
+    def __len__(self) -> int:
+        with self.cv:
+            return len(self._heap)

@@ -19,6 +19,12 @@ fabric_van.h:123-127):
   traffic falls back to the message path, preserving the full KV contract
   (the "sync collective vs async per-message" duality of SURVEY §7).
 
+The message fallback path inherits the control plane's per-peer send
+lanes (van.py, docs/send_lanes.md) unchanged: unregistered fan-out to S
+server shards overlaps across peers even while the registered traffic
+rides collectives — relevant on ``IciTcpVan``/``IciShmVan``, where the
+message path crosses real sockets/segments.
+
 Multi-process meshes (``PS_ICI_MULTIHOST=1``): each worker process joins
 ``jax.distributed`` (coordinator derived from the same DMLC_* variables
 the control plane uses — parallel/distributed.py) and the engines are

@@ -70,9 +70,15 @@ class LoopbackVan(Van):
         return van
 
     def send_msg(self, msg: Message) -> int:
+        # Thread-safe without any van-level locking: per-peer send lanes
+        # may call this concurrently for different recvers, and the
+        # registry lookup + queue push are each internally locked.  The
+        # one-pass join also serializes the payload HERE (dispatch
+        # time), so the zero-copy contract matches the socket vans:
+        # callers must not mutate buffers until wait(ts).
         target = self._resolve(msg.meta.recver)
         chunks = wire.pack_frame(msg)
-        blob = b"".join(bytes(c) for c in chunks)
+        blob = b"".join(chunks)  # join accepts memoryviews: one copy
         target._queue.push(blob)
         return len(blob)
 

@@ -6,6 +6,14 @@ receive queue fed by per-rail pump threads, control traffic pinned to rail
 0, and data traffic routed by the message's device id (falling back to
 round-robin) — the multi-NIC pattern that maps to multiple ICI/DCN rails
 on TPU pods.
+
+Send lanes are keyed on ``(recver, rail)`` rather than the base class's
+per-peer key: the rail is chosen once at enqueue time (stamped on the
+message so dispatch agrees), and data round-robinned across rails to
+ONE peer streams down all of them concurrently instead of serializing
+behind a single per-peer lane.  Per-rail FIFO is preserved per peer;
+cross-rail arrival order was never guaranteed (distinct sockets), which
+is exactly why receive-side sid reordering (PS_FORCE_REQ_ORDER) exists.
 """
 
 from __future__ import annotations
@@ -97,16 +105,32 @@ class MultiVan(Van):
             )
             rail.connect_transport(sub)
 
-    def _pick_rail(self, msg: Message) -> TcpVan:
+    def _rail_index(self, msg: Message) -> int:
+        """The rail this message rides.  Chosen once (then stamped on
+        the message) so the lane key picked at enqueue time and the
+        rail used at dispatch time always agree — and so a resender
+        retransmit reuses the original rail."""
+        rail = getattr(msg, "_rail", None)
+        if rail is not None:
+            return rail
         if not msg.meta.control.empty():
-            return self._rails[0]  # control plane rides rail 0
-        dev = msg.meta.src_dev_id
-        if dev is not None and dev >= 0:
-            return self._rails[dev % self.num_rails]
-        return self._rails[next(self._rr) % self.num_rails]
+            rail = 0  # control plane rides rail 0
+        else:
+            dev = msg.meta.src_dev_id
+            if dev is not None and dev >= 0:
+                rail = dev % self.num_rails
+            else:
+                rail = next(self._rr) % self.num_rails
+        msg._rail = rail
+        return rail
+
+    def _lane_key(self, msg: Message):
+        # (recver, rail): one peer's data streams down every rail
+        # concurrently; same-rail frames to a peer stay serialized.
+        return (msg.meta.recver, self._rail_index(msg))
 
     def send_msg(self, msg: Message) -> int:
-        return self._pick_rail(msg).send_msg(msg)
+        return self._rails[self._rail_index(msg)].send_msg(msg)
 
     def recv_msg(self) -> Optional[Message]:
         return self._queue.wait_and_pop()

@@ -5,6 +5,13 @@ is buffered under a signature; the receiver acks everything and drops
 duplicates; a monitor thread retransmits entries older than
 ``PS_RESEND_TIMEOUT`` ms, up to 10 retries.  Enabled with ``PS_RESEND=1``;
 exercised together with the ``PS_DROP_MSG`` fault injector.
+
+Retransmits go through ``van.send_msg_locked``, which routes each data
+message into its destination peer's SEND LANE (van.py): the monitor
+thread only enqueues, so one dead peer blocking on its socket cannot
+head-of-line-block retransmits to healthy peers — and the retransmit
+cannot interleave mid-frame with that lane's in-flight send, because
+the lane's transmit lock serializes the actual wire writes.
 """
 
 from __future__ import annotations
@@ -109,6 +116,9 @@ class Resender:
             for msg in resend:
                 log.vlog(1, f"Resend {msg.debug_string()}")
                 try:
+                    # Routed through the owning peer's send lane (no sid
+                    # re-assignment, no re-buffering); lane-side failures
+                    # surface via the van's parked-error path, not here.
                     self._van.send_msg_locked(msg)
                 except Exception as exc:
                     log.warning(f"resend failed: {exc!r}")

@@ -70,7 +70,13 @@ class _Segment:
 
 class ShmVan(TcpVan):
     """TCP control/meta plane + shared-memory data plane for same-host
-    peers; remote peers transparently use plain TCP frames."""
+    peers; remote peers transparently use plain TCP frames.
+
+    Safe under the Van's per-peer send lanes: ``send_msg`` runs under
+    the owning peer's transmit lock, segment names embed (sender,
+    recver, key, direction), and ``_seg_mu`` guards only the segment
+    map — so lanes to different peers copy into disjoint segments
+    concurrently (the copy pool parallelizes WITHIN a copy as well)."""
 
     def __init__(self, postoffice):
         super().__init__(postoffice)
@@ -88,13 +94,17 @@ class ShmVan(TcpVan):
         # (BYTEPS_IPC_COPY_NUM_THREADS=4, rdma_transport.h:570-589).
         # Process-wide and process-lived: co-located vans share it, and a
         # van shutting down can never free it under a peer's in-flight
-        # copy.  Gated on library AVAILABILITY (load() honors
-        # PS_NATIVE=0), not on TcpVan's core-count auto-select: the pool
-        # only engages on multi-MB copies and has no per-message handoff
-        # cost, so it is harmless on single-core (PARITY 3b).
+        # copy.  Gated on library availability AND this node's
+        # _native_allowed (the PER-NODE Environment's PS_NATIVE —
+        # load()'s os.environ check cannot see the override maps
+        # in-process multi-node tests use, so a node-level PS_NATIVE=0
+        # must be honored here), not on TcpVan's core-count auto-select:
+        # the pool only engages on multi-MB copies and has no
+        # per-message handoff cost, so it is harmless on single-core
+        # (PARITY 3b).
         self._copy_pool = None
         n_copy = self.env.find_int("PS_SHM_COPY_THREADS", 4)
-        if n_copy > 0:
+        if n_copy > 0 and self._native_allowed:
             from . import native as _native_mod
 
             if _native_mod.load() is not None:
@@ -115,11 +125,13 @@ class ShmVan(TcpVan):
         self._pipe_mode = False
         self._pipe_bytes = self.env.find_int("PS_SHM_RING_BYTES", 1 << 22)
         if self.env.find_int("PS_SHM_RING", 0):
-            if self._native is None:
+            if self._native is None and self._native_allowed:
                 # Ring pipes ARE the native meta plane — asking for them
                 # is an explicit opt-in that overrides the core-count
                 # auto-select (which only judges the TCP offload's
-                # per-message handoffs).
+                # per-message handoffs).  It does NOT override this
+                # node's PS_NATIVE=0: the documented contract is that
+                # PS_NATIVE=0 forces the pure-Python path, per node.
                 from . import native as _native_mod
 
                 if _native_mod.load() is not None:
@@ -128,8 +140,8 @@ class ShmVan(TcpVan):
                 self._pipe_mode = True
             else:
                 log.warning(
-                    "PS_SHM_RING needs the native core (make -C cpp); "
-                    "staying on sockets"
+                    "PS_SHM_RING needs the native core (make -C cpp, "
+                    "and PS_NATIVE not 0); staying on sockets"
                 )
 
     def bind_transport(self, node, max_retry: int) -> int:

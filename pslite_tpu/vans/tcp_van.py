@@ -7,8 +7,17 @@ side).  Frames use the shared wire format (``wire.py``); data segments are
 sent zero-copy as memoryviews and received with ``recv_into`` directly into
 their final numpy buffers.
 
+Send concurrency: each peer socket has its OWN send lock (never a
+van-wide one), so the Van's per-peer send lanes (van.py, docs/
+send_lanes.md) stream to different peers truly concurrently.  A frame
+goes out as one vectored ``socket.sendmsg`` of ``[header, lens, meta,
+*data]`` memoryviews — one syscall instead of one per chunk — with a
+``sendall`` fallback covering partial writes and socket-like transports
+without scatter-gather support.
+
 When the native C++ core (``cpp/pslite_core.cc``) is built, the framing and
-socket loops can be offloaded to it via ``pslite_tpu.vans.native``.
+socket loops can be offloaded to it via ``pslite_tpu.vans.native`` (the
+core applies the same pattern natively: per-fd send locks + ``writev``).
 """
 
 from __future__ import annotations
@@ -67,7 +76,14 @@ class TcpVan(Van):
         # reference's always-native posture, zmq_van.h:344-394),
         # PS_NATIVE=0 forces Python regardless of cores.
         self._native = None
+        # Consulted via the PER-NODE Environment (not os.environ): in-
+        # process multi-node tests give each node its own override map,
+        # and PS_NATIVE=0 must force pure Python for THAT node even when
+        # the process environment would allow native.  Subclass native
+        # opt-ins (ShmVan's copy pool and PS_SHM_RING pipes) gate on
+        # _native_allowed for the same reason.
         native_pref = self.env.find("PS_NATIVE", "auto")
+        self._native_allowed = native_pref not in ("0", "false")
         try:
             # Affinity-aware: a container pinned to 1 CPU of a 64-core
             # host must count as single-core (cpu_count ignores cgroup
@@ -75,7 +91,7 @@ class TcpVan(Van):
             n_cores = len(os.sched_getaffinity(0))
         except (AttributeError, OSError):
             n_cores = os.cpu_count() or 1
-        want_native = native_pref not in ("0", "false") and (
+        want_native = self._native_allowed and (
             native_pref in ("1", "true") or n_cores >= 2
         )
         if want_native:
@@ -93,7 +109,16 @@ class TcpVan(Van):
         )
         self._send_socks: Dict[int, socket.socket] = {}
         self._send_addrs: Dict[int, Tuple[str, int]] = {}
-        self._socks_mu = threading.Lock()
+        self._socks_mu = threading.Lock()  # guards the maps, not writes
+        # Per-peer socket write locks: a frame's vectored write must not
+        # interleave with another writer's (or a redial's close) on the
+        # SAME socket, but writes to different peers proceed in
+        # parallel — the narrow replacement for the old van-wide lock.
+        self._sock_send_mus: Dict[int, threading.Lock] = {}
+        # OS send-call counter (sendmsg + sendall), observability for
+        # the vectored write path: one increment per syscall-ish call,
+        # so a fully-accepted vector costs exactly 1 per message.
+        self._send_syscalls = 0
         self._closing = False
         # DMLC_LOCAL: unix-domain sockets for same-host clusters.
         self._local = bool(self.env.find_int("DMLC_LOCAL", 0))
@@ -272,15 +297,19 @@ class TcpVan(Van):
                     and node.id in self._send_socks):
                 return
         sock = self._retry_connect(connect_once, deadline)
-        with self._socks_mu:
-            old = self._send_socks.pop(node.id, None)
-            self._send_socks[node.id] = sock
-            self._send_addrs[node.id] = (node.hostname, node.port)
-        if old is not None:
-            try:
-                old.close()
-            except OSError:
-                pass
+        # Swap + close under the peer's SEND lock: closing the old
+        # socket under an in-flight vectored write would at best error
+        # the frame and at worst let the freed fd be reused mid-frame.
+        with self._sock_send_lock(node.id):
+            with self._socks_mu:
+                old = self._send_socks.pop(node.id, None)
+                self._send_socks[node.id] = sock
+                self._send_addrs[node.id] = (node.hostname, node.port)
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
 
     def _connect_local(self, node: Node, deadline: float = 60.0,
                        timeout_s: float = 30.0) -> None:
@@ -330,24 +359,29 @@ class TcpVan(Van):
         """Drop the broken connection and reconnect to the peer's
         last-known address (clearing the dedup entries so the connect
         actually redials)."""
-        with self._socks_mu:
-            addr = self._send_addrs.pop(recver, None)
-            sock = self._send_socks.pop(recver, None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        # Pop + close under the peer's send lock (same reason as the
+        # swap in _dial_and_swap); released before the re-dial, which
+        # re-acquires it to install the fresh socket.
+        with self._sock_send_lock(recver):
+            with self._socks_mu:
+                addr = self._send_addrs.pop(recver, None)
+                sock = self._send_socks.pop(recver, None)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
         if addr is None:
             return False
         try:
             # Bounded retry window: long enough to ride out a peer
             # restarting in place at the same address (the transparent
-            # reconnect the redial exists for), short enough not to wedge
-            # the van-wide send lock on a truly dead peer (heartbeats
-            # own that verdict).  Shutdown sends never get here: the
-            # finalize barrier keeps every peer alive until TERMINATE,
-            # and the self-send rides a real self-connection.
+            # reconnect the redial exists for), short enough not to
+            # wedge this peer's send lane on a truly dead peer
+            # (heartbeats own that verdict; other peers' lanes are
+            # unaffected either way).  Shutdown sends never get here:
+            # the finalize barrier keeps every peer alive until
+            # TERMINATE, and the self-send rides a real self-connection.
             self.connect_transport(
                 Node(id=recver, hostname=addr[0], ports=[addr[1]]),
                 deadline=3.0,
@@ -362,24 +396,75 @@ class TcpVan(Van):
             return False
         return True
 
+    def _sock_send_lock(self, recver: int) -> threading.Lock:
+        with self._socks_mu:
+            mu = self._sock_send_mus.get(recver)
+            if mu is None:
+                mu = self._sock_send_mus[recver] = threading.Lock()
+            return mu
+
+    def _sendv(self, sock, chunks) -> int:
+        """Write a frame's chunk list: ONE vectored ``sendmsg`` when the
+        OS accepts the full iovec; on a partial write, skip what went
+        out and ``sendall`` the remainder.  The chunk-at-a-time
+        ``sendall`` loop also covers socket-like objects without
+        scatter-gather support (non-TCP transports, test doubles)."""
+        views = []
+        total = 0
+        for c in chunks:
+            v = memoryview(c)
+            if v.format != "B" or v.ndim != 1:
+                v = v.cast("B")
+            views.append(v)
+            total += v.nbytes
+        # Local call count, committed under _bytes_mu at the end:
+        # concurrent lane threads would otherwise lose increments in
+        # the unlocked read-modify-write.
+        calls = 0
+        try:
+            if getattr(sock, "sendmsg", None) is None:
+                for v in views:
+                    calls += 1
+                    sock.sendall(v)
+                return total
+            calls += 1
+            sent = sock.sendmsg(views)
+            if sent < total:
+                # Partial vector write (socket buffer full): drop the
+                # whole chunks already on the wire, then sendall the
+                # straddling chunk's tail and everything after it.
+                for v in views:
+                    if sent >= v.nbytes:
+                        sent -= v.nbytes
+                        continue
+                    calls += 1
+                    sock.sendall(v[sent:] if sent else v)
+                    sent = 0
+            return total
+        finally:
+            with self._bytes_mu:
+                self._send_syscalls += calls
+
     def _send_msg_once(self, msg: Message) -> int:
         recver = msg.meta.recver
         if self._native is not None:
+            # The native core owns its own per-fd send locks + writev.
             meta_buf = wire.pack_meta(msg.meta)
             data = [
                 memoryview(np.ascontiguousarray(d.data)).cast("B")
                 for d in msg.data
             ]
             return self._native.send(recver, meta_buf, data)
-        with self._socks_mu:
-            sock = self._send_socks.get(recver)
-        log.check(sock is not None, f"tcp: not connected to node {recver}")
-        chunks = wire.pack_frame(msg)
-        total = 0
-        for c in chunks:
-            sock.sendall(c)
-            total += len(c) if isinstance(c, bytes) else c.nbytes
-        return total
+        # Per-SOCKET lock: holds off a concurrent redial's close/swap of
+        # this peer's socket mid-frame; writes to other peers' sockets
+        # proceed concurrently (the van's lanes drive one thread per
+        # peer, so this lock is uncontended in steady state).
+        with self._sock_send_lock(recver):
+            with self._socks_mu:
+                sock = self._send_socks.get(recver)
+            log.check(sock is not None,
+                      f"tcp: not connected to node {recver}")
+            return self._sendv(sock, wire.pack_frame(msg))
 
     # -- registered recv buffers (RegisterRecvBuffer, van.h:114-116) ---------
 

@@ -9,12 +9,41 @@ counters, and the receiving loop that dispatches data messages to Customers.
 
 Transport subclasses implement ``bind_transport / connect_transport /
 send_msg / recv_msg / stop_transport``.
+
+Send path — per-peer send lanes (see ``docs/send_lanes.md``)
+------------------------------------------------------------
+The reference gets fan-out concurrency for free (one ZMQ socket per
+peer; RDMA QPs post independently); here the same property comes from a
+lane scheduler: every destination node gets its own FIFO lane (a
+:class:`~..utils.queues.LaneQueue` + per-lane transmit lock + a
+lazily-spawned sender thread), so sends to different peers proceed
+concurrently and one slow peer never head-of-line-blocks traffic to the
+others.  Guarantees:
+
+- **Per-peer ordering**: ``sid`` is assigned at dispatch time and each
+  lane dispatches one message at a time, so the per-recver sid sequence
+  is exactly the per-peer wire order.
+- **Priority within a lane**: lanes drain highest ``meta.priority``
+  first, FIFO within a level (the BytePS communication-scheduling idea,
+  formerly opt-in via PS_PRIORITY_SCHED — now the default ordering of
+  every lane).
+- **Control stays inline**: control messages (ADD_NODE, barriers,
+  heartbeats, TERMINATE, ACKs) dispatch synchronously on the caller's
+  thread, serialized with the recver's lane via its transmit lock.
+- **Drain before TERMINATE**: ``stop()`` waits for every lane to go
+  idle before the TERMINATE self-send, so shutdown cannot overtake
+  queued data.
+- **Error propagation**: a lane thread cannot raise to its caller;
+  dispatch errors park in ``_lane_error`` and re-raise on the next
+  ``send()`` (read-and-clear, exactly like the old ``_prio_error``).
+
+``PS_SEND_LANES=0`` disables the async lanes: data messages dispatch
+inline (still under the per-peer transmit lock — never a van-wide one).
 """
 
 from __future__ import annotations
 
 import copy
-import heapq
 import os
 import random
 import sys
@@ -34,7 +63,23 @@ from ..message import Command, Control, Message, Meta, Node, Role
 from ..utils import logging as log
 from ..utils.network import get_ip
 from ..utils.profiling import Profiler
+from ..utils.queues import LaneQueue
 from .resender import Resender
+
+
+class _SendLane:
+    """One per-destination send lane: the queue, the transmit lock that
+    serializes every wire write to this peer (lane thread, inline
+    control sends, and resender retransmits all take it), and the
+    lazily-spawned sender thread."""
+
+    __slots__ = ("key", "q", "tx_mu", "thread")
+
+    def __init__(self, key):
+        self.key = key
+        self.q: LaneQueue = LaneQueue()
+        self.tx_mu = threading.Lock()
+        self.thread: Optional[threading.Thread] = None
 
 
 class Van:
@@ -47,7 +92,7 @@ class Van:
         self.send_bytes = 0
         self.recv_bytes = 0
         self._start_mu = threading.Lock()
-        self._send_mu = threading.Lock()
+        self._bytes_mu = threading.Lock()  # send_bytes read-modify-write
         self._init_stage = 0
         self._recv_thread: Optional[threading.Thread] = None
         self._heartbeat_thread: Optional[threading.Thread] = None
@@ -73,22 +118,21 @@ class Van:
         self._send_sids: Dict[int, int] = {}
         self._recv_expected: Dict[int, int] = {}
         self._recv_buffered: Dict[int, Dict[int, Message]] = {}
-        # Optional priority send scheduling (PS_PRIORITY_SCHED=1): data
-        # messages drain through a max-heap so higher-priority tensors
-        # (KVPairs.priority, e.g. front layers a training step needs
-        # first) overtake lower ones queued behind a busy link — the
-        # BytePS communication-scheduling idea, new TPU-framework scope
-        # (the reference sends strictly FIFO).  sids are assigned at
-        # DISPATCH time so receive-side ordering (PS_FORCE_REQ_ORDER)
-        # sees a consistent sequence.  Control messages bypass the heap.
-        self._prio_sched = bool(self.env.find_int("PS_PRIORITY_SCHED", 0))
-        self._prio_heap: List[Tuple[int, int, Message]] = []
-        self._prio_cv = threading.Condition()
-        self._prio_seq = 0
-        self._prio_thread: Optional[threading.Thread] = None
-        self._prio_stop = False
-        self._prio_abort = False
-        self._prio_error: Optional[Exception] = None
+        # Per-peer send lanes (module docstring): data messages enqueue
+        # to their destination's lane and a per-lane thread dispatches
+        # them — highest meta.priority first, FIFO within a level (this
+        # subsumes the old opt-in PS_PRIORITY_SCHED; the env var remains
+        # accepted but lanes honor priority unconditionally).  sids are
+        # assigned at DISPATCH time so receive-side ordering
+        # (PS_FORCE_REQ_ORDER) sees a consistent sequence.  Control
+        # messages bypass the lanes and dispatch inline.
+        self._send_async = self.env.find_int("PS_SEND_LANES", 1) != 0
+        self._lanes: Dict[object, _SendLane] = {}
+        self._lanes_mu = threading.Lock()
+        self._lane_stop = False
+        self._lane_abort = False
+        self._lane_error: Optional[Exception] = None
+        self._lane_err_mu = threading.Lock()
 
     # -- transport interface -------------------------------------------------
 
@@ -119,9 +163,11 @@ class Van:
     def start(self, customer_id: int) -> None:
         with self._start_mu:
             if self._init_stage == 0:
-                self._prio_stop = False  # re-arm after a prior stop()
-                self._prio_abort = False
-                self._prio_error = None
+                self._lane_stop = False  # re-arm after a prior stop()
+                self._lane_abort = False
+                self._lane_error = None
+                with self._lanes_mu:
+                    self._lanes = {}  # drop joined threads/stale lanes
                 self._init_nodes()
                 port = self.bind_transport(self.my_node, max_retry=40)
                 # Transports that bind multiple rails populate node.ports
@@ -193,7 +239,7 @@ class Van:
             self._connected_nodes[addr] = node.id
 
     def stop(self) -> None:
-        self._drain_priority_queue()
+        self._drain_send_lanes()
         if self.resender is not None:
             # Flush unacked messages (e.g. barrier replies a lossy link
             # dropped) before tearing the transport down.
@@ -228,41 +274,64 @@ class Van:
             self._timestamp += 1
             return self._timestamp
 
+    def _lane_key(self, msg: Message):
+        """Lane identity for a message.  Default: the destination node —
+        one lane per peer.  Multi-rail transports may widen this (e.g.
+        MultiVan keys on (recver, rail) so one peer's data can stream
+        down several rails concurrently)."""
+        return msg.meta.recver
+
+    def _lane_for(self, msg: Message) -> _SendLane:
+        key = self._lane_key(msg)
+        with self._lanes_mu:
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = _SendLane(key)
+            return lane
+
+    def _ensure_lane_thread(self, lane: _SendLane) -> None:
+        if lane.thread is not None and lane.thread.is_alive():
+            return
+        with self._lanes_mu:
+            if lane.thread is None or not lane.thread.is_alive():
+                t = threading.Thread(
+                    target=self._lane_sender, args=(lane,),
+                    name=f"van-send-{lane.key}", daemon=True,
+                )
+                lane.thread = t
+                t.start()
+
+    def _raise_pending_send_error(self) -> None:
+        # A prior async lane dispatch failed; surface it on the next
+        # send so the application sees the transport error instead of a
+        # silent wait() hang (the inline path raises in place).  Read-
+        # and-clear under the lock: two racing senders must not both
+        # claim (and one re-raise None of) the same error.
+        if self._lane_error is None:
+            return
+        with self._lane_err_mu:
+            exc, self._lane_error = self._lane_error, None
+        if exc is not None:
+            raise exc
+
     def send(self, msg: Message) -> int:
         if msg.meta.sender == EMPTY_ID:
             msg.meta.sender = self.my_node.id
-        if self._prio_error is not None:
-            # A prior async dispatch failed; surface it on the next send
-            # so the application sees the transport error instead of a
-            # silent wait() hang (the sync path raises in place).  Read-
-            # and-clear under the lock: two racing senders must not both
-            # claim (and one re-raise None of) the same error.
-            with self._prio_cv:
-                exc, self._prio_error = self._prio_error, None
-            if exc is not None:
-                raise exc
-        if msg.meta.control.empty() and self._prio_sched:
-            with self._prio_cv:
-                # _prio_stop re-checked under the lock: a concurrent
-                # drain could have retired the consumer since the
-                # unlocked fast path — fall through to inline dispatch
-                # rather than stranding the message in the heap.
-                if not self._prio_stop:
-                    # Heap orders by (-priority, seq): highest priority
-                    # first, FIFO within a priority level.
-                    heapq.heappush(
-                        self._prio_heap,
-                        (-msg.meta.priority, self._prio_seq, msg),
-                    )
-                    self._prio_seq += 1
-                    if self._prio_thread is None:
-                        self._prio_thread = threading.Thread(
-                            target=self._priority_sender,
-                            name="van-prio-send", daemon=True,
-                        )
-                        self._prio_thread.start()
-                    self._prio_cv.notify()
-                    return 0  # bytes are accounted at dispatch
+        self._raise_pending_send_error()
+        if (msg.meta.control.empty() and self._send_async
+                and not self._lane_stop):  # unlocked fast path; re-checked
+            lane = self._lane_for(msg)
+            # Thread before push: a lane thread idling on an empty queue
+            # retires cleanly at drain, but a message pushed with no
+            # thread to drain it would strand until the drain deadline.
+            self._ensure_lane_thread(lane)
+            # unless=: re-checked under the lane lock — a concurrent
+            # drain could have retired the consumer, in which case the
+            # message falls through to inline dispatch rather than
+            # stranding in the queue.
+            if lane.q.push(msg.meta.priority, (msg, False),
+                           unless=lambda: self._lane_stop):
+                return 0  # bytes are accounted at dispatch
         return self._dispatch_send(msg)
 
     def _dispatch_send(self, msg: Message) -> int:
@@ -273,73 +342,111 @@ class Van:
             msg.meta.sid = sid
         if self.resender is not None:
             self.resender.add_outgoing(msg)
-        with self._send_mu:
-            nbytes = self.send_msg(msg)
-        self.send_bytes += nbytes
+        nbytes = self._transmit(msg)
         if msg.meta.control.empty():
             self.profiler.record(msg.meta.key, "send", msg.meta.push)
         log.vlog(2, lambda: f"SEND {msg.debug_string()}")
         return nbytes
 
-    def _priority_sender(self) -> None:
+    def _transmit(self, msg: Message) -> int:
+        """Wire write under the owning lane's transmit lock — the only
+        serialization on the send path, and it is per-peer: writes to
+        different peers never contend."""
+        lane = self._lane_for(msg)
+        with lane.tx_mu:
+            nbytes = self.send_msg(msg)
+        with self._bytes_mu:
+            self.send_bytes += nbytes
+        return nbytes
+
+    def _lane_sender(self, lane: _SendLane) -> None:
         while True:
-            with self._prio_cv:
-                while not self._prio_heap and not self._prio_stop:
-                    self._prio_cv.wait()
-                if self._prio_abort:
-                    if self._prio_heap:
-                        log.error(
-                            f"priority queue aborted with "
-                            f"{len(self._prio_heap)} undispatched messages"
-                        )
-                        self._prio_heap.clear()
-                    self._prio_cv.notify_all()
-                    return
-                if not self._prio_heap and self._prio_stop:
-                    return
-                _, _, msg = heapq.heappop(self._prio_heap)
-                drained = not self._prio_heap
+            item, dropped = lane.q.pop(
+                stopping=lambda: self._lane_stop,
+                aborting=lambda: self._lane_abort,
+            )
+            if item is None:
+                if dropped:
+                    log.warning(
+                        f"send lane {lane.key} aborted with {dropped} "
+                        f"undispatched messages"
+                    )
+                return
+            msg, raw = item
             try:
-                self._dispatch_send(msg)
+                if raw:  # resender retransmit: already sid'd + buffered
+                    self._transmit(msg)
+                else:
+                    self._dispatch_send(msg)
             except Exception as exc:
                 # Async dispatch cannot raise to the caller; park the
                 # error for the next send() and log loudly (without
                 # PS_RESEND the message is lost and its wait() hangs).
-                log.error(f"priority send failed: {exc!r}")
-                self._prio_error = exc
-            if drained:
-                with self._prio_cv:
-                    self._prio_cv.notify_all()  # wake drain waiters
+                log.warning(
+                    f"send lane {lane.key} dispatch failed: {exc!r}"
+                )
+                with self._lane_err_mu:
+                    if self._lane_error is None:
+                        self._lane_error = exc
+            finally:
+                lane.q.done()
 
-    def _drain_priority_queue(self, timeout_s: float = 10.0) -> None:
-        """Block until every queued data message has been dispatched
-        (called before TERMINATE so shutdown cannot overtake data),
-        then retire the consumer.  Leaves the scheduler restart-safe:
-        late sends dispatch inline while _prio_stop holds, and stop()
-        re-arms the flags for a fresh start()."""
-        if not self._prio_sched:
+    def _drain_send_lanes(self, timeout_s: float = 10.0) -> None:
+        """Block until every lane has dispatched its queued data
+        messages (called before TERMINATE so shutdown cannot overtake
+        data), then retire the lane threads.  Leaves the van
+        restart-safe: late sends dispatch inline while _lane_stop
+        holds, and start() re-arms the flags and lane map.
+
+        _lane_stop is raised FIRST: every push re-checks it under the
+        lane lock, so no message can be enqueued anywhere after this
+        point — queued items still dispatch (consumers drain a
+        non-empty heap regardless of the stop flag) and stragglers fall
+        through to inline dispatch.  The snapshot loop then reaps lanes
+        created by sends that raced the flag flip (such lanes can never
+        receive a message, but their just-spawned threads must still be
+        woken and joined)."""
+        if not self._send_async:
             return
+        self._lane_stop = True
         deadline = time.monotonic() + timeout_s
-        with self._prio_cv:
-            while self._prio_heap and time.monotonic() < deadline:
-                self._prio_cv.wait(timeout=0.1)
-            self._prio_stop = True
-            if self._prio_heap:
+        seen: set = set()
+        while True:
+            with self._lanes_mu:
+                lanes = [l for l in self._lanes.values()
+                         if id(l) not in seen
+                         and (l.thread is not None or len(l.q))]
+            if not lanes:
+                return
+            seen.update(id(l) for l in lanes)
+            idle = [lane.q.wait_idle(deadline) for lane in lanes]
+            if not all(idle):
                 # Deadline expired with messages still queued (stuck
-                # link): abort the consumer rather than letting it keep
-                # dispatching into a transport stop() is tearing down.
-                self._prio_abort = True
-            self._prio_cv.notify_all()
-        if self._prio_thread is not None:
-            self._prio_thread.join(timeout=5)
-            self._prio_thread = None
+                # link): abort the consumers rather than letting them
+                # keep dispatching into a transport stop() is tearing
+                # down.
+                self._lane_abort = True
+            for lane in lanes:
+                lane.q.wake()
+            for lane in lanes:
+                if lane.thread is not None:
+                    lane.thread.join(timeout=5)
+                    lane.thread = None
 
     def send_msg_locked(self, msg: Message) -> int:
-        """Raw retransmit path used by the Resender (no re-buffering)."""
-        with self._send_mu:
-            nbytes = self.send_msg(msg)
-        self.send_bytes += nbytes
-        return nbytes
+        """Retransmit path used by the Resender (no sid re-assignment,
+        no re-buffering).  Routed through the owning peer's lane so one
+        dead peer's blocked retransmit cannot head-of-line-block the
+        monitor's retransmits to healthy peers; control retransmits and
+        shutdown-drain retransmits (lanes already retired) go inline."""
+        if (self._send_async and msg.meta.control.empty()
+                and not self._lane_stop):
+            lane = self._lane_for(msg)
+            self._ensure_lane_thread(lane)
+            if lane.q.push(msg.meta.priority, (msg, True),
+                           unless=lambda: self._lane_stop):
+                return 0
+        return self._transmit(msg)
 
     # -- receive loop --------------------------------------------------------
 
@@ -363,8 +470,11 @@ class Van:
                     f"recv_msg failed (budget {error_budget:.0f}): {exc!r}"
                 )
                 if error_budget >= 100.0:
-                    log.error("receive pump giving up after repeated "
-                              "decode failures")
+                    # fatal_log, not a (nonexistent) log.error: the old
+                    # attribute error would have killed the pump with an
+                    # AttributeError instead of this message.
+                    log.fatal_log("receive pump giving up after repeated "
+                                  "decode failures")
                     break
                 continue
             if msg is None:
@@ -598,7 +708,7 @@ class Van:
                 # _dispatch_send + catch, as in the recovery broadcast
                 # below: a transport error here must not kill the
                 # scheduler's receive pump (and send() could re-raise an
-                # unrelated parked _prio_error).
+                # unrelated parked _lane_error).
                 try:
                     self._dispatch_send(reply)
                 except Exception as e:
@@ -654,7 +764,7 @@ class Van:
                 # _dispatch_send, not send(): a peer of this roster may
                 # ALSO be dead right now (its endpoint gone) — the send
                 # must not kill the scheduler pump, and the catch must
-                # not consume a parked _prio_error belonging to an
+                # not consume a parked _lane_error belonging to an
                 # unrelated application send (send() re-raises those).
                 # A falsely-dead peer (slow, not crashed) still gets its
                 # broadcast attempted.

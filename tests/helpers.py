@@ -50,10 +50,17 @@ class LoopbackCluster:
             "DMLC_NODE_HOST": host,
             "PS_VAN_TYPE": van_type,
         }
-        # PS_TEST_PRIORITY=1 runs the whole in-process matrix with the
-        # priority send scheduler on — a cross-cutting race flush.
+        # PS_TEST_PRIORITY=1 historically ran the matrix with the
+        # priority scheduler on; per-peer send lanes now honor priority
+        # unconditionally, so the env var is kept only as a no-op
+        # compatibility knob.  PS_TEST_SYNC_SEND=1 is the new
+        # cross-cutting flush: the whole matrix with lanes DISABLED
+        # (inline synchronous sends), exercising the PS_SEND_LANES=0
+        # regime.
         if os.environ.get("PS_TEST_PRIORITY"):
             self.base_env.setdefault("PS_PRIORITY_SCHED", "1")
+        if os.environ.get("PS_TEST_SYNC_SEND"):
+            self.base_env.setdefault("PS_SEND_LANES", "0")
         if env_extra:
             self.base_env.update(env_extra)
         self.scheduler = self._make(Role.SCHEDULER, 0)

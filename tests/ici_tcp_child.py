@@ -25,6 +25,15 @@ import numpy as np  # noqa: E402
 import pslite_tpu as ps  # noqa: E402
 
 
+def _multiproc_unsupported(exc: Exception) -> bool:
+    """This jaxlib's CPU backend may lack cross-process computations
+    entirely ('Multiprocess computations aren't implemented on the CPU
+    backend') — an environment limitation, not a code failure.  The
+    parent test skips on the sentinel; every node still finalizes so
+    the cluster tears down fast instead of hanging its timeout out."""
+    return "Multiprocess computations aren't implemented" in repr(exc)
+
+
 def main() -> None:
     role = os.environ["DMLC_ROLE"]
     ps.start_ps()
@@ -45,47 +54,53 @@ def main() -> None:
         # aggregated sum = 4*1 + 4*2 = 12 on every element.
         vals = np.full(4 * val_len, float(rank + 1), np.float32)
         outs = np.zeros_like(vals)
-        kv.wait(kv.push_pull(keys, vals, outs))
-        np.testing.assert_allclose(outs, 12.0)
+        try:
+            kv.wait(kv.push_pull(keys, vals, outs))
+            np.testing.assert_allclose(outs, 12.0)
 
-        # Second round on the same bucket: store accumulated 12s, push
-        # adds another 12 -> 24 (server aggregation contract,
-        # kv_app.h:430-452, across 2 processes x 4 shards).
-        kv.wait(kv.push_pull(keys, vals, outs))
-        np.testing.assert_allclose(outs, 24.0)
+            # Second round on the same bucket: store accumulated 12s,
+            # push adds another 12 -> 24 (server aggregation contract,
+            # kv_app.h:430-452, across 2 processes x 4 shards).
+            kv.wait(kv.push_pull(keys, vals, outs))
+            np.testing.assert_allclose(outs, 24.0)
 
-        # Sparse table across processes: every worker row pushes 1.0 into
-        # row 3; 8 mesh rows total -> store[3] = 8 per dim.
-        eng_sp = kv.po.van.sparse_engine
-        eng_sp.register_sparse("emb", num_rows=16, dim=4)
-        idx = np.full((4, 1), 3, np.int32)  # this process's 4 rows
-        g = np.ones((4, 1, 4), np.float32)
-        kv.wait(kv.push_sparse("emb", idx, g))
-        out_sp = np.zeros((4, 1, 4), np.float32)
-        kv.wait(kv.pull_sparse("emb", idx, out=out_sp))
-        np.testing.assert_allclose(out_sp, 8.0)
+            # Sparse table across processes: every worker row pushes 1.0
+            # into row 3; 8 mesh rows total -> store[3] = 8 per dim.
+            eng_sp = kv.po.van.sparse_engine
+            eng_sp.register_sparse("emb", num_rows=16, dim=4)
+            idx = np.full((4, 1), 3, np.int32)  # this process's 4 rows
+            g = np.ones((4, 1, 4), np.float32)
+            kv.wait(kv.push_sparse("emb", idx, g))
+            out_sp = np.zeros((4, 1, 4), np.float32)
+            kv.wait(kv.pull_sparse("emb", idx, out=out_sp))
+            np.testing.assert_allclose(out_sp, 8.0)
 
-        # Coordinated elastic recut over the LIVE cluster: both worker
-        # processes call kv.reshard with the same 4-device mesh (2 from
-        # each process); barriers ride the real TCP control plane, the
-        # collective snapshot rides jax.distributed.  State must
-        # survive and training continue on the new fan-in.
-        from jax.sharding import Mesh
+            # Coordinated elastic recut over the LIVE cluster: both
+            # worker processes call kv.reshard with the same 4-device
+            # mesh (2 from each process); barriers ride the real TCP
+            # control plane, the collective snapshot rides
+            # jax.distributed.  State must survive and training continue
+            # on the new fan-in.
+            from jax.sharding import Mesh
 
-        devs = sorted(jax.devices(),
-                      key=lambda d: (d.process_index, d.id))
-        mesh4 = Mesh(np.array(devs[0:2] + devs[4:6]), ("kv",))
-        kv.reshard(mesh4)
-        assert eng.num_shards == 4, eng.num_shards
-        out2 = np.zeros_like(vals)
-        kv.wait(kv.pull(keys, out2))
-        np.testing.assert_allclose(out2, 24.0)
-        # Flat [total] broadcasts to my (now 2) local worker rows:
-        # sum adds 2*1 + 2*2 = 6 on top of the carried 24.
-        outs3 = np.zeros(4 * val_len, np.float32)
-        kv.wait(kv.push_pull(keys, vals, outs3))
-        np.testing.assert_allclose(outs3, 30.0)
-        print(f"WORKER_OK {outs[0]}", flush=True)
+            devs = sorted(jax.devices(),
+                          key=lambda d: (d.process_index, d.id))
+            mesh4 = Mesh(np.array(devs[0:2] + devs[4:6]), ("kv",))
+            kv.reshard(mesh4)
+            assert eng.num_shards == 4, eng.num_shards
+            out2 = np.zeros_like(vals)
+            kv.wait(kv.pull(keys, out2))
+            np.testing.assert_allclose(out2, 24.0)
+            # Flat [total] broadcasts to my (now 2) local worker rows:
+            # sum adds 2*1 + 2*2 = 6 on top of the carried 24.
+            outs3 = np.zeros(4 * val_len, np.float32)
+            kv.wait(kv.push_pull(keys, vals, outs3))
+            np.testing.assert_allclose(outs3, 30.0)
+            print(f"WORKER_OK {outs[0]}", flush=True)
+        except Exception as exc:  # noqa: BLE001 - env-limitation sentinel
+            if not _multiproc_unsupported(exc):
+                raise
+            print("MULTIPROC_UNSUPPORTED", flush=True)
     ps.finalize()
     print(f"{role} DONE", flush=True)
 

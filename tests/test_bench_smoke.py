@@ -170,3 +170,17 @@ def test_bench_cli_contract(tmp_path):
     for field in ("metric", "value", "unit", "vs_baseline"):
         assert field in rec
     assert rec["value"] > 0
+
+
+def test_send_lanes_fanout_harness():
+    """The send_lanes section's harness: laned fan-out must beat the
+    serialized (PS_SEND_LANES=0) replay on a stub transport with a
+    fixed per-message delay."""
+    from pslite_tpu.benchmark import fanout_wall_times
+
+    laned, serial = fanout_wall_times(n_peers=6, delay_s=0.02, rounds=2)
+    assert laned > 0 and serial > 0
+    # Serial must cost ~6x the delay; laned ~1-2x.  Keep the bound loose
+    # for CI noise but strictly below the no-overlap regime.
+    assert laned < serial, (laned, serial)
+    assert laned < 0.6 * serial, (laned, serial)

@@ -254,3 +254,96 @@ def test_engine_checkpoint_orbax_cross_fleet(tmp_path):
                                            w.reshape(-1)[0])
             else:  # vector slots: compare the logical prefix
                 np.testing.assert_allclose(g[:21], w[:21], rtol=1e-6)
+
+
+def test_orbax_legacy_layout_restore(tmp_path):
+    """Regression: a hand-built LEGACY-layout orbax checkpoint (raw
+    physical store arrays, no format_v2 marker — what pre-v2 code
+    wrote) must still restore through restore_engine_orbax's legacy
+    path, same-fleet."""
+    from pslite_tpu.checkpoint import have_orbax, restore_engine_orbax
+
+    if not have_orbax():
+        pytest.skip("orbax not installed")
+    import orbax.checkpoint as ocp
+
+    mesh = default_mesh()
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("ld", keys, 10)  # total 20: pads on 8 shards
+    eng.push("ld", np.arange(20, dtype=np.float32))
+    # The legacy layout saved stores PHYSICALLY (padded, this fleet's
+    # sharded shape) with NO format marker and NO opt/ subtree.
+    legacy_state = {
+        "dense": {"ld": np.asarray(eng.store_array("ld"))},
+        "sparse": {},
+        "sparse_acc": {},
+    }
+    path = str(tmp_path / "legacy_ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), legacy_state, force=True)
+        ckptr.wait_until_finished()
+
+    eng2 = CollectiveEngine(mesh=mesh)
+    eng2.register_dense("ld", keys, 10)
+    restore_engine_orbax(eng2, path)
+    np.testing.assert_allclose(
+        np.asarray(eng2.pull("ld")), np.asarray(eng.pull("ld"))
+    )
+
+
+def test_orbax_probe_failure_warns_and_takes_legacy_path(
+        tmp_path, monkeypatch):
+    """When the v2 metadata probe fails outright, the restore must say
+    'could not determine checkpoint format' BEFORE falling into the
+    legacy path (a v2 checkpoint restored blind dies in opaque orbax
+    shape errors otherwise)."""
+    import logging as pylogging
+
+    from pslite_tpu.checkpoint import have_orbax, restore_engine_orbax
+
+    if not have_orbax():
+        pytest.skip("orbax not installed")
+    import orbax.checkpoint as ocp
+
+    mesh = default_mesh()
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("pd", keys, 10)
+    eng.push("pd", np.arange(20, dtype=np.float32))
+    legacy_state = {
+        "dense": {"pd": np.asarray(eng.store_array("pd"))},
+        "sparse": {},
+        "sparse_acc": {},
+    }
+    path = str(tmp_path / "probe_ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), legacy_state, force=True)
+        ckptr.wait_until_finished()
+
+    def boom(self, *_a, **_k):
+        raise RuntimeError("probe exploded")
+
+    monkeypatch.setattr(ocp.StandardCheckpointer, "metadata", boom)
+    eng2 = CollectiveEngine(mesh=mesh)
+    eng2.register_dense("pd", keys, 10)
+    # The pslite logger doesn't propagate (caplog can't see it): attach
+    # a recording handler directly.
+    records = []
+
+    class _Capture(pylogging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = pylogging.getLogger("pslite_tpu")
+    handler = _Capture(level=pylogging.WARNING)
+    logger.addHandler(handler)
+    try:
+        restore_engine_orbax(eng2, path)
+    finally:
+        logger.removeHandler(handler)
+    assert any("could not determine checkpoint format" in m
+               for m in records), records
+    np.testing.assert_allclose(
+        np.asarray(eng2.pull("pd")), np.asarray(eng.pull("pd"))
+    )

@@ -1147,3 +1147,26 @@ def test_sparse_adagrad_segment_sum_matches_dense_reference(mesh):
     sh = -lr * Gh / (np.sqrt(ah)[:, None] + eps)
     np.testing.assert_allclose(np.asarray(s2), sh, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(a2), ah, rtol=1e-5)
+
+
+def test_set_opt_state_device_restore_rejects_bad_dtype(mesh):
+    """The orbax-v2 device-restore branch must reject an optimizer slot
+    whose dtype doesn't match the bucket's (mirroring set_store_array's
+    dense 'bad restore dtype' check) instead of deferring to an opaque
+    XLA error steps later."""
+    import jax.numpy as jnp
+
+    from pslite_tpu.utils import logging as log
+
+    eng = CollectiveEngine(mesh=mesh)
+    keys = np.arange(2, dtype=np.uint64)
+    eng.register_dense("odt", keys, 10)  # float32, total 20, padded 24
+    bucket = eng._buckets["odt"]
+    bad = jnp.zeros(bucket.padded_len, jnp.int32)  # device array, wrong dtype
+    with pytest.raises(log.CheckError, match="bad opt restore dtype"):
+        eng.set_opt_state("odt", "sgd_momentum", [bad])
+    # Matching dtype passes through the same branch.
+    good = jnp.zeros(bucket.padded_len, jnp.float32)
+    eng.set_opt_state("odt", "sgd_momentum", [good])
+    kind, slots = eng.opt_state("odt")
+    assert kind == "sgd_momentum" and len(slots) == 1

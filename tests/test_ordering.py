@@ -1,9 +1,14 @@
 """PS_FORCE_REQ_ORDER: per-peer in-order delivery of data messages
-(UCX-van sid/reorder parity, ucx_van.h:1032-1039, 1217-1257)."""
+(UCX-van sid/reorder parity, ucx_van.h:1032-1039, 1217-1257) — plus the
+send-lane guarantee those sids rest on: per-recver sid monotonicity on
+the wire while lanes to several peers dispatch concurrently."""
+
+import collections
+import threading
 
 import numpy as np
 
-from pslite_tpu import KVServer, KVWorker, KVPairs
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker, KVPairs
 from pslite_tpu.base import EMPTY_ID
 from pslite_tpu.message import Message, Meta
 
@@ -65,6 +70,79 @@ def test_in_order_delivery_under_shuffle():
         assert out_of_order == []  # buffered, not delivered
         released = van._release_in_order(data_msg(expected, 100.0))
         assert [float(r.data[1].numpy()[0]) for r in released] == [100.0, 101.0]
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_fanout_sid_monotonic_per_peer():
+    """Per-recver sid monotonicity ON THE WIRE while ≥3 peers receive
+    concurrently: several app threads push through the same van, whose
+    per-peer send lanes dispatch to 3 servers in parallel — each
+    recver's sid sequence must still be exactly 0, 1, 2, … in wire
+    order (sids are assigned at dispatch time, under the lane)."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=3,
+        env_extra={"PS_FORCE_REQ_ORDER": "1"},
+    )
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        van = cluster.workers[0].van
+        wire_sids = collections.defaultdict(list)
+        wire_mu = threading.Lock()
+        orig = van.send_msg
+
+        def spying(msg):
+            if msg.meta.control.empty():
+                with wire_mu:
+                    wire_sids[msg.meta.recver].append(msg.meta.sid)
+            return orig(msg)
+
+        van.send_msg = spying
+        try:
+            ranges = cluster.workers[0].get_server_key_ranges()
+            # Keys spanning all 3 server ranges: every push fans out to
+            # every server (3 concurrent lanes per push).
+            keys = np.array(sorted(r.begin + 1 for r in ranges),
+                            dtype=np.uint64)
+            n_threads, n_pushes = 4, 8
+            workers = [
+                KVWorker(0, cid, postoffice=cluster.workers[0])
+                for cid in range(n_threads)
+            ]
+            errs = []
+
+            def pusher(kv):
+                try:
+                    vals = np.ones(len(keys) * 4, np.float32)
+                    for ts in [kv.push(keys, vals)
+                               for _ in range(n_pushes)]:
+                        kv.wait(ts)
+                except Exception as exc:  # noqa: BLE001 - surfaced below
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=pusher, args=(kv,),
+                                        daemon=True) for kv in workers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errs, errs
+        finally:
+            van.send_msg = orig
+        server_ids = {po.van.my_node.id for po in cluster.servers}
+        assert server_ids <= set(wire_sids)
+        for recver in server_ids:
+            sids = wire_sids[recver]
+            # Strictly consecutive from 0: monotonic, no gaps, no dups.
+            assert sids == list(range(len(sids))), (recver, sids)
+            assert len(sids) >= n_threads * n_pushes
     finally:
         for s in servers:
             s.stop()

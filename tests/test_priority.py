@@ -1,11 +1,16 @@
-"""Priority send scheduling (PS_PRIORITY_SCHED=1).
+"""Priority send scheduling within the per-peer send lanes.
 
 Higher-priority pushes queued behind a busy link must overtake lower
 ones (the BytePS communication-scheduling idea; the reference sends
 strictly FIFO).  The link is made "busy" by gating the transport's
-send_msg on an event while more pushes enqueue behind it.
+send_msg on an event while more pushes enqueue behind it.  Priority
+ordering is a PER-LANE property: each destination's lane drains its own
+queue highest-priority-first while lanes to other peers run
+concurrently (PS_PRIORITY_SCHED remains accepted but lanes honor
+priority unconditionally now).
 """
 
+import collections
 import threading
 
 import numpy as np
@@ -16,8 +21,12 @@ from helpers import LoopbackCluster
 
 
 def _cluster():
+    # PS_SEND_LANES pinned on: these tests gate the transport and rely
+    # on an async lane thread carrying the send — the PS_TEST_SYNC_SEND
+    # matrix (helpers.py forces lanes off) must not apply here.
     c = LoopbackCluster(num_workers=1, num_servers=1,
-                        env_extra={"PS_PRIORITY_SCHED": "1"})
+                        env_extra={"PS_PRIORITY_SCHED": "1",
+                                   "PS_SEND_LANES": "1"})
     c.start()
     return c
 
@@ -69,6 +78,73 @@ def test_priority_overtakes_fifo():
             np.testing.assert_allclose(out, 1.0)
         srv.stop()
     finally:
+        cluster.finalize()
+
+
+def test_priority_order_within_lane_while_peers_concurrent():
+    """Priority is a per-lane property: with 3 servers receiving
+    concurrently (proved by a barrier INSIDE the transport — all three
+    lane threads must be in send_msg at once, impossible under a
+    van-wide send lock), each lane still drains its queued pushes in
+    descending priority order.  Lanes pinned on: the in-transport
+    barrier deadlocks under the PS_TEST_SYNC_SEND (lanes-off) matrix."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra={"PS_SEND_LANES": "1"})
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        kv = KVWorker(0, 0, postoffice=cluster.workers[0])
+        van = cluster.workers[0].van
+        orig = van.send_msg
+        # All 3 lanes must reach the transport concurrently before any
+        # may proceed; they then block on the gate while more pushes
+        # (with distinct priorities) pile up in each lane's queue.
+        rendezvous = threading.Barrier(3, timeout=30)
+        gate = threading.Event()
+        order = collections.defaultdict(list)
+        first = set()
+        mu = threading.Lock()
+
+        def gated(msg):
+            if msg.meta.control.empty() and msg.meta.push:
+                recver = msg.meta.recver
+                with mu:
+                    order[recver].append(msg.meta.priority)
+                    head = recver not in first
+                    first.add(recver)
+                if head:
+                    rendezvous.wait()  # ≥3 peers in-flight at once
+                    assert gate.wait(timeout=30), "gate never released"
+            return orig(msg)
+
+        van.send_msg = gated
+        try:
+            ranges = cluster.workers[0].get_server_key_ranges()
+            # Keys spanning every range: each push lands one slice per
+            # server, so each lane sees the same priority sequence.
+            keys = np.array(sorted(r.begin + 1 for r in ranges),
+                            dtype=np.uint64)
+            vals = np.ones(len(keys) * 4, np.float32)
+            tss = [kv.push(keys, vals, priority=0)]  # heads block
+            for prio in (2, 9, 5):
+                tss.append(kv.push(keys, vals, priority=prio))
+            gate.set()
+            for ts in tss:
+                kv.wait(ts)
+        finally:
+            van.send_msg = orig
+        server_ids = {po.van.my_node.id for po in cluster.servers}
+        assert set(order) == server_ids
+        for recver, prios in order.items():
+            # Head first (already in flight), then descending priority.
+            assert prios == [0, 9, 5, 2], (recver, prios)
+    finally:
+        for s in servers:
+            s.stop()
         cluster.finalize()
 
 

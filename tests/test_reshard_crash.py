@@ -221,6 +221,9 @@ def _live_crash_cluster(mode: str, rank1_rc: int, timeout0: int):
     finally:
         for p in procs:
             p.kill()
+    if "MULTIPROC_UNSUPPORTED" in out0.decode() + out1.decode():
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                    "computations (environment limitation)")
     return out0.decode(), out1.decode(), procs[3].returncode
 
 

@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from pslite_tpu.utils.network import get_available_port
 
 
@@ -41,6 +43,9 @@ def test_reshard_across_two_processes():
                 q.kill()
             raise
         outs.append(out.decode())
+    if any("MULTIPROC_UNSUPPORTED" in o for o in outs):
+        pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                    "computations (environment limitation)")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"reshard child failed:\n{out}"
     assert sum("RESHARD_OK" in o for o in outs) == 2, outs

@@ -1,0 +1,296 @@
+"""Per-peer send lanes (van.py) + vectored TCP writes (tcp_van.py).
+
+The lane scheduler replaced the van-wide send lock: sends to different
+peers must overlap (one slow peer bounds the fan-out, not the sum of
+peers), per-lane dispatch errors park and re-raise on the next send(),
+and drain retires every lane before TERMINATE.  TcpVan's pure-Python
+send path must put a whole frame on the wire with ONE sendmsg when the
+OS accepts the full vector, falling back to sendall on partial writes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import Message
+from pslite_tpu.vans.van import Van
+
+
+class _StubPo:
+    """Just enough Postoffice for a transport-less Van."""
+
+    is_scheduler = False
+    is_worker = True
+
+    def __init__(self, env):
+        self.env = env
+
+    @staticmethod
+    def role_str() -> str:
+        return "test"
+
+
+def _make_van(cls=Van, env=None):
+    return cls(_StubPo(Environment(env or {})))
+
+
+def _data_msg(recver: int, tag: float = 0.0, priority: int = 0) -> Message:
+    m = Message()
+    m.meta.sender = 1
+    m.meta.recver = recver
+    m.meta.priority = priority
+    m.add_data(np.full(4, tag, np.float32))
+    return m
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return cond()
+
+
+def test_fanout_overlaps_slow_peer():
+    """Deterministic overlap proof: while peer 0's send is BLOCKED in
+    the transport, sends to peers 1..3 must still complete — impossible
+    under the old van-wide send lock."""
+    blocker = threading.Event()
+    sent = []
+
+    class _GatedVan(Van):
+        def send_msg(self, msg):
+            if msg.meta.recver == 0:
+                assert blocker.wait(timeout=10), "slow peer never released"
+            sent.append(msg.meta.recver)
+            return msg.meta.data_size
+
+    van = _make_van(_GatedVan)
+    try:
+        for peer in range(4):  # slow peer first: worst head-of-line case
+            van.send(_data_msg(peer))
+        assert _wait_until(lambda: {1, 2, 3} <= set(sent))
+        assert 0 not in sent  # still blocked — the others overtook it
+        blocker.set()
+        van._drain_send_lanes(timeout_s=10.0)
+        assert sorted(sent) == [0, 1, 2, 3]
+    finally:
+        blocker.set()
+        van._lane_stop = True
+        van.profiler.close()
+
+
+def test_fanout_bounded_by_slow_peer_wall_time():
+    """Timing form of the acceptance criterion: N-peer fan-out with one
+    slow peer completes in ~slow-peer time, not the serialized sum."""
+    slow_s = 0.4
+
+    class _SlowPeerVan(Van):
+        def send_msg(self, msg):
+            time.sleep(slow_s if msg.meta.recver == 0 else 0.01)
+            return msg.meta.data_size
+
+    van = _make_van(_SlowPeerVan)
+    try:
+        t0 = time.perf_counter()
+        for peer in range(4):
+            van.send(_data_msg(peer))
+        van._drain_send_lanes(timeout_s=30.0)
+        wall = time.perf_counter() - t0
+        # Serialized cost would be >= 0.43s; grant generous CI slack
+        # but stay strictly below the no-overlap regime.
+        assert wall < slow_s + 0.25, f"fan-out did not overlap: {wall:.3f}s"
+    finally:
+        van.profiler.close()
+
+
+def test_lane_error_parks_and_reraises_on_next_send():
+    class _FailingVan(Van):
+        def send_msg(self, msg):
+            if msg.meta.recver == 7:
+                raise OSError("wire down")
+            return msg.meta.data_size
+
+    van = _make_van(_FailingVan)
+    try:
+        van.send(_data_msg(7))
+        assert _wait_until(lambda: van._lane_error is not None)
+        with pytest.raises(OSError, match="wire down"):
+            van.send(_data_msg(8))
+        # Read-and-clear: the error surfaces exactly once.
+        assert van._lane_error is None
+        van.send(_data_msg(8))
+        van._drain_send_lanes(timeout_s=10.0)
+    finally:
+        van.profiler.close()
+
+
+def test_lanes_disabled_dispatches_inline():
+    """PS_SEND_LANES=0: the synchronous regime — send() returns only
+    after the transport write, and transport errors raise in place."""
+    sent = []
+
+    class _RecordingVan(Van):
+        def send_msg(self, msg):
+            sent.append((msg.meta.recver, threading.current_thread()))
+            return msg.meta.data_size
+
+    van = _make_van(_RecordingVan, env={"PS_SEND_LANES": "0"})
+    try:
+        van.send(_data_msg(3))
+        assert len(sent) == 1 and sent[0][1] is threading.current_thread()
+        assert not van._lanes or all(
+            lane.thread is None for lane in van._lanes.values()
+        )
+    finally:
+        van.profiler.close()
+
+
+def test_drain_then_late_send_goes_inline():
+    """After drain retires the lanes, a straggler send() must dispatch
+    inline rather than stranding in a consumer-less queue."""
+    sent = []
+
+    class _RecordingVan(Van):
+        def send_msg(self, msg):
+            sent.append(msg.meta.recver)
+            return msg.meta.data_size
+
+    van = _make_van(_RecordingVan)
+    try:
+        van.send(_data_msg(2))
+        van._drain_send_lanes(timeout_s=10.0)
+        assert sent == [2]
+        van.send(_data_msg(4))  # post-drain: inline path
+        assert sent == [2, 4]
+    finally:
+        van.profiler.close()
+
+
+def test_retransmit_rides_owning_lane():
+    """send_msg_locked (the resender's retransmit entry) must neither
+    re-assign sids nor re-buffer, and must flow through the peer's lane
+    when lanes are live."""
+    seen = []
+
+    class _RecordingVan(Van):
+        def send_msg(self, msg):
+            seen.append((msg.meta.recver, msg.meta.sid))
+            return msg.meta.data_size
+
+    van = _make_van(_RecordingVan)
+    try:
+        msg = _data_msg(5)
+        van.send(msg)
+        van._drain_send_lanes(timeout_s=10.0)
+        van._lane_stop = False  # re-arm (as start() would)
+        sid_after_first = dict(van._send_sids)
+        van.send_msg_locked(msg)  # retransmit of the SAME message
+        van._drain_send_lanes(timeout_s=10.0)
+        assert seen == [(5, 0), (5, 0)]  # same sid on the wire twice
+        assert van._send_sids == sid_after_first  # no sid re-assignment
+    finally:
+        van.profiler.close()
+
+
+# -- TcpVan vectored writes ----------------------------------------------
+
+
+class _FakeSock:
+    """Socket double recording send calls; optionally accepts only
+    ``first_accept`` bytes of the first sendmsg (partial-write path)."""
+
+    def __init__(self, first_accept=None):
+        self.first_accept = first_accept
+        self.sendmsg_calls = 0
+        self.sendall_calls = 0
+        self.wire = bytearray()
+
+    def sendmsg(self, views):
+        self.sendmsg_calls += 1
+        total = sum(v.nbytes for v in views)
+        accept = total
+        if self.sendmsg_calls == 1 and self.first_accept is not None:
+            accept = min(self.first_accept, total)
+        remaining = accept
+        for v in views:
+            take = min(remaining, v.nbytes)
+            self.wire += v[:take]
+            remaining -= take
+            if remaining == 0:
+                break
+        return accept
+
+    def sendall(self, v):
+        self.sendall_calls += 1
+        self.wire += v
+
+
+class _NoVectorSock(_FakeSock):
+    sendmsg = None  # transports without scatter-gather support
+
+
+def _tcp_van():
+    from pslite_tpu.vans.tcp_van import TcpVan
+
+    return _make_van(TcpVan, env={"PS_NATIVE": "0"})
+
+
+def _frame_bytes(msg) -> bytes:
+    from pslite_tpu import wire
+
+    return b"".join(wire.pack_frame(msg))
+
+
+@pytest.mark.parametrize("n_segs", [0, 1, 3])
+def test_tcp_one_sendmsg_per_message(n_segs):
+    van = _tcp_van()
+    try:
+        sock = _FakeSock()
+        van._send_socks[9] = sock
+        msg = _data_msg(9)
+        msg.data, msg.meta.data_type, msg.meta.data_size = [], [], 0
+        for i in range(n_segs):
+            msg.add_data(np.arange(16 + i, dtype=np.float32))
+        want = _frame_bytes(msg)
+        nbytes = van.send_msg(msg)
+        assert nbytes == len(want)
+        assert bytes(sock.wire) == want
+        # The whole [header, lens, meta, *data] vector in ONE syscall.
+        assert sock.sendmsg_calls == 1 and sock.sendall_calls == 0
+        assert van._send_syscalls == 1
+    finally:
+        van.profiler.close()
+
+
+def test_tcp_partial_sendmsg_falls_back_to_sendall():
+    van = _tcp_van()
+    try:
+        sock = _FakeSock(first_accept=11)  # mid-chunk cut
+        van._send_socks[9] = sock
+        msg = _data_msg(9, tag=3.0)
+        msg.add_data(np.arange(32, dtype=np.float32))
+        want = _frame_bytes(msg)
+        assert van.send_msg(msg) == len(want)
+        assert bytes(sock.wire) == want  # byte-exact despite the cut
+        assert sock.sendmsg_calls == 1 and sock.sendall_calls >= 1
+    finally:
+        van.profiler.close()
+
+
+def test_tcp_sendall_fallback_without_sendmsg():
+    van = _tcp_van()
+    try:
+        sock = _NoVectorSock()
+        van._send_socks[9] = sock
+        msg = _data_msg(9, tag=5.0)
+        want = _frame_bytes(msg)
+        assert van.send_msg(msg) == len(want)
+        assert bytes(sock.wire) == want
+        assert sock.sendall_calls >= 1
+    finally:
+        van.profiler.close()

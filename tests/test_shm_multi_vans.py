@@ -460,3 +460,25 @@ def test_repeated_cluster_cycles_leak_free():
         thr0, threading.active_count()
     )
     assert shm_files() == shm0, (shm0, shm_files())
+
+
+def test_ps_native_env_override_forces_python_shm():
+    """The documented contract: PS_NATIVE=0 forces the pure-Python path
+    PER NODE via its Environment override map — the native core, the
+    shared copy pool, AND the PS_SHM_RING pipe opt-in must all stay off
+    for that node even when the process env/built library would allow
+    them (regression: the pool and ring used to consult os.environ via
+    native.load() only, ignoring the per-node override)."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="shm",
+        env_extra={"PS_NATIVE": "0", "PS_SHM_RING": "1"},
+    )
+    cluster.start()
+    for po in cluster.all_nodes():
+        van = po.van
+        assert van._native is None, "PS_NATIVE=0 node went native"
+        assert van._copy_pool is None, "copy pool ignored PS_NATIVE=0"
+        assert not van._pipe_mode, "ring pipes ignored PS_NATIVE=0"
+    # The cluster still works end to end on the pure-Python path
+    # (the helper finalizes the cluster).
+    _push_pull_roundtrip(cluster, payload_floats=4096)
