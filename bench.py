@@ -1020,11 +1020,41 @@ def main() -> None:
                 "send_lanes_overlap_x": round(serial / max(laned, 1e-9), 2),
             }
 
+        def sec_server_apply():
+            # Server-side sharded apply (the receive-path mirror of
+            # send_lanes): a 4-worker-stub push storm through ONE
+            # dispatcher thread, applied serially (PS_APPLY_SHARDS=0,
+            # the pre-shard regime) vs through the 4-shard apply pool.
+            # Pure host-side — no sockets, no backend — so it prices
+            # the apply engine itself, tunnel-independent.
+            from pslite_tpu.benchmark import apply_storm_rates
+
+            shards = 4
+            cfg = (dict(n_workers=4, msgs_per_worker=4, keys_per_msg=8,
+                        val_len=1 << 20, rounds=2) if quick
+                   else dict(n_workers=4, msgs_per_worker=8,
+                             keys_per_msg=8, val_len=1 << 20, rounds=2))
+            serial = apply_storm_rates(0, **cfg)
+            sharded = apply_storm_rates(shards, **cfg)
+            return {
+                "server_apply_serial_msgs_per_s": round(serial, 1),
+                "server_apply_sharded_msgs_per_s": round(sharded, 1),
+                "server_apply_shards": shards,
+                "server_apply_workers": cfg["n_workers"],
+                "server_apply_msg_mb": round(
+                    cfg["keys_per_msg"] * cfg["val_len"] * 4 / 2**20, 1),
+                # None (not a bogus ratio) when either leg timed out.
+                "server_apply_speedup_x": (
+                    round(sharded / serial, 2)
+                    if serial > 0 and sharded > 0 else None),
+            }
+
         if quick:
             headline_ok = rec.run("headline", sec_headline_quick)
             rec.run("host_origin", sec_host_origin)
             rec.run("latency", sec_latency)
             rec.run("send_lanes", sec_send_lanes)
+            rec.run("server_apply", sec_server_apply)
         else:
             headline_ok = rec.run("headline", sec_headline)
             rec.run("copy_pull", sec_copy_pull)
@@ -1036,6 +1066,7 @@ def main() -> None:
             rec.run("latency", sec_latency)
             rec.run("van_latency", sec_van_latency)
             rec.run("send_lanes", sec_send_lanes)
+            rec.run("server_apply", sec_server_apply)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)
 

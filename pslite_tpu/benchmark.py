@@ -223,6 +223,110 @@ def fanout_wall_times(n_peers: int, delay_s: float,
     return _run(True), _run(False)
 
 
+def apply_storm_rates(num_shards: int, n_workers: int = 4,
+                      msgs_per_worker: int = 8, keys_per_msg: int = 8,
+                      val_len: int = 1 << 20, rounds: int = 2) -> float:
+    """Msgs/s of a server-side push storm through the apply path with
+    ``PS_APPLY_SHARDS=num_shards`` (0 = the serial inline path), over a
+    stub responder — no sockets, no scheduler bootstrap: prices the
+    apply engine alone, tunnel-independent (the server_apply analog of
+    :func:`fanout_wall_times`).
+
+    ``n_workers`` stub workers enqueue pre-built push requests into ONE
+    dispatcher thread (the ``Customer._receiving`` analog), which either
+    runs the handle inline (serial, today's regime) or feeds the shard
+    pool.  Every message pushes the SAME overlapping key set, so each
+    apply is the ``store[key] += seg`` hot path and per-key ordering
+    rides shard affinity; the clock stops when the last response is
+    emitted.  Best of ``rounds``.
+
+    Sizing note: per-key values default to the reference headline's
+    MB-class blocks — numpy releases the GIL inside the add loops, but
+    sub-MB segments spend comparable time in GIL handoff churn and the
+    shards convoy instead of overlapping.
+    """
+    import threading
+
+    from .kv.apply_shards import ApplyShardPool
+    from .kv.kv_app import (KVMeta, KVPairs, KVServerDefaultHandle,
+                            _push_segs)
+    from .utils.queues import ThreadsafeQueue
+
+    total = n_workers * msgs_per_worker
+    keys = np.arange(keys_per_msg, dtype=np.uint64)
+    payloads = [
+        np.full(keys_per_msg * val_len, 1.0 + w, np.float32)
+        for w in range(n_workers)
+    ]
+
+    best = None
+    for _ in range(rounds):
+        handle = KVServerDefaultHandle()
+        done = threading.Event()
+
+        class _StubServer:
+            def __init__(self):
+                self.responses = 0
+                self._mu = threading.Lock()
+
+            def response(self, req, res=None):
+                with self._mu:
+                    self.responses += 1
+                    if self.responses >= total:
+                        done.set()
+
+            def response_error(self, req):
+                self.response(req)
+
+        server = _StubServer()
+        pool = (ApplyShardPool(handle, num_shards, server)
+                if num_shards > 0 else None)
+        # Seed the store so every timed push takes the += path.
+        seed_meta = KVMeta(push=True)
+        seed_vals = np.zeros(keys_per_msg * val_len, np.float32)
+        handle.apply_shard(seed_meta, keys,
+                           _push_segs(seed_meta, keys, seed_vals))
+        queue: ThreadsafeQueue = ThreadsafeQueue()
+
+        def dispatcher():
+            while True:
+                item = queue.wait_and_pop()
+                if item is None:
+                    return
+                meta, kvs = item
+                if pool is not None:
+                    pool.submit(meta, kvs)
+                else:
+                    handle(meta, kvs, server)
+
+        def feeder(w: int):
+            kvs = KVPairs(keys=keys, vals=payloads[w])
+            for i in range(msgs_per_worker):
+                queue.push((KVMeta(push=True, sender=9 + 2 * w,
+                                   timestamp=i), kvs))
+
+        disp = threading.Thread(target=dispatcher, daemon=True)
+        disp.start()
+        feeders = [threading.Thread(target=feeder, args=(w,), daemon=True)
+                   for w in range(n_workers)]
+        t0 = time.perf_counter()
+        for t in feeders:
+            t.start()
+        finished = done.wait(timeout=300)
+        dt = time.perf_counter() - t0
+        for t in feeders:
+            t.join(timeout=10)
+        queue.push(None)
+        disp.join(timeout=10)
+        if pool is not None:
+            pool.stop()
+        if not finished:
+            continue  # keep an earlier successful round's rate
+        rate = total / max(dt, 1e-9)
+        best = rate if best is None else max(best, rate)
+    return best if best is not None else 0.0
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A

@@ -490,11 +490,13 @@ def save_server_handle(handle, path: str) -> None:
     counts).  The reference has no server persistence at all (its
     server state dies with the handler's memory — SURVEY §5)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    # list() snapshots guard against the van receive thread inserting
-    # first-seen keys mid-iteration.  Per-key consistency holds because
-    # _apply replaces values atomically; for a bitwise-exact multi-slot
-    # snapshot (e.g. adam m/v of the same in-flight key), quiesce the
-    # server (stop pushing / drain) before saving.
+    # list() snapshots guard against apply threads inserting first-seen
+    # keys mid-iteration.  Handles now apply IN PLACE (no per-push
+    # reallocation — kv_app.py / docs/apply_shards.md), so a key being
+    # updated while it is copied below may capture a mid-update value;
+    # for a consistent snapshot (and bitwise-exact multi-slot state,
+    # e.g. adam m/v of one in-flight key), quiesce the server (stop
+    # pushing / drain) before saving.
     arrays = {f"s_{k}": v for k, v in list(handle.store.items())}
     for slot in ("_m", "_v"):
         for k, v in list(getattr(handle, slot, {}).items()):

@@ -10,11 +10,20 @@ the handle runs, which KVWorker's completion logic relies on —
 One extension for the TPU data plane: a timestamp can carry *completion
 hooks* (e.g. ``jax.Array.block_until_ready``) so ICI-van requests — which
 never produce response messages — still honor ``wait_request`` semantics.
+
+Executor mode (``PS_CUSTOMER_EXECUTOR=N``): handler calls run on N
+worker threads fed by a BOUNDED queue, so the pump keeps draining the
+receive queue while handlers run — the feed stage of the server's
+sharded apply pipeline (docs/apply_shards.md).  ``N=1`` preserves
+handler order (one drainer); ``N>1`` is only for order-insensitive
+handlers.  Backpressure: a full executor queue blocks the pump instead
+of ballooning memory.
 """
 
 from __future__ import annotations
 
 import threading
+import traceback
 from typing import Callable, Dict, List, Optional
 
 from .message import Message
@@ -28,11 +37,19 @@ class Customer:
         customer_id: int,
         recv_handle: Callable[[Message], None],
         postoffice,
+        on_request_error: Optional[
+            Callable[[Message, Exception], None]
+        ] = None,
+        executor_workers: Optional[int] = None,
     ):
         self.app_id = app_id
         self.customer_id = customer_id
         self._recv_handle = recv_handle
         self._po = postoffice
+        # Hook: a handler exception on a REQUEST message (the remote
+        # side is waiting) — KVServer uses it to send an error-marked
+        # response so the waiter fails fast instead of hanging.
+        self._on_request_error = on_request_error
         # ts -> [expected, received]; insertion-ordered and pruned of old
         # completed entries (bounded, unlike the reference's ever-growing
         # vector) — see _prune_tracker_locked.
@@ -42,6 +59,27 @@ class Customer:
         self._cv = threading.Condition(self._mu)
         self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
         self._hooks: Dict[int, List[Callable[[], None]]] = {}
+        if executor_workers is None:
+            env = getattr(postoffice, "env", None)
+            executor_workers = (
+                env.find_int("PS_CUSTOMER_EXECUTOR", 0)
+                if env is not None else 0
+            )
+        self._exec_workers = max(0, int(executor_workers))
+        self._exec_queue: Optional[ThreadsafeQueue] = None
+        self._exec_threads: List[threading.Thread] = []
+        if self._exec_workers:
+            self._exec_queue = ThreadsafeQueue(
+                maxsize=4 * self._exec_workers
+            )
+            for i in range(self._exec_workers):
+                t = threading.Thread(
+                    target=self._exec_loop,
+                    name=f"customer-exec-{app_id}-{customer_id}-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._exec_threads.append(t)
         self._thread = threading.Thread(
             target=self._receiving, name=f"customer-{app_id}-{customer_id}", daemon=True
         )
@@ -155,17 +193,51 @@ class Customer:
             msg = self._queue.wait_and_pop()
             if msg is None or msg.meta.control.cmd.name == "TERMINATE":
                 break
-            try:
-                self._recv_handle(msg)
-            except Exception as exc:
-                # A handler bug must not kill the pump: responses still have
-                # to be counted or every waiter on this node hangs silently.
-                from .utils import logging as _log
+            if self._exec_queue is not None:
+                # Bounded push: blocks when the executor is saturated,
+                # so backpressure reaches the van instead of memory.
+                self._exec_queue.push(msg)
+            else:
+                self._handle_msg(msg)
+        if self._exec_queue is not None:
+            # FIFO sentinels ride behind any queued messages; join so
+            # stop() returns only after in-flight handlers finish.
+            for _ in self._exec_threads:
+                self._exec_queue.push(None)
+            for t in self._exec_threads:
+                t.join(timeout=5)
 
-                _log.warning(f"recv handle raised: {exc!r}")
-            finally:
-                if not msg.meta.request:
-                    self.add_response(msg.meta.timestamp)
+    def _exec_loop(self) -> None:
+        while True:
+            msg = self._exec_queue.wait_and_pop()
+            if msg is None:
+                return
+            self._handle_msg(msg)
+
+    def _handle_msg(self, msg: Message) -> None:
+        try:
+            self._recv_handle(msg)
+        except Exception as exc:
+            # A handler bug must not kill the pump: responses still have
+            # to be counted or every waiter on this node hangs silently.
+            # Log the FULL traceback (a one-line repr buried the actual
+            # bug site) and, for requests, let the app fail the remote
+            # waiter fast instead of leaving it to hang until timeout.
+            from .utils import logging as _log
+
+            _log.warning(
+                f"recv handle raised: {exc!r}\n{traceback.format_exc()}"
+            )
+            if msg.meta.request and self._on_request_error is not None:
+                try:
+                    self._on_request_error(msg, exc)
+                except Exception as hook_exc:
+                    _log.warning(
+                        f"on_request_error hook failed: {hook_exc!r}"
+                    )
+        finally:
+            if not msg.meta.request:
+                self.add_response(msg.meta.timestamp)
 
     def stop(self) -> None:
         self._queue.push(None)

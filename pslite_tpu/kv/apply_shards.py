@@ -1,0 +1,404 @@
+"""Sharded server-side apply engine (``PS_APPLY_SHARDS``).
+
+PR 1 removed the van-wide send lock so a worker's fan-out to S servers
+overlaps; this module is the server-side mirror: the other half of the
+hot loop was one ``Customer._receiving`` thread running the KV handler
+inline, so N workers' pushes serialized on a single thread ("RPC
+Considered Harmful"'s request/apply pipeline tax).  The engine here
+hashes keys into ``PS_APPLY_SHARDS`` shards, gives each shard a worker
+thread that owns its slice of the KV store, and turns one incoming
+``KVPairs`` into per-shard segments applied concurrently.
+
+Invariants (see ``docs/apply_shards.md``):
+
+- **Shard affinity**: ``shard(key) = key % num_shards`` — every op on a
+  key runs on exactly one shard thread, in the order requests were
+  submitted, so ``push +=`` never races and the per-key application
+  order matches the serial path bit-for-bit.
+- **Response-completion barrier**: a request's response is emitted only
+  after ALL of its shard segments completed (a completion counter, not
+  a thread join).
+- **Per-sender response order**: responses leave in request-arrival
+  order per sender (a FIFO ticket gate), exactly as the serial path's
+  single thread produced them.
+- **Error fast-fail**: a handler exception on any shard produces an
+  empty ``OPT_APPLY_ERROR``-marked response instead of a silent hang.
+
+Requests the hash split cannot express (variable-length ``lens``,
+empty key sets, malformed shapes) run as **global ops**: every shard
+thread rendezvouses at a barrier and the full handler runs exactly
+once while all shards are parked — total order around the op is
+preserved.  ``PS_APPLY_SHARDS=0`` removes the engine entirely
+(``KVServer`` then calls the handler inline, today's serial path).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import traceback
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import logging as log
+from ..utils.queues import ThreadsafeQueue
+
+# Queue-item task tags.
+_ALL = ("all",)        # whole request lands on one shard (no subsetting)
+_GLOBAL = ("global",)  # barrier op: full handler under all-shard rendezvous
+
+
+class _Pending:
+    """One in-flight request: completion counter + response slot."""
+
+    __slots__ = (
+        "meta", "kvs", "mu", "remaining", "parts", "error",
+        "done", "response", "arrived", "barrier", "emitted",
+    )
+
+    def __init__(self, meta, kvs):
+        self.meta = meta
+        self.kvs = kvs
+        self.mu = threading.Lock()
+        self.remaining = 0
+        # (positions | None, snapshot, lens) per completed pull segment.
+        self.parts: List[tuple] = []
+        self.error: Optional[BaseException] = None
+        self.done = False
+        self.response: tuple = ("none",)
+        self.arrived = 0
+        self.barrier: Optional[threading.Event] = None
+        self.emitted: Optional[threading.Event] = None  # wait=True only
+
+
+class _CaptureResponder:
+    """Server proxy handed to global-op handler calls: captures the
+    ``response`` instead of sending it, so emission still goes through
+    the per-sender order gate; everything else forwards to the real
+    server."""
+
+    def __init__(self, server, pending: _Pending):
+        self._server = server
+        self._pending = pending
+
+    def response(self, req, res=None) -> None:
+        self._pending.response = ("res", res) if res is not None else ("ok",
+                                                                       None)
+
+    def __getattr__(self, name):
+        return getattr(self._server, name)
+
+
+class ApplyShardPool:
+    """Shard threads + per-request completion/order bookkeeping.
+
+    ``handle`` must expose ``apply_shard(meta, keys, vals)`` (the
+    shard-safe apply protocol ``KVServerDefaultHandle`` /
+    ``KVServerOptimizerHandle`` implement); arbitrary handler calls made
+    for global ops go through the plain ``__call__`` contract.
+    """
+
+    def __init__(self, handle, num_shards: int, server):
+        log.check(num_shards > 0, "ApplyShardPool needs >= 1 shard")
+        self.handle = handle
+        self.num_shards = num_shards
+        self._server = server
+        self._queues: List[ThreadsafeQueue] = [
+            ThreadsafeQueue() for _ in range(num_shards)
+        ]
+        # Per-sender FIFO ticket gate: responses leave in arrival order.
+        self._order_mu = threading.Lock()
+        self._order: Dict[int, Deque[_Pending]] = {}
+        # Observability.
+        self.sharded_requests = 0
+        self.global_requests = 0
+        self._stopping = False
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(sid,),
+                name=f"kv-apply-{sid}", daemon=True,
+            )
+            for sid in range(num_shards)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- submission (KVServer._process thread) --------------------------------
+
+    def submit(self, meta, kvs, wait: bool = False) -> None:
+        """Slice the request across shards and dispatch; returns
+        immediately (the response is emitted by whichever shard thread
+        finishes last, behind the per-sender order gate).
+
+        ``wait=True`` blocks until this request's response has been
+        emitted — used for requests whose payload aliases a SHARED
+        buffer the pump may overwrite on the very next message
+        (registered recv buffers): the serial path's implicit
+        handler-before-next-copy guarantee is restored while the order
+        gate still sees one coherent stream.  Earlier async requests
+        complete on shard threads, so the blocked pump cannot deadlock
+        the gate."""
+        if self._stopping:
+            # Late request racing stop(): shard threads are retiring
+            # behind their sentinels, so queueing would strand it (and
+            # a wait=True pump would hang forever) — dispatch inline,
+            # the send-lanes "late sends dispatch inline" analog.
+            try:
+                self.handle(meta, kvs, self._server)
+            except Exception as exc:
+                log.warning(
+                    f"apply (inline, stopping) failed for request "
+                    f"ts={meta.timestamp}: {exc!r}\n"
+                    f"{traceback.format_exc()}"
+                )
+                try:
+                    self._server.response_error(meta)
+                except Exception:
+                    pass  # transport likely torn down too
+            return
+        pending = _Pending(meta, kvs)
+        if wait:
+            pending.emitted = threading.Event()
+        with self._order_mu:
+            self._order.setdefault(meta.sender,
+                                   collections.deque()).append(pending)
+        plan = self._split(kvs)
+        if plan is None:
+            self.global_requests += 1
+            pending.remaining = self.num_shards
+            pending.barrier = threading.Event()
+            for q in self._queues:
+                q.push((pending, _GLOBAL))
+        elif len(plan) == 1:
+            # Every key maps to one shard (1-key messages, clustered key
+            # sets): skip the positions machinery and its copies.
+            self.sharded_requests += 1
+            pending.remaining = 1
+            self._queues[plan[0][0]].push((pending, _ALL))
+        else:
+            self.sharded_requests += 1
+            pending.remaining = len(plan)
+            for sid, positions in plan:
+                self._queues[sid].push((pending, ("slice", positions)))
+        if wait:
+            # Bounded: stop()'s strand sweep releases a pump caught in
+            # the submit-vs-stop window; the timeout is a last-resort
+            # backstop so no race can wedge the pump permanently.
+            if not pending.emitted.wait(timeout=60.0):
+                log.warning(
+                    f"apply pool: registered-buffer apply for "
+                    f"ts={meta.timestamp} did not complete in 60s "
+                    f"(shutting down?)"
+                )
+
+    def _split(self, kvs) -> Optional[List[tuple]]:
+        """[(shard_id, positions)] for a hash-splittable request, else
+        None (global op)."""
+        keys = kvs.keys
+        n = len(keys)
+        if n == 0 or kvs.lens is not None:
+            return None
+        if len(kvs.vals) % n:
+            return None  # malformed shape: let the full handler raise it
+        shard_of = (keys % self.num_shards).astype(np.intp)
+        plan = []
+        for sid in range(self.num_shards):
+            pos = np.nonzero(shard_of == sid)[0]
+            if len(pos):
+                plan.append((sid, pos))
+        return plan
+
+    # -- shard threads --------------------------------------------------------
+
+    def _worker(self, sid: int) -> None:
+        q = self._queues[sid]
+        while True:
+            item = q.wait_and_pop()
+            if item is None:
+                return
+            pending, task = item
+            if task is _GLOBAL:
+                self._run_global(pending)
+                continue
+            part = None
+            try:
+                part = self._apply_slice(pending, task)
+            except Exception as exc:
+                log.warning(
+                    f"apply shard {sid} failed for request "
+                    f"ts={pending.meta.timestamp} from "
+                    f"{pending.meta.sender}: {exc!r}\n"
+                    f"{traceback.format_exc()}"
+                )
+                with pending.mu:
+                    if pending.error is None:
+                        pending.error = exc
+            with pending.mu:
+                if part is not None:
+                    pending.parts.append(part)
+                pending.remaining -= 1
+                finished = pending.remaining == 0
+            if finished:
+                self._complete(pending)
+
+    def _apply_slice(self, pending: _Pending, task) -> Optional[tuple]:
+        """Run the handler's shard apply for this shard's keys; for a
+        pull, snapshot the values NOW (a later in-place push queued on a
+        sibling shard must not mutate what this request observed)."""
+        from .kv_app import _push_segs
+
+        meta, kvs = pending.meta, pending.kvs
+        if task is _ALL:
+            positions = None
+            keys = kvs.keys
+        else:
+            positions = task[1]
+            keys = kvs.keys[positions]
+        # Zero-copy per-key views of the payload (built on the shard
+        # thread, so even the slicing overlaps across shards).
+        segs = _push_segs(meta, kvs.keys, kvs.vals, positions)
+        parts = self.handle.apply_shard(meta, keys, segs)
+        if not meta.pull:
+            return None
+        log.check(parts is not None and len(parts) == len(keys),
+                  "apply_shard returned a bad pull result")
+        lens = np.array([p.size for p in parts], dtype=np.int64)
+        snap = parts[0].copy() if len(parts) == 1 else np.concatenate(parts)
+        return (positions, snap, lens)
+
+    def _run_global(self, pending: _Pending) -> None:
+        """All-shard rendezvous: the last shard to arrive runs the full
+        handler while the others park, preserving total order around
+        ops the hash split cannot express."""
+        with pending.mu:
+            pending.arrived += 1
+            last = pending.arrived >= self.num_shards
+        if not last:
+            pending.barrier.wait()
+            return
+        try:
+            self.handle(pending.meta, pending.kvs,
+                        _CaptureResponder(self._server, pending))
+        except Exception as exc:
+            log.warning(
+                f"apply (global) failed for request "
+                f"ts={pending.meta.timestamp} from {pending.meta.sender}: "
+                f"{exc!r}\n{traceback.format_exc()}"
+            )
+            pending.error = exc
+            pending.response = ("error",)
+        finally:
+            pending.barrier.set()
+        self._finish(pending)
+
+    # -- completion -----------------------------------------------------------
+
+    def _complete(self, pending: _Pending) -> None:
+        meta = pending.meta
+        if pending.error is not None:
+            pending.response = ("error",)
+        elif meta.pull:
+            try:
+                pending.response = ("res", self._assemble(pending))
+            except Exception as exc:
+                log.warning(
+                    f"pull assembly failed for request "
+                    f"ts={meta.timestamp}: {exc!r}\n"
+                    f"{traceback.format_exc()}"
+                )
+                pending.response = ("error",)
+        else:
+            pending.response = ("ok", None)
+        self._finish(pending)
+
+    def _assemble(self, pending: _Pending):
+        """Merge per-shard pull snapshots into one response buffer in
+        original key order (uniform-length fast path: one fancy-index
+        scatter per shard)."""
+        from .kv_app import KVPairs
+
+        keys = pending.kvs.keys
+        n = len(keys)
+        parts = pending.parts
+        if len(parts) == 1 and parts[0][0] is None:
+            return KVPairs(keys=keys, vals=parts[0][1])
+        lens_by_pos = np.zeros(n, dtype=np.int64)
+        for positions, _snap, lens in parts:
+            lens_by_pos[positions] = lens
+        dtype = parts[0][1].dtype
+        for _pos, snap, _lens in parts:
+            if snap.dtype != dtype:
+                # Mixed per-key dtypes across shards: promote like the
+                # serial np.concatenate did (upcast assignment is
+                # lossless).
+                dtype = np.result_type(*[p[1].dtype for p in parts])
+                break
+        k = int(lens_by_pos[0]) if n else 0
+        if np.all(lens_by_pos == k):
+            out = np.empty(n * k, dtype)
+            rows = out.reshape(n, max(k, 1)) if k else out.reshape(n, 0)
+            for positions, snap, _lens in parts:
+                rows[positions] = snap.reshape(len(positions), k)
+            return KVPairs(keys=keys, vals=out)
+        offs = np.concatenate(([0], np.cumsum(lens_by_pos)))
+        out = np.empty(int(offs[-1]), dtype)
+        for positions, snap, lens in parts:
+            so = 0
+            for pos, ln in zip(positions, lens):
+                ln = int(ln)
+                out[offs[pos]:offs[pos] + ln] = snap[so:so + ln]
+                so += ln
+        return KVPairs(keys=keys, vals=out)
+
+    def _finish(self, pending: _Pending) -> None:
+        """Mark done and flush the sender's ticket queue in order.
+        Emission happens UNDER the order lock so two shard threads
+        completing back-to-back requests cannot interleave their
+        sends."""
+        with self._order_mu:
+            pending.done = True
+            dq = self._order.get(pending.meta.sender)
+            while dq and dq[0].done:
+                head = dq.popleft()
+                self._emit(head)
+                if head.emitted is not None:
+                    head.emitted.set()  # unblock a submit(wait=True) pump
+            if dq is not None and not dq:
+                del self._order[pending.meta.sender]
+
+    def _emit(self, pending: _Pending) -> None:
+        kind = pending.response[0]
+        try:
+            if kind == "res":
+                self._server.response(pending.meta, pending.response[1])
+            elif kind == "ok":
+                self._server.response(pending.meta)
+            elif kind == "error":
+                self._server.response_error(pending.meta)
+            # "none": the handler deliberately did not respond.
+        except Exception as exc:
+            log.warning(f"apply-shard response emit failed: {exc!r}")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def stop(self) -> None:
+        """Drain and retire the shard threads (queued work dispatches
+        first: the sentinel rides behind it in FIFO order), then sweep
+        any request a racing submit() enqueued behind the sentinels so
+        a pump blocked in submit(wait=True) is released."""
+        self._stopping = True
+        for q in self._queues:
+            q.push(None)
+        for t in self._threads:
+            t.join(timeout=10)
+        with self._order_mu:
+            stranded = [p for dq in self._order.values() for p in dq]
+            self._order.clear()
+        for p in stranded:
+            log.warning(
+                f"apply pool stopping with request ts={p.meta.timestamp} "
+                f"from {p.meta.sender} undispatched"
+            )
+            if p.emitted is not None:
+                p.emitted.set()

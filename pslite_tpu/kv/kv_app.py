@@ -23,6 +23,7 @@ Capability parity with the reference's ``include/ps/kv_app.h``:
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -32,10 +33,11 @@ import numpy as np
 from .. import ps as ps_mod
 from ..base import SERVER_GROUP, server_rank_to_id
 from ..customer import Customer
-from ..message import Message, Role
+from ..message import Message, OPT_APPLY_ERROR, Role
 from ..range import Range, find_range
 from ..sarray import SArray
 from ..utils import logging as log
+from .apply_shards import ApplyShardPool
 
 
 @dataclass
@@ -129,7 +131,16 @@ class KVWorker:
 
     def __init__(self, app_id: int, customer_id: int = 0, postoffice=None):
         self.po = postoffice or ps_mod.postoffice(Role.WORKER)
-        self._customer = Customer(app_id, customer_id, self._process, self.po)
+        # Executor clamped to <= 1 (like KVServer): _process's
+        # last-response detection (num_response(ts)+1 >= expected) and
+        # _finish's reassembly assume responses are handled one at a
+        # time — two executor threads racing it would drop pull data.
+        self._customer = Customer(
+            app_id, customer_id, self._process, self.po,
+            executor_workers=min(
+                1, self.po.env.find_int("PS_CUSTOMER_EXECUTOR", 0)
+            ),
+        )
         self._mu = threading.Lock()
         self._callbacks: Dict[int, Callable[[], None]] = {}
         self._recv_kvs: Dict[int, List[KVPairs]] = {}
@@ -143,6 +154,13 @@ class KVWorker:
         self._zpull_bufs: Dict[Tuple[int, int, int], dict] = {}
         self._zpull_ts: set = set()
         self.zpull_hits = 0  # pulls completed without reassembly
+        # Timestamps whose response carried OPT_APPLY_ERROR (the server
+        # handler raised): wait(ts) raises instead of hanging/returning
+        # unapplied data, and completion callbacks are suppressed.  An
+        # insertion-ordered dict-as-set so bounding evicts the OLDEST
+        # entry (set.pop would evict arbitrarily — possibly the very ts
+        # a caller is about to wait on).
+        self._error_ts: Dict[int, None] = {}
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
@@ -573,6 +591,14 @@ class KVWorker:
 
     def wait(self, timestamp: int) -> None:
         self._customer.wait_request(timestamp)
+        with self._mu:
+            failed = timestamp in self._error_ts
+            self._error_ts.pop(timestamp, None)
+        if failed:
+            raise RuntimeError(
+                f"request {timestamp} failed server-side (handler raised "
+                f"while applying; see the server's log for the traceback)"
+            )
 
     # aliases matching the reference spelling
     ZPush = push
@@ -662,6 +688,11 @@ class KVWorker:
         if msg.meta.request:
             return  # workers only receive responses
         ts = msg.meta.timestamp
+        if msg.meta.option == OPT_APPLY_ERROR:
+            with self._mu:
+                self._error_ts[ts] = None
+                while len(self._error_ts) > 4096:
+                    self._error_ts.pop(next(iter(self._error_ts)))
         if msg.meta.pull and len(msg.data) >= 2:
             if msg.meta.option == OPT_COMPRESS_INT8 and len(msg.data) >= 3:
                 # Server quantized the response slice; val_len carries
@@ -730,27 +761,73 @@ class KVWorker:
     def _run_callback(self, ts: int) -> None:
         with self._mu:
             cb = self._callbacks.pop(ts, None)
-        if cb is not None:
+            # An error-marked response means this request's data never
+            # (fully) landed: running the completion callback would hand
+            # the caller a partially-written buffer as if it were good.
+            # The error stays recorded for wait(ts) to raise.
+            errored = ts in self._error_ts
+        if cb is not None and not errored:
             cb()
 
 
 class KVServer:
-    """Holder of a key-range shard of the store (kv_app.h:304-420)."""
+    """Holder of a key-range shard of the store (kv_app.h:304-420).
+
+    Apply concurrency (``docs/apply_shards.md``): when the handler
+    implements the shard-safe ``apply_shard`` protocol (the default and
+    optimizer handles do), incoming requests are hash-split across
+    ``PS_APPLY_SHARDS`` shard threads (default ``min(8, cpus)``) so N
+    workers' pushes apply concurrently instead of serializing on the
+    Customer's receive thread.  ``PS_APPLY_SHARDS=0`` restores the
+    serial inline path; handlers without ``apply_shard`` always run
+    serially.
+    """
 
     def __init__(self, app_id: int, postoffice=None):
         self.po = postoffice or ps_mod.postoffice(Role.SERVER)
-        self._customer = Customer(app_id, app_id, self._process, self.po)
+        # Executor mode is clamped to <= 1 here: the apply pool's
+        # invariants (arrival-order shard affinity, per-sender response
+        # order, serial/sharded bit-exactness) all assume ONE thread
+        # submits requests in arrival order — PS_CUSTOMER_EXECUTOR>1 on
+        # a server would silently break them.
+        self._customer = Customer(
+            app_id, app_id, self._process, self.po,
+            on_request_error=self._request_error,
+            executor_workers=min(
+                1, self.po.env.find_int("PS_CUSTOMER_EXECUTOR", 0)
+            ),
+        )
         self._handle: Optional[Callable[[KVMeta, KVPairs, "KVServer"], None]] = None
         self._recv_buffers: Dict[Tuple[int, int], np.ndarray] = {}
         # Count of pushes the TRANSPORT placed directly into a registered
         # buffer (vs the kv_app copy fallback) — observability for the
         # zero-copy delivery contract.
         self.delivered_in_place = 0
+        self._apply_pool: Optional[ApplyShardPool] = None
+        self._apply_shards = self._resolve_apply_shards()
+
+    def _resolve_apply_shards(self) -> int:
+        try:
+            # Affinity-aware, like TcpVan's native auto-select: a pinned
+            # container must not spawn 8 shard threads for 1 core.
+            n_cores = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            n_cores = os.cpu_count() or 1
+        return self.po.env.find_int("PS_APPLY_SHARDS", min(8, n_cores))
 
     def set_request_handle(
         self, handle: Callable[[KVMeta, KVPairs, "KVServer"], None]
     ) -> None:
+        if self._apply_pool is not None:
+            self._apply_pool.stop()
+            self._apply_pool = None
         self._handle = handle
+        if self._apply_shards > 0 and callable(
+            getattr(handle, "apply_shard", None)
+        ):
+            self._apply_pool = ApplyShardPool(
+                handle, self._apply_shards, self
+            )
 
     def register_recv_buffer(
         self, sender_id: int, key: int, buffer: np.ndarray
@@ -762,9 +839,10 @@ class KVServer:
         if hook is not None:
             hook(sender_id, key, buffer)
 
-    def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
-        """Reply to a request; echoes routing fields so one-sided transports
-        can deliver in place (kv_app.h:536-564)."""
+    def _response_msg(self, req: KVMeta) -> Message:
+        """Response skeleton echoing the request's routing fields so
+        one-sided transports can deliver in place (kv_app.h:536-564) —
+        shared by response() and response_error()."""
         msg = Message()
         m = msg.meta
         m.app_id = self._customer.app_id
@@ -782,6 +860,12 @@ class KVServer:
         # Echo the request's priority: the response carries the bulk
         # bytes on a pull, so scheduling must apply where they travel.
         m.priority = req.priority
+        return msg
+
+    def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
+        """Reply to a request (kv_app.h:536-564)."""
+        msg = self._response_msg(req)
+        m = msg.meta
         if res is not None and not res.empty():
             if (
                 req.pull
@@ -813,8 +897,39 @@ class KVServer:
                 msg.add_data(SArray(np.asarray(res.lens, dtype=np.int32)))
         self.po.van.send(msg)
 
+    def response_error(self, req: KVMeta) -> None:
+        """Empty ``OPT_APPLY_ERROR``-marked response: the waiting worker
+        still gets its response counted (so ``wait`` unblocks) and its
+        ``wait`` raises instead of hanging until timeout."""
+        msg = self._response_msg(req)
+        # The error marker REPLACES any echoed option (OPT_ZPULL /
+        # compression): an empty error response must not claim in-place
+        # or quantized payload the transport would act on.
+        msg.meta.option = OPT_APPLY_ERROR
+        msg.meta.addr = 0
+        msg.meta.val_len = 0
+        self.po.van.send(msg)
+
+    def _request_error(self, msg: Message, exc: Exception) -> None:
+        """Customer hook: the handler raised while processing ``msg`` on
+        the serial path — fail the remote waiter fast."""
+        if msg.meta.simple_app or not msg.meta.request:
+            return
+        self.response_error(KVMeta(
+            cmd=msg.meta.head,
+            push=msg.meta.push,
+            pull=msg.meta.pull,
+            sender=msg.meta.sender,
+            timestamp=msg.meta.timestamp,
+            customer_id=msg.meta.customer_id,
+            key=msg.meta.key,
+        ))
+
     def stop(self) -> None:
         self._customer.stop()
+        if self._apply_pool is not None:
+            self._apply_pool.stop()
+            self._apply_pool = None
 
     def _process(self, msg: Message) -> None:
         if msg.meta.simple_app:
@@ -845,6 +960,7 @@ class KVServer:
                 kvs.vals = msg.data[1].numpy()
                 if len(msg.data) > 2:
                     kvs.lens = msg.data[2].astype_view(np.int32).numpy()
+        reg = None
         if meta.push and len(kvs.keys):
             reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
             if reg is not None:
@@ -865,39 +981,138 @@ class KVServer:
                         : len(kvs.vals.reshape(-1).view(reg.dtype))
                     ]
         log.check(self._handle is not None, "KVServer handle not set")
+        if self._apply_pool is not None:
+            # Sharded apply: returns immediately — the response is
+            # emitted (in per-sender arrival order) by whichever shard
+            # thread completes the request last, so the receive pump
+            # keeps draining while shards apply concurrently.
+            # Registered-buffer pushes apply SYNCHRONOUSLY (wait=True):
+            # their vals alias the shared per-(sender, key) buffer,
+            # which the pump would overwrite with the sender's next
+            # push while shards still read this one — the serial path's
+            # implicit handler-before-next-copy guarantee, restored.
+            self._apply_pool.submit(meta, kvs, wait=reg is not None)
+            return
         self._handle(meta, kvs, self)
 
 
-class KVServerDefaultHandle:
-    """push => store[key] += vals; pull => store[key] (kv_app.h:430-452)."""
+def _push_segs(meta: KVMeta, all_keys: np.ndarray, vals: np.ndarray,
+               positions=None) -> List[np.ndarray]:
+    """Per-key value views of a fixed-k push payload (zero copy) — the
+    currency of the ``apply_shard`` protocol.  ``positions`` selects a
+    shard's subset (indices into the request's full key array); the
+    serial path passes None for all keys in order.
+    """
+    n = len(all_keys)
+    if not meta.push or n == 0:
+        return []
+    log.check(len(vals) % n == 0, "bad push shape")
+    k = len(vals) // n
+    if positions is None:
+        return [vals[i * k:(i + 1) * k] for i in range(n)]
+    return [vals[int(p) * k:(int(p) + 1) * k] for p in positions]
 
-    def __init__(self):
+
+def _pack_pull_vals(parts: List[np.ndarray],
+                    val_len: Optional[int] = None) -> np.ndarray:
+    """Single-pass gather of per-key store arrays into ONE preallocated
+    response buffer (the old path validated, indexed, and
+    ``np.concatenate``d — three passes and a temp list per pull).  With
+    a registered ``val_len`` the output size is known without scanning
+    and each key's length is checked as it lands."""
+    if not parts:
+        return np.empty(0, np.float32)
+    dtype = parts[0].dtype
+    for p in parts:
+        if p.dtype != dtype:
+            # Mixed per-key dtypes: promote like the old np.concatenate
+            # did (assigning into the promoted buffer is lossless).
+            dtype = np.result_type(*[q.dtype for q in parts])
+            break
+    if val_len is not None:
+        out = np.empty(len(parts) * val_len, dtype)
+        off = 0
+        for p in parts:
+            log.check(p.size == val_len,
+                      f"stored value length {p.size} != registered "
+                      f"val_len {val_len}")
+            out[off:off + val_len] = p
+            off += val_len
+        return out
+    total = 0
+    for p in parts:
+        total += p.size
+    out = np.empty(total, dtype)
+    off = 0
+    for p in parts:
+        out[off:off + p.size] = p
+        off += p.size
+    return out
+
+
+class KVServerDefaultHandle:
+    """push => store[key] += vals; pull => store[key] (kv_app.h:430-452).
+
+    Pushes apply IN PLACE into an owned per-key array (the old path
+    reallocated ``store[key] + seg`` on every push); pulls gather into
+    one preallocated response buffer.  ``val_len`` (optional) registers
+    a fixed per-key value count so pull responses size without scanning
+    the store.  Shard-safe via ``apply_shard``: shard affinity (one key
+    -> one shard thread) is what makes the lock-free in-place ``+=``
+    sound under the sharded apply pool.
+    """
+
+    def __init__(self, val_len: Optional[int] = None):
         self.store: Dict[int, np.ndarray] = {}
+        self.val_len = val_len
+
+    def apply_shard(self, meta: KVMeta, keys: np.ndarray,
+                    segs) -> Optional[List[np.ndarray]]:
+        """Apply a push (``segs``: one value view per key, zero-copy
+        slices of the received payload) and/or gather pull refs for
+        exactly ``keys``.  Each key is only ever presented to one shard
+        thread (or the single serial thread), so per-key state needs no
+        locking."""
+        store = self.store
+        if meta.push:
+            for key, seg in zip(keys, segs):
+                key = int(key)
+                cur = store.get(key)
+                if cur is None:
+                    store[key] = seg.copy()  # owned: later += is in place
+                else:
+                    # A key's dtype is fixed by its first push: the old
+                    # reallocating path silently PROMOTED on mixed-dtype
+                    # pushes; in-place would silently DOWNCAST instead —
+                    # fail loudly rather than corrupt precision.
+                    log.check(
+                        cur.dtype == seg.dtype,
+                        f"push dtype {seg.dtype} != stored dtype "
+                        f"{cur.dtype} for key {key}",
+                    )
+                    cur += seg
+        if meta.pull:
+            parts = []
+            for key in keys:
+                arr = store.get(int(key))
+                # A missing key must fail loudly: a zero-length chunk
+                # would silently shift later keys' values in the
+                # caller's buffer.
+                log.check(arr is not None, f"pull of unknown key {key}")
+                parts.append(arr)
+            return parts
+        return None
 
     def __call__(self, req_meta: KVMeta, req_data: KVPairs, server: KVServer):
-        if req_meta.push:
-            n = len(req_data.keys)
-            if n:
-                log.check(len(req_data.vals) % n == 0, "bad push shape")
-                k = len(req_data.vals) // n
-                for i, key in enumerate(req_data.keys):
-                    key = int(key)
-                    seg = req_data.vals[i * k : (i + 1) * k]
-                    if key in self.store:
-                        self.store[key] = self.store[key] + seg
-                    else:
-                        self.store[key] = seg.copy()
+        parts = self.apply_shard(
+            req_meta, req_data.keys,
+            _push_segs(req_meta, req_data.keys, req_data.vals),
+        )
         if req_meta.pull:
-            for k in req_data.keys:
-                # A missing key must fail loudly: a zero-length chunk would
-                # silently shift later keys' values in the caller's buffer.
-                log.check(int(k) in self.store, f"pull of unknown key {k}")
-            vals = [self.store[int(k)] for k in req_data.keys]
-            res = KVPairs(
+            server.response(req_meta, KVPairs(
                 keys=req_data.keys,
-                vals=(np.concatenate(vals) if vals else np.empty(0, np.float32)),
-            )
-            server.response(req_meta, res)
+                vals=_pack_pull_vals(parts, self.val_len),
+            ))
         else:
             server.response(req_meta)
 
@@ -914,7 +1129,10 @@ class KVServerOptimizerHandle:
     (host/numpy) twin so both PS aggregation modes offer optimizers.
 
     ``kind``: "sgd" | "sgd_momentum" | "adam".  Unknown keys initialize
-    to zeros on first push (or seed via ``init``).
+    to zeros on first push (or seed via ``init``).  Updates apply IN
+    PLACE into owned param/slot arrays (no per-push reallocation), and
+    the handle is shard-safe via ``apply_shard`` (shard affinity keys
+    every per-key slot to one thread).
     """
 
     def __init__(self, kind: str = "sgd", lr: float = 0.01,
@@ -939,53 +1157,63 @@ class KVServerOptimizerHandle:
         p = self.store.get(key)
         if p is None:
             p = np.zeros_like(grad)
+            self.store[key] = p
         if self.kind == "sgd":
-            p = p - self.lr * grad
+            p -= self.lr * grad
         elif self.kind == "sgd_momentum":
-            m = self._m.get(key, np.zeros_like(grad))
-            m = self.momentum * m + grad
-            self._m[key] = m
-            p = p - self.lr * m
+            m = self._m.get(key)
+            if m is None:
+                m = np.zeros_like(grad)
+                self._m[key] = m
+            m *= self.momentum
+            m += grad
+            p -= self.lr * m
         else:  # adam
             b1, b2 = self.betas
             t = self._t.get(key, 0) + 1
             self._t[key] = t
-            m = b1 * self._m.get(key, np.zeros_like(grad)) + (1 - b1) * grad
-            v = b2 * self._v.get(key, np.zeros_like(grad)) + (
-                1 - b2
-            ) * grad * grad
-            self._m[key] = m
-            self._v[key] = v
+            m = self._m.get(key)
+            if m is None:
+                m = np.zeros_like(grad)
+                self._m[key] = m
+            v = self._v.get(key)
+            if v is None:
+                v = np.zeros_like(grad)
+                self._v[key] = v
+            m *= b1
+            m += (1 - b1) * grad
+            v *= b2
+            v += (1 - b2) * grad * grad
             mhat = m / (1 - b1 ** t)
             vhat = v / (1 - b2 ** t)
-            p = p - self.lr * mhat / (np.sqrt(vhat) + self.eps)
-        self.store[key] = p
+            p -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def apply_shard(self, meta: KVMeta, keys: np.ndarray,
+                    segs) -> Optional[List[np.ndarray]]:
+        """Shard-safe apply protocol (see KVServerDefaultHandle)."""
+        if meta.push:
+            for key, seg in zip(keys, segs):
+                self._apply(int(key), seg.astype(np.float32, copy=False))
+        if meta.pull:
+            parts = []
+            for key in keys:
+                arr = self.store.get(int(key))
+                log.check(arr is not None, f"pull of unknown key {key}")
+                parts.append(arr)
+            return parts
+        return None
 
     def __call__(self, req_meta: KVMeta, req_data: KVPairs,
                  server: KVServer):
-        if req_meta.push:
-            n = len(req_data.keys)
-            if n:
-                log.check(len(req_data.vals) % n == 0, "bad push shape")
-                k = len(req_data.vals) // n
-                for i, key in enumerate(req_data.keys):
-                    self._apply(
-                        int(key),
-                        req_data.vals[i * k : (i + 1) * k].astype(
-                            np.float32, copy=False
-                        ),
-                    )
+        parts = self.apply_shard(
+            req_meta, req_data.keys,
+            _push_segs(req_meta, req_data.keys, req_data.vals),
+        )
         if req_meta.pull:
-            for k in req_data.keys:
-                log.check(int(k) in self.store,
-                          f"pull of unknown key {k}")
-            vals = [self.store[int(k)] for k in req_data.keys]
-            res = KVPairs(
+            server.response(req_meta, KVPairs(
                 keys=req_data.keys,
-                vals=(np.concatenate(vals) if vals
-                      else np.empty(0, np.float32)),
-            )
-            server.response(req_meta, res)
+                vals=_pack_pull_vals(parts),
+            ))
         else:
             server.response(req_meta)
 

@@ -80,6 +80,13 @@ ZPULL_OFF_BITS = 40
 # for the same layering reason as OPT_ZPULL.
 OPT_COMPRESS_INT8 = 1
 
+# meta.option marker on an (empty) response: the server-side handler
+# raised while applying this request.  The waiting worker still gets its
+# response counted — so ``wait`` unblocks — and ``KVWorker.wait`` raises
+# instead of returning silently-unapplied data.  Without this, a handler
+# bug left the remote waiter hanging until timeout.
+OPT_APPLY_ERROR = 3
+
 
 def dtype_code(dt) -> int:
     return _DTYPE_TO_CODE.get(np.dtype(dt), 2)  # default: raw bytes

@@ -24,17 +24,29 @@ T = TypeVar("T")
 
 
 class ThreadsafeQueue(Generic[T]):
-    def __init__(self, busy_poll_ns: int = 0):
+    def __init__(self, busy_poll_ns: int = 0, maxsize: int = 0):
         self._q: Deque[T] = collections.deque()
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
+        # Bounded mode (maxsize > 0): push blocks while the queue is
+        # full — the backpressure the Customer's executor mode needs so
+        # a slow handler stalls the pump instead of ballooning memory.
+        self._maxsize = maxsize
+        self._not_full = threading.Condition(self._mu)
         # Busy-poll window before falling back to a blocking wait.
         self._busy_poll_s = busy_poll_ns / 1e9
 
     def push(self, item: T) -> None:
         with self._cv:
+            if self._maxsize > 0:
+                while len(self._q) >= self._maxsize:
+                    self._not_full.wait()
             self._q.append(item)
             self._cv.notify()
+
+    def _popped_locked(self) -> None:
+        if self._maxsize > 0:
+            self._not_full.notify()
 
     def wait_and_pop(self, timeout: Optional[float] = None) -> Optional[T]:
         """Pop the next item, blocking.  Returns None on timeout."""
@@ -43,6 +55,7 @@ class ThreadsafeQueue(Generic[T]):
             while time.monotonic() < deadline:
                 with self._mu:
                     if self._q:
+                        self._popped_locked()
                         return self._q.popleft()
         with self._cv:
             if timeout is None:
@@ -55,11 +68,15 @@ class ThreadsafeQueue(Generic[T]):
                     if remaining <= 0 or not self._cv.wait(remaining):
                         if not self._q:
                             return None
+            self._popped_locked()
             return self._q.popleft()
 
     def try_pop(self) -> Optional[T]:
         with self._mu:
-            return self._q.popleft() if self._q else None
+            if not self._q:
+                return None
+            self._popped_locked()
+            return self._q.popleft()
 
     def __len__(self) -> int:
         with self._mu:

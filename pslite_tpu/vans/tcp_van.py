@@ -28,10 +28,11 @@ import os
 import random
 import socket
 import struct
+import sys
 import tempfile
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -61,6 +62,92 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
             return None
         got += r
     return memoryview(buf)
+
+
+def _free_block_refcount() -> int:
+    """CPython refcount of a pool block referenced ONLY by the pool's
+    entry list, as observed by ``sys.getrefcount(entries[i])`` (the
+    list slot + the probe's argument).  Calibrated rather than
+    hard-coded so an interpreter that counts temporaries differently
+    degrades to never-reuse (safe) instead of use-after-reuse."""
+    probe = [np.empty(0, np.uint8)]
+    return sys.getrefcount(probe[0])
+
+
+_FREE_BLOCK_REFS = _free_block_refcount()
+
+
+class _RecvPool:
+    """Pooled receive arena for data segments — the receive-side mirror
+    of PR 1's vectored sends: reader loops acquire recycled uint8
+    blocks instead of allocating a fresh ``bytearray`` per frame (and
+    ``rebuild_message`` views them instead of ``np.frombuffer``-ing a
+    throwaway buffer).
+
+    Recycling safety: a block is handed out only when NOTHING outside
+    the pool references it.  Blocks are numpy arrays that OWN their
+    data, so numpy's view-base collapsing pins every derived view's
+    ``.base`` directly to the block — ``sys.getrefcount(block)`` at its
+    free baseline therefore proves the previous message (keys/vals
+    arrays, handler slices, resender buffers) is fully dead.  No
+    weakrefs, no explicit release calls.
+    """
+
+    _MAX_ENTRIES = 32          # distinct pooled blocks
+    _MAX_BLOCK = 32 << 20      # larger requests bypass the pool
+    _MAX_TOTAL = 128 << 20     # arena budget: beyond it, don't pool
+
+    def __init__(self):
+        self._mu = threading.Lock()  # several reader threads share us
+        self._entries: List[np.ndarray] = []
+        self._total = 0
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        """A uint8 block of >= nbytes (recycled when possible)."""
+        if nbytes > self._MAX_BLOCK:
+            with self._mu:
+                self.misses += 1
+            return np.empty(nbytes, np.uint8)
+        with self._mu:
+            best = -1
+            for i in range(len(self._entries)):
+                if (self._entries[i].nbytes >= nbytes
+                        and sys.getrefcount(self._entries[i])
+                        == _FREE_BLOCK_REFS
+                        and (best < 0 or self._entries[i].nbytes
+                             < self._entries[best].nbytes)):
+                    best = i  # smallest adequate free block
+            if best >= 0:
+                self.hits += 1
+                return self._entries[best]
+            # Miss: size classes are powers of two (>= 4 KB) so repeat
+            # traffic of similar sizes converges onto reusable blocks.
+            block = np.empty(1 << max(12, (max(nbytes, 1) - 1).bit_length()),
+                             np.uint8)
+            if (len(self._entries) < self._MAX_ENTRIES
+                    and self._total + block.nbytes <= self._MAX_TOTAL):
+                self._entries.append(block)
+                self._total += block.nbytes
+            self.misses += 1
+            return block
+
+    def recv_exact_into(self, sock: socket.socket, block: np.ndarray,
+                        n: int) -> bool:
+        view = memoryview(block)
+        try:
+            got = 0
+            while got < n:
+                r = sock.recv_into(view[got:n], n - got)
+                if r == 0:
+                    return False
+                got += r
+            return True
+        finally:
+            # Promptly drop the buffer ref so the block's refcount
+            # baseline only reflects real message views.
+            view.release()
 
 
 class TcpVan(Van):
@@ -135,6 +222,18 @@ class TcpVan(Van):
         # address by the deliver_data_msg hook (both native and
         # pure-Python receive paths).
         self._push_recv_bufs: Dict[tuple, np.ndarray] = {}
+        # Pooled receive arena for data segments (PS_RECV_POOL=0
+        # disables): reader loops recycle uint8 blocks instead of
+        # allocating a fresh bytearray per frame — the receive-side
+        # mirror of the vectored-send work, with the same style of
+        # observability counter (_recv_pool_hits).
+        self._recv_pool: Optional[_RecvPool] = (
+            _RecvPool() if self.env.find_int("PS_RECV_POOL", 1) else None
+        )
+
+    @property
+    def _recv_pool_hits(self) -> int:
+        return self._recv_pool.hits if self._recv_pool is not None else 0
 
     # -- transport interface -------------------------------------------------
 
@@ -607,7 +706,21 @@ class TcpVan(Van):
                 bufs = []
                 ok = True
                 for ln in lens:
-                    b = _recv_exact(conn, int(ln))
+                    ln = int(ln)
+                    if ln and self._recv_pool is not None:
+                        block = self._recv_pool.acquire(ln)
+                        if not self._recv_pool.recv_exact_into(
+                            conn, block, ln
+                        ):
+                            ok = False
+                            break
+                        # A slice, not frombuffer: every derived view's
+                        # .base collapses onto the pool-owned block, so
+                        # the pool's refcount probe can tell when the
+                        # message is dead and the block reusable.
+                        bufs.append(block[:ln])
+                        continue
+                    b = _recv_exact(conn, ln)
                     if b is None:
                         ok = False
                         break

@@ -237,7 +237,14 @@ def pack_frame(msg: Message) -> List[bytes]:
     hdr = _FRAME_HDR.pack(MAGIC, len(meta_buf), len(msg.data))
     chunks: List[bytes] = [hdr, lens, meta_buf]
     for d in msg.data:
-        chunks.append(memoryview(np.ascontiguousarray(d.data)).cast("B"))
+        arr = d.data
+        # Fast path: already-contiguous arrays (the overwhelmingly
+        # common case — every KVPairs slice is) go straight to a
+        # memoryview; ascontiguousarray is reserved for the rare
+        # strided view, where it actually has to copy.
+        if not (isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]):
+            arr = np.ascontiguousarray(arr)
+        chunks.append(memoryview(arr).cast("B"))
     return chunks
 
 
@@ -252,10 +259,19 @@ FRAME_HEADER_SIZE = _FRAME_HDR.size
 
 
 def rebuild_message(meta: Meta, data_bufs: List[bytes]) -> Message:
-    """Reassemble a Message from unpacked meta + raw data segments."""
+    """Reassemble a Message from unpacked meta + raw data segments.
+
+    Segments may be bytes-like (frombuffer view) or uint8 ndarrays (the
+    tcp van's pooled receive arena — a .view keeps every derived array's
+    ``base`` collapsed onto the pool-owned block, which is what lets the
+    pool's refcount probe prove the block is free again).
+    """
     msg = Message(meta=meta)
     for i, raw in enumerate(data_bufs):
         code = meta.data_type[i] if i < len(meta.data_type) else 2
-        arr = np.frombuffer(raw, dtype=code_dtype(code))
+        if isinstance(raw, np.ndarray):
+            arr = raw.view(code_dtype(code))
+        else:
+            arr = np.frombuffer(raw, dtype=code_dtype(code))
         msg.data.append(SArray(arr))
     return msg

@@ -93,3 +93,35 @@ def test_frame_roundtrip():
     np.testing.assert_array_equal(out.data[0].numpy().view(np.uint64), keys)
     np.testing.assert_array_equal(out.data[1].numpy().view(np.float32), vals)
     assert out.meta.data_size == keys.nbytes + vals.nbytes
+
+
+def test_pack_frame_contiguous_zero_copy():
+    """Contiguous data segments pass through pack_frame without a copy
+    (the chunk aliases the source buffer); strided views are made
+    contiguous with identical bytes."""
+    msg = Message(meta=Meta(app_id=1))
+    contiguous = np.arange(16, dtype=np.float32)
+    strided = np.arange(32, dtype=np.float32)[::2]
+    msg.add_data(SArray(contiguous))
+    msg.add_data(SArray(strided))
+    chunks = wire.pack_frame(msg)
+    # chunks: [hdr, lens, meta, data0, data1]
+    assert np.shares_memory(np.frombuffer(chunks[3], np.float32),
+                            contiguous)
+    np.testing.assert_array_equal(
+        np.frombuffer(chunks[4], dtype=np.float32), strided)
+    assert not np.shares_memory(
+        np.frombuffer(chunks[4], np.float32), strided)
+
+
+def test_rebuild_message_accepts_ndarray_segments():
+    """The tcp van's pooled receive path hands rebuild_message uint8
+    ndarray views; derived arrays must alias them (base collapse onto
+    the pool block) with correct dtypes."""
+    vals = np.arange(12, dtype=np.float32)
+    block = np.empty(64, np.uint8)
+    block[: vals.nbytes] = vals.view(np.uint8)
+    meta = Meta(data_type=[10], data_size=vals.nbytes)
+    out = wire.rebuild_message(meta, [block[: vals.nbytes]])
+    np.testing.assert_array_equal(out.data[0].numpy(), vals)
+    assert out.data[0].numpy().base is block
