@@ -1049,12 +1049,26 @@ def main() -> None:
                     if serial > 0 and sharded > 0 else None),
             }
 
+        def sec_fault_recovery():
+            # Recovery path gets a tracked number like the perf paths:
+            # server kill -> detector broadcast -> failover pull success
+            # (loopback in-process cluster, PS_KV_REPLICATION=2,
+            # deadlines on — docs/fault_tolerance.md).  Host-side only,
+            # tunnel-independent; kill_to_detect is bounded below by
+            # the heartbeat timeout, detect_to_pull is the failover
+            # hot path.
+            from pslite_tpu.benchmark import fault_recovery_times
+
+            ft = fault_recovery_times(quick=quick)
+            return {f"fault_recovery_{k}": v for k, v in ft.items()}
+
         if quick:
             headline_ok = rec.run("headline", sec_headline_quick)
             rec.run("host_origin", sec_host_origin)
             rec.run("latency", sec_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("fault_recovery", sec_fault_recovery)
         else:
             headline_ok = rec.run("headline", sec_headline)
             rec.run("copy_pull", sec_copy_pull)
@@ -1067,6 +1081,7 @@ def main() -> None:
             rec.run("van_latency", sec_van_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("fault_recovery", sec_fault_recovery)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)
 

@@ -327,6 +327,105 @@ def apply_storm_rates(num_shards: int, n_workers: int = 4,
     return best if best is not None else 0.0
 
 
+def fault_recovery_times(quick: bool = True) -> dict:
+    """End-to-end recovery latency of the fault-tolerance tier
+    (docs/fault_tolerance.md), over an in-process loopback cluster —
+    no sockets, host-side only, tunnel-independent.
+
+    Timeline measured from the instant a server's van is killed
+    mid-service (1 worker, 2 servers, ``PS_KV_REPLICATION=2``,
+    deadlines on):
+
+    - ``kill_to_detect_s``: kill -> the scheduler's failure detector
+      broadcasts NODE_FAILURE and the worker's hook marks the rank down
+      (bounded below by PS_HEARTBEAT_TIMEOUT).
+    - ``detect_to_pull_s``: detection -> a pull of the dead rank's key
+      range completes against the replica (the failover hot path).
+    - ``kill_to_pull_s``: the sum the application experiences.
+    """
+    import threading
+
+    from .environment import Environment
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+    from .message import Role
+    from .postoffice import Postoffice
+
+    hb_interval, hb_timeout = (0.2, 0.8) if quick else (0.3, 1.0)
+    env_map = {
+        "DMLC_NUM_WORKER": "1",
+        "DMLC_NUM_SERVER": "2",
+        "DMLC_PS_ROOT_URI": "lo",
+        "DMLC_PS_ROOT_PORT": str(41000 + os.getpid() % 1000),
+        "DMLC_NODE_HOST": "lo",
+        "PS_VAN_TYPE": "loopback",
+        "PS_LOOPBACK_NS": f"fault-recovery-{os.getpid()}",
+        "PS_KV_REPLICATION": "2",
+        "PS_HEARTBEAT_INTERVAL": str(hb_interval),
+        "PS_HEARTBEAT_TIMEOUT": str(hb_timeout),
+        "PS_REQUEST_TIMEOUT": "0.5",
+        "PS_REQUEST_RETRIES": "5",
+    }
+    nodes = [Postoffice(Role.SCHEDULER, env=Environment(dict(env_map)))]
+    nodes += [Postoffice(Role.SERVER, env=Environment(dict(env_map)))
+              for _ in range(2)]
+    nodes.append(Postoffice(Role.WORKER, env=Environment(dict(env_map))))
+    threads = [threading.Thread(target=po.start, args=(0,), daemon=True)
+               for po in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    scheduler, server_pos, worker_po = nodes[0], nodes[1:3], nodes[3]
+    servers = []
+    for po in server_pos:
+        srv = KVServer(0, postoffice=po)
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+    worker = KVWorker(0, 0, postoffice=worker_po)
+    from .base import server_rank_to_id
+
+    keys = np.array([7], dtype=np.uint64)
+    vals = np.ones(256, dtype=np.float32)
+    rounds = 3 if quick else 10
+    for _ in range(rounds):
+        worker.wait(worker.push(keys, vals))
+    time.sleep(3 * hb_interval)  # replication forwards + steady beats
+
+    victim_po = next(po for po in server_pos
+                     if po.van.my_node.id == server_rank_to_id(0))
+    dead_id = server_rank_to_id(0)
+    t_kill = time.perf_counter()
+    victim_po.van.stop()
+    while dead_id not in worker._down_servers:
+        if time.perf_counter() - t_kill > 60:
+            raise TimeoutError("failure detector never fired")
+        time.sleep(0.005)
+    t_detect = time.perf_counter()
+    out = np.zeros_like(vals)
+    worker.wait(worker.pull(keys, out))
+    t_pull = time.perf_counter()
+    ok = bool(np.all(out == rounds))
+
+    worker.stop()
+    for srv, po in zip(servers, server_pos):
+        if po is not victim_po:
+            srv.stop()
+    for po in [scheduler, worker_po] + [
+        p for p in server_pos if p is not victim_po
+    ]:
+        try:
+            po.van.stop()
+        except Exception:
+            pass
+    return {
+        "kill_to_detect_s": round(t_detect - t_kill, 3),
+        "detect_to_pull_s": round(t_pull - t_detect, 3),
+        "kill_to_pull_s": round(t_pull - t_kill, 3),
+        "heartbeat_timeout_s": hb_timeout,
+        "replica_data_exact": ok,
+    }
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A

@@ -25,18 +25,26 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .. import ps as ps_mod
-from ..base import SERVER_GROUP, server_rank_to_id
+from ..base import SERVER_GROUP, is_server_id, server_rank_to_id
 from ..customer import Customer
-from ..message import Message, OPT_APPLY_ERROR, Role
+from ..message import (
+    Message,
+    OPT_APPLY_ERROR,
+    OPT_REPLICA,
+    OPT_SEND_FAILED,
+    Role,
+)
 from ..range import Range, find_range
 from ..sarray import SArray
 from ..utils import logging as log
+from ..utils.bounded import BoundedKeySet
 from .apply_shards import ApplyShardPool
 
 
@@ -126,6 +134,43 @@ def default_slicer(
     return out
 
 
+@dataclass
+class _PendingSlice:
+    """One per-server slice of an in-flight bounded request."""
+
+    group_rank: int
+    part: KVPairs
+    dest: int
+    sent_msg: Optional[Message] = None  # for resender forget on re-route
+    responded: bool = False
+    # Set when THIS slice's delivery is known failed (send raised, or
+    # the van synthesized OPT_SEND_FAILED): the sweeper retries it
+    # immediately — and ONLY it, so one bad destination cannot trigger
+    # duplicate sends of the request's healthy slices.
+    retry_now: bool = False
+
+
+@dataclass
+class _PendingReq:
+    """Deadline bookkeeping for one timestamp (PS_REQUEST_TIMEOUT —
+    docs/fault_tolerance.md): the sweeper retries unresponded slices
+    with exponential backoff against the failed-over destination, and
+    after PS_REQUEST_RETRIES fails the request so wait(ts) raises
+    TimeoutError instead of hanging."""
+
+    ts: int
+    push: bool
+    pull: bool
+    cmd: int
+    deadline: float
+    attempt: int = 0
+    slices: List[_PendingSlice] = field(default_factory=list)
+    val_dtype: object = None
+    val_nbytes: int = 0
+    compress: Optional[str] = None
+    zpull: Optional[dict] = None
+
+
 class KVWorker:
     """Client of the KV store (kv_app.h:134-300)."""
 
@@ -157,10 +202,10 @@ class KVWorker:
         # Timestamps whose response carried OPT_APPLY_ERROR (the server
         # handler raised): wait(ts) raises instead of hanging/returning
         # unapplied data, and completion callbacks are suppressed.  An
-        # insertion-ordered dict-as-set so bounding evicts the OLDEST
-        # entry (set.pop would evict arbitrarily — possibly the very ts
-        # a caller is about to wait on).
-        self._error_ts: Dict[int, None] = {}
+        # bounded FIFO so eviction drops the OLDEST entry (set.pop
+        # would evict arbitrarily — possibly the very ts a caller is
+        # about to wait on).
+        self._error_ts = BoundedKeySet(4096)
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
@@ -170,6 +215,24 @@ class KVWorker:
         # Last completion per pinned bucket: the next pinned pull joins it
         # before donating the previous result (one-outstanding contract).
         self._pinned_pull_futs: Dict[str, Callable] = {}
+        # Bounded requests + failover (docs/fault_tolerance.md):
+        # PS_REQUEST_TIMEOUT (seconds, 0 = off) deadlines every message-
+        # path request; a sweeper thread retries expired slices with
+        # exponential backoff, re-routing a dead rank's slice to its
+        # first live replica when PS_KV_REPLICATION is on; after
+        # PS_REQUEST_RETRIES the request fails and wait(ts) raises
+        # TimeoutError.  _down_servers mirrors the failure detector's
+        # NODE_FAILURE broadcasts via the postoffice hook registry.
+        self._req_timeout = self.po.env.find_float("PS_REQUEST_TIMEOUT", 0.0)
+        self._req_retries = self.po.env.find_int("PS_REQUEST_RETRIES", 3)
+        self._replication = self.po.env.find_int("PS_KV_REPLICATION", 1)
+        self._down_servers: set = set()
+        self._pending: Dict[int, _PendingReq] = {}
+        self._timeout_ts = BoundedKeySet(4096)
+        self._sweep_thread: Optional[threading.Thread] = None
+        self._sweep_cv = threading.Condition()
+        self._sweep_stop = False
+        self.po.register_node_failure_hook(self._on_node_event)
 
     @property
     def engine(self):
@@ -592,8 +655,16 @@ class KVWorker:
     def wait(self, timestamp: int) -> None:
         self._customer.wait_request(timestamp)
         with self._mu:
+            timed_out = timestamp in self._timeout_ts
+            self._timeout_ts.discard(timestamp)
             failed = timestamp in self._error_ts
-            self._error_ts.pop(timestamp, None)
+            self._error_ts.discard(timestamp)
+        if timed_out:
+            raise TimeoutError(
+                f"request {timestamp} was abandoned: no response within "
+                f"PS_REQUEST_TIMEOUT across {self._req_retries} retries, "
+                f"or its destination is dead with no live replica"
+            )
         if failed:
             raise RuntimeError(
                 f"request {timestamp} failed server-side (handler raised "
@@ -607,9 +678,222 @@ class KVWorker:
     Wait = wait
 
     def stop(self) -> None:
+        self.po.unregister_node_failure_hook(self._on_node_event)
+        with self._sweep_cv:
+            self._sweep_stop = True
+            self._sweep_cv.notify_all()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=5)
+            self._sweep_thread = None
         self._customer.stop()
 
+    # -- failure handling / bounded requests ---------------------------------
+
+    def _on_node_event(self, node_id: int, down: bool) -> None:
+        """Postoffice node-failure hook: track dead servers for
+        failover routing; a failure wakes the sweeper so in-flight
+        requests against the dead rank retry immediately instead of
+        waiting out their deadlines."""
+        if not is_server_id(node_id):
+            return
+        with self._mu:
+            if down:
+                self._down_servers.add(node_id)
+            else:
+                self._down_servers.discard(node_id)
+        if down:
+            self._wake_sweeper()
+
+    def _route(self, group_rank: int) -> int:
+        """Destination id for a key-range slice: the owning rank, or —
+        when it is down and replication is on — the first live member
+        of its replica chain (the topology lives in ONE place:
+        replication.chain_ranks, shared with the server's forwarder)."""
+        from .replication import chain_ranks
+
+        gs = self.po.group_size
+        base = server_rank_to_id(group_rank * gs + self.po.instance_idx)
+        if base not in self._down_servers:
+            return base
+        for rank in chain_ranks(group_rank, self._replication,
+                                self.po.num_servers):
+            cand = server_rank_to_id(rank * gs + self.po.instance_idx)
+            if cand not in self._down_servers:
+                return cand
+        return base
+
+    def _mark_timed_out(self, ts: int) -> None:
+        """Record a timed-out/abandoned request (caller holds _mu):
+        wait(ts) raises TimeoutError; completion callbacks suppress."""
+        self._timeout_ts.add(ts)
+
+    def _ensure_sweeper(self) -> None:
+        if self._sweep_thread is not None and self._sweep_thread.is_alive():
+            return
+        self._sweep_thread = threading.Thread(
+            target=self._sweep_loop, name="kv-deadline-sweeper", daemon=True
+        )
+        self._sweep_thread.start()
+
+    def _wake_sweeper(self) -> None:
+        with self._sweep_cv:
+            self._sweep_cv.notify_all()
+
+    def _sweep_loop(self) -> None:
+        period = max(0.02, min(self._req_timeout / 4.0, 0.5))
+        while True:
+            with self._sweep_cv:
+                if self._sweep_stop:
+                    return
+                self._sweep_cv.wait(period)
+                if self._sweep_stop:
+                    return
+            try:
+                self._sweep_once()
+            except Exception as exc:  # noqa: BLE001 - sweeper must survive
+                log.warning(f"deadline sweeper error: {exc!r}")
+
+    def _sweep_once(self) -> None:
+        now = time.monotonic()
+        retries: List[Tuple[_PendingReq, List[_PendingSlice]]] = []
+        failures: List[Tuple[int, int]] = []
+        with self._mu:
+            for ts, req in list(self._pending.items()):
+                unresp = [s for s in req.slices if not s.responded]
+                if not unresp:
+                    self._pending.pop(ts)
+                    continue
+                # A slice is retried when the request's deadline passed,
+                # or ITS delivery is known failed (destination declared
+                # dead / send raised / OPT_SEND_FAILED) — never its
+                # healthy siblings, which would duplicate their sends.
+                expired = now >= req.deadline
+                troubled = [
+                    s for s in unresp
+                    if expired or s.retry_now
+                    or s.dest in self._down_servers
+                ]
+                if not troubled:
+                    continue
+                if req.attempt >= self._req_retries:
+                    self._pending.pop(ts)
+                    self._mark_timed_out(ts)
+                    # Release the abandoned request's pull state NOW:
+                    # no further response may ever arrive to trigger
+                    # _finish, and these entries hold real payload
+                    # arrays (partial chunks, destination buffers).
+                    self._recv_kvs.pop(ts, None)
+                    self._pull_dst.pop(ts, None)
+                    self._callbacks.pop(ts, None)
+                    self._zpull_ts.discard(ts)
+                    failures.append((ts, len(unresp)))
+                    continue
+                req.attempt += 1
+                # Exponential backoff: each attempt doubles the window.
+                req.deadline = now + self._req_timeout * (2 ** req.attempt)
+                for s in troubled:
+                    s.retry_now = False
+                retries.append((req, troubled))
+        for req, slices in retries:
+            for sl in slices:
+                dest = self._route(sl.group_rank)
+                old = sl.sent_msg
+                if (old is not None and dest != sl.dest
+                        and self.po.van.resender is not None):
+                    # Stop retransmitting the original: its destination
+                    # is being abandoned, and a give-up there would
+                    # spuriously fail the now-failed-over request.
+                    self.po.van.resender.forget(old.meta.control.msg_sig)
+                log.vlog(1, f"retry ts={req.ts} slice rank="
+                            f"{sl.group_rank} -> node {dest} "
+                            f"(attempt {req.attempt})")
+                sl.dest = dest
+                msg = self._slice_msg(
+                    req.ts, req.push, req.pull, req.cmd, sl.part,
+                    sl.group_rank, dest, req.val_dtype, req.val_nbytes,
+                    req.compress, req.zpull,
+                )
+                try:
+                    self.po.van.send(msg)
+                    sl.sent_msg = msg
+                except Exception as exc:  # noqa: BLE001 - next sweep retries
+                    log.warning(
+                        f"retry send ts={req.ts} to {dest} failed: {exc!r}"
+                    )
+        for ts, deficit in failures:
+            log.warning(
+                f"request ts={ts} abandoned after {self._req_retries} "
+                f"retries; failing wait()"
+            )
+            # Square the response ledger so wait(ts) unblocks (and then
+            # raises TimeoutError via _timeout_ts).
+            self._customer.add_response(ts, deficit)
+
     # -- internals -----------------------------------------------------------
+
+    def _slice_msg(
+        self,
+        ts: int,
+        push: bool,
+        pull: bool,
+        cmd: int,
+        part: KVPairs,
+        group_rank: int,
+        dest: int,
+        val_dtype=None,
+        val_nbytes: int = 0,
+        compress: Optional[str] = None,
+        zpull: Optional[dict] = None,
+    ) -> Message:
+        """Build one per-server slice message (shared by the initial
+        send and the deadline sweeper's failover retries)."""
+        msg = Message()
+        m = msg.meta
+        m.priority = part.priority
+        m.app_id = self._customer.app_id
+        m.customer_id = self._customer.customer_id
+        m.request = True
+        m.push = push
+        m.pull = pull
+        m.head = cmd
+        m.timestamp = ts
+        m.recver = dest
+        m.key = int(part.keys[0]) if len(part.keys) else 0
+        if pull and not push:
+            m.val_len = val_nbytes
+        else:
+            m.val_len = part.vals.nbytes
+        if zpull is not None:
+            # Registered-buffer routing: the transport writes this
+            # slice's response at (buf_id, offset) in the worker's
+            # buffer (the rdma_van pull_addr_ / ucx w_pool_ analog).
+            m.option = OPT_ZPULL
+            m.addr = (
+                (zpull["buf_id"] << _ZPULL_OFF_BITS)
+                | zpull["offsets"][group_rank]
+            )
+        else:
+            if compress == "int8" and pull and not push:
+                # Ask the server to quantize its response slice.
+                m.option = OPT_COMPRESS_INT8
+            m.addr = id(part.vals)  # same-process fast-path token
+        msg.add_data(SArray(part.keys))
+        if compress == "int8" and push:  # dtype validated in push()
+            from ..ops.quantize import np_quantize_int8
+
+            q, scales, _n = np_quantize_int8(part.vals)
+            m.option = OPT_COMPRESS_INT8
+            # m.val_len already holds the uncompressed byte count (set
+            # above); the server derives n = val_len // 4 from it.
+            msg.add_data(SArray(q.reshape(-1)))
+            msg.add_data(SArray(scales))
+        else:
+            msg.add_data(SArray(part.vals))
+            if part.lens is not None:
+                msg.add_data(
+                    SArray(np.asarray(part.lens, dtype=np.int32))
+                )
+        return msg
 
     def _send(
         self,
@@ -631,68 +915,122 @@ class KVWorker:
             if skipped == len(sliced):
                 self._finish(ts)  # also releases any _pull_dst entry
                 return
-        for group_rank, part in enumerate(sliced):
-            if part is None or part.empty():
-                continue
-            msg = Message()
-            m = msg.meta
-            m.priority = part.priority
-            m.app_id = self._customer.app_id
-            m.customer_id = self._customer.customer_id
-            m.request = True
-            m.push = push
-            m.pull = pull
-            m.head = cmd
-            m.timestamp = ts
-            m.recver = server_rank_to_id(
-                group_rank * self.po.group_size + self.po.instance_idx
+        parts = [
+            (group_rank, part, self._route(group_rank))
+            for group_rank, part in enumerate(sliced)
+            if part is not None and not part.empty()
+        ]
+        req: Optional[_PendingReq] = None
+        if self._req_timeout > 0:
+            # Built COMPLETE before publication: a sweeper tick racing
+            # this send must never observe a half-populated slice list
+            # (it retires requests whose every slice has responded).
+            req = _PendingReq(
+                ts=ts, push=push, pull=pull, cmd=cmd,
+                deadline=time.monotonic() + self._req_timeout,
+                slices=[
+                    _PendingSlice(group_rank=gr, part=part, dest=dest)
+                    for gr, part, dest in parts
+                ],
+                val_dtype=val_dtype, val_nbytes=val_nbytes,
+                compress=compress, zpull=zpull,
             )
-            m.key = int(part.keys[0]) if len(part.keys) else 0
-            if pull and not push:
-                m.val_len = val_nbytes
-            else:
-                m.val_len = part.vals.nbytes
-            if zpull is not None:
-                # Registered-buffer routing: the transport writes this
-                # slice's response at (buf_id, offset) in the worker's
-                # buffer (the rdma_van pull_addr_ / ucx w_pool_ analog).
-                m.option = OPT_ZPULL
-                m.addr = (
-                    (zpull["buf_id"] << _ZPULL_OFF_BITS)
-                    | zpull["offsets"][group_rank]
-                )
-            else:
-                if compress == "int8" and pull and not push:
-                    # Ask the server to quantize its response slice.
-                    m.option = OPT_COMPRESS_INT8
-                m.addr = id(part.vals)  # same-process fast-path token
-            msg.add_data(SArray(part.keys))
-            if compress == "int8" and push:  # dtype validated in push()
-                from ..ops.quantize import np_quantize_int8
-
-                q, scales, _n = np_quantize_int8(part.vals)
-                m.option = OPT_COMPRESS_INT8
-                # m.val_len already holds the uncompressed byte count (set
-                # above); the server derives n = val_len // 4 from it.
-                msg.add_data(SArray(q.reshape(-1)))
-                msg.add_data(SArray(scales))
-            else:
-                msg.add_data(SArray(part.vals))
-                if part.lens is not None:
-                    msg.add_data(
-                        SArray(np.asarray(part.lens, dtype=np.int32))
+            with self._mu:
+                self._pending[ts] = req
+            self._ensure_sweeper()
+        for idx, (group_rank, part, dest) in enumerate(parts):
+            sl = req.slices[idx] if req is not None else None
+            msg = self._slice_msg(ts, push, pull, cmd, part, group_rank,
+                                  dest, val_dtype, val_nbytes, compress,
+                                  zpull)
+            try:
+                self.po.van.send(msg)
+                if sl is not None:
+                    sl.sent_msg = msg
+            except Exception as exc:  # noqa: BLE001 - PeerDeadError & co
+                if sl is not None:
+                    # Deadlines on: mark THIS slice failed — the sweeper
+                    # re-routes it (to a replica if the rank is down)
+                    # right away, without touching healthy siblings.
+                    log.warning(
+                        f"send ts={ts} to {dest} failed ({exc!r}); "
+                        f"handing to the deadline sweeper"
                     )
-            self.po.van.send(msg)
+                    with self._mu:
+                        sl.retry_now = True
+                    self._wake_sweeper()
+                else:
+                    # No deadline machinery: fail the slice fast so
+                    # wait(ts) raises TimeoutError instead of hanging
+                    # on a destination the detector declared dead —
+                    # and release the doomed request's pull state (no
+                    # response will ever arrive to trigger _finish).
+                    log.warning(
+                        f"send ts={ts} to {dest} failed ({exc!r}); "
+                        f"failing the request (PS_REQUEST_TIMEOUT off)"
+                    )
+                    with self._mu:
+                        self._mark_timed_out(ts)
+                        self._recv_kvs.pop(ts, None)
+                        self._pull_dst.pop(ts, None)
+                        self._callbacks.pop(ts, None)
+                        self._zpull_ts.discard(ts)
+                    self._customer.add_response(ts, 1)
 
     def _process(self, msg: Message) -> None:
         if msg.meta.request:
             return  # workers only receive responses
         ts = msg.meta.timestamp
+        discount = False
+        retry_now = False
+        with self._mu:
+            req = self._pending.get(ts)
+            sl = None
+            if req is not None:
+                key = msg.meta.key  # responses echo the slice's first key
+                sl = next(
+                    (s for s in req.slices
+                     if len(s.part.keys) and int(s.part.keys[0]) == key),
+                    None,
+                )
+            if msg.meta.option == OPT_SEND_FAILED:
+                # The van abandoned the slice's delivery.  With retry
+                # budget left, hand it to the sweeper (and discount the
+                # synthesized response so the retry's real response
+                # completes the count); otherwise the request fails.
+                if req is not None and req.attempt < self._req_retries:
+                    discount = retry_now = True
+                    if sl is not None:
+                        sl.retry_now = True
+                    else:
+                        req.deadline = 0.0  # unmatched: expire them all
+                elif req is None and self._req_timeout > 0:
+                    # Stale give-up: with deadlines on, a missing
+                    # pending entry means the request already completed
+                    # (failover) or was already abandoned — marking it
+                    # now would make a SUCCESSFUL wait() raise.
+                    pass
+                else:
+                    self._mark_timed_out(ts)
+                    if sl is not None:
+                        sl.responded = True
+            elif sl is not None:
+                if sl.responded:
+                    # Duplicate (a slow original answered after its
+                    # retry already did): the first response per slice
+                    # is the one that counts.
+                    discount = True
+                else:
+                    sl.responded = True
+        if discount:
+            # Pre-compensate the +1 the Customer adds after this handle.
+            self._customer.add_response(ts, -1)
+            if retry_now:
+                self._wake_sweeper()
+            return
         if msg.meta.option == OPT_APPLY_ERROR:
             with self._mu:
-                self._error_ts[ts] = None
-                while len(self._error_ts) > 4096:
-                    self._error_ts.pop(next(iter(self._error_ts)))
+                self._error_ts.add(ts)
         if msg.meta.pull and len(msg.data) >= 2:
             if msg.meta.option == OPT_COMPRESS_INT8 and len(msg.data) >= 3:
                 # Server quantized the response slice; val_len carries
@@ -726,6 +1064,7 @@ class KVWorker:
             dst = self._pull_dst.pop(ts, None)
             zpull = ts in self._zpull_ts
             self._zpull_ts.discard(ts)
+            self._pending.pop(ts, None)  # retire deadline tracking
         if zpull and chunks and dst is not None and all(
             np.shares_memory(c.vals, dst[1]) for c in chunks
         ):
@@ -761,11 +1100,11 @@ class KVWorker:
     def _run_callback(self, ts: int) -> None:
         with self._mu:
             cb = self._callbacks.pop(ts, None)
-            # An error-marked response means this request's data never
-            # (fully) landed: running the completion callback would hand
-            # the caller a partially-written buffer as if it were good.
-            # The error stays recorded for wait(ts) to raise.
-            errored = ts in self._error_ts
+            # An error- or timeout-marked response means this request's
+            # data never (fully) landed: running the completion callback
+            # would hand the caller a partially-written buffer as if it
+            # were good.  The marks stay recorded for wait(ts) to raise.
+            errored = ts in self._error_ts or ts in self._timeout_ts
         if cb is not None and not errored:
             cb()
 
@@ -805,6 +1144,29 @@ class KVServer:
         self.delivered_in_place = 0
         self._apply_pool: Optional[ApplyShardPool] = None
         self._apply_shards = self._resolve_apply_shards()
+        # Chain replication (PS_KV_REPLICATION=k, docs/fault_tolerance.md):
+        # accepted pushes forward to the next k-1 servers in rank order;
+        # a recovered server restores its range from its first replica
+        # before serving.
+        self._replicator = None
+        self._restored = False
+        # While a recovered server restores its range from the replica,
+        # incoming requests PARK here (list) and replay in arrival
+        # order afterwards — applying them to the still-empty store and
+        # then overwriting with the restore snapshot would silently
+        # lose them.  None = not restoring (steady-state fast path).
+        self._restore_mu = threading.Lock()
+        self._restore_buffer: Optional[List[Message]] = None
+        rep = self.po.env.find_int("PS_KV_REPLICATION", 1)
+        if rep >= 2 and self.po.num_servers >= 2:
+            from .replication import Replicator
+
+            self._replicator = Replicator(self, rep)
+            # Rehabilitation resync: if THIS server is falsely declared
+            # dead and later forgiven, it missed every write that
+            # failed over to its replica in the window — re-restore
+            # from the replica before resuming as the range's truth.
+            self.po.register_node_failure_hook(self._on_self_rehab)
 
     def _resolve_apply_shards(self) -> int:
         try:
@@ -828,6 +1190,74 @@ class KVServer:
             self._apply_pool = ApplyShardPool(
                 handle, self._apply_shards, self
             )
+        if (self._replicator is not None and self.po.is_recovery
+                and not self._restored):
+            # Recovered server: restore this rank's key range from its
+            # first replica BEFORE serving — the old path rejoined with
+            # a silently empty store.  Requests arriving during the
+            # restore park in _restore_buffer (workers may route back
+            # the moment the recovery roster lands) and replay after
+            # the snapshot import, preserving arrival order — applying
+            # them first and then importing would overwrite them.
+            self._restored = True
+            with self._restore_mu:
+                self._restore_buffer = []
+            try:
+                self._replicator.restore(handle)
+            finally:
+                self._drain_restore_buffer()
+
+    def _on_self_rehab(self, node_id: int, down: bool) -> None:
+        if down or node_id != self.po.van.my_node.id:
+            return
+        if self._handle is None or self._replicator is None:
+            return
+        # Off-thread: this hook runs on the van's receive pump, and the
+        # resync must WAIT for fetch responses that arrive through that
+        # very pump — blocking here would deadlock the node.
+        threading.Thread(
+            target=self._resync_from_replica,
+            name="kv-rehab-resync", daemon=True,
+        ).start()
+
+    def _resync_from_replica(self) -> None:
+        with self._restore_mu:
+            if self._restore_buffer is not None:
+                return  # a restore/resync is already in flight
+            self._restore_buffer = []
+        log.warning("rehabilitated after a false death declaration; "
+                    "resyncing ranges from replicas")
+        try:
+            self._replicator.restore(self._handle)
+        except Exception as exc:  # noqa: BLE001 - keep serving regardless
+            log.warning(f"rehab resync failed: {exc!r}")
+        finally:
+            self._drain_restore_buffer()
+
+    def _drain_restore_buffer(self) -> None:
+        """Replay requests parked during a restore, in arrival order;
+        concurrent arrivals keep parking until the buffer drains dry."""
+        while True:
+            with self._restore_mu:
+                batch = self._restore_buffer
+                if not batch:
+                    self._restore_buffer = None
+                    return
+                self._restore_buffer = []
+            for msg in batch:
+                # _process_request directly (NOT _process — a replayed
+                # message must not re-park on the still-active buffer),
+                # with the normal fail-the-remote-waiter error handling.
+                try:
+                    self._process_request(msg)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(
+                        f"replayed request failed: {exc!r}"
+                    )
+                    try:
+                        self._request_error(msg, exc)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def register_recv_buffer(
         self, sender_id: int, key: int, buffer: np.ndarray
@@ -864,6 +1294,12 @@ class KVServer:
 
     def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
         """Reply to a request (kv_app.h:536-564)."""
+        if req.option == OPT_REPLICA:
+            # Replica-forwarded pushes are fire-and-forget at the app
+            # level (van-level ACKs cover delivery under PS_RESEND): a
+            # response would collide with the origin worker's timestamp
+            # numbering at the primary.
+            return
         msg = self._response_msg(req)
         m = msg.meta
         if res is not None and not res.empty():
@@ -901,6 +1337,8 @@ class KVServer:
         """Empty ``OPT_APPLY_ERROR``-marked response: the waiting worker
         still gets its response counted (so ``wait`` unblocks) and its
         ``wait`` raises instead of hanging until timeout."""
+        if req.option == OPT_REPLICA:
+            return  # no app-level responses on the replication plane
         msg = self._response_msg(req)
         # The error marker REPLACES any echoed option (OPT_ZPULL /
         # compression): an empty error response must not claim in-place
@@ -923,6 +1361,9 @@ class KVServer:
             timestamp=msg.meta.timestamp,
             customer_id=msg.meta.customer_id,
             key=msg.meta.key,
+            # Carry the option so replica-forwarded pushes stay
+            # response-free even on the error path.
+            option=msg.meta.option,
         ))
 
     def stop(self) -> None:
@@ -930,10 +1371,28 @@ class KVServer:
         if self._apply_pool is not None:
             self._apply_pool.stop()
             self._apply_pool = None
+        if self._replicator is not None:
+            self.po.unregister_node_failure_hook(self._on_self_rehab)
+            self._replicator.close()
 
     def _process(self, msg: Message) -> None:
         if msg.meta.simple_app:
             return
+        if not msg.meta.request:
+            # With replication on, servers receive responses too (the
+            # recovery restore's fetch).  Anything else is dropped: a
+            # response must never run the request handler.
+            if self._replicator is not None:
+                self._replicator.absorb_response(msg)
+            return
+        if self._restore_buffer is not None:  # unlocked fast-path probe
+            with self._restore_mu:
+                if self._restore_buffer is not None:
+                    self._restore_buffer.append(msg)
+                    return
+        self._process_request(msg)
+
+    def _process_request(self, msg: Message) -> None:
         meta = KVMeta(
             cmd=msg.meta.head,
             push=msg.meta.push,
@@ -981,6 +1440,33 @@ class KVServer:
                         : len(kvs.vals.reshape(-1).view(reg.dtype))
                     ]
         log.check(self._handle is not None, "KVServer handle not set")
+        if self._replicator is not None:
+            from .replication import REPLICA_FETCH_CMD
+
+            if meta.cmd == REPLICA_FETCH_CMD:
+                # A recovered primary fetching its range's state.
+                self._replicator.handle_fetch(meta, kvs, self)
+                return
+            if meta.push and len(kvs.keys):
+                if not self._replicator.should_apply(meta):
+                    # Duplicate origin (a worker's failover retry racing
+                    # the primary's forwarded copy, in either order):
+                    # apply nothing; still serve the pull half and ack
+                    # the waiting worker.
+                    if meta.pull:
+                        meta.push = False
+                        kvs.vals = np.empty(0, kvs.vals.dtype)
+                    else:
+                        self.response(meta)
+                        return
+                elif meta.option != OPT_REPLICA:
+                    # Accepted worker push: chain-forward before the
+                    # apply dispatch, on this (single) processing thread
+                    # so replicas see the exact arrival order.  A
+                    # registered-buffer payload is snapshotted: the pump
+                    # overwrites the shared buffer on the sender's next
+                    # push while the replica lane may still serialize.
+                    self._replicator.forward(meta, kvs, copy=reg is not None)
         if self._apply_pool is not None:
             # Sharded apply: returns immediately — the response is
             # emitted (in per-sender arrival order) by whichever shard

@@ -1,0 +1,333 @@
+"""Chain replication of accepted pushes across servers
+(``PS_KV_REPLICATION=k``, docs/fault_tolerance.md).
+
+With replication enabled, every server forwards each accepted worker
+push to the next ``k-1`` servers in rank order (the chain wraps), so a
+server's key range survives its death:
+
+- **Forwarding** happens on the server's single request-processing
+  thread, in arrival order, into ONE send lane per replica — so a
+  replica applies the primary's stream in exactly the primary's arrival
+  order.  Combined with the apply pool's shard affinity (per-key apply
+  order == arrival order, docs/apply_shards.md) the replica's store is
+  **bit-exact** with the primary's.
+- **Failover**: on a ``NODE_FAILURE`` broadcast, workers re-route the
+  dead rank's key range to its first live replica
+  (``KVWorker``'s node-failure hook), which already holds the data.
+- **Dedup**: a forwarded push carries ``OPT_REPLICA`` with the ORIGIN
+  worker id in ``meta.addr`` and the origin timestamp, so a worker's
+  failover retry of a request the primary already forwarded applies
+  exactly once (the retry and the forwarded copy share an origin
+  identity).
+- **Recovery restore**: a recovered server fetches its range's state
+  from its first replica (``REPLICA_FETCH_CMD``) before serving —
+  replacing the old silent-empty-store rejoin.
+
+Replicas never re-forward (``OPT_REPLICA`` stops the chain) and never
+emit app-level responses for forwarded pushes (``KVServer.response``
+suppresses them); delivery reliability rides the van-level resender
+when ``PS_RESEND`` is on.  Restore moves the handle's ``store`` (or the
+pair ``export_range``/``import_range`` when the handle defines them);
+optimizer slot state not exposed through those hooks restarts fresh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..message import Message, OPT_REPLICA
+from ..sarray import SArray
+from ..utils import logging as log
+from ..utils.bounded import BoundedKeySet
+
+# meta.head (cmd) marking a replica state-fetch: the request's two keys
+# are [range_begin, range_end); the response carries every stored key in
+# that range with per-key lens.
+REPLICA_FETCH_CMD = 0x5EED
+
+
+def chain_ranks(group_rank: int, k: int, num_servers: int) -> List[int]:
+    """The replica chain of a server rank: the next ``k-1`` group ranks
+    in rank order, wrapping.  THE single source of the chain topology —
+    servers use it to pick forward targets and workers to pick failover
+    destinations; two private copies would silently diverge."""
+    k = min(k, max(num_servers, 1))
+    return [
+        (group_rank + i) % num_servers
+        for i in range(1, k)
+        if (group_rank + i) % num_servers != group_rank
+    ]
+
+
+class Replicator:
+    """Per-KVServer replication engine: forwarding, origin dedup, and
+    the recovery fetch/restore protocol."""
+
+    def __init__(self, server, k: int):
+        self._server = server
+        self.po = server.po
+        self.k = min(k, max(self.po.num_servers, 1))
+        # Origin identities already applied on this server, bounded FIFO
+        # (the ack-cache pattern): (origin_sender, customer, ts, key).
+        self._applied = BoundedKeySet(max(
+            1024, self.po.env.find_int("PS_REPLICA_DEDUP_CACHE", 65536)
+        ))
+        self._mu = threading.Lock()
+        self._restore_ts: Optional[int] = None
+        self._restore_msg: Optional[Message] = None
+        self.forwarded = 0  # observability
+        self.deduped = 0
+        # A recovered WORKER restarts its timestamp sequence at 0, so
+        # its fresh pushes would collide with the dead incarnation's
+        # origin identities still in the dedup cache and be silently
+        # dropped — purge that sender's entries on recovery.
+        self.po.register_node_failure_hook(self._on_node_event)
+
+    def close(self) -> None:
+        self.po.unregister_node_failure_hook(self._on_node_event)
+
+    def _on_node_event(self, node_id: int, down: bool) -> None:
+        if down:
+            return
+        with self._mu:
+            n = self._applied.discard_where(lambda o: o[0] == node_id)
+        if n:
+            log.vlog(1, f"purged {n} dedup origins for recovered "
+                        f"node {node_id}")
+
+    # -- topology ------------------------------------------------------------
+
+    def replica_ids(self) -> List[int]:
+        """Instance ids of my next k-1 chain members, rank order."""
+        from ..base import server_rank_to_id
+
+        gs = self.po.group_size
+        my_rank = self.po.my_rank()
+        g, idx = my_rank // gs, my_rank % gs
+        return [
+            server_rank_to_id(r * gs + idx)
+            for r in chain_ranks(g, self.k, self.po.num_servers)
+        ]
+
+    # -- origin dedup --------------------------------------------------------
+
+    @staticmethod
+    def _origin(meta) -> Tuple:
+        origin_sender = meta.addr if meta.option == OPT_REPLICA else meta.sender
+        return (origin_sender, meta.customer_id, meta.timestamp, meta.key)
+
+    def should_apply(self, meta) -> bool:
+        """Record a push's origin identity; False when this origin was
+        already applied here (a worker's failover retry racing the
+        primary's forwarded copy, in either order)."""
+        origin = self._origin(meta)
+        with self._mu:
+            if not self._applied.add(origin):
+                self.deduped += 1
+                return False
+        return True
+
+    # -- forwarding (primary side) -------------------------------------------
+
+    def forward(self, meta, kvs, copy: bool = False) -> None:
+        """Chain-forward an accepted worker push to the next k-1
+        servers.  Runs on the server's single request-processing thread,
+        so forwards enter each replica's send lane in arrival order;
+        priority is pinned to one level so the lane's FIFO-within-level
+        IS the arrival order (bit-exactness depends on it).
+
+        ``copy=True`` snapshots the payload first — required when vals
+        alias a registered recv buffer, which the pump overwrites with
+        the sender's next push while the replica lane may still be
+        serializing this one."""
+        van = self.po.van
+        vals = kvs.vals.copy() if copy else kvs.vals
+        for rid in self.replica_ids():
+            if van.is_peer_down(rid):
+                continue
+            msg = Message()
+            m = msg.meta
+            m.app_id = self._server._customer.app_id
+            m.customer_id = meta.customer_id
+            m.request = True
+            m.push = True
+            m.pull = False
+            m.head = meta.cmd
+            # Origin identity rides (addr, timestamp, key): the replica
+            # dedups a worker's failover retry of this same request.
+            m.timestamp = meta.timestamp
+            m.addr = meta.sender
+            m.key = meta.key
+            m.option = OPT_REPLICA
+            m.recver = rid
+            m.priority = 0
+            msg.add_data(SArray(kvs.keys))
+            msg.add_data(SArray(vals))
+            if kvs.lens is not None:
+                msg.add_data(SArray(np.asarray(kvs.lens, dtype=np.int32)))
+            try:
+                van.send(msg)
+                self.forwarded += 1
+            except Exception as exc:  # noqa: BLE001 - replica may be down
+                log.warning(f"replica forward to {rid} failed: {exc!r}")
+
+    # -- state fetch (replica side) ------------------------------------------
+
+    def handle_fetch(self, meta, kvs, server) -> None:
+        """Serve a recovered primary's range-state fetch: every stored
+        key in [begin, end), with per-key lens."""
+        log.check(len(kvs.keys) >= 2, "replica fetch wants [begin, end)")
+        begin, end = int(kvs.keys[0]), int(kvs.keys[1])
+        handle = server._handle
+        from .kv_app import KVPairs
+
+        if callable(getattr(handle, "export_range", None)):
+            keys, vals, lens = handle.export_range(begin, end)
+        else:
+            store = getattr(handle, "store", None) or {}
+            # The apply pool's shard threads insert into the store
+            # concurrently; a bare iteration would raise "dictionary
+            # changed size during iteration" and turn the restore into
+            # a silent empty-range rejoin.  Snapshot with a short retry
+            # loop — an insert-heavy window loses the race only briefly.
+            items = None
+            for _ in range(100):
+                try:
+                    items = list(store.items())
+                    break
+                except RuntimeError:
+                    continue
+            log.check(items is not None,
+                      "could not snapshot the store for a replica fetch")
+            pairs = sorted(
+                (kk, arr) for kk, arr in items if begin <= kk < end
+            )
+            keys = np.asarray([kk for kk, _ in pairs], dtype=np.uint64)
+            lens = np.asarray([arr.size for _, arr in pairs],
+                              dtype=np.int32)
+            vals = (
+                np.concatenate([arr.reshape(-1) for _, arr in pairs])
+                if pairs else np.empty(0, np.float32)
+            )
+        log.vlog(1, f"replica fetch [{begin}, {end}): {len(keys)} keys")
+        server.response(meta, KVPairs(keys=keys, vals=vals, lens=lens))
+
+    # -- restore (recovered primary side) ------------------------------------
+
+    def absorb_response(self, msg: Message) -> bool:
+        """Intercept the in-flight restore's response (KVServer routes
+        every non-request here before discarding it)."""
+        if self._restore_ts is None or msg.meta.timestamp != self._restore_ts:
+            return False
+        self._restore_msg = msg
+        return True
+
+    def restore(self, handle, timeout_s: float = 30.0) -> int:
+        """Fetch the state of EVERY range this server holds — its own
+        key range (from its chain) plus the replica copies it keeps for
+        the ranks whose chains include it (from those primaries) — and
+        load it into ``handle``.  Run BEFORE serving, replacing the old
+        silent-empty-store recovery; restoring only the own range would
+        void the durability guarantee for the OTHER primaries' ranges
+        the moment this replica rejoined empty.  Returns the number of
+        keys restored (0 when nothing is reachable — logged, not fatal:
+        an empty rejoin is still better than refusing to rejoin)."""
+        from ..base import server_rank_to_id
+
+        gs = self.po.group_size
+        my_rank = self.po.my_rank()
+        g, idx = my_rank // gs, my_rank % gs
+        num = self.po.num_servers
+        ranges = self.po.get_server_key_ranges()
+        to_id = lambda r: server_rank_to_id(r * gs + idx)  # noqa: E731
+        total = 0
+        # My own range: fetch from my chain members.
+        total += self._fetch_range(
+            handle, ranges[g],
+            [to_id(r) for r in chain_ranks(g, self.k, num)], timeout_s,
+        )
+        # Ranges I replicate for others: fetch from the primary first,
+        # then its other chain members.
+        for r in range(num):
+            if r == g or g not in chain_ranks(r, self.k, num):
+                continue
+            total += self._fetch_range(
+                handle, ranges[r],
+                [to_id(r)] + [
+                    to_id(c) for c in chain_ranks(r, self.k, num) if c != g
+                ],
+                timeout_s,
+            )
+        return total
+
+    def _fetch_range(self, handle, rng, candidate_ids: List[int],
+                     timeout_s: float) -> int:
+        """Fetch one key range's state from the first live candidate
+        and import it into ``handle``; 0 on failure (logged)."""
+        van = self.po.van
+        rid = next(
+            (r for r in candidate_ids if not van.is_peer_down(r)), None
+        )
+        if rid is None:
+            log.warning(f"restore of [{rng.begin}, {rng.end}) skipped: "
+                        f"no live holder")
+            return 0
+        customer = self._server._customer
+        ts = customer.new_request(rid)
+        self._restore_ts = ts
+        self._restore_msg = None
+        msg = Message()
+        m = msg.meta
+        m.app_id = customer.app_id
+        m.customer_id = customer.customer_id
+        m.request = True
+        m.pull = True
+        m.head = REPLICA_FETCH_CMD
+        m.timestamp = ts
+        m.recver = rid
+        msg.add_data(SArray(np.asarray([rng.begin, rng.end], dtype=np.uint64)))
+        # Empty vals segment: the server's decode path only populates
+        # kvs.keys when the frame carries both segments.
+        msg.add_data(SArray(np.empty(0, np.float32)))
+        try:
+            van.send(msg)
+        except Exception as exc:  # noqa: BLE001 - holder died in the gap
+            log.warning(f"restore fetch to {rid} failed: {exc!r}; "
+                        f"[{rng.begin}, {rng.end}) left empty")
+            self._restore_ts = None
+            return 0
+        ok = customer.wait_request(ts, timeout=timeout_s)
+        resp, self._restore_msg, self._restore_ts = (
+            self._restore_msg, None, None
+        )
+        if not ok or resp is None:
+            log.warning(f"restore from {rid} timed out ({timeout_s}s); "
+                        f"[{rng.begin}, {rng.end}) left empty")
+            return 0
+        if len(resp.data) < 2:
+            log.vlog(1, f"restore: [{rng.begin}, {rng.end}) is empty")
+            return 0
+        keys = resp.data[0].astype_view(np.uint64).numpy()
+        vals = resp.data[1].numpy()
+        lens = (resp.data[2].astype_view(np.int32).numpy()
+                if len(resp.data) > 2 else None)
+        if callable(getattr(handle, "import_range", None)):
+            handle.import_range(keys, vals, lens)
+        else:
+            store = getattr(handle, "store", None)
+            log.check(store is not None,
+                      "replica restore needs a handle with .store or "
+                      "import_range()")
+            off = 0
+            for i, key in enumerate(keys):
+                n = int(lens[i]) if lens is not None else (
+                    len(vals) // max(len(keys), 1)
+                )
+                store[int(key)] = vals[off:off + n].copy()
+                off += n
+        log.vlog(1, f"restored {len(keys)} keys of "
+                    f"[{rng.begin}, {rng.end}) from node {rid}")
+        return len(keys)

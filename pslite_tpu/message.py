@@ -40,6 +40,10 @@ class Command(enum.IntEnum):
     ADDR_REQUEST = 7
     ADDR_RESOLVED = 8
     INSTANCE_BARRIER = 9
+    # Active failure detection (docs/fault_tolerance.md): the scheduler's
+    # detector thread broadcasts the dead node's identity to surviving
+    # peers, which mark it down and fail its parked sends fast.
+    NODE_FAILURE = 10
 
 
 # Wire dtype codes (stable across hosts; independent of numpy internals).
@@ -86,6 +90,20 @@ OPT_COMPRESS_INT8 = 1
 # instead of returning silently-unapplied data.  Without this, a handler
 # bug left the remote waiter hanging until timeout.
 OPT_APPLY_ERROR = 3
+
+# meta.option marker on a LOCALLY synthesized (empty) response: the van
+# gave up delivering the request (resender retry budget exhausted, or
+# the destination was declared dead with the message still parked in
+# its send lane).  The owning ``KVWorker.wait`` raises ``TimeoutError``
+# instead of hanging on a message the transport already abandoned.
+OPT_SEND_FAILED = 4
+
+# meta.option marker on a server→server forwarded push (chain
+# replication, kv/replication.py): the receiver applies the payload but
+# never re-forwards it and never emits an app-level response; meta.addr
+# carries the ORIGIN worker id and meta.timestamp the origin timestamp
+# so a worker's failover retry of the same request dedups exactly once.
+OPT_REPLICA = 5
 
 
 def dtype_code(dt) -> int:

@@ -64,6 +64,12 @@ class Postoffice:
         self._heartbeats: Dict[int, float] = {}
         self._heartbeat_mu = threading.Lock()
         self._start_time = time.time()
+        # Failure/recovery hooks (docs/fault_tolerance.md): apps register
+        # callbacks to learn when the failure detector declares a peer
+        # dead (down=True) or a recovered replacement rejoins
+        # (down=False).  KVWorker uses this to fail over key ranges.
+        self._node_failure_hooks: List[Callable[[int, bool], None]] = []
+        self._node_failure_mu = threading.Lock()
         self._exit_callback: Optional[Callable[[], None]] = None
         self._server_key_ranges: List[Range] = []
         self._server_key_ranges_mu = threading.Lock()
@@ -319,8 +325,16 @@ class Postoffice:
         with self._heartbeat_mu:
             self._heartbeats[node_id] = t
 
-    def get_dead_nodes(self, timeout_s: int = 60) -> List[int]:
-        """Nodes silent for > timeout_s (reference: postoffice.cc:285-304)."""
+    def get_dead_nodes(self, timeout_s: float = 60) -> List[int]:
+        """Nodes silent for > timeout_s (reference: postoffice.cc:285-304).
+
+        Never-heartbeated nodes age from their registration-time seed
+        (the scheduler seeds every registrant on ADD_NODE; non-scheduler
+        nodes seed the scheduler's entry on roster receipt) rather than
+        from process ``_start_time`` — a node that registered late must
+        get a full heartbeat window before it can be declared dead.
+        ``_start_time`` remains only as the fallback for nodes that were
+        somehow never seeded."""
         if timeout_s == 0:
             return []
         dead: List[int] = []
@@ -334,3 +348,36 @@ class Postoffice:
                 if last + timeout_s < now:
                     dead.append(node_id)
         return dead
+
+    # -- node failure hooks --------------------------------------------------
+
+    def register_node_failure_hook(
+        self, hook: Callable[[int, bool], None]
+    ) -> None:
+        """Register ``hook(node_id, down)``: called with ``down=True``
+        when the failure detector declares ``node_id`` dead, and
+        ``down=False`` when a recovered replacement rejoins under that
+        id.  Hooks run on van/detector threads — keep them fast and
+        never let them block on the van."""
+        with self._node_failure_mu:
+            self._node_failure_hooks.append(hook)
+
+    def unregister_node_failure_hook(
+        self, hook: Callable[[int, bool], None]
+    ) -> None:
+        with self._node_failure_mu:
+            try:
+                self._node_failure_hooks.remove(hook)
+            except ValueError:
+                pass
+
+    def notify_node_failure(self, node_id: int, down: bool = True) -> None:
+        """Run the failure hooks (exceptions logged, never propagated —
+        one bad hook must not stop the others or kill the van pump)."""
+        with self._node_failure_mu:
+            hooks = list(self._node_failure_hooks)
+        for hook in hooks:
+            try:
+                hook(node_id, down)
+            except Exception as exc:  # noqa: BLE001 - isolate hooks
+                log.warning(f"node failure hook failed: {exc!r}")

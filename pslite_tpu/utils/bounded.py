@@ -1,0 +1,45 @@
+"""BoundedKeySet — an insertion-ordered set with FIFO eviction.
+
+One shared implementation of the "bounded dedup window" idiom used by
+the resender's ack cache, the replicator's origin-identity cache, and
+the KV worker's error/timeout timestamp marks.  NOT thread-safe: every
+user already serializes access under its own lock.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Hashable
+
+
+class BoundedKeySet:
+    def __init__(self, cap: int):
+        self._cap = max(1, int(cap))
+        self._d: "collections.OrderedDict[Hashable, None]" = (
+            collections.OrderedDict()
+        )
+
+    def add(self, key: Hashable) -> bool:
+        """Record ``key``; returns True when it was new.  Evicts the
+        OLDEST entries beyond the cap (never the one just added)."""
+        if key in self._d:
+            return False
+        self._d[key] = None
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+        return True
+
+    def discard(self, key: Hashable) -> bool:
+        return self._d.pop(key, None) is not None or False
+
+    def discard_where(self, pred: Callable[[Hashable], bool]) -> int:
+        stale = [k for k in self._d if pred(k)]
+        for k in stale:
+            del self._d[k]
+        return len(stale)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)

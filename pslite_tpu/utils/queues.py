@@ -154,6 +154,16 @@ class LaneQueue(Generic[T]):
         with self.cv:
             self.cv.notify_all()
 
+    def drain(self) -> List[T]:
+        """Remove and return every queued item (heap order).  Used to
+        fail a dead peer's parked messages fast instead of letting them
+        sit until the drain deadline."""
+        with self.cv:
+            items = [item for _, _, item in sorted(self._heap)]
+            self._heap.clear()
+            self.cv.notify_all()
+            return items
+
     def __len__(self) -> int:
         with self.cv:
             return len(self._heap)

@@ -9,37 +9,61 @@ IPC fast path), ``multi`` (multi-rail composite).
 
 from __future__ import annotations
 
+from typing import Optional
+
+
+def transport_class(van_type: str) -> Optional[type]:
+    """Resolve a van type name to its class — THE name→class table,
+    shared by :func:`create` and the chaos wrapper (two private copies
+    would drift).  None for unknown names."""
+    if van_type in ("tcp", "zmq", "0", ""):
+        from .tcp_van import TcpVan
+
+        return TcpVan
+    if van_type == "loopback":
+        from .loopback_van import LoopbackVan
+
+        return LoopbackVan
+    if van_type == "ici":
+        from .ici_van import IciVan
+
+        return IciVan
+    if van_type in ("ici_tcp", "ici+tcp", "xla"):
+        from .ici_van import IciTcpVan
+
+        return IciTcpVan
+    if van_type in ("ici_shm", "ici+shm"):
+        from .ici_van import IciShmVan
+
+        return IciShmVan
+    if van_type == "shm":
+        from .shm_van import ShmVan
+
+        return ShmVan
+    if van_type in ("multi", "multivan"):
+        from .multi_van import MultiVan
+
+        return MultiVan
+    return None
+
 
 def create(van_type: str, postoffice):
     try:
-        if van_type in ("tcp", "zmq", "0", ""):
-            from .tcp_van import TcpVan
+        cls = transport_class(van_type)
+        if cls is not None:
+            return cls(postoffice)
+        if van_type == "chaos" or van_type.startswith("chaos+"):
+            # Chaos-injection wrapper (docs/fault_tolerance.md): wraps
+            # any socket/loopback transport with the seeded PS_CHAOS
+            # fault injector.  "chaos" alone wraps PS_CHAOS_INNER
+            # (default tcp); "chaos+shm" etc. name the inner explicitly.
+            from .chaos_van import create_chaos
 
-            return TcpVan(postoffice)
-        if van_type == "loopback":
-            from .loopback_van import LoopbackVan
-
-            return LoopbackVan(postoffice)
-        if van_type == "ici":
-            from .ici_van import IciVan
-
-            return IciVan(postoffice)
-        if van_type in ("ici_tcp", "ici+tcp", "xla"):
-            from .ici_van import IciTcpVan
-
-            return IciTcpVan(postoffice)
-        if van_type in ("ici_shm", "ici+shm"):
-            from .ici_van import IciShmVan
-
-            return IciShmVan(postoffice)
-        if van_type == "shm":
-            from .shm_van import ShmVan
-
-            return ShmVan(postoffice)
-        if van_type in ("multi", "multivan"):
-            from .multi_van import MultiVan
-
-            return MultiVan(postoffice)
+            inner = (
+                van_type.split("+", 1)[1] if "+" in van_type
+                else (postoffice.env.find("PS_CHAOS_INNER") or "tcp")
+            )
+            return create_chaos(inner, postoffice)
     except ImportError as exc:
         raise ValueError(
             f"van type {van_type!r} is not available in this build: {exc}"

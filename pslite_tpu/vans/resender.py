@@ -18,19 +18,30 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Set, Tuple
+from typing import Dict, Tuple
 
 from ..message import Command, Control, Message
 from ..utils import logging as log
+from ..utils.bounded import BoundedKeySet
 
 
 def _signature(msg: Message) -> int:
     m = msg.meta
     # Unlike the reference (which truncates ids to 8 bits — resender.h:98-100,
     # a known quirk), hash the full ids so large clusters stay collision-free.
+    # option+addr are part of the identity: replication forwards carry the
+    # ORIGIN (worker, timestamp) of the push they relay, so two forwards
+    # relaying different workers' pushes share every other field — without
+    # addr in the hash the receiver would drop the second as a duplicate.
+    # sid too: it distinguishes a deadline-sweeper RETRY (a new message,
+    # fresh sid at dispatch) from a van-level retransmit of the original
+    # (same message, sid kept) — retransmit dupes still dedup, while a
+    # retry whose original REQUEST was delivered but whose RESPONSE was
+    # lost reaches the app again instead of being silently ack-dropped.
+    # All three fields are stable across retransmits of one message.
     return hash(
         (m.app_id, m.customer_id, m.sender, m.recver, m.timestamp, m.request,
-         m.push, m.simple_app, m.key, m.control.cmd)
+         m.push, m.simple_app, m.key, m.option, m.addr, m.sid, m.control.cmd)
     ) & ((1 << 64) - 1)
 
 
@@ -41,7 +52,15 @@ class Resender:
         self._max_retries = max_retries
         self._mu = threading.Lock()
         self._send_buff: Dict[int, Tuple[Message, float, int]] = {}
-        self._acked: Set[int] = set()
+        # Receive-side dedup signatures, bounded FIFO: the reference's
+        # (and our former) unbounded set leaks ~8 bytes per message
+        # forever on long runs.  ~64k signatures cover far more in-
+        # flight traffic than any retransmit window can hold; a sig
+        # evicted this long after its ack can only dedup a duplicate
+        # that 10 retransmit timeouts have already passed by.
+        self._acked = BoundedKeySet(
+            max(1024, van.env.find_int("PS_RESEND_ACK_CACHE", 65536))
+        )
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._monitoring, name="resender", daemon=True
@@ -84,35 +103,69 @@ class Resender:
                 self._send_buff.pop(msg.meta.control.msg_sig, None)
             return True
         sig = msg.meta.control.msg_sig or _signature(msg)
-        ack = Message()
-        ack.meta.recver = msg.meta.sender
-        ack.meta.control = Control(cmd=Command.ACK, msg_sig=sig)
-        self._van.send(ack)
+        if msg.meta.sender >= 0:
+            ack = Message()
+            ack.meta.recver = msg.meta.sender
+            ack.meta.control = Control(cmd=Command.ACK, msg_sig=sig)
+            try:
+                # Runs on the receive pump: a transport error (sender
+                # died between its send and our ack) must not kill it —
+                # the sender's retransmit path owns that failure.
+                self._van.send(ack)
+            except Exception as exc:  # noqa: BLE001
+                log.vlog(1, f"ack to {msg.meta.sender} failed: {exc!r}")
         with self._mu:
-            duplicated = sig in self._acked
-            if not duplicated:
-                self._acked.add(sig)
+            duplicated = not self._acked.add(sig)
         if duplicated:
             log.vlog(2, lambda: f"Duplicated message dropped: {msg.debug_string()}")
         return duplicated
+
+    def forget(self, sig: int) -> None:
+        """Stop tracking one outgoing message (the owning request was
+        failed over to another destination; retransmitting the original
+        would only end in a spurious give-up)."""
+        with self._mu:
+            self._send_buff.pop(sig, None)
 
     def _monitoring(self) -> None:
         while not self._stop.wait(self._timeout_s / 2):
             now = time.monotonic()
             resend = []
+            gave_up = []
             with self._mu:
                 for sig, (msg, sent_at, retries) in list(self._send_buff.items()):
+                    if self._van.is_peer_down(msg.meta.recver):
+                        # The failure detector already declared the
+                        # destination dead: burning the remaining retry
+                        # budget against it only delays the owner's
+                        # failover.
+                        del self._send_buff[sig]
+                        gave_up.append((msg, retries, "peer declared dead"))
+                        continue
                     if now - sent_at <= self._timeout_s:
                         continue
                     if retries >= self._max_retries:
-                        log.warning(
-                            f"Failed to deliver after {retries} retries: "
-                            f"{msg.debug_string()}"
-                        )
                         del self._send_buff[sig]
+                        gave_up.append(
+                            (msg, retries, f"{retries} retries exhausted")
+                        )
                         continue
                     self._send_buff[sig] = (msg, now, retries + 1)
                     resend.append(msg)
+            for msg, retries, why in gave_up:
+                log.warning(
+                    f"Failed to deliver ({why}): {msg.debug_string()}"
+                )
+                # Fail the owning request (or park a van error) instead
+                # of the old silent delete, which left the waiting
+                # caller hanging forever on a message the resender had
+                # already abandoned.
+                try:
+                    self._van._delivery_failed(
+                        msg, ConnectionError(f"resender gave up: {why}")
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(f"delivery-failure report failed: {exc!r}")
             for msg in resend:
                 log.vlog(1, f"Resend {msg.debug_string()}")
                 try:

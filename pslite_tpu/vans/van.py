@@ -56,15 +56,25 @@ from ..base import (
     ALL_GROUP,
     EMPTY_ID,
     SCHEDULER_ID,
+    SERVER_GROUP,
+    WORKER_GROUP,
+    is_server_id,
     server_rank_to_id,
     worker_rank_to_id,
 )
-from ..message import Command, Control, Message, Meta, Node, Role
+from ..message import Command, Control, Message, Meta, Node, OPT_SEND_FAILED, Role
 from ..utils import logging as log
 from ..utils.network import get_ip
 from ..utils.profiling import Profiler
 from ..utils.queues import LaneQueue
 from .resender import Resender
+
+
+class PeerDeadError(ConnectionError):
+    """The destination was declared dead by the failure detector; the
+    send fails fast instead of parking on a lane that will never
+    drain.  Cleared when a recovered replacement rejoins under the
+    dead id."""
 
 
 class _SendLane:
@@ -133,6 +143,19 @@ class Van:
         self._lane_abort = False
         self._lane_error: Optional[Exception] = None
         self._lane_err_mu = threading.Lock()
+        # Active failure detection (docs/fault_tolerance.md): peers the
+        # scheduler's detector declared dead.  Data sends to a down peer
+        # raise PeerDeadError instead of parking forever; a recovered
+        # replacement clears the mark.
+        self._down_peers: Set[int] = set()
+        self._down_mu = threading.Lock()
+        self._failure_thread: Optional[threading.Thread] = None
+        self._announced_dead: Set[int] = set()  # scheduler: already broadcast
+        # Chain replication (PS_KV_REPLICATION >= 2) needs server↔server
+        # connections, which the bootstrap otherwise never establishes.
+        self._connect_server_peers = (
+            self.env.find_int("PS_KV_REPLICATION", 1) >= 2
+        )
 
     # -- transport interface -------------------------------------------------
 
@@ -166,6 +189,9 @@ class Van:
                 self._lane_stop = False  # re-arm after a prior stop()
                 self._lane_abort = False
                 self._lane_error = None
+                with self._down_mu:
+                    self._down_peers = set()
+                self._announced_dead = set()
                 with self._lanes_mu:
                     self._lanes = {}  # drop joined threads/stale lanes
                 self._init_nodes()
@@ -198,14 +224,44 @@ class Van:
                 if self.env.find_int("PS_RESEND", 0):
                     timeout_ms = self.env.find_int("PS_RESEND_TIMEOUT", 1000)
                     self.resender = Resender(self, timeout_ms)
-                interval = self.env.find_int("PS_HEARTBEAT_INTERVAL", 0)
+                interval = self.env.find_float("PS_HEARTBEAT_INTERVAL", 0)
                 if interval > 0 and not self.po.is_scheduler:
                     self._heartbeat_thread = threading.Thread(
                         target=self._heartbeat_loop, args=(interval,),
                         name="van-heartbeat", daemon=True,
                     )
                     self._heartbeat_thread.start()
+                timeout = self.heartbeat_timeout_s()
+                # interval > 0 required: with PS_HEARTBEAT_TIMEOUT set
+                # but heartbeats off (a legacy passive-recovery config),
+                # peers never beat and the detector would declare the
+                # whole healthy cluster dead.
+                if self.po.is_scheduler and timeout > 0 and interval > 0:
+                    # Active failure detection: scan the heartbeat
+                    # registry and broadcast NODE_FAILURE for silent
+                    # peers — the passive registry alone never notices a
+                    # death until a replacement re-registers.
+                    scan = max(0.2, min(timeout / 2.0, interval or timeout))
+                    self._failure_thread = threading.Thread(
+                        target=self._failure_detector_loop,
+                        args=(scan, timeout),
+                        name="van-failure-detector", daemon=True,
+                    )
+                    self._failure_thread.start()
                 self._init_stage = 2
+
+    def heartbeat_timeout_s(self) -> float:
+        """Dead-node threshold.  Enabling heartbeats implies a timeout:
+        with ``PS_HEARTBEAT_INTERVAL`` set but ``PS_HEARTBEAT_TIMEOUT``
+        unset, default to 5 intervals — heartbeating with no one ever
+        judging the beats is the passive posture this layer replaces.
+        An EXPLICIT ``PS_HEARTBEAT_TIMEOUT=0`` opts out of detection
+        entirely (the legacy heartbeats-for-monitoring-only posture)."""
+        raw = self.env.find("PS_HEARTBEAT_TIMEOUT")
+        if raw not in (None, ""):
+            return float(raw)
+        interval = self.env.find_float("PS_HEARTBEAT_INTERVAL", 0)
+        return 5.0 * interval if interval > 0 else 0.0
 
     def _init_nodes(self) -> None:
         uri = self.env.find("DMLC_PS_ROOT_URI")
@@ -260,6 +316,9 @@ class Van:
             self._recv_thread.join(timeout=10)
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=5)
+        if self._failure_thread is not None:
+            self._failure_thread.join(timeout=5)
+            self._failure_thread = None
         if self.resender is not None:
             self.resender.stop()
         self.post_stop()
@@ -318,6 +377,16 @@ class Van:
         if msg.meta.sender == EMPTY_ID:
             msg.meta.sender = self.my_node.id
         self._raise_pending_send_error()
+        if msg.meta.control.empty() and msg.meta.recver in self._down_peers:
+            # Fail fast: the destination was declared dead — parking the
+            # message on its lane would strand the caller's wait()
+            # forever.  Control messages still go through best-effort
+            # (e.g. the scheduler's roster broadcast to a possibly-slow
+            # peer must be attempted).
+            raise PeerDeadError(
+                f"node {msg.meta.recver} was declared dead by the "
+                f"failure detector"
+            )
         if (msg.meta.control.empty() and self._send_async
                 and not self._lane_stop):  # unlocked fast path; re-checked
             lane = self._lane_for(msg)
@@ -448,6 +517,179 @@ class Van:
                 return 0
         return self._transmit(msg)
 
+    # -- failure detection ---------------------------------------------------
+
+    def is_peer_down(self, node_id: int) -> bool:
+        return node_id in self._down_peers
+
+    def mark_peer_down(self, node_id: int) -> None:
+        """Declare a peer dead: future data sends to it raise
+        PeerDeadError, and every message already parked in its send
+        lane(s) fails fast (owning requests get a synthesized
+        OPT_SEND_FAILED response instead of hanging)."""
+        with self._down_mu:
+            if node_id in self._down_peers:
+                return
+            self._down_peers.add(node_id)
+        for lane in self._lanes_of(node_id):
+            for item in lane.q.drain():
+                msg, _raw = item
+                self._delivery_failed(
+                    msg, PeerDeadError(f"node {node_id} declared dead with "
+                                       f"message parked in its send lane"))
+            lane.q.wake()
+
+    def clear_peer_down(self, node_id: int) -> None:
+        with self._down_mu:
+            self._down_peers.discard(node_id)
+        self._announced_dead.discard(node_id)
+
+    def _lanes_of(self, node_id: int) -> List[_SendLane]:
+        """Every lane owned by this peer (MultiVan widens lane keys to
+        (recver, rail) tuples)."""
+        with self._lanes_mu:
+            return [
+                lane for key, lane in self._lanes.items()
+                if key == node_id
+                or (isinstance(key, tuple) and key and key[0] == node_id)
+            ]
+
+    def _delivery_failed(self, msg: Message, exc: Exception) -> None:
+        """The transport gave up on ``msg`` (resender retries exhausted,
+        or its destination died with the message still parked).  A data
+        REQUEST has a local waiter: synthesize an empty OPT_SEND_FAILED
+        response so its wait() raises instead of hanging on a message
+        the van already abandoned.  Control messages and responses have
+        no local waiter — log loudly, never park: a parked error would
+        fail the van's next unrelated send and cascade one dead peer
+        into a cluster-wide delivery collapse."""
+        m = msg.meta
+        if not m.control.empty():
+            # Control-plane give-ups (heartbeats, broadcasts) must NOT
+            # park: the parked error would poison the next unrelated
+            # send (ACKs included) and cascade one dead peer into a
+            # cluster-wide delivery collapse.  The failure detector is
+            # the authority on control-plane health — just log.
+            log.warning(
+                f"abandoned control delivery to node {m.recver}: "
+                f"{m.control.cmd.name} ({exc})"
+            )
+            return
+        if not m.request:
+            # An abandoned RESPONSE has no local waiter to fail (its
+            # destination — the requester — is the dead one); parking it
+            # would only poison the van's next healthy send.  The
+            # requester's own deadline/retry machinery owns this loss.
+            log.warning(
+                f"abandoned response delivery to node {m.recver} "
+                f"ts={m.timestamp} ({exc})"
+            )
+            return
+        log.warning(
+            f"delivery to node {m.recver} failed ({exc}); failing "
+            f"local request ts={m.timestamp}"
+        )
+        fail = Message()
+        f = fail.meta
+        f.app_id = m.app_id
+        f.customer_id = m.customer_id
+        f.timestamp = m.timestamp
+        f.sender = m.recver
+        f.recver = self.my_node.id
+        f.request = False
+        f.push = m.push
+        f.pull = m.pull
+        f.simple_app = m.simple_app
+        f.key = m.key
+        f.option = OPT_SEND_FAILED
+        try:
+            self._process_data_msg(fail)
+        except Exception as deliver_exc:  # noqa: BLE001
+            log.warning(
+                f"could not fail local request ts={m.timestamp}: "
+                f"{deliver_exc!r}"
+            )
+
+    def _failure_detector_loop(self, scan_s: float, timeout_s: float) -> None:
+        """Scheduler-side active scan: poll the heartbeat registry and
+        broadcast NODE_FAILURE for newly silent peers — the passive
+        registry the reference keeps (postoffice.cc:285-304) is only
+        ever read when a replacement registers; this thread closes the
+        detection loop."""
+        while not self._stop_event.wait(scan_s):
+            if not self.ready.is_set():
+                continue
+            dead = [d for d in self.po.get_dead_nodes(timeout_s)
+                    if d not in self._announced_dead]
+            if not dead:
+                continue
+            dead_nodes = []
+            for d in dead:
+                self._announced_dead.add(d)
+                log.warning(
+                    f"failure detector: node {d} silent for more than "
+                    f"{timeout_s}s — declaring dead"
+                )
+                self.mark_peer_down(d)
+                dead_nodes.append(Node(
+                    role=Role.SERVER if is_server_id(d) else Role.WORKER,
+                    id=d,
+                ))
+                self.po.notify_node_failure(d, True)
+            survivors = [
+                i for i in self.po.get_node_ids(SERVER_GROUP + WORKER_GROUP)
+                if i not in self._announced_dead
+            ]
+            for peer in survivors:
+                msg = Message()
+                msg.meta.recver = peer
+                msg.meta.sender = self.my_node.id
+                msg.meta.request = True
+                msg.meta.control = Control(
+                    cmd=Command.NODE_FAILURE, node=dead_nodes
+                )
+                msg.meta.timestamp = self.next_timestamp()
+                try:
+                    # _dispatch_send, not send(): a broadcast failure
+                    # must not consume a parked _lane_error, and another
+                    # peer of this roster may be dead too.
+                    self._dispatch_send(msg)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(
+                        f"NODE_FAILURE broadcast to {peer} failed: {exc!r}"
+                    )
+
+    def _process_node_failure(self, msg: Message) -> None:
+        """Peer-side handling of the scheduler's NODE_FAILURE broadcast:
+        mark the peer down, fail its parked sends, run the app hooks.
+        A NODE_REHAB_OPT-marked broadcast is the inverse (a falsely
+        declared peer heartbeat again)."""
+        if msg.meta.option == self.NODE_REHAB_OPT:
+            for node in msg.meta.control.node:
+                if node.id == self.my_node.id:
+                    # I was falsely declared dead and am now forgiven:
+                    # run the hooks so the replication layer can resync
+                    # the failover writes this node never saw.
+                    log.warning("this node was rehabilitated by the "
+                                "scheduler")
+                    self.po.notify_node_failure(node.id, False)
+                    continue
+                log.warning(f"peer {node.id} rehabilitated by the scheduler")
+                self.clear_peer_down(node.id)
+                self.po.notify_node_failure(node.id, False)
+            return
+        for node in msg.meta.control.node:
+            if node.id == self.my_node.id:
+                # Falsely declared dead (slow, not crashed): keep
+                # serving — the scheduler rehabilitates on the next
+                # heartbeat it hears.
+                log.warning("this node was declared dead by the "
+                            "scheduler; continuing to serve")
+                continue
+            log.warning(f"peer {node.id} declared dead by the scheduler")
+            self.mark_peer_down(node.id)
+            self.po.notify_node_failure(node.id, True)
+
     # -- receive loop --------------------------------------------------------
 
     def _receiving(self) -> None:
@@ -509,6 +751,8 @@ class Van:
                     self._process_barrier(msg, instance=True)
                 elif ctrl.cmd == Command.HEARTBEAT:
                     self._process_heartbeat(msg)
+                elif ctrl.cmd == Command.NODE_FAILURE:
+                    self._process_node_failure(msg)
                 elif ctrl.cmd == Command.ACK:
                     pass  # consumed by the resender when enabled
                 else:
@@ -714,7 +958,7 @@ class Van:
                 except Exception as e:
                     log.warning(f"roster resend to {known_id} failed: {e}")
                 continue
-            timeout = self.env.find_int("PS_HEARTBEAT_TIMEOUT", 0)
+            timeout = self.heartbeat_timeout_s()
             dead = [
                 d
                 for d in self.po.get_dead_nodes(timeout)
@@ -739,6 +983,11 @@ class Van:
             node.is_recovery = True
             log.vlog(1, f"recovering node {node.short_debug()}")
             self._reset_peer_sids(node.id)
+            # Rehabilitate: the replacement inherits the dead id, so the
+            # down mark (and the detector's announced set) must clear
+            # before the roster broadcast below tries to reach it.
+            self.clear_peer_down(node.id)
+            self.po.notify_node_failure(node.id, False)
             self.connect(node)
             self._registered_addrs[addr] = node.id
             self.po.update_heartbeat(node.id, time.time())
@@ -792,7 +1041,14 @@ class Van:
                 # (reference: README.md:20) — but DO connect to self
                 # (zmq_van.h:150 skips same-role only when it isn't me):
                 # the TERMINATE self-send rides that connection.
-                if node.id != self.my_node.id:
+                # Exception: chain replication needs the server peer
+                # mesh, so with PS_KV_REPLICATION >= 2 servers DO
+                # connect to their fellow servers.
+                if node.id != self.my_node.id and not (
+                    self._connect_server_peers
+                    and self.po.is_server
+                    and node.role == Role.SERVER
+                ):
                     continue
             if node.role == Role.SCHEDULER and not self.po.is_scheduler:
                 continue  # already connected during start()
@@ -801,8 +1057,16 @@ class Van:
                 # stale per-peer ordering state would stall force-order
                 # delivery forever.
                 self._reset_peer_sids(node.id)
+                self.clear_peer_down(node.id)
+                self.po.notify_node_failure(node.id, False)
             self.connect(node)
         log.check(self.my_node.id != EMPTY_ID, "scheduler did not assign my id")
+        # Seed the scheduler's heartbeat entry at registration time: the
+        # scheduler seeds every registrant on ADD_NODE; without the
+        # symmetric seed here, a non-scheduler that registered late
+        # would age the scheduler from process _start_time and could
+        # declare it dead before its first heartbeat window elapsed.
+        self.po.update_heartbeat(SCHEDULER_ID, time.time())
         self.ready.set()
 
     # -- barriers ------------------------------------------------------------
@@ -919,9 +1183,49 @@ class Van:
             except Exception as exc:
                 log.warning(f"heartbeat send failed: {exc!r}")
 
+    # meta.option on a NODE_FAILURE control marking a REHABILITATION: a
+    # falsely-declared peer heartbeat again; receivers clear the down
+    # mark instead of setting it.
+    NODE_REHAB_OPT = 0xA11E
+
     def _process_heartbeat(self, msg: Message) -> None:
         now = time.time()
         self.po.update_heartbeat(msg.meta.sender, now)
+        if self.po.is_scheduler and msg.meta.sender in self._announced_dead:
+            # A falsely-declared-dead peer (slow, not crashed) beat
+            # again: rehabilitate it everywhere — locally AND on every
+            # peer that received the NODE_FAILURE broadcast (they have
+            # no other way to learn the node is back; without this they
+            # would route around it forever).
+            log.warning(f"node {msg.meta.sender} heartbeat after being "
+                        f"declared dead — rehabilitating")
+            self.clear_peer_down(msg.meta.sender)
+            self.po.notify_node_failure(msg.meta.sender, False)
+            back = Node(
+                role=Role.SERVER if is_server_id(msg.meta.sender)
+                else Role.WORKER,
+                id=msg.meta.sender,
+            )
+            # The rehabbed node itself is INCLUDED: a falsely-declared
+            # server uses the notification to resync its range from its
+            # replica (it missed the writes that failed over during the
+            # down window).
+            for peer in self.po.get_node_ids(SERVER_GROUP + WORKER_GROUP):
+                if peer in self._announced_dead:
+                    continue
+                rehab = Message()
+                rehab.meta.recver = peer
+                rehab.meta.sender = self.my_node.id
+                rehab.meta.request = True
+                rehab.meta.option = self.NODE_REHAB_OPT
+                rehab.meta.control = Control(
+                    cmd=Command.NODE_FAILURE, node=[back]
+                )
+                rehab.meta.timestamp = self.next_timestamp()
+                try:
+                    self._dispatch_send(rehab)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(f"rehab broadcast to {peer} failed: {exc!r}")
         if msg.meta.request and self.po.is_scheduler:
             reply = Message()
             reply.meta.recver = msg.meta.sender

@@ -29,8 +29,19 @@ class LoopbackCluster:
         group_size: int = 1,
         env_extra: Optional[Dict[str, str]] = None,
         van_type: str = "loopback",
+        per_node_env: Optional[Dict[str, Dict[str, str]]] = None,
     ):
-        if van_type in (
+        """``per_node_env`` overlays extra env vars onto ONE node:
+        keys are ``"scheduler"``, ``"server<N>"`` or ``"worker<N>"``
+        (N = creation order, pre-group-size) — e.g. chaos-inject only
+        the victim server of a fault scenario."""
+        self._per_node_env = per_node_env or {}
+        # A chaos wrapper addresses like its inner transport.
+        inner_type = (
+            van_type.split("+", 1)[1] if van_type.startswith("chaos+")
+            else ("tcp" if van_type == "chaos" else van_type)
+        )
+        if inner_type in (
             # Socket-based transports, incl. the factory's alias
             # spellings (pslite_tpu/vans/__init__.py).
             "tcp", "zmq", "0", "shm", "multi", "multivan",
@@ -63,21 +74,24 @@ class LoopbackCluster:
             self.base_env.setdefault("PS_SEND_LANES", "0")
         if env_extra:
             self.base_env.update(env_extra)
-        self.scheduler = self._make(Role.SCHEDULER, 0)
+        self.scheduler = self._make(Role.SCHEDULER, 0, "scheduler")
         self.servers: List[Postoffice] = [
-            self._make(Role.SERVER, idx)
-            for _ in range(num_servers)
+            self._make(Role.SERVER, idx, f"server{n}")
+            for n in range(num_servers)
             for idx in range(group_size)
         ]
         self.workers: List[Postoffice] = [
-            self._make(Role.WORKER, idx)
-            for _ in range(num_workers)
+            self._make(Role.WORKER, idx, f"worker{n}")
+            for n in range(num_workers)
             for idx in range(group_size)
         ]
 
-    def _make(self, role: Role, instance_idx: int) -> Postoffice:
-        env = Environment(dict(self.base_env))
-        return Postoffice(role, instance_idx=instance_idx, env=env)
+    def _make(self, role: Role, instance_idx: int,
+              node_key: str = "") -> Postoffice:
+        env_map = dict(self.base_env)
+        env_map.update(self._per_node_env.get(node_key, {}))
+        return Postoffice(role, instance_idx=instance_idx,
+                          env=Environment(env_map))
 
     def all_nodes(self) -> List[Postoffice]:
         return [self.scheduler] + self.servers + self.workers

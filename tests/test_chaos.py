@@ -1,0 +1,177 @@
+"""Chaos van scenarios (docs/fault_tolerance.md): the seeded ``PS_CHAOS``
+injector — drops, delays, reorders, duplicates, one-way partitions, and
+crash-at-phase hooks — wrapped around the loopback transport, proving
+the reliability tiers (resender, deadlines, failure detector) against
+hostile links.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.base import server_rank_to_id, worker_rank_to_id
+from pslite_tpu.vans.chaos_van import ChaosPolicy, parse_spec
+from pslite_tpu.utils.logging import CheckError
+
+from helpers import LoopbackCluster
+
+
+def test_spec_grammar():
+    spec = parse_spec(
+        "seed=42,drop=0.2,send_drop=0.1,delay=1:20,send_delay=5,"
+        "reorder=0.1,dup=0.05,part=9>8;8>9,crash=recv:50"
+    )
+    assert spec["seed"] == 42
+    assert spec["drop"] == 0.2
+    assert spec["send_drop"] == 0.1
+    assert spec["delay"] == (0.001, 0.02)
+    assert spec["send_delay"] == (0.005, 0.005)
+    assert spec["reorder"] == 0.1
+    assert spec["dup"] == 0.05
+    assert spec["partitions"] == {(9, 8), (8, 9)}
+    assert spec["crash_phase"] == "recv"
+    assert spec["crash_after"] == 50
+    assert parse_spec("")["crash_phase"] is None
+    for bad in ("drop=1.5", "crash=apply:3", "frob=1", "drop"):
+        with pytest.raises(CheckError):
+            parse_spec(bad)
+
+
+def test_policy_seeded_determinism():
+    """Same seed + node id => identical decision stream (scenarios
+    replay bit-identically); different node ids diverge."""
+    a = ChaosPolicy("seed=7,drop=0.5")
+    b = ChaosPolicy("seed=7,drop=0.5")
+    c = ChaosPolicy("seed=7,drop=0.5")
+    seq_a = [a.draw(9, "drop") for _ in range(64)]
+    seq_b = [b.draw(9, "drop") for _ in range(64)]
+    seq_c = [c.draw(11, "drop") for _ in range(64)]
+    assert seq_a == seq_b
+    assert seq_a != seq_c
+
+
+def test_crash_counter_phases():
+    p = ChaosPolicy("crash=recv:2")
+    for _ in range(2):
+        p.count_data("recv")
+    assert not p.crashed.is_set()
+    p.count_data("send")  # wrong phase: no effect
+    assert not p.crashed.is_set()
+    p.count_data("recv")
+    assert p.crashed.is_set()
+    assert p.crash_blocks("recv") and not p.crash_blocks("send")
+
+
+def test_chaos_matrix_healed_by_resender():
+    """drop + delay + reorder + dup on every node, healed end-to-end by
+    PS_RESEND acks/retransmits/dedup: the store still sums exactly."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=2, van_type="chaos+loopback",
+        env_extra={
+            "PS_CHAOS": "seed=11,drop=0.15,delay=0.5:2,reorder=0.1,dup=0.1",
+            "PS_RESEND": "1",
+            "PS_RESEND_TIMEOUT": "60",
+        },
+    )
+    cluster.start()
+    servers = []
+    try:
+        for po in cluster.servers:
+            s = KVServer(0, postoffice=po)
+            s.set_request_handle(KVServerDefaultHandle())
+            servers.append(s)
+        worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+        keys = np.array([3, 2**63 + 9], dtype=np.uint64)  # both ranges
+        vals = np.ones(32, dtype=np.float32)
+        rounds = 8
+        for _ in range(rounds):
+            worker.wait(worker.push(keys, vals))
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        np.testing.assert_allclose(out, rounds * vals)
+        injected = sum(
+            sum(po.van.chaos_stats.values()) for po in cluster.all_nodes()
+        )
+        assert injected > 0, "chaos injected nothing — spec inert?"
+        worker.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_oneway_partition_times_out():
+    """A one-way partition worker->server starves the request path even
+    though responses/acks could flow back: the resender exhausts and the
+    wait fails with TimeoutError instead of hanging."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="chaos+loopback",
+        env_extra={
+            "PS_CHAOS": f"part={worker_rank_to_id(0)}>{server_rank_to_id(0)}",
+            "PS_RESEND": "1",
+            "PS_RESEND_TIMEOUT": "40",
+        },
+    )
+    cluster.start()
+    srv = KVServer(0, postoffice=cluster.servers[0])
+    srv.set_request_handle(KVServerDefaultHandle())
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            worker.wait(worker.push(np.array([3], dtype=np.uint64),
+                                    np.ones(8, dtype=np.float32)))
+        assert time.monotonic() - t0 < 30.0
+        # The edge is cut at the sender: the worker's van swallowed the
+        # sends (the server-side recv filter covers asymmetric deploys
+        # where only one endpoint carries the spec).
+        assert cluster.workers[0].van.chaos_stats["send_partitioned"] > 0
+        assert cluster.servers[0].van.chaos_stats["recv_partitioned"] == 0
+    finally:
+        worker.stop()
+        srv.stop()
+        for po in cluster.all_nodes():
+            po.van.stop()
+
+
+def test_crash_hook_deaf_server_detected_and_bounded():
+    """crash=recv:N — after N data messages the server goes deaf and
+    stops heartbeating: later requests time out within their deadline
+    budget, and the scheduler's detector declares the node dead."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="chaos+loopback",
+        env_extra={
+            "PS_HEARTBEAT_INTERVAL": "0.3",
+            "PS_HEARTBEAT_TIMEOUT": "1.0",
+            "PS_REQUEST_TIMEOUT": "0.3",
+            "PS_REQUEST_RETRIES": "1",
+        },
+        per_node_env={"server0": {"PS_CHAOS": "crash=recv:3"}},
+    )
+    cluster.start()
+    srv = KVServer(0, postoffice=cluster.servers[0])
+    srv.set_request_handle(KVServerDefaultHandle())
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.array([3], dtype=np.uint64)
+    vals = np.ones(8, dtype=np.float32)
+    try:
+        for _ in range(3):
+            worker.wait(worker.push(keys, vals))
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            worker.wait(worker.push(keys, vals))
+        assert time.monotonic() - t0 < 10.0
+        assert cluster.servers[0].van.chaos_crashed.is_set()
+        deadline = time.monotonic() + 15
+        while (not cluster.scheduler.get_dead_nodes(1.0)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert server_rank_to_id(0) in cluster.scheduler.get_dead_nodes(1.0)
+        assert cluster.servers[0].van.chaos_stats["heartbeat_suppressed"] > 0
+    finally:
+        worker.stop()
+        srv.stop()
+        for po in cluster.all_nodes():
+            po.van.stop()

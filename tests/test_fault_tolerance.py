@@ -1,20 +1,26 @@
-"""Fault-tolerance tier: drop injection + resender, heartbeats, recovery.
+"""Fault-tolerance tier: drop injection + resender, heartbeats, recovery,
+active failure detection, and bounded requests.
 
 Mirrors the reference's reliability machinery: ``PS_DROP_MSG`` receive-side
 drop injection exercising the Resender (van.cc:652-658, src/resender.h),
 heartbeat-based dead-node detection (postoffice.cc:285-304), and dead-id
-reassignment recovery (van.cc:266-332).
+reassignment recovery (van.cc:266-332) — plus the ACTIVE tier this repo
+adds on top (docs/fault_tolerance.md): the scheduler's failure-detector
+scan + NODE_FAILURE broadcast, request deadlines surfacing TimeoutError
+through ``wait``, and the resender's delivery-failure reporting.
 """
 
 import time
 
 import numpy as np
+import pytest
 
 from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
-from pslite_tpu.base import server_rank_to_id
+from pslite_tpu.base import SCHEDULER_ID, server_rank_to_id
 from pslite_tpu.environment import Environment
 from pslite_tpu.message import Role
 from pslite_tpu.postoffice import Postoffice
+from pslite_tpu.vans.resender import Resender
 
 from helpers import LoopbackCluster
 
@@ -166,3 +172,225 @@ def test_two_dead_nodes_recovery_honors_preferred_rank():
                 po.van.stop()
             except Exception:
                 pass
+
+
+def test_heartbeat_timeout_implied_by_interval():
+    """Enabling PS_HEARTBEAT_INTERVAL implies a PS_HEARTBEAT_TIMEOUT
+    (5 intervals) — heartbeating with no one judging the beats is the
+    passive posture the detector replaces."""
+    po = Postoffice(Role.SCHEDULER, env=Environment({
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "lo", "DMLC_PS_ROOT_PORT": "1",
+        "PS_VAN_TYPE": "loopback",
+        "PS_HEARTBEAT_INTERVAL": "2",
+    }))
+    assert po.van.heartbeat_timeout_s() == 10.0
+    # An explicit timeout wins over the implied default.
+    po2 = Postoffice(Role.SCHEDULER, env=Environment({
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "lo", "DMLC_PS_ROOT_PORT": "1",
+        "PS_VAN_TYPE": "loopback",
+        "PS_HEARTBEAT_INTERVAL": "2", "PS_HEARTBEAT_TIMEOUT": "3",
+    }))
+    assert po2.van.heartbeat_timeout_s() == 3.0
+    # An EXPLICIT 0 opts out of detection (monitoring-only heartbeats).
+    po3 = Postoffice(Role.SCHEDULER, env=Environment({
+        "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+        "DMLC_PS_ROOT_URI": "lo", "DMLC_PS_ROOT_PORT": "1",
+        "PS_VAN_TYPE": "loopback",
+        "PS_HEARTBEAT_INTERVAL": "2", "PS_HEARTBEAT_TIMEOUT": "0",
+    }))
+    assert po3.van.heartbeat_timeout_s() == 0.0
+
+
+def test_registration_seeds_heartbeat_entries():
+    """Heartbeat entries are seeded at registration time on BOTH sides:
+    the scheduler seeds every registrant (pre-existing) and every
+    non-scheduler seeds the scheduler on roster receipt — so a
+    late-registering node cannot be aged from process start and
+    declared dead before its first heartbeat window."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    try:
+        # No PS_HEARTBEAT_INTERVAL: the only entries are the seeds.
+        assert set(cluster.scheduler._heartbeats) >= {8, 9}
+        for po in cluster.servers + cluster.workers:
+            assert SCHEDULER_ID in po._heartbeats
+            assert po.get_dead_nodes(timeout_s=30) == []
+    finally:
+        cluster.finalize()
+
+
+def test_failure_detector_broadcast_marks_peers_down():
+    """The scheduler's scan thread notices a silent server and
+    broadcasts NODE_FAILURE: surviving peers mark it down, run the
+    postoffice hook registry, and fail sends to it fast."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=2,
+        env_extra={
+            "PS_HEARTBEAT_INTERVAL": "0.3",
+            "PS_HEARTBEAT_TIMEOUT": "1.0",
+        },
+    )
+    cluster.start()
+    worker_po = cluster.workers[0]
+    events = []
+    worker_po.register_node_failure_hook(
+        lambda nid, down: events.append((nid, down))
+    )
+    victim = next(
+        po for po in cluster.servers
+        if po.van.my_node.id == server_rank_to_id(1)
+    )
+    try:
+        victim.van.stop()  # crash: heartbeats cease
+        deadline = time.monotonic() + 15
+        dead_id = server_rank_to_id(1)
+        while ((dead_id, True) not in events
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert (dead_id, True) in events
+        assert worker_po.van.is_peer_down(dead_id)
+        # Survivors are NOT down.
+        assert not worker_po.van.is_peer_down(server_rank_to_id(0))
+    finally:
+        for po in [cluster.scheduler, cluster.workers[0]] + [
+            s for s in cluster.servers if s is not victim
+        ]:
+            po.van.stop()
+
+
+def test_wait_raises_timeout_against_killed_server():
+    """A push to a dead server must surface TimeoutError through the
+    existing wait(ts) path instead of hanging forever."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={
+            "PS_HEARTBEAT_INTERVAL": "0.3",
+            "PS_HEARTBEAT_TIMEOUT": "1.0",
+            "PS_REQUEST_TIMEOUT": "0.3",
+            "PS_REQUEST_RETRIES": "2",
+        },
+    )
+    cluster.start()
+    srv = KVServer(0, postoffice=cluster.servers[0])
+    srv.set_request_handle(KVServerDefaultHandle())
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.array([3], dtype=np.uint64)
+    vals = np.ones(8, dtype=np.float32)
+    try:
+        worker.wait(worker.push(keys, vals))  # healthy round first
+        cluster.servers[0].van.stop()  # crash
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            worker.wait(worker.push(keys, vals))
+        # Bounded: timeout*2^1 + timeout*2^2 + slack, nowhere near a hang.
+        assert time.monotonic() - t0 < 10.0
+        # Callbacks for abandoned requests are suppressed.
+        fired = []
+        with pytest.raises(TimeoutError):
+            worker.wait(worker.push(keys, vals,
+                                    callback=lambda: fired.append(1)))
+        assert not fired
+    finally:
+        worker.stop()
+        srv.stop()
+        for po in [cluster.scheduler, cluster.workers[0]]:
+            po.van.stop()
+
+
+def test_resender_exhaustion_fails_owning_request():
+    """When the resender's retry budget runs out, the owning request is
+    failed (synthesized OPT_SEND_FAILED response -> TimeoutError) — the
+    old behavior was log.warning + silent delete, leaving the caller
+    hanging forever."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"PS_RESEND": "1", "PS_RESEND_TIMEOUT": "40"},
+    )
+    cluster.start()
+    srv = KVServer(0, postoffice=cluster.servers[0])
+    srv.set_request_handle(KVServerDefaultHandle())
+    worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+    keys = np.array([3], dtype=np.uint64)
+    vals = np.ones(8, dtype=np.float32)
+    try:
+        worker.wait(worker.push(keys, vals))
+        cluster.servers[0].van.stop()  # endpoint gone: sends now fail
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            # 10 retries x 40ms ~= 0.4s, then the give-up fails the ts.
+            worker.wait(worker.push(keys, vals))
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        worker.stop()
+        srv.stop()
+        for po in [cluster.scheduler, cluster.workers[0]]:
+            po.van.stop()
+
+
+def test_resender_ack_cache_bounded():
+    """The receive-side dedup signature set is bounded FIFO
+    (PS_RESEND_ACK_CACHE) — it used to grow without limit forever."""
+    class _FakeVan:
+        env = Environment({"PS_RESEND_ACK_CACHE": "1024"})
+
+        @staticmethod
+        def send(msg):
+            pass
+
+        @staticmethod
+        def is_peer_down(node_id):
+            return False
+
+    r = Resender(_FakeVan(), timeout_ms=10_000)
+    try:
+        from pslite_tpu.message import Message
+
+        for i in range(3000):
+            msg = Message()
+            msg.meta.sender = 9
+            msg.meta.recver = 8
+            msg.meta.timestamp = i
+            assert not r.add_incoming(msg)  # first sighting: not a dup
+        assert len(r._acked) == 1024
+        # Recent signatures still dedup.
+        dup = Message()
+        dup.meta.sender = 9
+        dup.meta.recver = 8
+        dup.meta.timestamp = 2999
+        assert r.add_incoming(dup)
+    finally:
+        r.stop()
+
+
+def test_false_positive_rehabilitation_reaches_peers():
+    """A peer falsely declared dead (slow, not crashed) is
+    rehabilitated on its next heartbeat — on the scheduler AND on every
+    peer that received the NODE_FAILURE broadcast (they have no other
+    way to learn the node is back)."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"PS_HEARTBEAT_INTERVAL": "0.2",
+                   "PS_HEARTBEAT_TIMEOUT": "30"},
+    )
+    cluster.start()
+    victim_id = server_rank_to_id(0)
+    sched_van = cluster.scheduler.van
+    worker_van = cluster.workers[0].van
+    try:
+        # Simulate a past false declaration: scheduler announced it,
+        # the worker heard the broadcast and marked the peer down.
+        sched_van._announced_dead.add(victim_id)
+        sched_van.mark_peer_down(victim_id)
+        worker_van.mark_peer_down(victim_id)
+        # The (alive) server's next heartbeat rehabilitates everywhere.
+        deadline = time.monotonic() + 10
+        while (worker_van.is_peer_down(victim_id)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert not sched_van.is_peer_down(victim_id)
+        assert not worker_van.is_peer_down(victim_id)
+        assert victim_id not in sched_van._announced_dead
+    finally:
+        cluster.finalize()

@@ -1,0 +1,257 @@
+"""Server chain replication (PS_KV_REPLICATION, kv/replication.py):
+forwarding bit-exactness, worker failover routing, recovered-server
+state restore, and the kill-a-server-mid-push-storm acceptance scenario
+(chaos crash hook + deadlines + replication, docs/fault_tolerance.md).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.base import server_rank_to_id
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import Role
+from pslite_tpu.postoffice import Postoffice
+
+from helpers import LoopbackCluster
+
+# Keys in server rank 0's range and rank 1's range (uniform split of
+# the uint64 key space over 2 servers).
+K0 = np.array([7, 42], dtype=np.uint64)
+K1 = np.array([2**63 + 5, 2**63 + 77], dtype=np.uint64)
+
+FT_ENV = {
+    "PS_KV_REPLICATION": "2",
+    "PS_HEARTBEAT_INTERVAL": "0.3",
+    "PS_HEARTBEAT_TIMEOUT": "1.0",
+    "PS_REQUEST_TIMEOUT": "0.5",
+    "PS_REQUEST_RETRIES": "5",
+}
+
+
+def _spin_up(cluster):
+    servers = []
+    for po in cluster.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    workers = [
+        KVWorker(0, 0, postoffice=po) for po in cluster.workers
+    ]
+    return servers, workers
+
+
+def _by_rank(servers, rank):
+    return next(
+        s for s in servers
+        if s.po.van.my_node.id == server_rank_to_id(rank)
+    )
+
+
+def _crash_teardown(cluster, servers, workers, dead_pos=()):
+    for w in workers:
+        w.stop()
+    for s in servers:
+        if s.po not in dead_pos:
+            s.stop()
+    # Stop EVERY van, dead ones included (idempotent): a chaos-crashed
+    # victim's heartbeat/resender threads otherwise outlive the test and
+    # spam delivery-failure warnings into the interpreter shutdown.
+    for po in cluster.all_nodes():
+        try:
+            po.van.stop()
+        except Exception:
+            pass
+
+
+def test_chain_forward_bit_exact():
+    """Each accepted push chain-forwards to the next rank; because the
+    forward stream preserves the primary's arrival order and the apply
+    pool keys per-key order to arrival order, the replica's stored
+    arrays are BIT-exact with the primary's."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=2,
+                              env_extra={"PS_KV_REPLICATION": "2"})
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    worker = workers[0]
+    try:
+        rng = np.random.default_rng(5)
+        for _ in range(6):
+            worker.wait(worker.push(
+                K0, rng.standard_normal(2 * 16).astype(np.float32)))
+            worker.wait(worker.push(
+                K1, rng.standard_normal(2 * 16).astype(np.float32)))
+        deadline = time.monotonic() + 5
+        primary = _by_rank(servers, 0)
+        replica = _by_rank(servers, 1)
+        while (time.monotonic() < deadline
+               and not all(int(k) in replica._handle.store for k in K0)):
+            time.sleep(0.05)  # forwards are async
+        for k in K0:
+            # Bit-exact: float sums applied in the identical order.
+            np.testing.assert_array_equal(
+                replica._handle.store[int(k)],
+                primary._handle.store[int(k)],
+            )
+        for k in K1:  # the chain wraps: rank1 forwards to rank0
+            np.testing.assert_array_equal(
+                primary._handle.store[int(k)],
+                replica._handle.store[int(k)],
+            )
+        assert primary._replicator.forwarded > 0
+    finally:
+        for w in workers:
+            w.stop()
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_failover_pull_and_push_after_kill():
+    """After the detector declares rank 0 dead, the worker re-routes
+    rank 0's key range to its first replica: pulls return the replicated
+    values, pushes keep applying, and nothing hangs."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=2,
+                              env_extra=FT_ENV)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    worker = workers[0]
+    victim_po = _by_rank(servers, 0).po
+    vals = np.ones(2 * 16, dtype=np.float32)
+    try:
+        for _ in range(3):
+            worker.wait(worker.push(K0, vals))
+        time.sleep(0.3)  # let forwards drain
+        victim_po.van.stop()  # crash
+        deadline = time.monotonic() + 15
+        while (server_rank_to_id(0) not in worker._down_servers
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert server_rank_to_id(0) in worker._down_servers
+        out = np.zeros_like(vals)
+        t0 = time.monotonic()
+        worker.wait(worker.pull(K0, out))
+        assert time.monotonic() - t0 < 5.0
+        np.testing.assert_array_equal(out, 3 * vals)
+        # Pushes to the dead rank's range apply on the replica too.
+        worker.wait(worker.push(K0, vals))
+        out2 = np.zeros_like(vals)
+        worker.wait(worker.pull(K0, out2))
+        np.testing.assert_array_equal(out2, 4 * vals)
+    finally:
+        _crash_teardown(cluster, servers, workers, dead_pos=(victim_po,))
+
+
+def test_recovered_server_restores_range_from_replica():
+    """A recovered server pulls its range's state from its first
+    replica BEFORE serving (REPLICA_FETCH) — replacing the old silently
+    empty rejoin — and workers route back to it on recovery."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=2,
+                              env_extra=FT_ENV)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    worker = workers[0]
+    victim_po = _by_rank(servers, 0).po
+    vals = np.arange(2 * 16, dtype=np.float32)
+    try:
+        worker.wait(worker.push(K0, vals))
+        time.sleep(0.3)  # forwards drain
+        victim_po.van.stop()
+        deadline = time.monotonic() + 15
+        while (server_rank_to_id(0) not in worker._down_servers
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert server_rank_to_id(0) in worker._down_servers
+
+        repl_po = Postoffice(Role.SERVER, env=Environment(
+            dict(cluster.base_env, **FT_ENV)))
+        repl_po.start(0)
+        assert repl_po.is_recovery
+        assert repl_po.van.my_node.id == server_rank_to_id(0)
+        handle = KVServerDefaultHandle()
+        repl_srv = KVServer(0, postoffice=repl_po)
+        repl_srv.set_request_handle(handle)  # restore happens here
+        np.testing.assert_array_equal(handle.store[7], vals[:16])
+        np.testing.assert_array_equal(handle.store[42], vals[16:])
+
+        # The worker heard the recovery broadcast: rank 0 serves again.
+        deadline = time.monotonic() + 15
+        while (server_rank_to_id(0) in worker._down_servers
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert server_rank_to_id(0) not in worker._down_servers
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(K0, out))
+        np.testing.assert_array_equal(out, vals)
+        repl_srv.stop()
+        repl_po.van.stop()
+    finally:
+        _crash_teardown(cluster, servers, workers, dead_pos=(victim_po,))
+
+
+def test_kill_server_mid_push_storm_acceptance():
+    """The acceptance scenario: a server crashes (chaos crash hook)
+    mid-push-storm with PS_KV_REPLICATION=2 and PS_REQUEST_TIMEOUT set.
+    Every worker completes, no wait() blocks past its retry budget, and
+    the failed rank's key range served by the replica is bit-exact with
+    a fault-free run of the identical schedule."""
+    rounds, crash_after = 12, 8
+    vals = np.ones(2 * 16, dtype=np.float32)  # exact float additions
+
+    def run_storm(chaos: bool):
+        per_node = (
+            {"server0": {"PS_CHAOS": f"crash=recv:{crash_after}"}}
+            if chaos else {}
+        )
+        cluster = LoopbackCluster(
+            num_workers=2, num_servers=2,
+            van_type="chaos+loopback" if chaos else "loopback",
+            env_extra=dict(FT_ENV, PS_RESEND="1",
+                           PS_RESEND_TIMEOUT="200"),
+            per_node_env=per_node,
+        )
+        cluster.start()
+        servers, workers = _spin_up(cluster)
+        victim_po = _by_rank(servers, 0).po
+        max_wait = [0.0]
+        errors = []
+
+        def storm(w):
+            try:
+                for _ in range(rounds):
+                    for keys in (K0, K1):
+                        t0 = time.monotonic()
+                        w.wait(w.push(keys, vals))
+                        max_wait[0] = max(
+                            max_wait[0], time.monotonic() - t0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=storm, args=(w,), daemon=True)
+                   for w in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "storm hung"
+        assert not errors, f"storm waits failed: {errors!r}"
+        time.sleep(1.0)  # replication forwards drain
+        out = np.zeros_like(vals)
+        workers[0].wait(workers[0].pull(K0, out))
+        dead = (victim_po,) if chaos else ()
+        _crash_teardown(cluster, servers, workers, dead_pos=dead)
+        if chaos:
+            assert victim_po.van.chaos_crashed.is_set(), \
+                "victim never crashed — scenario inert"
+        return out, max_wait[0]
+
+    faulty, faulty_max_wait = run_storm(chaos=True)
+    clean, _ = run_storm(chaos=False)
+    # Bit-exact: the replica-served range equals the fault-free run.
+    np.testing.assert_array_equal(faulty, clean)
+    np.testing.assert_array_equal(clean, 2 * rounds * vals)
+    # No wait() blocked past its deadline budget: detection (~1.3s) +
+    # backoff retries, far below the 120s join that would mark a hang.
+    assert faulty_max_wait < 60.0, f"wait took {faulty_max_wait:.1f}s"
