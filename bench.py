@@ -1049,6 +1049,23 @@ def main() -> None:
                     if serial > 0 and sharded > 0 else None),
             }
 
+        def sec_kv_telemetry():
+            # Registry snapshot embedded in the emitted record
+            # (docs/observability.md): a live loopback KV storm's
+            # counters + histogram quantiles (queue depths, apply
+            # latency, retransmits) land next to the throughput numbers
+            # so perf regressions come with their context for free.
+            from pslite_tpu.benchmark import kv_loopback_storm
+
+            storm = kv_loopback_storm(
+                msgs_per_worker=20 if quick else 60
+            )
+            return {
+                "kv_storm_msgs_per_s": storm["msgs_per_s"],
+                "kv_storm_wall_s": storm["wall_s"],
+                "telemetry": storm["telemetry"],
+            }
+
         def sec_fault_recovery():
             # Recovery path gets a tracked number like the perf paths:
             # server kill -> detector broadcast -> failover pull success
@@ -1068,6 +1085,7 @@ def main() -> None:
             rec.run("latency", sec_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("kv_telemetry", sec_kv_telemetry)
             rec.run("fault_recovery", sec_fault_recovery)
         else:
             headline_ok = rec.run("headline", sec_headline)
@@ -1081,6 +1099,7 @@ def main() -> None:
             rec.run("van_latency", sec_van_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("kv_telemetry", sec_kv_telemetry)
             rec.run("fault_recovery", sec_fault_recovery)
             rec.run("stress", sec_stress)
             rec.run("hbm_peak", sec_hbm_peak)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -327,6 +328,137 @@ def apply_storm_rates(num_shards: int, n_workers: int = 4,
     return best if best is not None else 0.0
 
 
+def _loopback_cluster(num_workers: int, num_servers: int, ns: str,
+                      env_extra: Optional[dict] = None) -> list:
+    """Boot an in-process loopback cluster and return its started
+    Postoffices as ``[scheduler, *servers, *workers]`` — the shared
+    harness of the host-side KV benches (storm, fault recovery, psmon
+    demo)."""
+    import threading
+
+    from .environment import Environment
+    from .message import Role
+    from .postoffice import Postoffice
+
+    env_map = {
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "DMLC_PS_ROOT_URI": "lo",
+        "DMLC_PS_ROOT_PORT": str(42000 + os.getpid() % 1000),
+        "DMLC_NODE_HOST": "lo",
+        "PS_VAN_TYPE": "loopback",
+        "PS_LOOPBACK_NS": f"{ns}-{os.getpid()}",
+    }
+    if env_extra:
+        env_map.update(env_extra)
+    nodes = [Postoffice(Role.SCHEDULER, env=Environment(dict(env_map)))]
+    nodes += [Postoffice(Role.SERVER, env=Environment(dict(env_map)))
+              for _ in range(num_servers)]
+    nodes += [Postoffice(Role.WORKER, env=Environment(dict(env_map)))
+              for _ in range(num_workers)]
+    threads = [threading.Thread(target=po.start, args=(0,), daemon=True)
+               for po in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return nodes
+
+
+def _teardown_cluster(nodes: list, workers: list, servers: list) -> None:
+    for w in workers:
+        try:
+            w.stop()
+        except Exception:
+            pass
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    for po in nodes:
+        try:
+            po.van.stop()
+        except Exception:
+            pass
+
+
+def _condense_snapshot(snap: dict) -> dict:
+    """Registry snapshot condensed for a bench record: counters plus
+    histogram quantiles (the raw buckets stay out of the JSON)."""
+    m = snap.get("metrics", snap)
+    return {
+        "counters": m.get("counters", {}),
+        "gauges": m.get("gauges", {}),
+        "histograms": {
+            name: {q: h.get(q) for q in
+                   ("count", "p50", "p90", "p99", "max")}
+            for name, h in m.get("histograms", {}).items()
+        },
+        "topk": m.get("topk", {}),
+    }
+
+
+def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
+                      msgs_per_worker: int = 50, keys_per_msg: int = 8,
+                      val_len: int = 1024, telemetry: bool = True,
+                      env_extra: Optional[dict] = None) -> dict:
+    """A full message-path push/pull storm over a live loopback cluster
+    (real bootstrap, real wire format, real apply pool) — the stub
+    bench the telemetry-overhead guard compares on, and the source of
+    the registry snapshot bench.py embeds next to its throughput
+    numbers.
+
+    The returned ``wall_s`` clocks ONLY the storm (bootstrap excluded);
+    ``telemetry`` is the per-node snapshot of every node after the
+    storm ({} when disabled).
+    """
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+
+    env = {"PS_TELEMETRY": "1" if telemetry else "0"}
+    if env_extra:
+        env.update(env_extra)
+    nodes = _loopback_cluster(n_workers, n_servers, "kv-storm", env)
+    servers = []
+    workers = []
+    try:
+        for po in nodes[1:1 + n_servers]:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        workers = [KVWorker(0, 0, postoffice=po)
+                   for po in nodes[1 + n_servers:]]
+        span = (1 << 64) // max(keys_per_msg, 1)
+        keys = np.arange(keys_per_msg, dtype=np.uint64) * span + 3
+        vals = np.ones(keys_per_msg * val_len, np.float32)
+        outs = [np.zeros_like(vals) for _ in workers]
+        t0 = time.perf_counter()
+        for i in range(msgs_per_worker):
+            tss = [w.push(keys, vals) for w in workers]
+            for w, ts in zip(workers, tss):
+                w.wait(ts)
+            if i % 10 == 9:
+                for w, out in zip(workers, outs):
+                    w.wait(w.pull(keys, out))
+        wall = time.perf_counter() - t0
+        total = n_workers * msgs_per_worker
+        tel = {}
+        if telemetry:
+            for po in nodes:
+                snap = po.telemetry_snapshot()
+                tel[f"{snap['role']}{snap['node_id']}"] = (
+                    _condense_snapshot(snap)
+                )
+        return {
+            "wall_s": round(wall, 4),
+            "msgs": total,
+            "msgs_per_s": round(total / max(wall, 1e-9), 1),
+            "telemetry": tel,
+        }
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+
+
 def fault_recovery_times(quick: bool = True) -> dict:
     """End-to-end recovery latency of the fault-tolerance tier
     (docs/fault_tolerance.md), over an in-process loopback cluster —
@@ -343,38 +475,19 @@ def fault_recovery_times(quick: bool = True) -> dict:
       range completes against the replica (the failover hot path).
     - ``kill_to_pull_s``: the sum the application experiences.
     """
-    import threading
-
-    from .environment import Environment
     from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
-    from .message import Role
-    from .postoffice import Postoffice
 
     hb_interval, hb_timeout = (0.2, 0.8) if quick else (0.3, 1.0)
-    env_map = {
-        "DMLC_NUM_WORKER": "1",
-        "DMLC_NUM_SERVER": "2",
-        "DMLC_PS_ROOT_URI": "lo",
-        "DMLC_PS_ROOT_PORT": str(41000 + os.getpid() % 1000),
-        "DMLC_NODE_HOST": "lo",
-        "PS_VAN_TYPE": "loopback",
-        "PS_LOOPBACK_NS": f"fault-recovery-{os.getpid()}",
-        "PS_KV_REPLICATION": "2",
-        "PS_HEARTBEAT_INTERVAL": str(hb_interval),
-        "PS_HEARTBEAT_TIMEOUT": str(hb_timeout),
-        "PS_REQUEST_TIMEOUT": "0.5",
-        "PS_REQUEST_RETRIES": "5",
-    }
-    nodes = [Postoffice(Role.SCHEDULER, env=Environment(dict(env_map)))]
-    nodes += [Postoffice(Role.SERVER, env=Environment(dict(env_map)))
-              for _ in range(2)]
-    nodes.append(Postoffice(Role.WORKER, env=Environment(dict(env_map))))
-    threads = [threading.Thread(target=po.start, args=(0,), daemon=True)
-               for po in nodes]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=30)
+    nodes = _loopback_cluster(
+        num_workers=1, num_servers=2, ns="fault-recovery",
+        env_extra={
+            "PS_KV_REPLICATION": "2",
+            "PS_HEARTBEAT_INTERVAL": str(hb_interval),
+            "PS_HEARTBEAT_TIMEOUT": str(hb_timeout),
+            "PS_REQUEST_TIMEOUT": "0.5",
+            "PS_REQUEST_RETRIES": "5",
+        },
+    )
     scheduler, server_pos, worker_po = nodes[0], nodes[1:3], nodes[3]
     servers = []
     for po in server_pos:
@@ -406,6 +519,15 @@ def fault_recovery_times(quick: bool = True) -> dict:
     t_pull = time.perf_counter()
     ok = bool(np.all(out == rounds))
 
+    # Registry context next to the recovery numbers (timeouts, retries,
+    # failovers, replication forwards) — the telemetry satellite of
+    # docs/observability.md.
+    telemetry = {
+        "worker": _condense_snapshot(worker_po.telemetry_snapshot()),
+        "survivor_server": _condense_snapshot(next(
+            po for po in server_pos if po is not victim_po
+        ).telemetry_snapshot()),
+    }
     worker.stop()
     for srv, po in zip(servers, server_pos):
         if po is not victim_po:
@@ -423,6 +545,7 @@ def fault_recovery_times(quick: bool = True) -> dict:
         "kill_to_pull_s": round(t_pull - t_kill, 3),
         "heartbeat_timeout_s": hb_timeout,
         "replica_data_exact": ok,
+        "telemetry": telemetry,
     }
 
 

@@ -36,11 +36,14 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 import traceback
 from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry.metrics import enabled_registry
+from ..telemetry.tracing import NULL_TRACER
 from ..utils import logging as log
 from ..utils.queues import ThreadsafeQueue
 
@@ -110,9 +113,24 @@ class ApplyShardPool:
         # Per-sender FIFO ticket gate: responses leave in arrival order.
         self._order_mu = threading.Lock()
         self._order: Dict[int, Deque[_Pending]] = {}
-        # Observability.
-        self.sharded_requests = 0
-        self.global_requests = 0
+        # Observability (docs/observability.md): registry-backed
+        # counters (the sharded_requests/global_requests properties
+        # below keep the historical read surface), per-shard queue-depth
+        # gauges, and an apply-latency histogram — the server-side
+        # numbers psmon's "apply" columns render.  Legacy views must
+        # keep counting without a live registry (stub servers,
+        # PS_TELEMETRY=0) — enabled_registry falls back privately.
+        po = getattr(server, "po", None)
+        self._metrics = enabled_registry(getattr(po, "metrics", None))
+        self._tracer = getattr(po, "tracer", None) or NULL_TRACER
+        self._c_sharded = self._metrics.counter("apply.sharded_requests")
+        self._c_global = self._metrics.counter("apply.global_requests")
+        self._h_latency = self._metrics.histogram("apply.latency_s")
+        for sid in range(num_shards):
+            self._metrics.gauge(
+                f"apply.shard{sid}.depth",
+                fn=(lambda q: (lambda: len(q)))(self._queues[sid]),
+            )
         self._stopping = False
         self._threads = [
             threading.Thread(
@@ -123,6 +141,14 @@ class ApplyShardPool:
         ]
         for t in self._threads:
             t.start()
+
+    @property
+    def sharded_requests(self) -> int:
+        return self._c_sharded.value
+
+    @property
+    def global_requests(self) -> int:
+        return self._c_global.value
 
     # -- submission (KVServer._process thread) --------------------------------
 
@@ -165,7 +191,7 @@ class ApplyShardPool:
                                    collections.deque()).append(pending)
         plan = self._split(kvs)
         if plan is None:
-            self.global_requests += 1
+            self._c_global.inc()
             pending.remaining = self.num_shards
             pending.barrier = threading.Event()
             for q in self._queues:
@@ -173,11 +199,11 @@ class ApplyShardPool:
         elif len(plan) == 1:
             # Every key maps to one shard (1-key messages, clustered key
             # sets): skip the positions machinery and its copies.
-            self.sharded_requests += 1
+            self._c_sharded.inc()
             pending.remaining = 1
             self._queues[plan[0][0]].push((pending, _ALL))
         else:
-            self.sharded_requests += 1
+            self._c_sharded.inc()
             pending.remaining = len(plan)
             for sid, positions in plan:
                 self._queues[sid].push((pending, ("slice", positions)))
@@ -258,7 +284,17 @@ class ApplyShardPool:
         # Zero-copy per-key views of the payload (built on the shard
         # thread, so even the slicing overlaps across shards).
         segs = _push_segs(meta, kvs.keys, kvs.vals, positions)
+        t0 = time.monotonic()
         parts = self.handle.apply_shard(meta, keys, segs)
+        dur = time.monotonic() - t0
+        self._h_latency.observe(dur)
+        trace = getattr(meta, "trace", 0)
+        if trace and self._tracer.active:
+            now = self._tracer.now_us()
+            self._tracer.span(
+                trace, "apply", now - dur * 1e6, dur * 1e6,
+                args={"keys": len(keys), "push": meta.push},
+            )
         if not meta.pull:
             return None
         log.check(parts is not None and len(parts) == len(keys),
@@ -278,8 +314,10 @@ class ApplyShardPool:
             pending.barrier.wait()
             return
         try:
+            t0 = time.monotonic()
             self.handle(pending.meta, pending.kvs,
                         _CaptureResponder(self._server, pending))
+            self._h_latency.observe(time.monotonic() - t0)
         except Exception as exc:
             log.warning(
                 f"apply (global) failed for request "

@@ -76,6 +76,10 @@ class KVMeta:
     val_len: int = 0
     option: int = 0
     priority: int = 0
+    # Distributed tracing id (telemetry/tracing.py): nonzero when the
+    # originating worker sampled this request; carried so server-side
+    # apply/respond spans join the same trace.
+    trace: int = 0
 
 
 # Re-exported from message.py (transports consume it there without
@@ -163,6 +167,7 @@ class _PendingReq:
     pull: bool
     cmd: int
     deadline: float
+    trace: int = 0
     attempt: int = 0
     slices: List[_PendingSlice] = field(default_factory=list)
     val_dtype: object = None
@@ -232,6 +237,18 @@ class KVWorker:
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_cv = threading.Condition()
         self._sweep_stop = False
+        # Telemetry (docs/observability.md): request-latency histograms
+        # (message path, send → last response), failure-path counters,
+        # and per-ts trace bookkeeping for the distributed spans.
+        self._c_pushes = self.po.metrics.counter("kv.pushes")
+        self._c_pulls = self.po.metrics.counter("kv.pulls")
+        self._h_push_lat = self.po.metrics.histogram("kv.push_latency_s")
+        self._h_pull_lat = self.po.metrics.histogram("kv.pull_latency_s")
+        self._c_timeouts = self.po.metrics.counter("kv.timeouts")
+        self._c_failovers = self.po.metrics.counter("kv.failovers")
+        self._c_retries = self.po.metrics.counter("kv.retries")
+        # ts -> (monotonic start, pull?, trace id, wall-aligned start us)
+        self._req_track: Dict[int, Tuple[float, bool, int, float]] = {}
         self.po.register_node_failure_hook(self._on_node_event)
 
     @property
@@ -513,6 +530,19 @@ class KVWorker:
         return self._engine_dispatch(result, out=out, callback=callback,
                                      keep_result=True)
 
+    # -- telemetry -----------------------------------------------------------
+
+    def _track_request(self, ts: int, pull: bool) -> int:
+        """Start request-latency tracking for a message-path timestamp
+        and mint a trace id when sampled (PS_TRACE_SAMPLE); returns the
+        trace id (0 = untraced)."""
+        (self._c_pulls if pull else self._c_pushes).inc()
+        trace = self.po.tracer.maybe_trace()
+        t0_us = self.po.tracer.now_us() if trace else 0.0
+        with self._mu:
+            self._req_track[ts] = (time.monotonic(), pull, trace, t0_us)
+        return trace
+
     # -- public ops ----------------------------------------------------------
 
     def push(
@@ -549,11 +579,12 @@ class KVWorker:
                 f"{kvs.vals.dtype}",
             )
         ts = self._customer.new_request(SERVER_GROUP)
+        trace = self._track_request(ts, pull=False)
         if callback is not None:
             with self._mu:
                 self._callbacks[ts] = callback
         self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs,
-                   compress=compress)
+                   compress=compress, trace=trace)
         return ts
 
     def pull(
@@ -603,6 +634,7 @@ class KVWorker:
                 self._pinned_pull_futs[route] = holder[0]
             return ts
         ts = self._customer.new_request(SERVER_GROUP)
+        trace = self._track_request(ts, pull=True)
         zpull = (
             self._zpull_lookup(keys, vals)
             if lens is None and compress is None else None
@@ -616,7 +648,7 @@ class KVWorker:
         kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
                    val_dtype=vals.dtype, val_nbytes=vals.nbytes,
-                   zpull=zpull, compress=compress)
+                   zpull=zpull, compress=compress, trace=trace)
         return ts
 
     def push_pull(
@@ -638,6 +670,7 @@ class KVWorker:
                                          keep_result=True)
         kvs = _as_kvs(keys, vals, lens, priority)
         ts = self._customer.new_request(SERVER_GROUP)
+        trace = self._track_request(ts, pull=True)
         # Registered pull buffers apply to the fused round trip too: the
         # response is transport-delivered into ``outs`` in place
         # (is_worker_zpull_ covers Pull_ from PushPull as well,
@@ -649,7 +682,8 @@ class KVWorker:
             self._pull_dst[ts] = (kvs.keys, outs, lens)
             if zpull is not None:
                 self._zpull_ts.add(ts)
-        self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs, zpull=zpull)
+        self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs, zpull=zpull,
+                   trace=trace)
         return ts
 
     def wait(self, timestamp: int) -> None:
@@ -719,6 +753,7 @@ class KVWorker:
                                 self.po.num_servers):
             cand = server_rank_to_id(rank * gs + self.po.instance_idx)
             if cand not in self._down_servers:
+                self._c_failovers.inc()
                 return cand
         return base
 
@@ -726,6 +761,8 @@ class KVWorker:
         """Record a timed-out/abandoned request (caller holds _mu):
         wait(ts) raises TimeoutError; completion callbacks suppress."""
         self._timeout_ts.add(ts)
+        self._c_timeouts.inc()
+        self._req_track.pop(ts, None)  # no _finish will ever run
 
     def _ensure_sweeper(self) -> None:
         if self._sweep_thread is not None and self._sweep_thread.is_alive():
@@ -793,6 +830,7 @@ class KVWorker:
                 req.deadline = now + self._req_timeout * (2 ** req.attempt)
                 for s in troubled:
                     s.retry_now = False
+                self._c_retries.inc(len(troubled))
                 retries.append((req, troubled))
         for req, slices in retries:
             for sl in slices:
@@ -811,7 +849,7 @@ class KVWorker:
                 msg = self._slice_msg(
                     req.ts, req.push, req.pull, req.cmd, sl.part,
                     sl.group_rank, dest, req.val_dtype, req.val_nbytes,
-                    req.compress, req.zpull,
+                    req.compress, req.zpull, req.trace,
                 )
                 try:
                     self.po.van.send(msg)
@@ -844,11 +882,13 @@ class KVWorker:
         val_nbytes: int = 0,
         compress: Optional[str] = None,
         zpull: Optional[dict] = None,
+        trace: int = 0,
     ) -> Message:
         """Build one per-server slice message (shared by the initial
         send and the deadline sweeper's failover retries)."""
         msg = Message()
         m = msg.meta
+        m.trace = trace
         m.priority = part.priority
         m.app_id = self._customer.app_id
         m.customer_id = self._customer.customer_id
@@ -906,6 +946,7 @@ class KVWorker:
         val_nbytes: int = 0,
         compress: Optional[str] = None,
         zpull: Optional[dict] = None,
+        trace: int = 0,
     ) -> None:
         ranges = self.po.get_server_key_ranges()
         sliced = self._slicer(kvs, ranges)
@@ -928,6 +969,7 @@ class KVWorker:
             req = _PendingReq(
                 ts=ts, push=push, pull=pull, cmd=cmd,
                 deadline=time.monotonic() + self._req_timeout,
+                trace=trace,
                 slices=[
                     _PendingSlice(group_rank=gr, part=part, dest=dest)
                     for gr, part, dest in parts
@@ -942,7 +984,7 @@ class KVWorker:
             sl = req.slices[idx] if req is not None else None
             msg = self._slice_msg(ts, push, pull, cmd, part, group_rank,
                                   dest, val_dtype, val_nbytes, compress,
-                                  zpull)
+                                  zpull, trace)
             try:
                 self.po.van.send(msg)
                 if sl is not None:
@@ -1065,6 +1107,16 @@ class KVWorker:
             zpull = ts in self._zpull_ts
             self._zpull_ts.discard(ts)
             self._pending.pop(ts, None)  # retire deadline tracking
+            track = self._req_track.pop(ts, None)
+        if track is not None:
+            t0, was_pull, trace, t0_us = track
+            dur = time.monotonic() - t0
+            (self._h_pull_lat if was_pull else self._h_push_lat).observe(dur)
+            if trace:
+                tracer = self.po.tracer
+                tracer.span(trace, "request", t0_us, dur * 1e6,
+                            args={"ts": ts, "pull": was_pull})
+                tracer.instant(trace, "complete", args={"ts": ts})
         if zpull and chunks and dst is not None and all(
             np.shares_memory(c.vals, dst[1]) for c in chunks
         ):
@@ -1157,6 +1209,12 @@ class KVServer:
         # lose them.  None = not restoring (steady-state fast path).
         self._restore_mu = threading.Lock()
         self._restore_buffer: Optional[List[Message]] = None
+        # Telemetry (docs/observability.md): request counters and the
+        # bounded hot-key tracker psmon's "top keys" column renders.
+        self._c_push_reqs = self.po.metrics.counter("kv.server_push_requests")
+        self._c_pull_reqs = self.po.metrics.counter("kv.server_pull_requests")
+        self._hot_keys = self.po.metrics.topk("kv.hot_keys")
+        self._h_serial_apply = self.po.metrics.histogram("apply.latency_s")
         rep = self.po.env.find_int("PS_KV_REPLICATION", 1)
         if rep >= 2 and self.po.num_servers >= 2:
             from .replication import Replicator
@@ -1290,6 +1348,13 @@ class KVServer:
         # Echo the request's priority: the response carries the bulk
         # bytes on a pull, so scheduling must apply where they travel.
         m.priority = req.priority
+        # Echo the trace id so the response's wire/recv spans (and the
+        # worker's completion) join the request's trace.
+        m.trace = req.trace
+        if req.trace and self.po.tracer.active:
+            self.po.tracer.instant(req.trace, "respond",
+                                   args={"to": req.sender,
+                                         "ts": req.timestamp})
         return msg
 
     def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
@@ -1405,7 +1470,12 @@ class KVServer:
             val_len=msg.meta.val_len,
             option=msg.meta.option,
             priority=msg.meta.priority,
+            trace=msg.meta.trace,
         )
+        if meta.push:
+            self._c_push_reqs.inc()
+        if meta.pull:
+            self._c_pull_reqs.inc()
         kvs = KVPairs()
         if len(msg.data) >= 2:
             kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
@@ -1419,6 +1489,16 @@ class KVServer:
                 kvs.vals = msg.data[1].numpy()
                 if len(msg.data) > 2:
                     kvs.lens = msg.data[2].astype_view(np.int32).numpy()
+        if len(kvs.keys):
+            # Hot-key accounting: exact per-key counts for small key
+            # sets; big bulk slices charge the slice's first key with
+            # the whole weight (slice granularity — a per-key Python
+            # loop over 10k-key messages would tax the hot path).
+            if len(kvs.keys) <= 64:
+                for k in kvs.keys.tolist():
+                    self._hot_keys.add(int(k))
+            else:
+                self._hot_keys.add(int(kvs.keys[0]), len(kvs.keys))
         reg = None
         if meta.push and len(kvs.keys):
             reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
@@ -1479,7 +1559,15 @@ class KVServer:
             # implicit handler-before-next-copy guarantee, restored.
             self._apply_pool.submit(meta, kvs, wait=reg is not None)
             return
+        t0 = time.monotonic()
         self._handle(meta, kvs, self)
+        dur = time.monotonic() - t0
+        self._h_serial_apply.observe(dur)
+        if meta.trace and self.po.tracer.active:
+            now = self.po.tracer.now_us()
+            self.po.tracer.span(meta.trace, "apply", now - dur * 1e6,
+                                dur * 1e6, args={"keys": len(kvs.keys),
+                                                 "push": meta.push})
 
 
 def _push_segs(meta: KVMeta, all_keys: np.ndarray, vals: np.ndarray,

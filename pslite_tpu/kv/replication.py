@@ -78,13 +78,43 @@ class Replicator:
         self._mu = threading.Lock()
         self._restore_ts: Optional[int] = None
         self._restore_msg: Optional[Message] = None
-        self.forwarded = 0  # observability
-        self.deduped = 0
+        # Observability (docs/observability.md): registry counters (the
+        # forwarded/deduped properties keep the historical reads, so
+        # they must keep counting under PS_TELEMETRY=0 — enabled_registry
+        # falls back privately) plus a replication-lag gauge — forwards
+        # still parked in the send lanes toward this primary's replicas,
+        # i.e. writes the replicas have not yet even been sent.
+        from ..telemetry.metrics import enabled_registry
+
+        reg = enabled_registry(self.po.metrics)
+        self._c_forwarded = reg.counter("replication.forwards")
+        self._c_deduped = reg.counter("replication.dedup_hits")
+        self.po.metrics.gauge("replication.lag", fn=self._pending_forwards)
         # A recovered WORKER restarts its timestamp sequence at 0, so
         # its fresh pushes would collide with the dead incarnation's
         # origin identities still in the dedup cache and be silently
         # dropped — purge that sender's entries on recovery.
         self.po.register_node_failure_hook(self._on_node_event)
+
+    @property
+    def forwarded(self) -> int:
+        return self._c_forwarded.value
+
+    @property
+    def deduped(self) -> int:
+        return self._c_deduped.value
+
+    def _pending_forwards(self) -> int:
+        """Messages queued in the van's send lanes toward this server's
+        replicas (sampled by the ``replication.lag`` gauge)."""
+        van = self.po.van
+        try:
+            ids = self.replica_ids()
+        except Exception:  # noqa: BLE001 - pre-bootstrap snapshot
+            return 0
+        return sum(
+            len(lane.q) for rid in ids for lane in van._lanes_of(rid)
+        )
 
     def close(self) -> None:
         self.po.unregister_node_failure_hook(self._on_node_event)
@@ -126,7 +156,7 @@ class Replicator:
         origin = self._origin(meta)
         with self._mu:
             if not self._applied.add(origin):
-                self.deduped += 1
+                self._c_deduped.inc()
                 return False
         return True
 
@@ -164,13 +194,16 @@ class Replicator:
             m.option = OPT_REPLICA
             m.recver = rid
             m.priority = 0
+            # Forwards join the origin request's trace: the replica's
+            # recv/apply spans land under the same trace id.
+            m.trace = getattr(meta, "trace", 0)
             msg.add_data(SArray(kvs.keys))
             msg.add_data(SArray(vals))
             if kvs.lens is not None:
                 msg.add_data(SArray(np.asarray(kvs.lens, dtype=np.int32)))
             try:
                 van.send(msg)
-                self.forwarded += 1
+                self._c_forwarded.inc()
             except Exception as exc:  # noqa: BLE001 - replica may be down
                 log.warning(f"replica forward to {rid} failed: {exc!r}")
 

@@ -44,6 +44,10 @@ class Command(enum.IntEnum):
     # detector thread broadcasts the dead node's identity to surviving
     # peers, which mark it down and fail its parked sends fast.
     NODE_FAILURE = 10
+    # Cluster telemetry pull (docs/observability.md): the scheduler asks
+    # a node for its metrics-registry snapshot; the reply carries it as
+    # JSON in meta.body.  Rides the control plane like BARRIER.
+    METRICS_PULL = 11
 
 
 # Wire dtype codes (stable across hosts; independent of numpy internals).
@@ -191,6 +195,11 @@ class Meta:
     # PS_PRIORITY_SCHED heap, and carried on the wire so a server can
     # echo the request's priority into its (bulk) pull response.
     priority: int = 0
+    # Distributed tracing (telemetry/tracing.py): nonzero = this request
+    # was sampled; every process touching the message records lifecycle
+    # spans against this id.  Travels as a backward-compatible wire
+    # extension (wire.py) and is echoed on responses.
+    trace: int = 0
     src_dev_type: int = int(DeviceType.UNK)
     src_dev_id: int = -1
     dst_dev_type: int = int(DeviceType.UNK)

@@ -11,6 +11,7 @@ the customer registry (with the 5 s readiness wait), and lifecycle
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -31,8 +32,10 @@ from .base import (
     server_rank_to_id,
     worker_rank_to_id,
 )
-from .message import Command, Message, Node, Role
+from .message import Command, Control, Message, Node, Role
 from .range import Range
+from .telemetry.metrics import Registry
+from .telemetry.tracing import Tracer
 from .utils import logging as log
 
 
@@ -75,6 +78,19 @@ class Postoffice:
         self._server_key_ranges_mu = threading.Lock()
         self._node_ids: Dict[int, List[int]] = {}
         self._build_node_id_table()
+
+        # Per-NODE telemetry (docs/observability.md): one metrics
+        # registry + one tracer per Postoffice — per-node even when many
+        # logical nodes share a test process.  Created BEFORE the van so
+        # transports can instrument from __init__.
+        self.metrics = Registry(
+            enabled=self.env.find_bool("PS_TELEMETRY", True)
+        )
+        self.tracer = Tracer(self.env, self.role_str())
+        # METRICS_PULL collection state (scheduler side).
+        self._metrics_cv = threading.Condition()
+        self._metrics_token = 0
+        self._metrics_replies: Dict[int, dict] = {}
 
         van_type = self.env.find("PS_VAN_TYPE") or self.env.find(
             "DMLC_ENABLE_RDMA"
@@ -136,6 +152,7 @@ class Postoffice:
         return self.van.my_node.is_recovery
 
     def on_id_assigned(self, node: Node) -> None:
+        self.tracer.node_id = node.id
         log.vlog(1, f"assigned id {node.id} (rank {id_to_rank(node.id)}) to me")
 
     # -- group membership ----------------------------------------------------
@@ -323,7 +340,15 @@ class Postoffice:
 
     def update_heartbeat(self, node_id: int, t: float) -> None:
         with self._heartbeat_mu:
+            prev = self._heartbeats.get(node_id)
             self._heartbeats[node_id] = t
+        if prev is not None and t > prev:
+            # Observed beat gap: the failure detector's raw signal —
+            # a p99 creeping toward PS_HEARTBEAT_TIMEOUT is the early
+            # warning a threshold alone never gives (lo=1ms scale).
+            self.metrics.histogram("heartbeat.gap_s", lo=1e-3).observe(
+                t - prev
+            )
 
     def get_dead_nodes(self, timeout_s: float = 60) -> List[int]:
         """Nodes silent for > timeout_s (reference: postoffice.cc:285-304).
@@ -348,6 +373,80 @@ class Postoffice:
                 if last + timeout_s < now:
                     dead.append(node_id)
         return dead
+
+    # -- cluster telemetry (METRICS_PULL — docs/observability.md) ------------
+
+    def telemetry_snapshot(self) -> dict:
+        """This node's registry snapshot plus identity, the payload a
+        METRICS_PULL reply carries (and what psmon renders per node)."""
+        return {
+            "node_id": self.van.my_node.id,
+            "role": self.role_str(),
+            "rank": (
+                id_to_rank(self.van.my_node.id)
+                if self.van.my_node.id > 1 else 0
+            ),
+            "wall_time": time.time(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def absorb_metrics_reply(self, msg: Message) -> None:
+        """Van hook: a node's METRICS_PULL response arrived."""
+        try:
+            snap = json.loads(msg.meta.body.decode())
+        except Exception as exc:  # noqa: BLE001 - a corrupt reply must
+            log.warning(f"bad METRICS_PULL reply: {exc!r}")  # not wedge
+            snap = {"node_id": msg.meta.sender, "error": repr(exc)}
+        with self._metrics_cv:
+            if msg.meta.timestamp != self._metrics_token:
+                return  # stale reply from an earlier (timed-out) pull
+            self._metrics_replies[msg.meta.sender] = snap
+            self._metrics_cv.notify_all()
+
+    def collect_cluster_metrics(self, timeout_s: float = 5.0) -> Dict[int, dict]:
+        """Scheduler-side cluster snapshot: broadcast METRICS_PULL to
+        every live worker/server, gather their registry snapshots, and
+        include the scheduler's own — ``{node_id: snapshot}``.  Nodes
+        that fail to answer within ``timeout_s`` are simply absent
+        (psmon flags them); a down peer is skipped up front."""
+        log.check(self.is_scheduler,
+                  "collect_cluster_metrics runs on the scheduler")
+        peers = [
+            i for i in self.get_node_ids(WORKER_GROUP + SERVER_GROUP)
+            if not self.van.is_peer_down(i)
+        ]
+        with self._metrics_cv:
+            self._metrics_token += 1
+            token = self._metrics_token
+            self._metrics_replies = {}
+        reached = 0
+        for peer in peers:
+            msg = Message()
+            msg.meta.recver = peer
+            msg.meta.sender = self.van.my_node.id
+            msg.meta.request = True
+            msg.meta.timestamp = token
+            msg.meta.control = Control(cmd=Command.METRICS_PULL)
+            try:
+                self.van.send(msg)
+                reached += 1
+            except Exception as exc:  # noqa: BLE001 - a dead peer must
+                # not fail the whole pull — and must not count toward
+                # the expected replies either, or every pull would
+                # stall the full timeout waiting on a peer that was
+                # never asked.
+                log.warning(f"METRICS_PULL to {peer} failed: {exc!r}")
+        deadline = time.monotonic() + timeout_s
+        with self._metrics_cv:
+            while len(self._metrics_replies) < reached:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._metrics_cv.wait(remaining)
+            replies = dict(self._metrics_replies)
+        out = {self.van.my_node.id: self.telemetry_snapshot()}
+        out.update(replies)
+        return out
 
     # -- node failure hooks --------------------------------------------------
 

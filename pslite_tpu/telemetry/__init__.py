@@ -1,0 +1,29 @@
+"""Cluster-wide telemetry: metrics registry + distributed tracing.
+
+The read-side mirror of the perf/fault tiers (send lanes, sharded
+apply, deadlines/failover, replication): every hot path publishes
+counters/gauges/histograms into a per-node :class:`~.metrics.Registry`,
+request lifecycles are stitched across processes by
+:class:`~.tracing.Tracer` trace ids carried in ``Message.meta``, and
+the scheduler can snapshot every node's registry over the control plane
+(``Command.METRICS_PULL`` — see ``tools/psmon.py``).
+
+Env knobs (docs/observability.md):
+
+- ``PS_TELEMETRY`` (default 1): 0 swaps every instrument for a shared
+  no-op singleton — near-zero cost, empty snapshots.
+- ``PS_TRACE_SAMPLE`` (default 0): probability in [0, 1] that a
+  ``KVWorker.push/pull`` mints a trace id; 0 disables tracing.
+- ``PS_TRACE_DIR``: directory for per-node Chrome trace-event JSON
+  exports (default: current directory).
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    NULL_REGISTRY,
+    Registry,
+    TopK,
+)
+from .tracing import NULL_TRACER, Tracer  # noqa: F401

@@ -1,0 +1,178 @@
+"""Distributed request tracing — Chrome trace-event JSON per node.
+
+A trace id is minted at ``KVWorker.push/pull`` with probability
+``PS_TRACE_SAMPLE`` and rides in ``Message.meta.trace`` (a
+backward-compatible wire extension — see ``wire.py``), so every process
+that touches the request can record lifecycle spans against the same
+id: enqueue → lane-dequeue → wire-send on the worker, recv → apply →
+respond on the server, completion back on the worker.
+
+Each node buffers its spans locally (bounded — sampling plus the cap
+make this safe under full load) and exports ONE Chrome trace-event JSON
+file on shutdown (or on demand).  Timestamps are ``monotonic_ns``
+offsets re-based onto a single wall-clock anchor captured at tracer
+construction, so per-node files from one cluster merge on a shared
+timeline in Perfetto (open them together, or concatenate the
+``traceEvents`` arrays — docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from typing import List, Optional
+
+from ..utils.profiling import MonotonicAnchor
+
+
+class Tracer:
+    """Per-node span recorder.  ``active`` is False unless
+    ``PS_TRACE_SAMPLE > 0`` — every recording call no-ops then, so the
+    tracer costs one attribute check on untraced deployments."""
+
+    MAX_EVENTS = 65536
+
+    def __init__(self, env, role: str):
+        self.sample = env.find_float("PS_TRACE_SAMPLE", 0.0)
+        self.active = self.sample > 0.0
+        self.role = role
+        self.node_id = -1  # assigned at bootstrap (export-time pid)
+        self._dir = env.find("PS_TRACE_DIR") or "."
+        self._mu = threading.Lock()
+        self._events: List[dict] = []
+        self.dropped = 0
+        # Cross-node clock alignment: durations come from monotonic_ns,
+        # absolute timestamps re-base onto ONE wall anchor per tracer
+        # (the Profiler's timebase — utils/profiling.MonotonicAnchor).
+        self._anchor = MonotonicAnchor()
+
+    # -- ids & clock ---------------------------------------------------------
+
+    def maybe_trace(self) -> int:
+        """A fresh nonzero trace id when this request is sampled, else
+        0 (untraced — every downstream stage checks the id, not the
+        sampling knob, so the decision is made exactly once)."""
+        if not self.active or random.random() >= self.sample:
+            return 0
+        return random.getrandbits(63) | 1
+
+    def now_us(self) -> float:
+        """Wall-aligned monotonic microseconds (the event timebase)."""
+        return self._anchor.now_ns() / 1000.0
+
+    # -- recording -----------------------------------------------------------
+
+    def _append(self, ev: dict) -> None:
+        with self._mu:
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def span(self, trace_id: int, name: str, t0_us: float,
+             dur_us: Optional[float] = None, args: Optional[dict] = None)\
+            -> None:
+        """A complete ("X") span: ``[t0_us, t0_us + dur_us]``.  With
+        ``dur_us`` omitted, the span ends now."""
+        if not trace_id or not self.active:
+            return
+        if dur_us is None:
+            dur_us = max(0.0, self.now_us() - t0_us)
+        a = {"trace": f"{trace_id:x}"}
+        if args:
+            a.update(args)
+        self._append({
+            "name": name, "cat": "pslite", "ph": "X",
+            "ts": t0_us, "dur": dur_us,
+            "tid": threading.get_ident() & 0xFFFF,
+            "args": a,
+        })
+
+    def instant(self, trace_id: int, name: str,
+                args: Optional[dict] = None) -> None:
+        if not trace_id or not self.active:
+            return
+        a = {"trace": f"{trace_id:x}"}
+        if args:
+            a.update(args)
+        self._append({
+            "name": name, "cat": "pslite", "ph": "i",
+            "ts": self.now_us(), "s": "t",
+            "tid": threading.get_ident() & 0xFFFF,
+            "args": a,
+        })
+
+    # -- export --------------------------------------------------------------
+
+    @property
+    def num_events(self) -> int:
+        with self._mu:
+            return len(self._events)
+
+    def default_path(self) -> str:
+        return os.path.join(
+            self._dir, f"pslite_trace_{self.role}_{self.node_id}.json"
+        )
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the buffered spans as Chrome trace-event JSON; returns
+        the path, or None when nothing was recorded.  Idempotent: the
+        buffer is kept, a later export rewrites the same file with any
+        additional spans."""
+        with self._mu:
+            events = list(self._events)
+        if not events:
+            return None
+        pid = self.node_id
+        label = f"{self.role} {pid}"
+        out = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        }]
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = pid
+            out.append(ev)
+        path = path or self.default_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, fh)
+        os.replace(tmp, path)
+        return path
+
+    def export_if_any(self) -> Optional[str]:
+        if not self.active or self.num_events == 0:
+            return None
+        return self.export()
+
+
+class _NullTracer:
+    """Do-nothing tracer for stub postoffices (benches)."""
+
+    active = False
+    sample = 0.0
+    node_id = -1
+    num_events = 0
+
+    def maybe_trace(self) -> int:
+        return 0
+
+    def now_us(self) -> float:
+        return 0.0
+
+    def span(self, *a, **kw) -> None:
+        pass
+
+    def instant(self, *a, **kw) -> None:
+        pass
+
+    def export(self, path=None):
+        return None
+
+    def export_if_any(self):
+        return None
+
+
+NULL_TRACER = _NullTracer()

@@ -9,12 +9,19 @@ user already serializes access under its own lock.
 from __future__ import annotations
 
 import collections
-from typing import Callable, Hashable
+from typing import Callable, Hashable, Optional
 
 
 class BoundedKeySet:
-    def __init__(self, cap: int):
+    def __init__(self, cap: int,
+                 on_evict: Optional[Callable[[Hashable], None]] = None):
+        """``on_evict(key)`` (optional) observes every cap eviction —
+        telemetry for the dedup windows (an evicted signature that is
+        later needed again is a silent correctness hazard worth
+        counting).  Must not raise and must not call back into the
+        set."""
         self._cap = max(1, int(cap))
+        self._on_evict = on_evict
         self._d: "collections.OrderedDict[Hashable, None]" = (
             collections.OrderedDict()
         )
@@ -26,7 +33,9 @@ class BoundedKeySet:
             return False
         self._d[key] = None
         while len(self._d) > self._cap:
-            self._d.popitem(last=False)
+            evicted, _ = self._d.popitem(last=False)
+            if self._on_evict is not None:
+                self._on_evict(evicted)
         return True
 
     def discard(self, key: Hashable) -> bool:

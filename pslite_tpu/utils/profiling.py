@@ -15,11 +15,36 @@ import time
 from typing import Optional
 
 
+class MonotonicAnchor:
+    """One wall-clock anchor plus monotonic offsets: timestamps that
+    can never go backwards within a stream (NTP steps used to corrupt
+    durations) yet merge across nodes on a shared wall timeline.  THE
+    single timebase of the event log (Profiler) and the distributed
+    tracer (telemetry/tracing.py) — two private copies of this formula
+    would skew cross-file merge alignment if they ever drifted."""
+
+    __slots__ = ("wall_ns", "mono_ns")
+
+    def __init__(self):
+        self.wall_ns = time.time_ns()
+        self.mono_ns = time.monotonic_ns()
+
+    def now_ns(self) -> int:
+        return self.wall_ns + (time.monotonic_ns() - self.mono_ns)
+
+
 class Profiler:
+    # Events between explicit flushes: small enough that a crash loses
+    # at most a syscall's worth of tail, large enough to stay off the
+    # per-event hot path.
+    _FLUSH_EVERY = 256
+
     def __init__(self, env, role: str):
         self._enabled = bool(env.find_int("ENABLE_PROFILING", 0))
         self._fh = None
         self._mu = threading.Lock()
+        self._since_flush = 0
+        self._anchor = MonotonicAnchor()
         if self._enabled:
             path = env.find("PROFILE_PATH")
             if not path:
@@ -30,13 +55,31 @@ class Profiler:
     def enabled(self) -> bool:
         return self._enabled
 
+    @property
+    def closed(self) -> bool:
+        """True when an enabled profiler's log was closed (Van.stop);
+        a restarted van re-creates the profiler instead of silently
+        dropping every event of its second life."""
+        return self._enabled and self._fh is None
+
+    def _ts_us(self) -> int:
+        return self._anchor.now_ns() // 1000
+
+    def _write(self, line: str) -> None:
+        with self._mu:
+            if self._fh is None:
+                return
+            self._fh.write(line)
+            self._since_flush += 1
+            if self._since_flush >= self._FLUSH_EVERY:
+                self._fh.flush()
+                self._since_flush = 0
+
     def record(self, key: int, event: str, push: bool) -> None:
         if not self._enabled or self._fh is None:
             return
-        ts_us = int(time.time() * 1e6)
         kind = "push" if push else "pull"
-        with self._mu:
-            self._fh.write(f"{key},{event}_{kind},{ts_us}\n")
+        self._write(f"{key},{event}_{kind},{self._ts_us()}\n")
 
     def record_engine(self, bucket: str, op: str, nbytes: int,
                       dur_us: int) -> None:
@@ -45,17 +88,17 @@ class Profiler:
         log, so ENABLE_PROFILING covers the flagship transport too."""
         if not self._enabled or self._fh is None:
             return
-        ts_us = int(time.time() * 1e6)
-        with self._mu:
-            self._fh.write(
-                f"{bucket},{op}_engine,{ts_us},{nbytes},{dur_us}\n"
-            )
+        self._write(
+            f"{bucket},{op}_engine,{self._ts_us()},{nbytes},{dur_us}\n"
+        )
 
     def close(self) -> None:
         if self._fh is not None:
             with self._mu:
-                self._fh.close()
-                self._fh = None
+                if self._fh is not None:
+                    self._fh.flush()
+                    self._fh.close()
+                    self._fh = None
 
 
 def clocked(loop, measure=None):

@@ -175,6 +175,50 @@ class ChaosPolicy:
             return self._roll_locked(node_id).uniform(lo, hi)
 
 
+class _ChaosStats:
+    """Counter-style view over the node registry's ``chaos.*`` counters
+    (one counter idiom everywhere — docs/observability.md).  Keeps the
+    historical ``van.chaos_stats`` read surface: ``stats["recv_dropped"]``,
+    ``stats.values()``, ``stats.items()``; unseen keys read 0.  When the
+    registry is disabled (PS_TELEMETRY=0) a private enabled registry
+    backs the view, so chaos accounting keeps working untelemetered."""
+
+    _PREFIX = "chaos."
+
+    def __init__(self, registry):
+        from ..telemetry.metrics import enabled_registry
+
+        self._registry = enabled_registry(registry)
+
+    def inc(self, key: str, n: int = 1) -> None:
+        self._registry.counter(self._PREFIX + key).inc(n)
+
+    def __getitem__(self, key: str) -> int:
+        return self._registry.counter(self._PREFIX + key).value
+
+    def get(self, key: str, default: int = 0) -> int:
+        # dict.get semantics: the default applies only to counters that
+        # were never created — a present counter returns its value even
+        # when that value is 0.
+        name = self._PREFIX + key
+        vals = self._registry.counters_with_prefix(self._PREFIX)
+        return vals.get(name, default)
+
+    def items(self):
+        return {
+            name[len(self._PREFIX):]: v
+            for name, v in self._registry.counters_with_prefix(
+                self._PREFIX
+            ).items()
+        }.items()
+
+    def keys(self):
+        return [k for k, _ in self.items()]
+
+    def values(self):
+        return [v for _, v in self.items()]
+
+
 _CLASS_CACHE: Dict[type, type] = {}
 
 
@@ -189,7 +233,7 @@ def chaos_class(inner_cls: type) -> type:
         def __init__(self, postoffice):
             super().__init__(postoffice)
             self.chaos = ChaosPolicy(self.env.find("PS_CHAOS") or "")
-            self.chaos_stats: collections.Counter = collections.Counter()
+            self.chaos_stats = _ChaosStats(self.metrics)
             # Reorder holdback + redelivery queue: only the (single)
             # receive-loop thread touches these.
             self._chaos_held: Optional[Message] = None
@@ -211,30 +255,30 @@ def chaos_class(inner_cls: type) -> type:
                         and ctrl.cmd == Command.HEARTBEAT):
                     # A crashed node stops heartbeating — this is the
                     # signal the failure detector keys on.
-                    self.chaos_stats["heartbeat_suppressed"] += 1
+                    self.chaos_stats.inc("heartbeat_suppressed")
                     return 0
                 if (chaos.crash_blocks("send")
                         and ctrl.cmd != Command.TERMINATE
                         and chaos.spec["crash_phase"] == "dead"):
-                    self.chaos_stats["send_blackholed"] += 1
+                    self.chaos_stats.inc("send_blackholed")
                     return 0
                 return super().send_msg(msg)
             me = self.my_node.id
             chaos.count_data("send")
             if chaos.crash_blocks("send"):
-                self.chaos_stats["send_blackholed"] += 1
+                self.chaos_stats.inc("send_blackholed")
                 return 0
             if chaos.partitioned(me, msg.meta.recver):
-                self.chaos_stats["send_partitioned"] += 1
+                self.chaos_stats.inc("send_partitioned")
                 return 0
             if chaos.draw(me, "send_drop"):
-                self.chaos_stats["send_dropped"] += 1
+                self.chaos_stats.inc("send_dropped")
                 return 0
             d = chaos.delay_s(me, "send_delay")
             if d > 0:
                 # Sleeping here only stalls this peer's lane thread —
                 # per-peer lanes keep the other destinations flowing.
-                self.chaos_stats["send_delayed"] += 1
+                self.chaos_stats.inc("send_delayed")
                 time.sleep(d)
             return super().send_msg(msg)
 
@@ -266,31 +310,31 @@ def chaos_class(inner_cls: type) -> type:
                     if (msg.meta.control.cmd != Command.TERMINATE
                             and chaos.crash_blocks("recv")
                             and chaos.spec["crash_phase"] == "dead"):
-                        self.chaos_stats["recv_swallowed"] += 1
+                        self.chaos_stats.inc("recv_swallowed")
                         continue
                     return self._chaos_release(msg)
                 me = self.my_node.id
                 chaos.count_data("recv")
                 if chaos.crash_blocks("recv"):
-                    self.chaos_stats["recv_swallowed"] += 1
+                    self.chaos_stats.inc("recv_swallowed")
                     continue
                 if chaos.partitioned(msg.meta.sender, me):
-                    self.chaos_stats["recv_partitioned"] += 1
+                    self.chaos_stats.inc("recv_partitioned")
                     continue
                 if chaos.draw(me, "drop"):
-                    self.chaos_stats["recv_dropped"] += 1
+                    self.chaos_stats.inc("recv_dropped")
                     continue
                 d = chaos.delay_s(me, "delay")
                 if d > 0:
-                    self.chaos_stats["recv_delayed"] += 1
+                    self.chaos_stats.inc("recv_delayed")
                     time.sleep(d)
                 if self._chaos_held is None and chaos.draw(me, "reorder"):
                     # Hold this one back; its successor passes it.
-                    self.chaos_stats["reordered"] += 1
+                    self.chaos_stats.inc("reordered")
                     self._chaos_held = msg
                     continue
                 if chaos.draw(me, "dup"):
-                    self.chaos_stats["duplicated"] += 1
+                    self.chaos_stats.inc("duplicated")
                     self._chaos_requeued.append(self._chaos_dup(msg))
                 return self._chaos_release(msg)
 

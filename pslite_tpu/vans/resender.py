@@ -52,6 +52,17 @@ class Resender:
         self._max_retries = max_retries
         self._mu = threading.Lock()
         self._send_buff: Dict[int, Tuple[Message, float, int]] = {}
+        # Telemetry (docs/observability.md): retransmit volume is THE
+        # health signal of a lossy link, and ack-cache evictions bound
+        # how long the dedup window actually is in practice.  Test
+        # doubles without a registry degrade to the no-op singletons.
+        from ..telemetry.metrics import NULL_REGISTRY
+
+        metrics = getattr(van, "metrics", None) or NULL_REGISTRY
+        self._c_retransmits = metrics.counter("resender.retransmits")
+        self._c_giveups = metrics.counter("resender.giveups")
+        self._c_dup_dropped = metrics.counter("resender.dup_dropped")
+        evict = metrics.counter("resender.ack_cache_evictions")
         # Receive-side dedup signatures, bounded FIFO: the reference's
         # (and our former) unbounded set leaks ~8 bytes per message
         # forever on long runs.  ~64k signatures cover far more in-
@@ -59,7 +70,8 @@ class Resender:
         # evicted this long after its ack can only dedup a duplicate
         # that 10 retransmit timeouts have already passed by.
         self._acked = BoundedKeySet(
-            max(1024, van.env.find_int("PS_RESEND_ACK_CACHE", 65536))
+            max(1024, van.env.find_int("PS_RESEND_ACK_CACHE", 65536)),
+            on_evict=lambda _sig: evict.inc(),
         )
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -117,6 +129,7 @@ class Resender:
         with self._mu:
             duplicated = not self._acked.add(sig)
         if duplicated:
+            self._c_dup_dropped.inc()
             log.vlog(2, lambda: f"Duplicated message dropped: {msg.debug_string()}")
         return duplicated
 
@@ -153,6 +166,7 @@ class Resender:
                     self._send_buff[sig] = (msg, now, retries + 1)
                     resend.append(msg)
             for msg, retries, why in gave_up:
+                self._c_giveups.inc()
                 log.warning(
                     f"Failed to deliver ({why}): {msg.debug_string()}"
                 )
@@ -167,6 +181,7 @@ class Resender:
                 except Exception as exc:  # noqa: BLE001
                     log.warning(f"delivery-failure report failed: {exc!r}")
             for msg in resend:
+                self._c_retransmits.inc()
                 log.vlog(1, f"Resend {msg.debug_string()}")
                 try:
                     # Routed through the owning peer's send lane (no sid

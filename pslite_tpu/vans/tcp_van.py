@@ -97,18 +97,31 @@ class _RecvPool:
     _MAX_BLOCK = 32 << 20      # larger requests bypass the pool
     _MAX_TOTAL = 128 << 20     # arena budget: beyond it, don't pool
 
-    def __init__(self):
+    def __init__(self, metrics=None):
+        from ..telemetry.metrics import enabled_registry
+
         self._mu = threading.Lock()  # several reader threads share us
         self._entries: List[np.ndarray] = []
         self._total = 0
-        self.hits = 0
-        self.misses = 0
+        # Registry counters (one counter idiom everywhere); .hits /
+        # .misses stay readable as before via the properties below, so
+        # pool accounting works even untelemetered (private fallback).
+        reg = enabled_registry(metrics)
+        self._c_hits = reg.counter("tcp.recv_pool_hits")
+        self._c_misses = reg.counter("tcp.recv_pool_misses")
+
+    @property
+    def hits(self) -> int:
+        return self._c_hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._c_misses.value
 
     def acquire(self, nbytes: int) -> np.ndarray:
         """A uint8 block of >= nbytes (recycled when possible)."""
         if nbytes > self._MAX_BLOCK:
-            with self._mu:
-                self.misses += 1
+            self._c_misses.inc()
             return np.empty(nbytes, np.uint8)
         with self._mu:
             best = -1
@@ -120,7 +133,7 @@ class _RecvPool:
                              < self._entries[best].nbytes)):
                     best = i  # smallest adequate free block
             if best >= 0:
-                self.hits += 1
+                self._c_hits.inc()
                 return self._entries[best]
             # Miss: size classes are powers of two (>= 4 KB) so repeat
             # traffic of similar sizes converges onto reusable blocks.
@@ -130,7 +143,7 @@ class _RecvPool:
                     and self._total + block.nbytes <= self._MAX_TOTAL):
                 self._entries.append(block)
                 self._total += block.nbytes
-            self.misses += 1
+            self._c_misses.inc()
             return block
 
     def recv_exact_into(self, sock: socket.socket, block: np.ndarray,
@@ -204,8 +217,10 @@ class TcpVan(Van):
         self._sock_send_mus: Dict[int, threading.Lock] = {}
         # OS send-call counter (sendmsg + sendall), observability for
         # the vectored write path: one increment per syscall-ish call,
-        # so a fully-accepted vector costs exactly 1 per message.
-        self._send_syscalls = 0
+        # so a fully-accepted vector costs exactly 1 per message.  Lives
+        # on the node's metrics registry (one counter idiom everywhere);
+        # the _send_syscalls property below is the legacy read view.
+        self._c_syscalls = self.metrics.counter("tcp.send_syscalls")
         self._closing = False
         # DMLC_LOCAL: unix-domain sockets for same-host clusters.
         self._local = bool(self.env.find_int("DMLC_LOCAL", 0))
@@ -228,8 +243,13 @@ class TcpVan(Van):
         # mirror of the vectored-send work, with the same style of
         # observability counter (_recv_pool_hits).
         self._recv_pool: Optional[_RecvPool] = (
-            _RecvPool() if self.env.find_int("PS_RECV_POOL", 1) else None
+            _RecvPool(self.metrics)
+            if self.env.find_int("PS_RECV_POOL", 1) else None
         )
+
+    @property
+    def _send_syscalls(self) -> int:
+        return self._c_syscalls.value
 
     @property
     def _recv_pool_hits(self) -> int:
@@ -516,9 +536,8 @@ class TcpVan(Van):
                 v = v.cast("B")
             views.append(v)
             total += v.nbytes
-        # Local call count, committed under _bytes_mu at the end:
-        # concurrent lane threads would otherwise lose increments in
-        # the unlocked read-modify-write.
+        # Local call count, committed to the registry counter once at
+        # the end (one inc per frame, not per chunk).
         calls = 0
         try:
             if getattr(sock, "sendmsg", None) is None:
@@ -541,8 +560,8 @@ class TcpVan(Van):
                     sent = 0
             return total
         finally:
-            with self._bytes_mu:
-                self._send_syscalls += calls
+            if calls:
+                self._c_syscalls.inc(calls)
 
     def _send_msg_once(self, msg: Message) -> int:
         recver = msg.meta.recver

@@ -44,6 +44,7 @@ inline (still under the per-peer transmit lock — never a van-wide one).
 from __future__ import annotations
 
 import copy
+import json
 import os
 import random
 import sys
@@ -63,6 +64,7 @@ from ..base import (
     worker_rank_to_id,
 )
 from ..message import Command, Control, Message, Meta, Node, OPT_SEND_FAILED, Role
+from ..telemetry.tracing import NULL_TRACER
 from ..utils import logging as log
 from ..utils.network import get_ip
 from ..utils.profiling import Profiler
@@ -110,6 +112,22 @@ class Van:
         self._drop_rate = 0
         self.resender: Optional[Resender] = None
         self.profiler = Profiler(self.env, postoffice.role_str())
+        # Telemetry (docs/observability.md): the owning node's registry
+        # and tracer.  A stub postoffice (benchmark/test harnesses) or
+        # a PS_TELEMETRY=0 node gets a PRIVATE enabled registry so the
+        # van's legacy-view counters (syscalls, pool hits, chaos stats)
+        # keep counting either way; the node snapshot still reads
+        # po.metrics and stays empty when disabled.
+        from ..telemetry.metrics import enabled_registry
+
+        self.metrics = enabled_registry(getattr(postoffice, "metrics", None))
+        self.tracer = getattr(postoffice, "tracer", None) or NULL_TRACER
+        self._c_sent_msgs = self.metrics.counter("van.sent_messages")
+        self._c_sent_bytes = self.metrics.counter("van.sent_bytes")
+        self._c_recv_msgs = self.metrics.counter("van.recv_messages")
+        self._c_recv_bytes = self.metrics.counter("van.recv_bytes")
+        self._h_lane_wait = self.metrics.histogram("van.lane_wait_s")
+        self.metrics.gauge("van.lane_depth", fn=self._owner_lane_depth)
         # Scheduler-side registration state.
         self._registrations: List[Node] = []
         self._registered_addrs: Dict[str, int] = {}  # addr -> assigned id
@@ -194,7 +212,14 @@ class Van:
                 self._announced_dead = set()
                 with self._lanes_mu:
                     self._lanes = {}  # drop joined threads/stale lanes
+                if self.profiler.closed:
+                    # A prior stop() closed the event log; a restarted
+                    # van records again instead of silently dropping
+                    # every event (the old lost-on-restart lifecycle).
+                    self.profiler = Profiler(self.env, self.po.role_str())
                 self._init_nodes()
+                if self.my_node.id >= 0:
+                    self.tracer.node_id = self.my_node.id  # scheduler
                 port = self.bind_transport(self.my_node, max_retry=40)
                 # Transports that bind multiple rails populate node.ports
                 # themselves (MultiVan); single-rail transports report one.
@@ -323,6 +348,12 @@ class Van:
             self.resender.stop()
         self.post_stop()
         self.profiler.close()
+        try:
+            # One Chrome trace file per node on clean shutdown (no-op
+            # when PS_TRACE_SAMPLE is off or nothing was recorded).
+            self.tracer.export_if_any()
+        except Exception as exc:  # noqa: BLE001 - teardown best-effort
+            log.warning(f"trace export failed: {exc!r}")
         self.ready.clear()
         self._init_stage = 0
 
@@ -332,6 +363,22 @@ class Van:
         with self._timestamp_mu:
             self._timestamp += 1
             return self._timestamp
+
+    def _total_lane_depth(self) -> int:
+        """Messages currently queued across every send lane (sampled by
+        the ``van.lane_depth`` gauge at snapshot time)."""
+        with self._lanes_mu:
+            lanes = list(self._lanes.values())
+        return sum(len(lane.q) for lane in lanes)
+
+    def _owner_lane_depth(self) -> int:
+        """Gauge fn for ``van.lane_depth``: sample the POSTOFFICE'S van
+        — under MultiVan every rail van shares the registry and would
+        otherwise re-register the gauge onto its own (always-empty)
+        lanes; the outer van registered on ``po.van`` owns the real
+        queues.  Stub postoffices without a ``van`` sample self."""
+        van = getattr(self.po, "van", None)
+        return (van if van is not None else self)._total_lane_depth()
 
     def _lane_key(self, msg: Message):
         """Lane identity for a message.  Default: the destination node —
@@ -389,6 +436,9 @@ class Van:
             )
         if (msg.meta.control.empty() and self._send_async
                 and not self._lane_stop):  # unlocked fast path; re-checked
+            # Lane-wait accounting (histogram + lane_wait trace span):
+            # stamped at enqueue, read at lane dequeue.
+            msg._lane_enq = time.monotonic()
             lane = self._lane_for(msg)
             # Thread before push: a lane thread idling on an empty queue
             # retires cleanly at drain, but a message pushed with no
@@ -411,7 +461,15 @@ class Van:
             msg.meta.sid = sid
         if self.resender is not None:
             self.resender.add_outgoing(msg)
-        nbytes = self._transmit(msg)
+        trace = msg.meta.trace if msg.meta.control.empty() else 0
+        if trace and self.tracer.active:
+            t0 = self.tracer.now_us()
+            nbytes = self._transmit(msg)
+            self.tracer.span(trace, "wire_send", t0, args={
+                "dst": msg.meta.recver, "bytes": nbytes,
+            })
+        else:
+            nbytes = self._transmit(msg)
         if msg.meta.control.empty():
             self.profiler.record(msg.meta.key, "send", msg.meta.push)
         log.vlog(2, lambda: f"SEND {msg.debug_string()}")
@@ -426,6 +484,8 @@ class Van:
             nbytes = self.send_msg(msg)
         with self._bytes_mu:
             self.send_bytes += nbytes
+            self._c_sent_msgs.inc()
+            self._c_sent_bytes.inc(nbytes)
         return nbytes
 
     def _lane_sender(self, lane: _SendLane) -> None:
@@ -442,6 +502,16 @@ class Van:
                     )
                 return
             msg, raw = item
+            enq = getattr(msg, "_lane_enq", None)
+            if enq is not None:
+                wait = time.monotonic() - enq
+                self._h_lane_wait.observe(wait)
+                if msg.meta.trace and self.tracer.active:
+                    now = self.tracer.now_us()
+                    self.tracer.span(
+                        msg.meta.trace, "lane_wait", now - wait * 1e6,
+                        wait * 1e6, args={"dst": msg.meta.recver},
+                    )
             try:
                 if raw:  # resender retransmit: already sid'd + buffered
                     self._transmit(msg)
@@ -510,6 +580,10 @@ class Van:
         shutdown-drain retransmits (lanes already retired) go inline."""
         if (self._send_async and msg.meta.control.empty()
                 and not self._lane_stop):
+            # Fresh enqueue stamp: the message may carry one from its
+            # ORIGINAL send — lane-wait accounting must clock this
+            # retransmit's queue time, not time-since-first-send.
+            msg._lane_enq = time.monotonic()
             lane = self._lane_for(msg)
             self._ensure_lane_thread(lane)
             if lane.q.push(msg.meta.priority, (msg, True),
@@ -690,6 +764,37 @@ class Van:
             self.mark_peer_down(node.id)
             self.po.notify_node_failure(node.id, True)
 
+    # -- cluster telemetry pull (docs/observability.md) ----------------------
+
+    def _process_metrics_pull(self, msg: Message) -> None:
+        """METRICS_PULL: a request snapshots this node's registry into
+        the reply's body (JSON); a response is routed to the postoffice
+        collector (the scheduler's ``collect_cluster_metrics``)."""
+        if not msg.meta.request:
+            self.po.absorb_metrics_reply(msg)
+            return
+        try:
+            body = json.dumps(self.po.telemetry_snapshot()).encode()
+        except Exception as exc:  # noqa: BLE001 - a bad gauge fn must
+            # not strand the collector waiting for this node's reply.
+            body = json.dumps({
+                "node_id": self.my_node.id, "error": repr(exc),
+            }).encode()
+        reply = Message()
+        reply.meta.recver = msg.meta.sender
+        reply.meta.sender = self.my_node.id
+        reply.meta.request = False
+        reply.meta.timestamp = msg.meta.timestamp  # collector token
+        reply.meta.control = Control(cmd=Command.METRICS_PULL)
+        reply.meta.body = body
+        try:
+            # _dispatch_send, not send(): runs on the receive pump and
+            # must neither consume a parked _lane_error nor die on a
+            # transport error.
+            self._dispatch_send(reply)
+        except Exception as exc:  # noqa: BLE001
+            log.warning(f"METRICS_PULL reply failed: {exc!r}")
+
     # -- receive loop --------------------------------------------------------
 
     def _receiving(self) -> None:
@@ -722,6 +827,8 @@ class Van:
             if msg is None:
                 break
             self.recv_bytes += msg.meta.data_size
+            self._c_recv_msgs.inc()
+            self._c_recv_bytes.inc(msg.meta.data_size)
             ctrl = msg.meta.control
             if (
                 self._drop_rate > 0
@@ -753,6 +860,8 @@ class Van:
                     self._process_heartbeat(msg)
                 elif ctrl.cmd == Command.NODE_FAILURE:
                     self._process_node_failure(msg)
+                elif ctrl.cmd == Command.METRICS_PULL:
+                    self._process_metrics_pull(msg)
                 elif ctrl.cmd == Command.ACK:
                     pass  # consumed by the resender when enabled
                 else:
@@ -838,6 +947,11 @@ class Van:
     def _process_data_msg(self, msg: Message) -> None:
         self.deliver_data_msg(msg)
         self.profiler.record(msg.meta.key, "recv", msg.meta.push)
+        if msg.meta.trace and self.tracer.active:
+            self.tracer.instant(msg.meta.trace, "recv", args={
+                "from": msg.meta.sender, "bytes": msg.meta.data_size,
+                "push": msg.meta.push, "request": msg.meta.request,
+            })
         app_id = msg.meta.app_id
         # Workers demux by customer_id (several KVWorker customers share one
         # app); servers demux by app_id (reference: van.cc:428-438).

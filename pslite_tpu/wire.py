@@ -33,6 +33,15 @@ from .sarray import SArray
 MAGIC = 0x50535450  # "PSTP"
 WIRE_VERSION = 2  # v2: priority field (send scheduling echo)
 
+# Optional trailing extension blocks appended after the node list:
+# ``u8 tag | u8 len | payload[len]``.  Decoders skip unknown tags by
+# length and older decoders (which stop after the node list) ignore the
+# tail entirely, so extensions never bump WIRE_VERSION — the native C++
+# core frames meta as opaque bytes and is unaffected.
+_EXT_HDR = struct.Struct("<BB")
+EXT_TRACE = 1  # payload: u64 trace id (telemetry/tracing.py)
+_EXT_TRACE_PAYLOAD = struct.Struct("<Q")
+
 _META_FIXED = struct.Struct(
     "<B"  # version
     "iiiii i"  # head app_id customer_id timestamp sender recver
@@ -144,6 +153,9 @@ def pack_meta(meta: Meta) -> bytes:
     parts.append(bytes(meta.body))
     for n in ctrl.node:
         parts.append(_pack_node(n))
+    if meta.trace:
+        parts.append(_EXT_HDR.pack(EXT_TRACE, _EXT_TRACE_PAYLOAD.size))
+        parts.append(_EXT_TRACE_PAYLOAD.pack(meta.trace % (1 << 64)))
     return b"".join(parts)
 
 
@@ -188,6 +200,15 @@ def unpack_meta(buf: bytes) -> Meta:
     for _ in range(num_nodes):
         node, off = _unpack_node(view, off)
         nodes.append(node)
+    trace = 0
+    while off + _EXT_HDR.size <= len(view):
+        tag, ext_len = _EXT_HDR.unpack_from(view, off)
+        off += _EXT_HDR.size
+        if off + ext_len > len(view):
+            break  # truncated tail: ignore, extensions are optional
+        if tag == EXT_TRACE and ext_len == _EXT_TRACE_PAYLOAD.size:
+            (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(view, off)
+        off += ext_len  # unknown tags skip by length
     meta = Meta(
         head=head,
         app_id=app_id,
@@ -213,6 +234,7 @@ def unpack_meta(buf: bytes) -> Meta:
         sid=sid,
         data_size=data_size,
         priority=priority,
+        trace=trace,
         src_dev_type=src_dt,
         src_dev_id=src_di,
         dst_dev_type=dst_dt,

@@ -172,6 +172,39 @@ def test_bench_cli_contract(tmp_path):
     assert rec["value"] > 0
 
 
+def test_telemetry_overhead_guard():
+    """The telemetry layer must never silently become the bottleneck:
+    the kv loopback storm with PS_TELEMETRY on stays within 10% of
+    telemetry-off on the stub bench (min-of-3 per leg to damp scheduler
+    noise, plus a small absolute epsilon for sub-second walls)."""
+    from pslite_tpu.benchmark import kv_loopback_storm
+
+    def best(telemetry: bool) -> float:
+        walls = []
+        for _ in range(3):
+            r = kv_loopback_storm(
+                n_workers=2, n_servers=2, msgs_per_worker=40,
+                keys_per_msg=8, val_len=512, telemetry=telemetry,
+            )
+            walls.append(r["wall_s"])
+        return min(walls)
+
+    # Interleave-insensitive order: off first warms every code path.
+    off = best(False)
+    on = best(True)
+    assert on <= off * 1.10 + 0.05, (
+        f"telemetry overhead too high: on={on:.3f}s off={off:.3f}s "
+        f"({on / off:.2f}x)"
+    )
+    # And the instrumented leg actually measured something.
+    r = kv_loopback_storm(n_workers=1, n_servers=1, msgs_per_worker=5,
+                          telemetry=True)
+    tel = r["telemetry"]
+    worker = next(v for k, v in tel.items() if k.startswith("worker"))
+    assert worker["counters"]["kv.pushes"] == 5
+    assert worker["histograms"]["kv.push_latency_s"]["count"] == 5
+
+
 def test_send_lanes_fanout_harness():
     """The send_lanes section's harness: laned fan-out must beat the
     serialized (PS_SEND_LANES=0) replay on a stub transport with a

@@ -83,12 +83,26 @@ def test_chain_forward_bit_exact():
                 K0, rng.standard_normal(2 * 16).astype(np.float32)))
             worker.wait(worker.push(
                 K1, rng.standard_normal(2 * 16).astype(np.float32)))
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 10
         primary = _by_rank(servers, 0)
         replica = _by_rank(servers, 1)
-        while (time.monotonic() < deadline
-               and not all(int(k) in replica._handle.store for k in K0)):
-            time.sleep(0.05)  # forwards are async
+
+        def _converged() -> bool:
+            # Forwards are async: a key being PRESENT on the replica
+            # does not mean every push has applied yet — poll until the
+            # stores actually agree (the asserts below then re-check
+            # and produce the real diagnostic on timeout).
+            for ks, holder, copy in ((K0, primary, replica),
+                                     (K1, replica, primary)):
+                for k in ks:
+                    a = holder._handle.store.get(int(k))
+                    b = copy._handle.store.get(int(k))
+                    if a is None or b is None or not np.array_equal(a, b):
+                        return False
+            return True
+
+        while time.monotonic() < deadline and not _converged():
+            time.sleep(0.05)
         for k in K0:
             # Bit-exact: float sums applied in the identical order.
             np.testing.assert_array_equal(

@@ -1,0 +1,364 @@
+"""Cluster-wide telemetry (docs/observability.md): metrics registry,
+distributed tracing, and the scheduler-pulled METRICS_PULL plane."""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.telemetry.metrics import Histogram, Registry
+
+from helpers import LoopbackCluster
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+# -- metrics primitives ------------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    h = Histogram("lat", lo=1e-6)
+    # Bucket 0 holds everything <= lo; bucket i covers
+    # [lo*2^(i-1), lo*2^i).
+    assert h.bucket_index(0.0) == 0
+    assert h.bucket_index(1e-6) == 0
+    assert h.bucket_index(1.5e-6) == 1
+    assert h.bucket_index(3e-6) == 2
+    assert h.bucket_index(1e13) == Histogram.NBUCKETS - 1  # clamped
+    for v in (1e-6, 2e-6, 4e-6, 1e-3, 1e-3, 1e-3):
+        h.observe(v)
+    assert h.count == 6
+    assert h.min == 1e-6 and h.max == 1e-3
+    assert abs(h.sum - (7e-6 + 3e-3)) < 1e-12
+    # Quantiles are monotone, bounded by observed extremes, and p50 of
+    # this set lands in the 1e-3 mass.
+    p50, p90, p99 = h.quantile(0.5), h.quantile(0.9), h.quantile(0.99)
+    assert h.min <= p50 <= p90 <= p99 <= h.max
+    # Half the mass sits at 1e-3: the upper quantiles must find it.
+    assert p90 > 1e-4
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert sum(n for _i, n in snap["buckets"]) == 6
+
+
+def test_registry_snapshot_and_reset():
+    reg = Registry()
+    reg.counter("a").inc(3)
+    reg.gauge("g").set(7.5)
+    reg.histogram("h").observe(0.5)
+    reg.topk("t").add(42, 5)
+    reg.topk("t").add(7, 1)
+    snap = reg.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 7.5
+    assert snap["histograms"]["h"]["count"] == 1
+    assert snap["topk"]["t"][0] == [42, 5]
+    assert snap["uptime_s"] >= 0
+    json.dumps(snap)  # the METRICS_PULL body contract
+    # Idempotent get-or-create; type collisions fail loud.
+    assert reg.counter("a") is reg.counter("a")
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+    reg.reset()
+    snap = reg.snapshot()
+    assert snap["counters"]["a"] == 0
+    assert snap["histograms"]["h"]["count"] == 0
+    assert snap["topk"]["t"] == []
+
+
+def test_disabled_registry_is_null():
+    reg = Registry(enabled=False)
+    c = reg.counter("x")
+    c.inc(100)
+    assert c.value == 0
+    reg.histogram("h").observe(1.0)
+    assert reg.snapshot()["counters"] == {}
+    # All disabled instruments are the same shared singleton.
+    assert reg.counter("y") is c
+
+
+def test_topk_bounded_eviction():
+    reg = Registry()
+    t = reg.topk("hot", cap=4)
+    for k in range(4):
+        t.add(k, 10 * (k + 1))
+    t.add(99, 1)  # evicts the min (key 0, count 10), inherits its count
+    top = dict(t.top(10))
+    assert 0 not in top
+    assert top[99] == 11
+
+
+def test_wire_trace_extension_roundtrip():
+    """meta.trace rides a tagged tail block: roundtrips when set, adds
+    zero bytes when unset, and decoders skip unknown tags by length."""
+    from pslite_tpu import wire
+    from pslite_tpu.message import Meta
+
+    m = Meta(timestamp=7, sender=9, recver=8, request=True, push=True)
+    plain = wire.pack_meta(m)
+    m.trace = 0xDEADBEEFCAFE
+    traced = wire.pack_meta(m)
+    assert len(traced) > len(plain)
+    out = wire.unpack_meta(traced)
+    assert out.trace == 0xDEADBEEFCAFE and out.timestamp == 7
+    assert wire.unpack_meta(plain).trace == 0
+    # Unknown trailing tag (tag=200, len=4): skipped, trace still read.
+    import struct
+
+    exotic = traced + struct.pack("<BB4s", 200, 4, b"abcd")
+    assert wire.unpack_meta(exotic).trace == 0xDEADBEEFCAFE
+
+
+# -- live-cluster storm fixtures ---------------------------------------------
+
+
+def _run_storm(cluster, rounds=5, keys=None):
+    servers = []
+    for po in cluster.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    workers = [KVWorker(0, 0, postoffice=po) for po in cluster.workers]
+    if keys is None:
+        keys = np.array([3, 2 ** 63 + 9], dtype=np.uint64)
+    vals = np.ones(len(keys) * 16, dtype=np.float32)
+    for _ in range(rounds):
+        tss = [w.push(keys, vals) for w in workers]
+        for w, ts in zip(workers, tss):
+            w.wait(ts)
+    out = np.zeros_like(vals)
+    workers[0].wait(workers[0].pull(keys, out))
+    return servers, workers, out
+
+
+# -- METRICS_PULL pull plane -------------------------------------------------
+
+
+def test_metrics_pull_returns_all_nodes():
+    cluster = LoopbackCluster(num_workers=2, num_servers=2)
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, _out = _run_storm(cluster)
+        snap = cluster.scheduler.collect_cluster_metrics(timeout_s=10)
+        ids = {po.van.my_node.id for po in cluster.all_nodes()}
+        assert set(snap.keys()) == ids  # every registered node answered
+        roles = sorted(s["role"] for s in snap.values())
+        assert roles == ["scheduler", "server", "server", "worker",
+                         "worker"]
+        wsnap = next(s for s in snap.values() if s["role"] == "worker")
+        m = wsnap["metrics"]
+        assert m["counters"]["kv.pushes"] >= 5
+        assert m["histograms"]["kv.push_latency_s"]["count"] >= 5
+        assert m["histograms"]["kv.push_latency_s"]["p99"] > 0
+        assert "van.lane_depth" in m["gauges"]
+        ssnap = next(s for s in snap.values() if s["role"] == "server")
+        sm = ssnap["metrics"]
+        assert sm["counters"]["kv.server_push_requests"] >= 5
+        assert sm["topk"]["kv.hot_keys"], "hot-key tracker empty"
+        # A second pull works (token machinery resets cleanly).
+        snap2 = cluster.scheduler.collect_cluster_metrics(timeout_s=10)
+        assert set(snap2.keys()) == ids
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_psmon_table_against_live_cluster():
+    """Acceptance: psmon against a live 2w+2s cluster prints per-node
+    rows with request-latency, lane depth, apply throughput, and
+    retransmit columns."""
+    import psmon
+
+    cluster = LoopbackCluster(num_workers=2, num_servers=2)
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, _out = _run_storm(cluster, rounds=8)
+        snap = psmon.collect(cluster.scheduler, timeout_s=10)
+        table = psmon.format_table(snap)
+        for col in ("req_p50ms", "lane_q", "apply/s", "retx",
+                    "repl_fwd", "per-role rollup", "hot keys"):
+            assert col in table, table
+        # One row per node.
+        for po in cluster.all_nodes():
+            assert f"\n{po.van.my_node.id:>5} " in "\n" + table, table
+        js = json.loads(psmon.to_json(snap))
+        assert len(js) == 5
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+# -- distributed tracing -----------------------------------------------------
+
+
+def test_trace_propagation_and_chrome_export(tmp_path):
+    """A sampled push's spans share one trace id across worker and
+    server processes; the per-node export is valid Chrome trace JSON
+    whose request span nests its wire_send."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=2,
+        env_extra={"PS_TRACE_SAMPLE": "1",
+                   "PS_TRACE_DIR": str(tmp_path)},
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, _out = _run_storm(cluster, rounds=3)
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+    files = sorted(glob.glob(str(tmp_path / "pslite_trace_*.json")))
+    worker_files = [f for f in files if "worker" in f]
+    server_files = [f for f in files if "server" in f]
+    assert worker_files and server_files, files
+    wdoc = json.load(open(worker_files[0]))
+    events = wdoc["traceEvents"]
+    assert all("ph" in e for e in events)  # valid shape
+    assert all("ts" in e for e in events if e["ph"] != "M")
+    # Pick a trace id that produced a request span on the worker.
+    req = next(e for e in events
+               if e["name"] == "request" and e["args"].get("trace"))
+    tid = req["args"]["trace"]
+    wire = [e for e in events if e["name"] == "wire_send"
+            and e["args"].get("trace") == tid]
+    assert wire, "request trace has no wire_send span"
+    # Nesting: the request span encloses its wire sends.
+    for e in wire:
+        assert req["ts"] <= e["ts"] + 1.0
+        assert e["ts"] + e["dur"] <= req["ts"] + req["dur"] + 1.0
+    # The SAME id shows up server-side as an apply span (the key 3
+    # slice lands on rank 0; check both server files).
+    server_hits = []
+    for f in server_files:
+        sev = json.load(open(f))["traceEvents"]
+        server_hits += [e for e in sev if e["args"].get("trace") == tid
+                        and e["name"] == "apply"]
+    assert server_hits, "worker trace id never reached a server apply"
+    # Worker-side completion closes the loop.
+    assert any(e["name"] == "complete" and e["args"].get("trace") == tid
+               for e in events)
+
+
+def test_trace_sample_zero_records_nothing(tmp_path):
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"PS_TRACE_DIR": str(tmp_path)},  # sampling off
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, _out = _run_storm(cluster, rounds=2,
+                                            keys=np.array([3], np.uint64))
+        assert cluster.workers[0].tracer.num_events == 0
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+    assert not glob.glob(str(tmp_path / "pslite_trace_*.json"))
+
+
+# -- counter migration (one idiom, thin legacy views) ------------------------
+
+
+def test_legacy_counter_views_ride_the_registry():
+    cluster = LoopbackCluster(num_workers=1, num_servers=1)
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, _out = _run_storm(cluster, rounds=3,
+                                            keys=np.array([3], np.uint64))
+        srv_po = cluster.servers[0]
+        pool = servers[0]._apply_pool
+        if pool is not None:
+            # The legacy attribute and the registry counter are one.
+            assert pool.sharded_requests == srv_po.metrics.counter(
+                "apply.sharded_requests").value
+            assert pool.sharded_requests >= 3
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_chaos_drop_shows_as_retransmit_delta():
+    """A chaos-van receive drop is healed by PS_RESEND and visible as a
+    resender.retransmits counter delta on the sending side."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="chaos+loopback",
+        env_extra={
+            "PS_CHAOS": "seed=5,drop=0.3",
+            "PS_RESEND": "1",
+            "PS_RESEND_TIMEOUT": "50",
+        },
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers, out = _run_storm(cluster, rounds=6,
+                                           keys=np.array([3], np.uint64))
+        np.testing.assert_allclose(out, 6 * np.ones_like(out))
+        dropped = sum(
+            po.van.chaos_stats["recv_dropped"]
+            for po in cluster.all_nodes()
+        )
+        assert dropped > 0, "chaos injected nothing"
+        retx = sum(
+            po.metrics.counter("resender.retransmits").value
+            for po in cluster.all_nodes()
+        )
+        assert retx > 0, "drops never surfaced as retransmit counters"
+        # chaos_stats itself is a registry view now (one counter idiom).
+        van = cluster.workers[0].van
+        assert van.chaos_stats["send_dropped"] == van.metrics.counter(
+            "chaos.send_dropped").value
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()
+
+
+def test_heartbeat_gap_histogram():
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"PS_HEARTBEAT_INTERVAL": "0.05",
+                   "PS_HEARTBEAT_TIMEOUT": "60"},
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        import time
+
+        time.sleep(0.4)
+        h = cluster.scheduler.metrics.histogram("heartbeat.gap_s",
+                                                lo=1e-3)
+        assert h.count >= 2
+        assert 0.01 < h.quantile(0.5) < 2.0
+        servers, workers, _out = _run_storm(cluster, rounds=1,
+                                            keys=np.array([3], np.uint64))
+        for w in workers:
+            w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cluster.finalize()

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""psmon — live cluster-wide telemetry monitor (docs/observability.md).
+
+Asks the scheduler for a ``METRICS_PULL`` snapshot of every node's
+metrics registry and renders one table row per node (request-latency
+quantiles, lane depth, apply-shard throughput, retransmits, replication
+forwards/lag) plus per-role rollups and each server's hottest keys.
+
+Library use (in-process clusters, tests, notebooks)::
+
+    from tools import psmon
+    snap = psmon.collect(scheduler_postoffice)   # {node_id: snapshot}
+    print(psmon.format_table(snap))              # or psmon.to_json(snap)
+
+CLI: ``python tools/psmon.py [--json]`` boots a live demo
+LoopbackCluster (2 workers, 2 servers, scheduler), drives a short
+push/pull storm, pulls the cluster snapshot through the scheduler, and
+prints it — the end-to-end proof of the pull plane without needing an
+external deployment to attach to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+# Script use from anywhere: put the repo root ahead of tools/.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def collect(scheduler_po, timeout_s: float = 5.0) -> Dict[int, dict]:
+    """Cluster snapshot via the scheduler's METRICS_PULL broadcast:
+    ``{node_id: telemetry_snapshot}`` (nodes that failed to answer
+    within the timeout are absent)."""
+    return scheduler_po.collect_cluster_metrics(timeout_s=timeout_s)
+
+
+def to_json(snap: Dict[int, dict]) -> str:
+    return json.dumps({str(k): v for k, v in sorted(snap.items())},
+                      indent=2, sort_keys=True)
+
+
+def _hist_q(m: dict, name: str, q: str) -> float:
+    h = m.get("histograms", {}).get(name)
+    return h.get(q, 0.0) if h else 0.0
+
+
+def _c(m: dict, name: str) -> int:
+    return int(m.get("counters", {}).get(name, 0))
+
+
+def _g(m: dict, name: str) -> float:
+    return float(m.get("gauges", {}).get(name, 0.0))
+
+
+def _req_quantiles(m: dict) -> tuple:
+    """Merged push/pull request-latency (p50, p99) in ms — worker side."""
+    hp = m.get("histograms", {}).get("kv.push_latency_s") or {}
+    hl = m.get("histograms", {}).get("kv.pull_latency_s") or {}
+    # Weighted pick: report the busier path's quantiles (a true merged
+    # quantile would need the raw buckets of both; close enough for a
+    # monitor row — the JSON dump has both histograms in full).
+    busy = hp if hp.get("count", 0) >= hl.get("count", 0) else hl
+    return busy.get("p50", 0.0) * 1e3, busy.get("p99", 0.0) * 1e3
+
+
+def _apply_row(m: dict, uptime: float) -> tuple:
+    n = _c(m, "apply.sharded_requests") + _c(m, "apply.global_requests")
+    rate = n / uptime if uptime > 0 else 0.0
+    depth = sum(
+        v for k, v in m.get("gauges", {}).items()
+        if k.startswith("apply.shard") and k.endswith(".depth")
+    )
+    return n, rate, depth
+
+
+def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
+    """Human-readable per-node table + per-role rollups."""
+    hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
+           f"{'req_p99ms':>9} {'lane_q':>6} {'apply_n':>8} "
+           f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
+           f"{'sent':>7} {'recv':>7}")
+    lines = [hdr, "-" * len(hdr)]
+    rollup: Dict[str, Dict[str, float]] = {}
+    hot_lines: List[str] = []
+    for node_id in sorted(snap):
+        s = snap[node_id]
+        m = s.get("metrics", {})
+        uptime = float(m.get("uptime_s", 0.0))
+        p50, p99 = _req_quantiles(m)
+        apply_n, apply_rate, _apply_depth = _apply_row(m, uptime)
+        lane_q = _g(m, "van.lane_depth")
+        retx = _c(m, "resender.retransmits")
+        fwd = _c(m, "replication.forwards")
+        lag = _g(m, "replication.lag")
+        sent = _c(m, "van.sent_messages")
+        recv = _c(m, "van.recv_messages")
+        role = s.get("role", "?")
+        lines.append(
+            f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
+            f"{p99:>9.3f} {lane_q:>6.0f} {apply_n:>8} "
+            f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
+            f"{sent:>7} {recv:>7}"
+        )
+        r = rollup.setdefault(role, {"nodes": 0, "sent": 0, "recv": 0,
+                                     "apply": 0, "retx": 0, "fwd": 0})
+        r["nodes"] += 1
+        r["sent"] += sent
+        r["recv"] += recv
+        r["apply"] += apply_n
+        r["retx"] += retx
+        r["fwd"] += fwd
+        top = m.get("topk", {}).get("kv.hot_keys") or []
+        if top:
+            pretty = ", ".join(f"{k}:{n}" for k, n in top[:top_keys])
+            hot_lines.append(f"  node {node_id} ({role}) hot keys: {pretty}")
+    lines.append("")
+    lines.append("per-role rollup:")
+    for role in sorted(rollup):
+        r = rollup[role]
+        lines.append(
+            f"  {role:>9}: {int(r['nodes'])} node(s), "
+            f"sent={int(r['sent'])} recv={int(r['recv'])} "
+            f"apply={int(r['apply'])} retx={int(r['retx'])} "
+            f"repl_fwd={int(r['fwd'])}"
+        )
+    if hot_lines:
+        lines.append("")
+        lines.extend(hot_lines)
+    return "\n".join(lines)
+
+
+def _demo(as_json: bool) -> int:
+    """Boot a live 2w+2s LoopbackCluster, run a short storm, snapshot
+    through the scheduler, print.  The standalone proof of the pull
+    plane (library callers attach to their own scheduler instead)."""
+    import numpy as np
+
+    from pslite_tpu.benchmark import _loopback_cluster, _teardown_cluster
+    from pslite_tpu.kv.kv_app import (KVServer, KVServerDefaultHandle,
+                                      KVWorker)
+
+    nodes = _loopback_cluster(num_workers=2, num_servers=2,
+                              ns="psmon-demo")
+    scheduler, server_pos, worker_pos = nodes[0], nodes[1:3], nodes[3:]
+    servers = []
+    workers = []
+    try:
+        for po in server_pos:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        workers = [KVWorker(0, 0, postoffice=po) for po in worker_pos]
+        keys = np.array([3, 2 ** 62, 2 ** 63 + 9], dtype=np.uint64)
+        vals = np.ones(3 * 128, dtype=np.float32)
+        out = np.zeros_like(vals)
+        for _ in range(20):
+            for w in workers:
+                w.wait(w.push(keys, vals))
+        workers[0].wait(workers[0].pull(keys, out))
+        snap = collect(scheduler)
+        print(to_json(snap) if as_json else format_table(snap))
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw snapshot as JSON")
+    args = ap.parse_args(argv)
+    return _demo(args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
