@@ -4,8 +4,11 @@
 #   make native ASAN=1  ... with AddressSanitizer
 #   make native TSAN=1  ... with ThreadSanitizer (io thread vs callers)
 #   make test           run the full suite (virtual 8-device CPU mesh)
+#   make tier1          THE tier-1 gate: the exact ROADMAP.md invocation
 #   make bench          run the headline benchmark on the local accelerator
 #   make lint           byte-compile every Python module
+
+SHELL := /bin/bash
 
 ASAN ?= 0
 TSAN ?= 0
@@ -19,7 +22,7 @@ ifeq ($(TSAN), 1)
 CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=thread"
 endif
 
-.PHONY: all native test bench lint clean
+.PHONY: all native test tier1 bench lint clean
 
 all: native
 
@@ -28,6 +31,13 @@ native:
 
 test: native
 	python -m pytest tests/ -x -q
+
+# The tier-1 verification gate, verbatim from ROADMAP.md ("Tier-1
+# verify") so builder and reviewer run ONE pinned invocation instead of
+# drifting copies (referenced by tests/test_bench_smoke.py).  Prints
+# DOTS_PASSED=<n> and exits with pytest's status.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 bench: native
 	python bench.py

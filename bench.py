@@ -1066,6 +1066,18 @@ def main() -> None:
                 "telemetry": storm["telemetry"],
             }
 
+        def sec_chunk_streaming():
+            # Chunked streaming transfers (docs/chunking.md): 64 MiB
+            # push goodput chunked vs monolithic, and the headline —
+            # small-pull p99 under a concurrent 64 MiB background push
+            # (the head-of-line wait chunking + the express receive
+            # lane bound to ~one chunk).  Real 1w+1s tcp cluster, one
+            # process per node, host-side only, tunnel-independent.
+            from pslite_tpu.benchmark import chunk_streaming_bench
+
+            cs = chunk_streaming_bench(quick=quick)
+            return {f"chunk_{k}": v for k, v in cs.items()}
+
         def sec_fault_recovery():
             # Recovery path gets a tracked number like the perf paths:
             # server kill -> detector broadcast -> failover pull success
@@ -1085,6 +1097,7 @@ def main() -> None:
             rec.run("latency", sec_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("chunk_streaming", sec_chunk_streaming)
             rec.run("kv_telemetry", sec_kv_telemetry)
             rec.run("fault_recovery", sec_fault_recovery)
         else:
@@ -1099,6 +1112,7 @@ def main() -> None:
             rec.run("van_latency", sec_van_latency)
             rec.run("send_lanes", sec_send_lanes)
             rec.run("server_apply", sec_server_apply)
+            rec.run("chunk_streaming", sec_chunk_streaming)
             rec.run("kv_telemetry", sec_kv_telemetry)
             rec.run("fault_recovery", sec_fault_recovery)
             rec.run("stress", sec_stress)

@@ -58,6 +58,31 @@ struct Frame {
   //   then data segments back to back
 };
 
+// Fixed offsets inside the python wire format's meta block (wire.py
+// _META_FIXED, little-endian, no padding): enough to peek a frame's
+// send priority and control command for the express receive lane
+// without decoding the meta.  Keep in sync with wire.py.
+constexpr size_t kMetaPriorityOff = 70;  // i32
+constexpr size_t kMetaControlCmdOff = 84;  // u8; 0 == EMPTY (data plane)
+constexpr size_t kMetaFixedSize = 105;
+
+// True when this frame rides the express receive lane, mirroring the
+// pure-Python PriorityRecvQueue discipline (utils/queues.py,
+// docs/chunking.md): control frames (ACKs, heartbeats, barriers) ride
+// above EVERY data level so a bulk chunk backlog can never starve the
+// control plane, and priority>0 data bypasses the backlog too.
+// TERMINATE stays in the ordinary queue — it must drain BEHIND queued
+// traffic, or the receive loop would retire with frames undelivered.
+static bool FrameIsExpress(const Frame& f) {
+  if (f.meta_len < kMetaFixedSize) return false;
+  const uint8_t* meta = f.buf + 8ull * f.n_data;
+  uint8_t cmd = meta[kMetaControlCmdOff];
+  if (cmd != 0) return cmd != 1;  // 1 == TERMINATE (message.py Command)
+  int32_t prio;
+  memcpy(&prio, meta + kMetaPriorityOff, sizeof(prio));
+  return prio > 0;
+}
+
 // Cross-process SPSC byte pipe over a /dev/shm mapping — the reference's
 // vendored in-process lock-free SPSC ring (spsc_queue.h) extended across
 // processes for same-host meta traffic.  Stream semantics: the writer
@@ -628,19 +653,26 @@ class Core {
     return sent_total;
   }
 
-  // Returns 1 with a frame, 0 on timeout, -1 when stopped.
+  // Returns 1 with a frame, 0 on timeout, -1 when stopped.  Express
+  // frames (priority > 0 data — see FrameIsExpress) pop first so a
+  // priority op never waits behind a bulk chunk backlog; each lane is
+  // FIFO, matching the Python PriorityRecvQueue discipline.
   int Recv(Frame* out, int timeout_ms) {
     std::unique_lock<std::mutex> lk(queue_mu_);
-    auto ready = [this] { return stopped_ || !queue_.empty(); };
+    auto ready = [this] {
+      return stopped_ || !express_.empty() || !queue_.empty();
+    };
     if (timeout_ms < 0) {
       queue_cv_.wait(lk, ready);
     } else if (!queue_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                                    ready)) {
       return 0;
     }
-    if (!queue_.empty()) {
-      *out = queue_.front();
-      queue_.pop_front();
+    std::deque<Frame>* q =
+        !express_.empty() ? &express_ : (!queue_.empty() ? &queue_ : nullptr);
+    if (q != nullptr) {
+      *out = q->front();
+      q->pop_front();
       return 1;
     }
     return stopped_ ? -1 : 0;
@@ -709,6 +741,8 @@ class Core {
     std::lock_guard<std::mutex> qlk(queue_mu_);
     for (auto& f : queue_) free(f.buf);
     queue_.clear();
+    for (auto& f : express_) free(f.buf);
+    express_.clear();
   }
 
  private:
@@ -1007,7 +1041,11 @@ class Core {
       // Frame complete.
       {
         std::lock_guard<std::mutex> lk(queue_mu_);
-        queue_.push_back(c->frame);
+        if (recv_priority_ && FrameIsExpress(c->frame)) {
+          express_.push_back(c->frame);
+        } else {
+          queue_.push_back(c->frame);
+        }
       }
       queue_cv_.notify_one();
       c->frame = Frame();
@@ -1057,6 +1095,14 @@ class Core {
   std::mutex send_mu_;
   std::mutex per_fd_send_mu_[kSendLocks];
   std::deque<Frame> queue_;
+  std::deque<Frame> express_;  // priority > 0 data frames pop first
+  // PS_RECV_PRIORITY=0 restores the single strict-FIFO queue (process
+  // env: the native core is per-process, unlike the per-node Python
+  // Environment overrides of the in-process test clusters).
+  const bool recv_priority_ = [] {
+    const char* v = getenv("PS_RECV_PRIORITY");
+    return v == nullptr || strcmp(v, "0") != 0;
+  }();
   std::mutex queue_mu_;
   std::condition_variable queue_cv_;
 };

@@ -26,7 +26,8 @@ from typing import Optional
 
 import numpy as np
 
-MODES = ("push_then_pull", "push_pull", "push_only", "pull_only")
+MODES = ("push_then_pull", "push_pull", "push_only", "pull_only",
+         "chunk_hol")
 
 
 def _recv_buffer_mode() -> bool:
@@ -84,6 +85,54 @@ class BenchmarkHandle:
             server.response(meta)
 
 
+def run_chunk_hol(worker, args) -> None:
+    """``--mode chunk_hol`` (docs/chunking.md): sequential large pushes
+    from a background thread while the main thread samples small-pull
+    latency against the same server — the pull request shares the
+    per-peer lane (and socket) with the push payload, so its latency IS
+    the head-of-line wait.  Run once with ``PS_CHUNK_BYTES`` set and
+    once with ``0`` to price the chunking win; one process per node, so
+    no shared-GIL convoy pollutes the numbers."""
+    import threading
+
+    nk = args.num_keys
+    val_len = args.len // 4
+    big_keys = np.arange(100, 100 + nk, dtype=np.uint64)
+    big_vals = np.ones(nk * val_len, np.float32)
+    small_key = np.array([7], dtype=np.uint64)
+    small_vals = np.ones(256, np.float32)
+    small_out = np.zeros_like(small_vals)
+    worker.wait(worker.push(big_keys, big_vals))
+    worker.wait(worker.push(small_key, small_vals))
+    worker.wait(worker.pull(small_key, small_out, priority=1))
+    push_wall = [0.0]
+
+    def pusher():
+        t0 = time.perf_counter()
+        for _ in range(args.repeat):
+            worker.wait(worker.push(big_keys, big_vals, priority=0))
+        push_wall[0] = time.perf_counter() - t0
+
+    t = threading.Thread(target=pusher, daemon=True)
+    lats = []
+    t.start()
+    while t.is_alive():
+        t0 = time.perf_counter()
+        worker.wait(worker.pull(small_key, small_out, priority=1))
+        lats.append((time.perf_counter() - t0) * 1e3)
+    t.join()
+    lats.sort()
+    gbps = (8.0 * args.repeat * big_vals.nbytes
+            / max(push_wall[0], 1e-9) / 1e9)
+    p50 = lats[len(lats) // 2] if lats else 0.0
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0.0
+    print(
+        f"CHUNK_HOL samples={len(lats)} pull_p50_ms={p50:.3f} "
+        f"pull_p99_ms={p99:.3f} push_gbps={gbps:.3f}",
+        flush=True,
+    )
+
+
 def run_worker(args) -> None:
     from . import postoffice
     from .kv.kv_app import KVWorker
@@ -91,6 +140,9 @@ def run_worker(args) -> None:
 
     po = postoffice(Role.WORKER)
     worker = KVWorker(0, 0)
+    if args.mode == "chunk_hol":
+        run_chunk_hol(worker, args)
+        return
     ranges = po.get_server_key_ranges()
     keys_per_server = args.num_keys
     val_len = args.len // 4  # fp32 elements per key
@@ -329,24 +381,35 @@ def apply_storm_rates(num_shards: int, n_workers: int = 4,
 
 
 def _loopback_cluster(num_workers: int, num_servers: int, ns: str,
-                      env_extra: Optional[dict] = None) -> list:
-    """Boot an in-process loopback cluster and return its started
-    Postoffices as ``[scheduler, *servers, *workers]`` — the shared
-    harness of the host-side KV benches (storm, fault recovery, psmon
-    demo)."""
+                      env_extra: Optional[dict] = None,
+                      van_type: str = "loopback") -> list:
+    """Boot an in-process cluster and return its started Postoffices as
+    ``[scheduler, *servers, *workers]`` — the shared harness of the
+    host-side KV benches (storm, fault recovery, psmon demo).  The
+    default transport is the loopback van; ``van_type="tcp"`` runs real
+    sockets over 127.0.0.1 (the chunk-streaming bench needs socket
+    semantics — monolithic frames block the peer socket for their full
+    serialize time, which is exactly the head-of-line effect under
+    measurement)."""
     import threading
 
     from .environment import Environment
     from .message import Role
     from .postoffice import Postoffice
 
+    if van_type == "loopback":
+        host, port = "lo", 42000 + os.getpid() % 1000
+    else:
+        from .utils.network import get_available_port
+
+        host, port = "127.0.0.1", get_available_port()
     env_map = {
         "DMLC_NUM_WORKER": str(num_workers),
         "DMLC_NUM_SERVER": str(num_servers),
-        "DMLC_PS_ROOT_URI": "lo",
-        "DMLC_PS_ROOT_PORT": str(42000 + os.getpid() % 1000),
-        "DMLC_NODE_HOST": "lo",
-        "PS_VAN_TYPE": "loopback",
+        "DMLC_PS_ROOT_URI": host,
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NODE_HOST": host,
+        "PS_VAN_TYPE": van_type,
         "PS_LOOPBACK_NS": f"{ns}-{os.getpid()}",
     }
     if env_extra:
@@ -549,6 +612,96 @@ def fault_recovery_times(quick: bool = True) -> dict:
     }
 
 
+def _chunk_run(push_mb: int, n_pushes: int,
+               chunk_bytes: str) -> dict:
+    """One leg of the chunk_streaming bench: a REAL 1w+1s tcp cluster
+    via the local tracker (one process per node — an in-process cluster
+    would measure the shared-GIL convoy, not the transport), running
+    ``--mode chunk_hol``: sequential ``push_mb``-MiB pushes from a
+    background thread while the foreground samples small-pull latency
+    against the same server.  The pull request rides the same per-peer
+    lane and socket as the push payload, so its latency IS the
+    head-of-line wait (docs/chunking.md)."""
+    import re
+    import subprocess
+    import sys
+
+    n_keys = 16
+    cmd = [
+        sys.executable, "-m", "pslite_tpu.tracker.local",
+        "-n", "1", "-s", "1", "--van", "tcp", "--",
+        sys.executable, "-m", "pslite_tpu.benchmark",
+        "--mode", "chunk_hol",
+        "--len", str(push_mb * (1 << 20) // n_keys),
+        "--num-keys", str(n_keys),
+        "--repeat", str(n_pushes),
+    ]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PS_CHUNK_BYTES=chunk_bytes,
+        # Cap kernel-buffered bytes (both legs, so the comparison is
+        # fair): without it the already-accepted send/recv buffers —
+        # not the lane — add a fixed term to the priority pull's wait.
+        PS_TCP_SNDBUF=str(256 << 10),
+        PS_TCP_RCVBUF=str(256 << 10),
+    )
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       env=env)
+    m = re.search(
+        r"CHUNK_HOL samples=(\d+) pull_p50_ms=([0-9.]+) "
+        r"pull_p99_ms=([0-9.]+) push_gbps=([0-9.]+)", r.stdout,
+    )
+    if m is None:
+        raise RuntimeError(
+            f"chunk_hol leg produced no result (rc={r.returncode}): "
+            f"{r.stdout[-500:]}\n{r.stderr[-500:]}"
+        )
+    return {
+        "pull_samples": int(m.group(1)),
+        "pull_p50_ms": float(m.group(2)),
+        "pull_p99_ms": float(m.group(3)),
+        "push_gbps": float(m.group(4)),
+    }
+
+
+def chunk_streaming_bench(quick: bool = True) -> dict:
+    """Chunked streaming transfers (docs/chunking.md) over a live
+    loopback cluster: (a) large-push goodput chunked vs monolithic —
+    the pipelining tax must stay small — and (b) small-pull p99 under a
+    concurrent large background push, chunked vs ``PS_CHUNK_BYTES=0`` —
+    the head-of-line win, the headline number."""
+    push_mb = 64
+    n_pushes = 4 if quick else 8
+    # 512 KiB chunks: measured sweet spot on the host stub — small
+    # enough that per-chunk GIL/copy bursts stay off the small-pull
+    # tail, large enough that goodput beats monolithic.
+    chunk_bytes = 512 << 10
+    chunked = _chunk_run(push_mb, n_pushes, str(chunk_bytes))
+    mono = _chunk_run(push_mb, n_pushes, "0")
+    out = {
+        "push_mb": push_mb,
+        "chunk_bytes": chunk_bytes,
+        "chunked_push_gbps": round(chunked["push_gbps"], 2),
+        "mono_push_gbps": round(mono["push_gbps"], 2),
+        "chunked_pull_p50_ms": round(chunked["pull_p50_ms"], 3),
+        "chunked_pull_p99_ms": round(chunked["pull_p99_ms"], 3),
+        "mono_pull_p50_ms": round(mono["pull_p50_ms"], 3),
+        "mono_pull_p99_ms": round(mono["pull_p99_ms"], 3),
+        "pull_samples": [chunked["pull_samples"], mono["pull_samples"]],
+        # Headline: how much lower the small-pull tail is with the lane
+        # interleaving between chunks instead of behind the monolith.
+        "hol_p99_ratio": (
+            round(mono["pull_p99_ms"] / chunked["pull_p99_ms"], 2)
+            if chunked["pull_p99_ms"] > 0 else None),
+        "push_tput_ratio": (
+            round(chunked["push_gbps"] / mono["push_gbps"], 3)
+            if mono["push_gbps"] > 0 else None),
+    }
+    return out
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A
@@ -586,7 +739,14 @@ def main(argv=None) -> int:
     server = None
     if role in ("server", "joint"):
         server = KVServer(0)
-        server.set_request_handle(BenchmarkHandle())
+        if args.mode == "chunk_hol":
+            # Shard-capable handle: the apply pool (and the streaming
+            # apply of chunked pushes) is part of what chunk_hol prices.
+            from .kv.kv_app import KVServerDefaultHandle
+
+            server.set_request_handle(KVServerDefaultHandle())
+        else:
+            server.set_request_handle(BenchmarkHandle())
         if _recv_buffer_mode():
             register_push_buffers(server, args)
     if role in ("worker", "joint"):

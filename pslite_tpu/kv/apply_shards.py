@@ -50,6 +50,9 @@ from ..utils.queues import ThreadsafeQueue
 # Queue-item task tags.
 _ALL = ("all",)        # whole request lands on one shard (no subsetting)
 _GLOBAL = ("global",)  # barrier op: full handler under all-shard rendezvous
+# ("feed", kvs, positions|None): one fed slice of a streamed chunked
+# push (docs/chunking.md) — carries its own KVPairs because the owning
+# _Pending accumulates many feeds before its close.
 
 
 class _Pending:
@@ -218,6 +221,80 @@ class ApplyShardPool:
                     f"(shutting down?)"
                 )
 
+    # -- streamed chunked pushes (docs/chunking.md) ---------------------------
+
+    def begin_stream(self, meta) -> "_StreamHandle":
+        """Open a streaming apply for one chunked push: ``feed`` each
+        newly arrived whole-key slice as it lands (apply overlaps the
+        remaining wire time), ``close`` when the transfer completes.
+        The response is emitted only after every fed slice's shard work
+        finished, and enters the per-sender order gate at CLOSE time —
+        the moment a monolithic delivery of the same transfer would
+        have arrived — so response order matches the serial view."""
+        pending = _Pending(meta, None)
+        pending.remaining = 1  # open guard, released by close()
+        return _StreamHandle(self, pending)
+
+    def _feed_stream(self, pending, kvs) -> None:
+        if self._stopping:
+            # Shard threads are retiring; apply inline (late-submit
+            # analog) so the fed slice is not silently lost.
+            try:
+                from .kv_app import _push_segs
+
+                self.handle.apply_shard(
+                    pending.meta, kvs.keys,
+                    _push_segs(pending.meta, kvs.keys, kvs.vals),
+                )
+            except Exception as exc:  # noqa: BLE001
+                with pending.mu:
+                    if pending.error is None:
+                        pending.error = exc
+            return
+        plan = self._split(kvs)
+        log.check(plan is not None and len(kvs.vals) % max(len(kvs.keys), 1)
+                  == 0, "stream feed must be a fixed-k key/val slice")
+        self._c_sharded.inc()
+        with pending.mu:
+            pending.remaining += len(plan)
+        for sid, positions in plan:
+            self._queues[sid].push(
+                (pending,
+                 ("feed", kvs, None if len(plan) == 1 else positions))
+            )
+
+    def _close_stream(self, pending, error, respond: bool) -> None:
+        if error is not None:
+            with pending.mu:
+                if pending.error is None:
+                    pending.error = error
+        if respond and self._stopping:
+            # Gate may never flush again; answer directly, best-effort.
+            with pending.mu:
+                pending.remaining -= 1
+            try:
+                if pending.error is not None:
+                    self._server.response_error(pending.meta)
+                else:
+                    self._server.response(pending.meta)
+            except Exception:  # noqa: BLE001 - transport torn down
+                pass
+            return
+        if respond:
+            # Enter the order gate BEFORE releasing the open guard: a
+            # shard task finishing in the gap would otherwise run the
+            # gate flush with this pending absent and strand the
+            # response forever.
+            with self._order_mu:
+                self._order.setdefault(
+                    pending.meta.sender, collections.deque()
+                ).append(pending)
+        with pending.mu:
+            pending.remaining -= 1
+            finished = pending.remaining == 0
+        if finished:
+            self._complete(pending)
+
     def _split(self, kvs) -> Optional[List[tuple]]:
         """[(shard_id, positions)] for a hash-splittable request, else
         None (global op)."""
@@ -274,12 +351,17 @@ class ApplyShardPool:
         sibling shard must not mutate what this request observed)."""
         from .kv_app import _push_segs
 
-        meta, kvs = pending.meta, pending.kvs
-        if task is _ALL:
-            positions = None
+        meta = pending.meta
+        if task[0] == "feed":
+            # Streamed slice: the KVPairs ride the task (the pending
+            # spans many feeds), and streams are push-only.
+            kvs, positions = task[1], task[2]
+        else:
+            kvs = pending.kvs
+            positions = None if task is _ALL else task[1]
+        if positions is None:
             keys = kvs.keys
         else:
-            positions = task[1]
             keys = kvs.keys[positions]
         # Zero-copy per-key views of the payload (built on the shard
         # thread, so even the slicing overlaps across shards).
@@ -440,3 +522,36 @@ class ApplyShardPool:
             )
             if p.emitted is not None:
                 p.emitted.set()
+
+
+class _StreamHandle:
+    """One chunked push being streamed into the pool (see
+    ``ApplyShardPool.begin_stream``).  ``feed``/``close`` run on the
+    server's single request-processing thread; shard threads complete
+    the fed slices concurrently.  ``t_last`` lets the owner reclaim
+    streams whose transfer died at the assembler (TTL/eviction) and
+    whose close therefore never comes."""
+
+    __slots__ = ("_pool", "pending", "closed", "fed_keys", "t_last")
+
+    def __init__(self, pool: ApplyShardPool, pending: _Pending):
+        self._pool = pool
+        self.pending = pending
+        self.closed = False
+        self.fed_keys = 0
+        self.t_last = time.monotonic()
+
+    def feed(self, kvs) -> None:
+        self.fed_keys += len(kvs.keys)
+        self.t_last = time.monotonic()
+        self._pool._feed_stream(self.pending, kvs)
+
+    def close(self, error: Optional[BaseException] = None,
+              respond: bool = True) -> None:
+        """Release the open guard.  ``respond=False`` aborts: the
+        pending never enters the order gate and no response is emitted
+        (used when the requesting worker is already dead)."""
+        if self.closed:
+            return
+        self.closed = True
+        self._pool._close_stream(self.pending, error, respond)

@@ -39,6 +39,7 @@ from ..message import (
     OPT_APPLY_ERROR,
     OPT_REPLICA,
     OPT_SEND_FAILED,
+    OPT_XFER_PART,
     Role,
 )
 from ..range import Range, find_range
@@ -1209,6 +1210,21 @@ class KVServer:
         # lose them.  None = not restoring (steady-state fast path).
         self._restore_mu = threading.Lock()
         self._restore_buffer: Optional[List[Message]] = None
+        # Streamed chunked pushes (docs/chunking.md): (sender, xfer) ->
+        # open _StreamHandle — partial deliveries feed the apply pool
+        # while the rest of the transfer is still on the wire; the
+        # final reassembled message closes the handle (response emitted
+        # when the last fed slice's shard work completes).  Bounded +
+        # reclaimed on sender death, so killed-peer partial transfers
+        # cannot grow the table.
+        self._streams_mu = threading.Lock()
+        self._streams: Dict[Tuple[int, int], object] = {}
+        # TTL (matches the assembler's PS_XFER_TIMEOUT): a stream whose
+        # transfer died at the assembler never gets its close — reclaim
+        # it opportunistically instead of waiting for sender death.
+        self._stream_ttl = self.po.env.find_float("PS_XFER_TIMEOUT", 120.0)
+        self._stream_ticks = 0
+        self.po.register_node_failure_hook(self._on_stream_peer_event)
         # Telemetry (docs/observability.md): request counters and the
         # bounded hot-key tracker psmon's "top keys" column renders.
         self._c_push_reqs = self.po.metrics.counter("kv.server_push_requests")
@@ -1239,6 +1255,7 @@ class KVServer:
         self, handle: Callable[[KVMeta, KVPairs, "KVServer"], None]
     ) -> None:
         if self._apply_pool is not None:
+            self._abort_streams()  # handles reference the old pool
             self._apply_pool.stop()
             self._apply_pool = None
         self._handle = handle
@@ -1433,12 +1450,113 @@ class KVServer:
 
     def stop(self) -> None:
         self._customer.stop()
+        self.po.unregister_node_failure_hook(self._on_stream_peer_event)
+        self._abort_streams()
         if self._apply_pool is not None:
             self._apply_pool.stop()
             self._apply_pool = None
         if self._replicator is not None:
             self.po.unregister_node_failure_hook(self._on_self_rehab)
             self._replicator.close()
+
+    # -- streamed chunked pushes (docs/chunking.md) --------------------------
+
+    _MAX_STREAMS = 64
+
+    def _abort_streams(self) -> None:
+        with self._streams_mu:
+            handles = list(self._streams.values())
+            self._streams.clear()
+        for h in handles:
+            h.close(respond=False)
+
+    def _sweep_stale_streams(self) -> None:
+        """Reclaim streams idle past the TTL: their transfer died at
+        the assembler (TTL sweep / table eviction), so no final message
+        will ever close them."""
+        now = time.monotonic()
+        with self._streams_mu:
+            stale = [k for k, h in self._streams.items()
+                     if now - h.t_last > self._stream_ttl]
+            handles = [self._streams.pop(k) for k in stale]
+        for k, h in zip(stale, handles):
+            log.warning(f"reclaiming stalled stream {k} (idle "
+                        f"> {self._stream_ttl:.0f}s)")
+            h.close(respond=False)
+
+    def _on_stream_peer_event(self, node_id: int, down: bool) -> None:
+        """Node-failure hook: a dead worker's open streams can never
+        close (no further chunks) — reclaim them without responding."""
+        if not down:
+            return
+        with self._streams_mu:
+            stale = [k for k in self._streams if k[0] == node_id]
+            handles = [self._streams.pop(k) for k in stale]
+        for h in handles:
+            log.warning(f"reclaiming open stream from dead node {node_id}")
+            h.close(respond=False)
+
+    def _stream_eligible(self, m) -> bool:
+        """Streaming apply is the narrow fast path: apply pool present
+        (shard-safe handler), no replication (forwards must observe the
+        complete payload in arrival order), and no registered recv
+        buffer for this (sender, key) (those apply synchronously from
+        the pinned buffer).  Everything else waits for the final
+        reassembled message — semantics identical to monolithic."""
+        return (
+            self._apply_pool is not None
+            and self._replicator is None
+            and (m.sender, m.key) not in self._recv_buffers
+            # A partial straggling in after its sender was declared
+            # dead must not re-open a stream the failure hook just
+            # reclaimed (the van marks the peer down BEFORE the hooks
+            # run, so this check closes the race).
+            and not self.po.van.is_peer_down(m.sender)
+        )
+
+    def _stream_part(self, msg: Message) -> None:
+        """One OPT_XFER_PART partial: feed the newly completed whole-key
+        slice to this transfer's open stream (opening it on first
+        touch).  Ineligible servers drop partials — the final complete
+        message always follows and takes the normal path."""
+        key = getattr(msg, "_xfer_key", None)
+        if key is None or len(msg.data) < 2:
+            return
+        self._stream_ticks += 1
+        if self._stream_ticks % 64 == 0:
+            self._sweep_stale_streams()
+        with self._streams_mu:
+            h = self._streams.get(key)
+        if h is None:
+            m = msg.meta
+            if not self._stream_eligible(m):
+                return
+            meta = KVMeta(
+                cmd=m.head, push=True, pull=False, sender=m.sender,
+                timestamp=m.timestamp, customer_id=m.customer_id,
+                key=m.key, addr=m.addr, val_len=m.val_len, option=0,
+                priority=m.priority, trace=m.trace,
+            )
+            h = self._apply_pool.begin_stream(meta)
+            self._c_push_reqs.inc()
+            evicted = None
+            with self._streams_mu:
+                if len(self._streams) >= self._MAX_STREAMS:
+                    victim = next(iter(self._streams))
+                    evicted = self._streams.pop(victim)
+                    log.warning(
+                        f"stream table full: aborting transfer {victim}"
+                    )
+                self._streams[key] = h
+            if evicted is not None:
+                evicted.close(respond=False)
+        kvs = KVPairs(
+            keys=msg.data[0].astype_view(np.uint64).numpy(),
+            vals=msg.data[1].numpy(),
+        )
+        if len(kvs.keys):
+            self._hot_keys.add(int(kvs.keys[0]), len(kvs.keys))
+        h.feed(kvs)
 
     def _process(self, msg: Message) -> None:
         if msg.meta.simple_app:
@@ -1458,6 +1576,23 @@ class KVServer:
         self._process_request(msg)
 
     def _process_request(self, msg: Message) -> None:
+        if msg.meta.option == OPT_XFER_PART:
+            # Partial delivery of a chunked streaming transfer: feed it
+            # to the apply pool (or drop it — the final reassembled
+            # message always follows).
+            self._stream_part(msg)
+            return
+        xfer = getattr(msg, "_xfer_key", None)
+        if xfer is not None:
+            with self._streams_mu:
+                h = self._streams.pop(xfer, None)
+            if h is not None:
+                # Every key already applied via the streamed partials;
+                # closing releases the response (emitted when the last
+                # fed slice's shard work completes, behind the
+                # per-sender order gate).
+                h.close()
+                return
         meta = KVMeta(
             cmd=msg.meta.head,
             push=msg.meta.push,

@@ -172,7 +172,19 @@ class Replicator:
         ``copy=True`` snapshots the payload first — required when vals
         alias a registered recv buffer, which the pump overwrites with
         the sender's next push while the replica lane may still be
-        serializing this one."""
+        serializing this one.
+
+        Chunking interplay (docs/chunking.md): a large forward is
+        RE-CHUNKED by ``van.send`` under the forwarding server's own
+        xfer ids, while the ORIGIN identity (meta.addr = origin worker,
+        meta.timestamp, meta.key) rides every chunk unchanged — the
+        replica reassembles the forward and dedups a worker's failover
+        retry of the same push exactly once, whether the retry arrives
+        chunked or monolithic.  Streaming apply is disabled on
+        replicated servers (``KVServer._stream_eligible``): the forward
+        must observe the COMPLETE payload at its arrival-order slot, so
+        pushes apply only after full reassembly, exactly like the
+        monolithic path."""
         van = self.po.van
         vals = kvs.vals.copy() if copy else kvs.vals
         for rid in self.replica_ids():

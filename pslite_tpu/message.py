@@ -109,6 +109,32 @@ OPT_SEND_FAILED = 4
 # so a worker's failover retry of the same request dedups exactly once.
 OPT_REPLICA = 5
 
+# meta.option marker on a LOCALLY constructed partial delivery of a
+# chunked streaming transfer (docs/chunking.md): the van's reassembler
+# hands the newly completed whole-key prefix of an in-flight push to
+# the app layer so apply overlaps the remaining wire time.  Never on
+# the wire (chunks are identified by the ChunkInfo meta extension);
+# consumers that can't stream simply drop these — the final complete
+# message always follows.
+OPT_XFER_PART = 6
+
+
+@dataclass(frozen=True)
+class ChunkInfo:
+    """Chunked-transfer wire extension (docs/chunking.md): one large
+    data message travels as ``total`` chunk messages, each carrying a
+    contiguous byte range of the logical concatenation of the original
+    data segments.  Every chunk repeats the segment table (lens +
+    dtype codes) so reassembly can start from whichever chunk a
+    multi-rail stripe lands first."""
+
+    xfer: int = 0       # per-sender transfer id (unique per message)
+    index: int = 0      # this chunk's position, 0..total-1
+    total: int = 1      # chunks in the transfer
+    offset: int = 0     # byte offset of this chunk in the logical stream
+    seg_lens: tuple = ()   # original per-segment byte lengths
+    seg_types: tuple = ()  # original per-segment wire dtype codes
+
 
 def dtype_code(dt) -> int:
     return _DTYPE_TO_CODE.get(np.dtype(dt), 2)  # default: raw bytes
@@ -200,6 +226,10 @@ class Meta:
     # spans against this id.  Travels as a backward-compatible wire
     # extension (wire.py) and is echoed on responses.
     trace: int = 0
+    # Chunked streaming transfer (docs/chunking.md): non-None marks this
+    # message as ONE chunk of a larger transfer.  Travels as a tagged
+    # wire extension like ``trace`` — old decoders skip it by length.
+    chunk: Optional[ChunkInfo] = None
     src_dev_type: int = int(DeviceType.UNK)
     src_dev_id: int = -1
     dst_dev_type: int = int(DeviceType.UNK)

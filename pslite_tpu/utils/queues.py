@@ -18,7 +18,9 @@ import collections
 import heapq
 import threading
 import time
-from typing import Callable, Deque, Generic, List, Optional, Tuple, TypeVar
+from typing import (
+    Callable, Deque, Dict, Generic, List, Optional, Tuple, TypeVar,
+)
 
 T = TypeVar("T")
 
@@ -83,6 +85,60 @@ class ThreadsafeQueue(Generic[T]):
             return len(self._q)
 
 
+class PriorityRecvQueue(Generic[T]):
+    """Receive-side mirror of the lane discipline (docs/chunking.md):
+    highest priority first, FIFO within a level.  Without it, a
+    priority frame that jumped every send lane still waits behind the
+    whole decoded chunk backlog in the receiver's FIFO — the pump, not
+    the wire, becomes the head-of-line block.
+
+    ``priority_fn`` maps an item to its level (called at push unless an
+    explicit ``priority`` is given — transports that decode lazily pass
+    the level they learned at send time).  The shutdown sentinel and
+    TERMINATE should map to a very low level so they drain last,
+    preserving the FIFO contract that queued traffic is delivered
+    before the pump retires."""
+
+    def __init__(self, priority_fn: Callable[[T], int]):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._heap: List[Tuple[int, int, T]] = []
+        self._seq = 0
+        self._priority_fn = priority_fn
+
+    def push(self, item: T, priority: Optional[int] = None) -> None:
+        if priority is None:
+            priority = self._priority_fn(item)
+        with self._cv:
+            heapq.heappush(self._heap, (-priority, self._seq, item))
+            self._seq += 1
+            self._cv.notify()
+
+    def wait_and_pop(self, timeout: Optional[float] = None) -> Optional[T]:
+        with self._cv:
+            if timeout is None:
+                while not self._heap:
+                    self._cv.wait()
+            else:
+                deadline = time.monotonic() + timeout
+                while not self._heap:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        if not self._heap:
+                            return None
+            return heapq.heappop(self._heap)[2]
+
+    def try_pop(self) -> Optional[T]:
+        with self._mu:
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._heap)
+
+
 class LaneQueue(Generic[T]):
     """Priority queue for one send lane: highest priority first, FIFO
     within a priority level (heap ordered by ``(-priority, seq)``; the
@@ -98,6 +154,12 @@ class LaneQueue(Generic[T]):
         self._heap: List[Tuple[int, int, T]] = []
         self._seq = 0
         self._inflight = False
+        # Cumulative dispatched bytes per priority level (the owner
+        # calls note_dispatch after each wire write).  Backs the van's
+        # head-of-line accounting: a message snapshots bytes_below(its
+        # priority) at enqueue; a positive delta at dequeue means it
+        # waited behind lower-priority bytes (``van.hol_wait_s``).
+        self._sent_bytes: Dict[int, int] = {}
 
     def push(self, priority: int, item: T,
              unless: Optional[Callable[[], bool]] = None) -> bool:
@@ -148,6 +210,21 @@ class LaneQueue(Generic[T]):
                    and time.monotonic() < deadline):
                 self.cv.wait(timeout=0.1)
             return not (self._heap or self._inflight)
+
+    def note_dispatch(self, priority: int, nbytes: int) -> None:
+        """Record ``nbytes`` dispatched at ``priority`` (HOL ledger)."""
+        with self.cv:
+            self._sent_bytes[priority] = (
+                self._sent_bytes.get(priority, 0) + nbytes
+            )
+
+    def bytes_below(self, priority: int) -> int:
+        """Cumulative bytes this lane has dispatched at priorities
+        strictly below ``priority`` (the levels in play are few, so the
+        sum is a handful of dict entries)."""
+        with self.cv:
+            return sum(v for p, v in self._sent_bytes.items()
+                       if p < priority)
 
     def wake(self) -> None:
         """Nudge the consumer to re-check its stop/abort predicates."""

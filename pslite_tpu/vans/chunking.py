@@ -1,0 +1,391 @@
+"""Chunked streaming transfers (``PS_CHUNK_BYTES`` — docs/chunking.md).
+
+The transports move each message as one monolithic frame, which makes
+pipelining and multi-rail striping impossible at message granularity: a
+multi-MB push head-of-line blocks every small op queued behind it on
+the same peer lane.  This module is the BytePS-style fix — partition
+large data messages into fixed-size chunk messages:
+
+- :func:`split_message` turns one large data message into ``total``
+  chunk messages, each carrying a contiguous byte range of the logical
+  concatenation of the original data segments (zero-copy views) plus a
+  :class:`~..message.ChunkInfo` wire extension.  Each chunk rides the
+  send path independently, so the lane scheduler can interleave
+  higher-priority small ops *between chunks* (bounded HOL wait ≈ one
+  chunk) and MultiVan can stripe one transfer across rails.
+- :class:`ChunkAssembler` is the receive side: a per-``(sender, xfer)``
+  reassembly table that copies chunks into per-segment buffers as they
+  land (in any order — rails do not preserve cross-rail order), emits
+  *partial* messages (``OPT_XFER_PART``) handing each newly completed
+  whole-key prefix of an eligible push straight to the app layer so
+  apply overlaps the remaining wire time, and emits the fully
+  reassembled message when the last chunk lands.
+
+Partial-emission eligibility is deliberately narrow: plain push
+requests (no pull half, no compression/replica/zpull option, fixed
+``k`` values, exactly keys+vals segments).  Everything else — pull
+responses, int8 payloads (their scales segment lands last), lens'd
+pushes — reassembles fully and takes the normal path, so chunking
+never changes apply semantics, only when bytes move.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..message import (
+    ChunkInfo,
+    Command,
+    Control,
+    Message,
+    OPT_XFER_PART,
+    code_dtype,
+)
+from ..sarray import SArray
+from ..utils import logging as log
+from ..utils.bounded import BoundedKeySet
+from ..wire import CHUNK_MAX_SEGS
+
+_UINT64_CODE = 8  # wire dtype code of the keys segment
+
+# Receive-queue levels (PriorityRecvQueue — utils/queues.py): control
+# rides above every data level so a chunk backlog can never starve
+# heartbeats/ACKs/barriers; TERMINATE and the shutdown sentinel drain
+# LAST, preserving the deliver-queued-traffic-before-retiring contract.
+RECV_CONTROL_PRIORITY = 1 << 20
+RECV_DRAIN_LAST = -(1 << 30)
+
+
+def recv_priority(msg) -> int:
+    """Receive-queue level of a decoded message (see the constants
+    above); data messages use their send-side ``meta.priority``, so a
+    priority op that jumped the send lanes jumps the receive backlog
+    too — without this, the pump's FIFO re-introduces the head-of-line
+    wait chunking removed from the wire."""
+    if msg is None:
+        return RECV_DRAIN_LAST
+    c = msg.meta.control
+    if not c.empty():
+        if c.cmd == Command.TERMINATE:
+            return RECV_DRAIN_LAST
+        return RECV_CONTROL_PRIORITY
+    return msg.meta.priority
+
+
+def _flat_u8(arr) -> np.ndarray:
+    """A contiguous 1-D uint8 view of an array (copying only the rare
+    strided input, like ``wire.pack_frame``)."""
+    if not (isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]):
+        arr = np.ascontiguousarray(arr)
+    return arr.reshape(-1).view(np.uint8)
+
+
+def split_message(msg: Message, chunk_bytes: int,
+                  xfer_id: int) -> Optional[List[Message]]:
+    """Split one large data message into chunk messages, or ``None``
+    when the message must go monolithic (small, control, zpull/shm
+    routed, or too many segments for the wire extension).
+
+    The chunk payloads are zero-copy uint8 views of the original
+    segments; callers must honor the usual don't-mutate-until-wait
+    contract, which they already do for monolithic sends.
+    """
+    m = msg.meta
+    if chunk_bytes <= 0 or not m.control.empty() or m.chunk is not None:
+        return None
+    n_data = len(msg.data)
+    if n_data == 0 or n_data > CHUNK_MAX_SEGS:
+        return None
+    seg_lens = [d.nbytes for d in msg.data]
+    total = sum(seg_lens)
+    if total <= chunk_bytes:
+        return None
+    seg_types = tuple(m.data_type[i] if i < len(m.data_type) else 2
+                      for i in range(n_data))
+    raws = [_flat_u8(d.data) for d in msg.data]
+    bounds = [0]
+    for ln in seg_lens:
+        bounds.append(bounds[-1] + ln)
+    n_chunks = (total + chunk_bytes - 1) // chunk_bytes
+    out: List[Message] = []
+    for idx in range(n_chunks):
+        lo = idx * chunk_bytes
+        hi = min(lo + chunk_bytes, total)
+        cm = copy.copy(m)
+        cm.control = Control()
+        cm.data_type = []
+        cm.data_size = 0
+        cm.chunk = ChunkInfo(
+            xfer=xfer_id, index=idx, total=n_chunks, offset=lo,
+            seg_lens=tuple(seg_lens), seg_types=seg_types,
+        )
+        cmsg = Message(meta=cm)
+        for si in range(n_data):
+            a, b = max(lo, bounds[si]), min(hi, bounds[si + 1])
+            if a < b:
+                cmsg.add_data(SArray(raws[si][a - bounds[si]:b - bounds[si]]))
+        out.append(cmsg)
+    return out
+
+
+class _Xfer:
+    """Reassembly state of one in-flight transfer."""
+
+    __slots__ = (
+        "meta", "bufs", "seg_lens", "seg_types", "total", "total_bytes",
+        "received", "ends", "got", "contig", "k_bytes", "n_keys",
+        "streamable", "emitted_keys", "t_last", "t0_us",
+    )
+
+    def __init__(self, ck: ChunkInfo, meta):
+        self.meta = meta  # original meta (chunk stripped, option kept)
+        self.seg_lens = ck.seg_lens
+        self.seg_types = ck.seg_types
+        self.total = ck.total
+        self.total_bytes = sum(ck.seg_lens)
+        self.bufs = [np.empty(int(ln), np.uint8) for ln in ck.seg_lens]
+        self.received = [False] * ck.total
+        self.ends = [0] * ck.total  # end offset of each received chunk
+        self.got = 0
+        self.contig = 0  # chunks contiguous from index 0
+        self.t_last = time.monotonic()
+        self.t0_us = 0.0
+        # Streaming eligibility (module docstring): plain fixed-k push
+        # request with exactly keys+vals segments.
+        m = meta
+        self.streamable = bool(
+            m.push and m.request and not m.pull and not m.simple_app
+            and m.option == 0 and len(ck.seg_lens) == 2
+            and ck.seg_types[0] == _UINT64_CODE
+            and ck.seg_lens[0] > 0 and ck.seg_lens[0] % 8 == 0
+        )
+        self.n_keys = int(ck.seg_lens[0]) // 8 if self.streamable else 0
+        if self.streamable:
+            vb = int(ck.seg_lens[1])
+            item = np.dtype(code_dtype(ck.seg_types[1])).itemsize
+            # vb > 0: an empty vals segment has no per-key stride (and
+            # nothing worth streaming) — k_bytes must stay a divisor.
+            if (vb > 0 and self.n_keys and vb % self.n_keys == 0
+                    and (vb // self.n_keys) % item == 0):
+                self.k_bytes = vb // self.n_keys
+            else:
+                self.streamable = False
+                self.k_bytes = 0
+        else:
+            self.k_bytes = 0
+        self.emitted_keys = 0
+
+    def watermark(self) -> int:
+        """Bytes contiguous from the start of the logical stream."""
+        return self.ends[self.contig - 1] if self.contig else 0
+
+
+class ChunkAssembler:
+    """Per-(sender, xfer) reassembly table (one per receiving van).
+
+    ``add`` is called from the van's single receive pump, so the lock
+    only guards against the cleanup entry points (peer death, stale
+    sweeps) that run on other threads.
+    """
+
+    def __init__(self, tracer=None, max_entries: int = 256,
+                 ttl_s: float = 120.0):
+        self._mu = threading.Lock()
+        self._xfers: Dict[Tuple[int, int], _Xfer] = {}
+        # Tombstones of recently COMPLETED transfers: a stale duplicate
+        # chunk (retransmit whose ACK was lost, dup older than the
+        # resender's bounded signature cache) must not re-create
+        # reassembly state — the partial it would emit re-applies
+        # already-applied keys on the server.
+        self._done: BoundedKeySet = BoundedKeySet(4096)
+        self._tracer = tracer
+        self._max_entries = max_entries
+        self._ttl_s = ttl_s
+        self._ticks = 0
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._xfers)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._xfers.clear()
+            self._done = BoundedKeySet(4096)
+
+    def drop_peer(self, node_id: int) -> int:
+        """Reclaim every partial transfer from a dead/recovered sender
+        — its xfer counter restarts at 1, so BOTH live entries and the
+        completed-transfer tombstones would collide with the new
+        incarnation's ids (stale tombstones would silently black-hole
+        its first chunked pushes)."""
+        with self._mu:
+            stale = [k for k in self._xfers if k[0] == node_id]
+            for k in stale:
+                del self._xfers[k]
+            self._done.discard_where(lambda k: k[0] == node_id)
+        if stale:
+            log.vlog(1, f"reclaimed {len(stale)} partial transfer(s) "
+                        f"from node {node_id}")
+        return len(stale)
+
+    def _sweep_stale(self) -> None:
+        now = time.monotonic()
+        with self._mu:
+            stale = [k for k, x in self._xfers.items()
+                     if now - x.t_last > self._ttl_s]
+            for k in stale:
+                del self._xfers[k]
+        for k in stale:
+            log.warning(f"abandoned partial transfer {k[1]} from node "
+                        f"{k[0]} reclaimed after {self._ttl_s:.0f}s")
+
+    def add(self, msg: Message) -> List[Message]:
+        """Absorb one chunk; returns ready-to-deliver messages: zero or
+        one ``OPT_XFER_PART`` partial (the newly completed whole-key
+        prefix of a streamable push) and, on the last chunk, the fully
+        reassembled original message."""
+        ck = msg.meta.chunk
+        key = (msg.meta.sender, ck.xfer)
+        self._ticks += 1
+        if self._ticks % 256 == 0:
+            self._sweep_stale()
+        with self._mu:
+            x = self._xfers.get(key)
+            if x is None and key in self._done:
+                return []  # stale duplicate of a completed transfer
+            if x is None:
+                meta = copy.copy(msg.meta)
+                meta.chunk = None
+                meta.data_type = list(ck.seg_types)
+                meta.data_size = sum(ck.seg_lens)
+                x = _Xfer(ck, meta)
+                if (self._tracer is not None and meta.trace
+                        and self._tracer.active):
+                    x.t0_us = self._tracer.now_us()
+                if len(self._xfers) >= self._max_entries:
+                    # Evict the stalest entry: an unbounded table is a
+                    # leak when senders die mid-transfer faster than
+                    # the TTL sweep runs.
+                    victim = min(self._xfers,
+                                 key=lambda k: self._xfers[k].t_last)
+                    del self._xfers[victim]
+                    log.warning(f"reassembly table full: evicted partial "
+                                f"transfer {victim[1]} from node "
+                                f"{victim[0]}")
+                self._xfers[key] = x
+        payload = sum(d.nbytes for d in msg.data)
+        if (x.total != ck.total or x.seg_lens != ck.seg_lens
+                or not (0 <= ck.index < x.total)
+                # Bounds BEFORE the scatter: a corrupt frame whose
+                # range walks past the transfer must drop the transfer
+                # (warn), never trip a CHECK the receive loop escalates
+                # to killing the node.
+                or ck.offset < 0
+                or ck.offset + payload > x.total_bytes):
+            log.warning(f"inconsistent chunk for transfer {ck.xfer} from "
+                        f"node {msg.meta.sender}; dropping the transfer")
+            with self._mu:
+                self._xfers.pop(key, None)
+            return []
+        if x.received[ck.index]:
+            return []  # duplicate chunk (retransmit raced its ACK)
+        nbytes = self._scatter(x, ck.offset, msg.data)
+        x.received[ck.index] = True
+        x.ends[ck.index] = ck.offset + nbytes
+        x.got += 1
+        x.t_last = time.monotonic()
+        while x.contig < x.total and x.received[x.contig]:
+            x.contig += 1
+        out: List[Message] = []
+        if x.got >= x.total:
+            with self._mu:
+                self._xfers.pop(key, None)
+                self._done.add(key)  # tombstone against stale dups
+            part = self._partial(x, key, final=True)
+            if part is not None:
+                out.append(part)
+            out.append(self._final(x, key))
+        else:
+            part = self._partial(x, key)
+            if part is not None:
+                out.append(part)
+        return out
+
+    def _scatter(self, x: _Xfer, offset: int, data) -> int:
+        """Copy a chunk's payload slices into the per-segment buffers;
+        returns the chunk's byte count."""
+        pos = offset
+        si = 0
+        bounds = [0]
+        for ln in x.seg_lens:
+            bounds.append(bounds[-1] + int(ln))
+        total = 0
+        for seg in data:
+            raw = _flat_u8(seg.data if isinstance(seg, SArray) else seg)
+            done = 0
+            while done < raw.nbytes:
+                while si + 1 < len(bounds) and pos >= bounds[si + 1]:
+                    si += 1
+                log.check(si < len(x.bufs), "chunk bytes beyond transfer")
+                take = min(raw.nbytes - done, bounds[si + 1] - pos)
+                b0 = pos - bounds[si]
+                x.bufs[si][b0:b0 + take] = raw[done:done + take]
+                done += take
+                pos += take
+            total += raw.nbytes
+        return total
+
+    def _partial(self, x: _Xfer, key: Tuple[int, int],
+                 final: bool = False) -> Optional[Message]:
+        """The newly completed whole-key prefix of a streamable push as
+        an ``OPT_XFER_PART`` message (views into the reassembly
+        buffers), or None when nothing new completed."""
+        if not x.streamable:
+            return None
+        keys_avail = min(x.watermark(), int(x.seg_lens[0])) // 8
+        vals_avail = max(0, x.watermark() - int(x.seg_lens[0]))
+        done_keys = min(keys_avail, vals_avail // x.k_bytes)
+        if final:
+            done_keys = x.n_keys
+        if done_keys <= x.emitted_keys:
+            return None
+        a, b = x.emitted_keys, done_keys
+        x.emitted_keys = done_keys
+        pm = copy.copy(x.meta)
+        pm.option = OPT_XFER_PART
+        pm.data_type = []
+        pm.data_size = 0
+        msg = Message(meta=pm)
+        msg.add_data(SArray(x.bufs[0][a * 8:b * 8].view(np.uint64)))
+        vdtype = code_dtype(x.seg_types[1])
+        msg.add_data(SArray(
+            x.bufs[1][a * x.k_bytes:b * x.k_bytes].view(vdtype)
+        ))
+        # In-process routing token for the app layer's stream state
+        # (partials never touch the wire, so a plain attribute works).
+        msg._xfer_key = key
+        msg._xfer_range = (a, b)
+        return msg
+
+    def _final(self, x: _Xfer, key: Tuple[int, int]) -> Message:
+        meta = copy.copy(x.meta)
+        meta.data_type = []
+        meta.data_size = 0
+        msg = Message(meta=meta)
+        for buf, code in zip(x.bufs, x.seg_types):
+            msg.add_data(SArray(buf.view(code_dtype(code))))
+        msg._xfer_key = key
+        msg._xfer_streamed = x.emitted_keys
+        if (self._tracer is not None and meta.trace
+                and self._tracer.active and x.t0_us):
+            self._tracer.span(
+                meta.trace, "xfer_recv", x.t0_us,
+                args={"from": meta.sender, "chunks": x.total,
+                      "bytes": x.total_bytes, "xfer": key[1]},
+            )
+        return msg

@@ -20,8 +20,9 @@ from typing import Dict, Optional, Tuple
 
 from ..message import Message, Node
 from ..utils import logging as log
-from ..utils.queues import ThreadsafeQueue
+from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
 from .. import wire
+from .chunking import RECV_DRAIN_LAST, recv_priority
 from .van import Van
 
 _registry_mu = threading.Lock()
@@ -39,7 +40,15 @@ class LoopbackVan(Van):
     def __init__(self, postoffice):
         super().__init__(postoffice)
         self._ns = self.env.find("PS_LOOPBACK_NS", "default")
-        self._queue: ThreadsafeQueue[Optional[bytes]] = ThreadsafeQueue()
+        # The queue holds packed blobs, so the receive-priority level is
+        # computed by the SENDER (which still has the Message) and
+        # pushed alongside — same discipline as the socket vans
+        # (docs/chunking.md), same PS_RECV_PRIORITY opt-out.
+        self._prio_recv = bool(self.env.find_int("PS_RECV_PRIORITY", 1))
+        self._queue = (
+            PriorityRecvQueue(lambda _b: 0) if self._prio_recv
+            else ThreadsafeQueue()
+        )
         self._peers: Dict[int, Tuple[str, int]] = {}
         self._bound_key: Optional[Tuple[str, str, int]] = None
 
@@ -79,7 +88,10 @@ class LoopbackVan(Van):
         target = self._resolve(msg.meta.recver)
         chunks = wire.pack_frame(msg)
         blob = b"".join(chunks)  # join accepts memoryviews: one copy
-        target._queue.push(blob)
+        if target._prio_recv:
+            target._queue.push(blob, priority=recv_priority(msg))
+        else:
+            target._queue.push(blob)
         return len(blob)
 
     def recv_msg(self) -> Optional[Message]:
@@ -99,7 +111,10 @@ class LoopbackVan(Van):
         return wire.rebuild_message(meta, bufs)
 
     def stop_transport(self) -> None:
-        self._queue.push(None)
+        if self._prio_recv:
+            self._queue.push(None, priority=RECV_DRAIN_LAST)
+        else:
+            self._queue.push(None)
         if self._bound_key is not None:
             with _registry_mu:
                 _registry.pop(self._bound_key, None)

@@ -24,7 +24,8 @@ from typing import List, Optional
 
 from ..message import Message, Node
 from ..utils import logging as log
-from ..utils.queues import ThreadsafeQueue
+from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
+from .chunking import recv_priority
 from .tcp_van import TcpVan
 from .van import Van
 
@@ -68,7 +69,14 @@ class MultiVan(Van):
                 # two rails resizing/unlinking ONE shared segment file
                 # under each other's cached mmaps would corrupt payloads.
                 rail._ns = f"{rail._ns}r{i}"
-        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
+        # Merge queue keeps the rails' priority discipline (chunk
+        # backlogs from one rail must not delay another rail's priority
+        # frames) — same knob as the rails' own intake queues.
+        self._queue = (
+            PriorityRecvQueue(recv_priority)
+            if postoffice.env.find_int("PS_RECV_PRIORITY", 1)
+            else ThreadsafeQueue()
+        )
         self._pumps: List[threading.Thread] = []
         self._rr = itertools.count()
 
@@ -115,6 +123,16 @@ class MultiVan(Van):
             return rail
         if not msg.meta.control.empty():
             rail = 0  # control plane rides rail 0
+        elif msg.meta.chunk is not None:
+            # Chunked streaming transfer (docs/chunking.md): stripe the
+            # chunks of ONE transfer deterministically across every
+            # rail instead of pinning the whole message to one — the
+            # xfer id offsets the start rail so concurrent transfers
+            # don't convoy on rail 0.  Overrides device pinning: the
+            # whole point of chunking a device-tagged tensor is to use
+            # all rails for it.
+            ck = msg.meta.chunk
+            rail = (ck.xfer + ck.index) % self.num_rails
         else:
             dev = msg.meta.src_dev_id
             if dev is not None and dev >= 0:

@@ -39,6 +39,11 @@ def _signature(msg: Message) -> int:
     # retry whose original REQUEST was delivered but whose RESPONSE was
     # lost reaches the app again instead of being silently ack-dropped.
     # All three fields are stable across retransmits of one message.
+    # Chunked transfers (docs/chunking.md) lean on sid the same way:
+    # the N chunks of one transfer share every app-level field and
+    # differ only in sid, so each chunk is tracked, acked, and
+    # retransmitted INDEPENDENTLY — a drop costs one chunk's resend,
+    # not the whole transfer.
     return hash(
         (m.app_id, m.customer_id, m.sender, m.recver, m.timestamp, m.request,
          m.push, m.simple_app, m.key, m.option, m.addr, m.sid, m.control.cmd)

@@ -337,10 +337,17 @@ class ShmVan(TcpVan):
             return super().send_msg(msg)
 
         # Segment identity mirrors the reference's per-key shm naming
-        # (rdma_utils.h:63-65); reused across iterations.
+        # (rdma_utils.h:63-65); reused across iterations.  Chunked
+        # transfers (docs/chunking.md) suffix the chunk INDEX: the
+        # chunks of one message would otherwise collide on a single
+        # segment and overwrite each other before the receiver copies
+        # them out; indexing (not xfer id) keeps the names — and the
+        # segments — reusable across iterations of the same key.
+        ck = m.chunk
         name = (
             f"psl_{self._ns}_{m.sender}_{m.recver}_{m.key}"
             f"_{int(m.push)}{int(m.request)}"
+            + (f"_c{ck.index}" if ck is not None else "")
         )
         try:
             seg = self._segment(name, total, create=True)

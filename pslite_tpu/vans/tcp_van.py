@@ -37,10 +37,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import wire
-from ..message import Message, Node, OPT_COMPRESS_INT8
+from ..message import Message, Node, OPT_COMPRESS_INT8, OPT_XFER_PART
 from ..sarray import SArray
 from ..utils import logging as log
-from ..utils.queues import ThreadsafeQueue
+from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
+from .chunking import recv_priority
 from .van import Van
 
 
@@ -93,22 +94,30 @@ class _RecvPool:
     weakrefs, no explicit release calls.
     """
 
-    _MAX_ENTRIES = 32          # distinct pooled blocks
+    _MAX_ENTRIES = 64          # distinct pooled blocks
     _MAX_BLOCK = 32 << 20      # larger requests bypass the pool
-    _MAX_TOTAL = 128 << 20     # arena budget: beyond it, don't pool
 
-    def __init__(self, metrics=None):
+    def __init__(self, metrics=None, budget_mb: int = 128):
         from ..telemetry.metrics import enabled_registry
 
         self._mu = threading.Lock()  # several reader threads share us
         self._entries: List[np.ndarray] = []
         self._total = 0
+        # Arena budget (PS_RECV_POOL_MB): pooled bytes never exceed it.
+        # Chunked transfers (docs/chunking.md) recycle chunk-sized
+        # blocks hard, so the budget is configurable and FREE smaller
+        # blocks are evicted to admit a new size class instead of
+        # permanently locking the arena to whatever sizes came first.
+        self._max_total = max(1, budget_mb) << 20
         # Registry counters (one counter idiom everywhere); .hits /
         # .misses stay readable as before via the properties below, so
         # pool accounting works even untelemetered (private fallback).
-        reg = enabled_registry(metrics)
-        self._c_hits = reg.counter("tcp.recv_pool_hits")
-        self._c_misses = reg.counter("tcp.recv_pool_misses")
+        self._reg = enabled_registry(metrics)
+        self._c_hits = self._reg.counter("tcp.recv_pool_hits")
+        self._c_misses = self._reg.counter("tcp.recv_pool_misses")
+        # Per-size-class hit/miss counters (class = the power-of-two
+        # block size a request rounds up to), created lazily.
+        self._class_counters: Dict[Tuple[int, str], object] = {}
 
     @property
     def hits(self) -> int:
@@ -118,10 +127,26 @@ class _RecvPool:
     def misses(self) -> int:
         return self._c_misses.value
 
+    @staticmethod
+    def _class_of(nbytes: int) -> int:
+        """Power-of-two size class (>= 4 KB) a request maps to."""
+        return 1 << max(12, (max(nbytes, 1) - 1).bit_length())
+
+    def _count(self, cls: int, kind: str) -> None:
+        key = (cls, kind)
+        c = self._class_counters.get(key)
+        if c is None:
+            c = self._class_counters[key] = self._reg.counter(
+                f"tcp.recv_pool.c{cls}.{kind}"
+            )
+        c.inc()
+
     def acquire(self, nbytes: int) -> np.ndarray:
         """A uint8 block of >= nbytes (recycled when possible)."""
+        cls = self._class_of(nbytes)
         if nbytes > self._MAX_BLOCK:
             self._c_misses.inc()
+            self._count(cls, "misses")
             return np.empty(nbytes, np.uint8)
         with self._mu:
             best = -1
@@ -134,16 +159,41 @@ class _RecvPool:
                     best = i  # smallest adequate free block
             if best >= 0:
                 self._c_hits.inc()
+                self._count(cls, "hits")
                 return self._entries[best]
             # Miss: size classes are powers of two (>= 4 KB) so repeat
             # traffic of similar sizes converges onto reusable blocks.
-            block = np.empty(1 << max(12, (max(nbytes, 1) - 1).bit_length()),
-                             np.uint8)
+            block = np.empty(cls, np.uint8)
+            if (self._total + block.nbytes > self._max_total
+                    or len(self._entries) >= self._MAX_ENTRIES):
+                # Over budget (or out of slots): evict FREE smaller
+                # blocks, smallest first — a traffic shift to bigger
+                # payloads (chunk-sized blocks) must not leave the
+                # arena pinned to stale small classes forever.  The
+                # refcount probe uses direct indexing: binding the
+                # entry to a local would perturb the free baseline.
+                live = len(self._entries)
+                for i in sorted(
+                    range(len(self._entries)),
+                    key=lambda j: self._entries[j].nbytes,
+                ):
+                    fits = (self._total + block.nbytes <= self._max_total
+                            and live < self._MAX_ENTRIES)
+                    if fits:
+                        break
+                    if (self._entries[i].nbytes < block.nbytes
+                            and sys.getrefcount(self._entries[i])
+                            == _FREE_BLOCK_REFS):
+                        self._total -= self._entries[i].nbytes
+                        self._entries[i] = None
+                        live -= 1
+                self._entries = [e for e in self._entries if e is not None]
             if (len(self._entries) < self._MAX_ENTRIES
-                    and self._total + block.nbytes <= self._MAX_TOTAL):
+                    and self._total + block.nbytes <= self._max_total):
                 self._entries.append(block)
                 self._total += block.nbytes
             self._c_misses.inc()
+            self._count(cls, "misses")
             return block
 
     def recv_exact_into(self, sock: socket.socket, block: np.ndarray,
@@ -202,11 +252,21 @@ class TcpVan(Van):
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reader_threads: list = []
-        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue(
-            busy_poll_ns=self.env.find_int("DMLC_POLLING_IN_NANOSECOND", 0)
-            if self.env.find_int("DMLC_LOCKLESS_QUEUE", 0)
-            else 0
-        )
+        # Receive intake: priority-aware by default (docs/chunking.md —
+        # a priority frame must not wait behind the decoded chunk
+        # backlog), FIFO within a level so same-priority semantics are
+        # exactly the old queue's.  PS_RECV_PRIORITY=0 or the lockless
+        # busy-poll mode restore the plain FIFO.
+        if (self.env.find_int("DMLC_LOCKLESS_QUEUE", 0)
+                or not self.env.find_int("PS_RECV_PRIORITY", 1)):
+            self._queue = ThreadsafeQueue(
+                busy_poll_ns=self.env.find_int(
+                    "DMLC_POLLING_IN_NANOSECOND", 0)
+                if self.env.find_int("DMLC_LOCKLESS_QUEUE", 0)
+                else 0
+            )
+        else:
+            self._queue = PriorityRecvQueue(recv_priority)
         self._send_socks: Dict[int, socket.socket] = {}
         self._send_addrs: Dict[int, Tuple[str, int]] = {}
         self._socks_mu = threading.Lock()  # guards the maps, not writes
@@ -231,6 +291,18 @@ class TcpVan(Van):
         # retries.  At-least-once on that frame — pair with PS_RESEND for
         # dedup, exactly like the reference.  -1 disables.
         self._reconnect_ms = self.env.find_int("PS_RECONNECT_TMO", 100)
+        # Bounded send buffer (PS_TCP_SNDBUF, bytes; 0 = OS default):
+        # chunking bounds the LANE's head-of-line wait to ~one chunk,
+        # but on a fast link the kernel send buffer re-introduces it —
+        # megabytes of already-accepted bytes sit ahead of a priority
+        # frame regardless of lane order.  Capping SO_SNDBUF makes the
+        # bounded-HOL property hold end to end (docs/chunking.md).
+        self._sndbuf = self.env.find_int("PS_TCP_SNDBUF", 0)
+        # Symmetric receive-side cap (PS_TCP_RCVBUF): bytes parked in
+        # the receiver's kernel buffer sit ahead of a priority frame
+        # just like send-side ones.  Applied to the LISTENER before
+        # listen() so accepted connections inherit it.
+        self._rcvbuf = self.env.find_int("PS_TCP_RCVBUF", 0)
         # (sender_id, key) -> pre-registered push receive buffer — the
         # zmq van's registered-buffer recv hook (zmq_van.h:206-218,
         # 243-263): push payloads for the pair are placed at this
@@ -243,7 +315,8 @@ class TcpVan(Van):
         # mirror of the vectored-send work, with the same style of
         # observability counter (_recv_pool_hits).
         self._recv_pool: Optional[_RecvPool] = (
-            _RecvPool(self.metrics)
+            _RecvPool(self.metrics,
+                      self.env.find_int("PS_RECV_POOL_MB", 128))
             if self.env.find_int("PS_RECV_POOL", 1) else None
         )
 
@@ -274,6 +347,7 @@ class TcpVan(Van):
             try:
                 s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                self._apply_rcvbuf(s)
                 s.bind(("", port))
                 break
             except OSError:
@@ -402,9 +476,26 @@ class TcpVan(Van):
             s = socket.create_connection((node.hostname, node.port),
                                          timeout=timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._apply_sndbuf(s)
             return s
 
         self._dial_and_swap(node, connect_once, deadline)
+
+    def _apply_sndbuf(self, s: socket.socket) -> None:
+        if self._sndbuf > 0:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                             self._sndbuf)
+            except OSError:
+                pass  # advisory: the OS default is merely less bounded
+
+    def _apply_rcvbuf(self, s: socket.socket) -> None:
+        if self._rcvbuf > 0:
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                             self._rcvbuf)
+            except OSError:
+                pass  # advisory, like _apply_sndbuf
 
     def _dial_and_swap(self, node: Node, connect_once,
                        deadline: float = 60.0) -> None:
@@ -455,6 +546,7 @@ class TcpVan(Van):
                 s.close()
                 raise
             s.settimeout(None)
+            self._apply_sndbuf(s)
             return s
 
         self._dial_and_swap(node, connect_once, deadline)
@@ -602,10 +694,14 @@ class TcpVan(Van):
 
     def _registered_for(self, meta, n_data: int):
         """The (sender, key) registered buffer this push should land in,
-        or None.  Compressed pushes are excluded: their wire payload is
-        quantized int8, not the values the buffer promises."""
+        or None.  Compressed pushes are excluded (their wire payload is
+        quantized int8, not the values the buffer promises), as are
+        streaming partials (OPT_XFER_PART — a prefix copied at offset 0
+        would misplace every later key; the final reassembled message
+        performs the placement)."""
         if not (meta.push and meta.request and meta.control.empty()
-                and meta.option != OPT_COMPRESS_INT8 and n_data >= 2):
+                and meta.option not in (OPT_COMPRESS_INT8, OPT_XFER_PART)
+                and n_data >= 2):
             return None
         return self._push_recv_bufs.get((meta.sender, meta.key))
 

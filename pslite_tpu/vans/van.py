@@ -44,6 +44,7 @@ inline (still under the per-peer transmit lock — never a van-wide one).
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 import os
 import random
@@ -63,12 +64,22 @@ from ..base import (
     server_rank_to_id,
     worker_rank_to_id,
 )
-from ..message import Command, Control, Message, Meta, Node, OPT_SEND_FAILED, Role
+from ..message import (
+    Command,
+    Control,
+    Message,
+    Meta,
+    Node,
+    OPT_SEND_FAILED,
+    OPT_ZPULL,
+    Role,
+)
 from ..telemetry.tracing import NULL_TRACER
 from ..utils import logging as log
 from ..utils.network import get_ip
 from ..utils.profiling import Profiler
 from ..utils.queues import LaneQueue
+from .chunking import ChunkAssembler, split_message
 from .resender import Resender
 
 
@@ -120,7 +131,16 @@ class Van:
         # po.metrics and stays empty when disabled.
         from ..telemetry.metrics import enabled_registry
 
-        self.metrics = enabled_registry(getattr(postoffice, "metrics", None))
+        node_metrics = getattr(postoffice, "metrics", None)
+        self.metrics = enabled_registry(node_metrics)
+        # Instruments with NO legacy read surface go on the node's real
+        # registry so PS_TELEMETRY=0 actually no-ops them (the private
+        # fallback above exists only to keep pre-registry counters
+        # counting); stub postoffices fall back to the private one so
+        # transport-less test vans still observe.
+        self._node_metrics = (
+            node_metrics if node_metrics is not None else self.metrics
+        )
         self.tracer = getattr(postoffice, "tracer", None) or NULL_TRACER
         self._c_sent_msgs = self.metrics.counter("van.sent_messages")
         self._c_sent_bytes = self.metrics.counter("van.sent_bytes")
@@ -128,6 +148,22 @@ class Van:
         self._c_recv_bytes = self.metrics.counter("van.recv_bytes")
         self._h_lane_wait = self.metrics.histogram("van.lane_wait_s")
         self.metrics.gauge("van.lane_depth", fn=self._owner_lane_depth)
+        # Chunked streaming transfers (docs/chunking.md): data messages
+        # larger than PS_CHUNK_BYTES split into chunk messages that the
+        # lanes interleave and MultiVan stripes; the assembler is the
+        # receive-side reassembly table.  0 disables (monolithic sends).
+        self._chunk_bytes = max(0, self.env.find_int("PS_CHUNK_BYTES",
+                                                     1 << 20))
+        self._xfer_seq = itertools.count(1)
+        self._assembler = ChunkAssembler(
+            tracer=self.tracer,
+            ttl_s=self.env.find_float("PS_XFER_TIMEOUT", 120.0),
+        )
+        self._c_chunks_sent = self._node_metrics.counter("van.chunks_sent")
+        self._c_chunks_recv = self._node_metrics.counter("van.chunks_recv")
+        self._h_hol_wait = self._node_metrics.histogram("van.hol_wait_s")
+        self._node_metrics.gauge("van.xfers_inflight",
+                                 fn=self._owner_xfer_depth)
         # Scheduler-side registration state.
         self._registrations: List[Node] = []
         self._registered_addrs: Dict[str, int] = {}  # addr -> assigned id
@@ -212,6 +248,7 @@ class Van:
                 self._announced_dead = set()
                 with self._lanes_mu:
                     self._lanes = {}  # drop joined threads/stale lanes
+                self._assembler.clear()  # no cross-run partial transfers
                 if self.profiler.closed:
                     # A prior stop() closed the event log; a restarted
                     # van records again instead of silently dropping
@@ -380,6 +417,13 @@ class Van:
         van = getattr(self.po, "van", None)
         return (van if van is not None else self)._total_lane_depth()
 
+    def _owner_xfer_depth(self) -> int:
+        """Gauge fn for ``van.xfers_inflight``: partially reassembled
+        transfers on the postoffice's van (owner pattern, see
+        ``_owner_lane_depth`` — rail vans' assemblers are never fed)."""
+        van = getattr(self.po, "van", None)
+        return len((van if van is not None else self)._assembler)
+
     def _lane_key(self, msg: Message):
         """Lane identity for a message.  Default: the destination node —
         one lane per peer.  Multi-rail transports may widen this (e.g.
@@ -434,12 +478,37 @@ class Van:
                 f"node {msg.meta.recver} was declared dead by the "
                 f"failure detector"
             )
+        if (self._chunk_bytes > 0 and msg.meta.control.empty()
+                and msg.meta.chunk is None
+                and msg.meta.data_size > self._chunk_bytes
+                and msg.meta.option != OPT_ZPULL and not msg.meta.shm_data):
+            # Chunked streaming transfer (docs/chunking.md): submit
+            # each chunk independently, so the lane can interleave
+            # higher-priority small ops between chunks and MultiVan can
+            # stripe the transfer across rails.  OPT_ZPULL payloads are
+            # excluded — their addr encodes an in-place placement the
+            # receiving transport performs per message.
+            chunks = split_message(msg, self._chunk_bytes,
+                                   next(self._xfer_seq))
+            if chunks is not None:
+                for c in chunks:
+                    self._submit_data(c)
+                return 0
+        return self._submit_data(msg)
+
+    def _submit_data(self, msg: Message) -> int:
+        """Route one (possibly chunk) message: lane enqueue in async
+        mode, inline dispatch otherwise."""
         if (msg.meta.control.empty() and self._send_async
                 and not self._lane_stop):  # unlocked fast path; re-checked
             # Lane-wait accounting (histogram + lane_wait trace span):
             # stamped at enqueue, read at lane dequeue.
             msg._lane_enq = time.monotonic()
             lane = self._lane_for(msg)
+            # HOL ledger mark: bytes this lane has pushed out at lower
+            # priorities so far — a positive delta at dequeue means the
+            # message waited behind lower-priority bytes.
+            msg._hol_mark = lane.q.bytes_below(msg.meta.priority)
             # Thread before push: a lane thread idling on an empty queue
             # retires cleanly at drain, but a message pushed with no
             # thread to drain it would strand until the drain deadline.
@@ -459,6 +528,8 @@ class Van:
                 sid = self._send_sids.get(msg.meta.recver, 0)
                 self._send_sids[msg.meta.recver] = sid + 1
             msg.meta.sid = sid
+            if msg.meta.chunk is not None:
+                self._c_chunks_sent.inc()
         if self.resender is not None:
             self.resender.add_outgoing(msg)
         trace = msg.meta.trace if msg.meta.control.empty() else 0
@@ -506,6 +577,14 @@ class Van:
             if enq is not None:
                 wait = time.monotonic() - enq
                 self._h_lane_wait.observe(wait)
+                # Head-of-line accounting (docs/chunking.md): a
+                # >= NORMAL-priority message that waited while LOWER-
+                # priority bytes went out ahead of it is exactly the
+                # stall chunking bounds to ~one chunk.
+                mark = getattr(msg, "_hol_mark", None)
+                if (mark is not None and msg.meta.priority >= 0
+                        and lane.q.bytes_below(msg.meta.priority) > mark):
+                    self._h_hol_wait.observe(wait)
                 if msg.meta.trace and self.tracer.active:
                     now = self.tracer.now_us()
                     self.tracer.span(
@@ -514,9 +593,10 @@ class Van:
                     )
             try:
                 if raw:  # resender retransmit: already sid'd + buffered
-                    self._transmit(msg)
+                    nbytes = self._transmit(msg)
                 else:
-                    self._dispatch_send(msg)
+                    nbytes = self._dispatch_send(msg)
+                lane.q.note_dispatch(msg.meta.priority, nbytes)
             except Exception as exc:
                 # Async dispatch cannot raise to the caller; park the
                 # error for the next send() and log loudly (without
@@ -585,6 +665,7 @@ class Van:
             # retransmit's queue time, not time-since-first-send.
             msg._lane_enq = time.monotonic()
             lane = self._lane_for(msg)
+            msg._hol_mark = lane.q.bytes_below(msg.meta.priority)
             self._ensure_lane_thread(lane)
             if lane.q.push(msg.meta.priority, (msg, True),
                            unless=lambda: self._lane_stop):
@@ -605,6 +686,10 @@ class Van:
             if node_id in self._down_peers:
                 return
             self._down_peers.add(node_id)
+        # Reclaim the dead sender's half-reassembled transfers: no
+        # further chunk can ever complete them, and the table must not
+        # grow across failures (docs/chunking.md).
+        self._assembler.drop_peer(node_id)
         for lane in self._lanes_of(node_id):
             for item in lane.q.drain():
                 msg, _raw = item
@@ -845,11 +930,7 @@ class Van:
                 break
             try:
                 if ctrl.empty():
-                    if self._force_order:
-                        for ready in self._release_in_order(msg):
-                            self._process_data_msg(ready)
-                    else:
-                        self._process_data_msg(msg)
+                    self._accept_data(msg)
                 elif ctrl.cmd == Command.ADD_NODE:
                     self._process_add_node(msg)
                 elif ctrl.cmd == Command.BARRIER:
@@ -900,6 +981,9 @@ class Van:
             self._send_sids.pop(node_id, None)
         self._recv_expected.pop(node_id, None)
         self._recv_buffered.pop(node_id, None)
+        # A restarted peer's xfer counter begins at 1 again; stale
+        # partial transfers from its previous incarnation would collide.
+        self._assembler.drop_peer(node_id)
 
     _MAX_REORDER_BUFFER = 1024
 
@@ -937,6 +1021,23 @@ class Van:
             expected += 1
         self._recv_expected[sender] = expected
         return ready
+
+    def _accept_data(self, msg: Message) -> None:
+        """Data-plane intake: per-sender sid ordering when forced, then
+        chunk reassembly — a chunk message feeds the assembler, which
+        hands back zero or more ready messages (streaming partials of
+        an in-flight push, and the fully reassembled original on the
+        last chunk)."""
+        ready = (
+            self._release_in_order(msg) if self._force_order else [msg]
+        )
+        for r in ready:
+            if r.meta.chunk is not None:
+                self._c_chunks_recv.inc()
+                for out in self._assembler.add(r):
+                    self._process_data_msg(out)
+            else:
+                self._process_data_msg(r)
 
     def deliver_data_msg(self, msg: Message) -> None:
         """Transport hook: last-mile payload placement (e.g. registered

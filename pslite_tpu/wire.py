@@ -20,6 +20,7 @@ from typing import List, Tuple
 import numpy as np
 
 from .message import (
+    ChunkInfo,
     Command,
     Control,
     Message,
@@ -41,6 +42,15 @@ WIRE_VERSION = 2  # v2: priority field (send scheduling echo)
 _EXT_HDR = struct.Struct("<BB")
 EXT_TRACE = 1  # payload: u64 trace id (telemetry/tracing.py)
 _EXT_TRACE_PAYLOAD = struct.Struct("<Q")
+# Chunked streaming transfer (docs/chunking.md): xfer id, chunk index,
+# chunk count, byte offset, then the original segment table (u64 len +
+# u8 dtype code per segment) so any chunk can seed reassembly.  The
+# u8 ext length bounds the table at _CHUNK_MAX_SEGS segments — the van
+# only chunks messages within that bound.
+EXT_CHUNK = 2
+_EXT_CHUNK_FIXED = struct.Struct("<QIIQB")  # xfer index total offset nseg
+_EXT_CHUNK_SEG = struct.Struct("<QB")       # seg byte len, dtype code
+CHUNK_MAX_SEGS = (255 - _EXT_CHUNK_FIXED.size) // _EXT_CHUNK_SEG.size
 
 _META_FIXED = struct.Struct(
     "<B"  # version
@@ -156,6 +166,17 @@ def pack_meta(meta: Meta) -> bytes:
     if meta.trace:
         parts.append(_EXT_HDR.pack(EXT_TRACE, _EXT_TRACE_PAYLOAD.size))
         parts.append(_EXT_TRACE_PAYLOAD.pack(meta.trace % (1 << 64)))
+    if meta.chunk is not None:
+        ck = meta.chunk
+        nseg = len(ck.seg_lens)
+        payload = [_EXT_CHUNK_FIXED.pack(
+            ck.xfer % (1 << 64), ck.index, ck.total, ck.offset, nseg,
+        )]
+        for ln, code in zip(ck.seg_lens, ck.seg_types):
+            payload.append(_EXT_CHUNK_SEG.pack(int(ln), int(code)))
+        blob = b"".join(payload)
+        parts.append(_EXT_HDR.pack(EXT_CHUNK, len(blob)))
+        parts.append(blob)
     return b"".join(parts)
 
 
@@ -201,6 +222,7 @@ def unpack_meta(buf: bytes) -> Meta:
         node, off = _unpack_node(view, off)
         nodes.append(node)
     trace = 0
+    chunk = None
     while off + _EXT_HDR.size <= len(view):
         tag, ext_len = _EXT_HDR.unpack_from(view, off)
         off += _EXT_HDR.size
@@ -208,6 +230,22 @@ def unpack_meta(buf: bytes) -> Meta:
             break  # truncated tail: ignore, extensions are optional
         if tag == EXT_TRACE and ext_len == _EXT_TRACE_PAYLOAD.size:
             (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(view, off)
+        elif tag == EXT_CHUNK and ext_len >= _EXT_CHUNK_FIXED.size:
+            xfer, index, total, c_off, nseg = _EXT_CHUNK_FIXED.unpack_from(
+                view, off
+            )
+            if ext_len == _EXT_CHUNK_FIXED.size + nseg * _EXT_CHUNK_SEG.size:
+                so = off + _EXT_CHUNK_FIXED.size
+                seg_lens, seg_types = [], []
+                for _ in range(nseg):
+                    ln, code = _EXT_CHUNK_SEG.unpack_from(view, so)
+                    so += _EXT_CHUNK_SEG.size
+                    seg_lens.append(ln)
+                    seg_types.append(code)
+                chunk = ChunkInfo(
+                    xfer=xfer, index=index, total=total, offset=c_off,
+                    seg_lens=tuple(seg_lens), seg_types=tuple(seg_types),
+                )
         off += ext_len  # unknown tags skip by length
     meta = Meta(
         head=head,
@@ -235,6 +273,7 @@ def unpack_meta(buf: bytes) -> Meta:
         data_size=data_size,
         priority=priority,
         trace=trace,
+        chunk=chunk,
         src_dev_type=src_dt,
         src_dev_id=src_di,
         dst_dev_type=dst_dt,

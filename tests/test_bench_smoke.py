@@ -1,5 +1,9 @@
 """bench.py must stay runnable: exercise its measurement helper on the CPU
-mesh and check the JSON contract fields."""
+mesh and check the JSON contract fields.
+
+Tier-1 note: the canonical gate these tests ride under is pinned as
+``make tier1`` (Makefile — the verbatim ROADMAP.md invocation), so the
+builder and reviewer never drift apart on pytest flags."""
 
 import json
 import subprocess
@@ -203,6 +207,18 @@ def test_telemetry_overhead_guard():
     worker = next(v for k, v in tel.items() if k.startswith("worker"))
     assert worker["counters"]["kv.pushes"] == 5
     assert worker["histograms"]["kv.push_latency_s"]["count"] == 5
+
+
+def test_chunk_hol_harness():
+    """The chunk_streaming section's harness: one subprocess leg of
+    ``--mode chunk_hol`` (real tcp cluster via the local tracker) must
+    produce the measurement line.  Ratios are asserted nowhere — the
+    bench records them; see docs/chunking.md."""
+    from pslite_tpu.benchmark import _chunk_run
+
+    r = _chunk_run(8, 1, str(256 << 10))
+    assert r["push_gbps"] > 0
+    assert r["pull_p50_ms"] >= 0 and r["pull_p99_ms"] >= r["pull_p50_ms"]
 
 
 def test_send_lanes_fanout_harness():

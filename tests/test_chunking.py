@@ -1,0 +1,764 @@
+"""Chunked streaming transfers (PS_CHUNK_BYTES — docs/chunking.md).
+
+Covers the wire extension roundtrip, split/reassembly bit-exactness
+(any chunk arrival order), lane interleave of priority ops between
+chunks, MultiVan rail striping of one transfer, streaming apply
+overlap, reassembly-state reclamation on peer death, failover of a
+whole chunked slice, the chunked-vs-monolithic bit-exact storm (with
+int8 compression and replication), and the recv-pool budget/size-class
+satellite.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pslite_tpu import wire
+from pslite_tpu.environment import Environment
+from pslite_tpu.message import ChunkInfo, Message, OPT_XFER_PART
+from pslite_tpu.sarray import SArray
+from pslite_tpu.vans.chunking import ChunkAssembler, split_message
+from pslite_tpu.vans.van import Van
+
+from helpers import LoopbackCluster
+
+
+class _StubPo:
+    is_scheduler = False
+    is_worker = True
+
+    def __init__(self, env):
+        self.env = env
+
+    @staticmethod
+    def role_str() -> str:
+        return "test"
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return cond()
+
+
+def _big_msg(nkeys=16, val_len=1024, sender=9, recver=8, push=True,
+             seed=0, lens=False):
+    msg = Message()
+    m = msg.meta
+    m.sender, m.recver = sender, recver
+    m.request = True
+    m.push = push
+    m.app_id = 0
+    m.timestamp = 3
+    keys = np.arange(nkeys, dtype=np.uint64)
+    vals = np.random.default_rng(seed).normal(
+        size=nkeys * val_len).astype(np.float32)
+    msg.add_data(SArray(keys))
+    msg.add_data(SArray(vals))
+    if lens:
+        msg.add_data(SArray(np.full(nkeys, val_len, np.int32)))
+    return msg, keys, vals
+
+
+def _roundtrip(chunk_msg):
+    """One chunk through the real wire format."""
+    meta = wire.unpack_meta(wire.pack_meta(chunk_msg.meta))
+    return wire.rebuild_message(
+        meta, [np.asarray(d.data) for d in chunk_msg.data]
+    )
+
+
+# -- wire extension ----------------------------------------------------------
+
+
+def test_chunk_ext_roundtrip():
+    ck = ChunkInfo(xfer=123, index=7, total=9, offset=7 << 20,
+                   seg_lens=(128, 1 << 20, 64), seg_types=(8, 10, 5))
+    from pslite_tpu.message import Meta
+
+    meta = Meta(app_id=1, timestamp=5, sender=9, recver=8, request=True,
+                push=True, key=42, trace=0xABC, chunk=ck)
+    out = wire.unpack_meta(wire.pack_meta(meta))
+    assert out.chunk == ck
+    assert out.trace == 0xABC  # both extensions coexist in the tail
+    assert out.key == 42
+
+
+def test_unchunked_meta_has_no_chunk():
+    from pslite_tpu.message import Meta
+
+    out = wire.unpack_meta(wire.pack_meta(Meta(app_id=1)))
+    assert out.chunk is None
+
+
+# -- split + reassembly ------------------------------------------------------
+
+
+@pytest.mark.parametrize("order", ["fifo", "reversed", "shuffled"])
+def test_split_reassemble_bit_exact(order):
+    msg, keys, vals = _big_msg(nkeys=16, val_len=1024)
+    chunks = split_message(msg, 4096, xfer_id=5)
+    assert len(chunks) > 8
+    assert sum(c.meta.data_size for c in chunks) == msg.meta.data_size
+    if order == "reversed":
+        chunks = chunks[::-1]
+    elif order == "shuffled":
+        rng = np.random.default_rng(0)
+        chunks = [chunks[i] for i in rng.permutation(len(chunks))]
+    asm = ChunkAssembler()
+    outs = []
+    for c in chunks:
+        outs.extend(asm.add(_roundtrip(c)))
+    finals = [o for o in outs if o.meta.option != OPT_XFER_PART]
+    parts = [o for o in outs if o.meta.option == OPT_XFER_PART]
+    assert len(finals) == 1
+    f = finals[0]
+    assert np.array_equal(f.data[0].numpy().view(np.uint64), keys)
+    assert np.array_equal(f.data[1].numpy().view(np.float32), vals)
+    assert len(asm) == 0  # table empties on completion
+    # Partials cover every key exactly once, in key order, bit-exact.
+    covered = 0
+    for p in parts:
+        pk = p.data[0].numpy().view(np.uint64)
+        pv = p.data[1].numpy().view(np.float32)
+        assert np.array_equal(pk, keys[covered:covered + len(pk)])
+        assert np.array_equal(
+            pv, vals[covered * 1024:(covered + len(pk)) * 1024]
+        )
+        covered += len(pk)
+    assert covered == len(keys)
+
+
+def test_split_skips_small_and_ineligible():
+    msg, _, _ = _big_msg(nkeys=2, val_len=8)
+    assert split_message(msg, 1 << 20, 1) is None  # small
+    big, _, _ = _big_msg(nkeys=16, val_len=1024)
+    big.meta.control.cmd = wire.Command.BARRIER
+    assert split_message(big, 4096, 1) is None  # control
+
+
+def test_lens_payload_reassembles_but_never_streams():
+    msg, keys, vals = _big_msg(nkeys=16, val_len=1024, lens=True)
+    chunks = split_message(msg, 4096, xfer_id=9)
+    asm = ChunkAssembler()
+    outs = []
+    for c in chunks:
+        outs.extend(asm.add(_roundtrip(c)))
+    assert all(o.meta.option != OPT_XFER_PART for o in outs)
+    f = outs[-1]
+    assert len(f.data) == 3
+    assert np.array_equal(f.data[1].numpy().view(np.float32), vals)
+    assert np.array_equal(
+        f.data[2].numpy().view(np.int32), np.full(16, 1024, np.int32)
+    )
+
+
+def test_stale_duplicate_after_completion_is_tombstoned():
+    """A retransmitted chunk landing AFTER its transfer completed (ACK
+    lost, dedup signature evicted) must not re-create reassembly state
+    — the partial it would emit re-applies already-applied keys."""
+    msg, _, vals = _big_msg()
+    chunks = split_message(msg, 8192, xfer_id=4)
+    asm = ChunkAssembler()
+    for c in chunks:
+        asm.add(_roundtrip(c))
+    assert len(asm) == 0
+    assert asm.add(_roundtrip(chunks[0])) == []
+    assert len(asm) == 0  # no resurrected entry
+
+
+def test_corrupt_chunk_range_drops_transfer_not_process():
+    """A chunk whose byte range walks past the transfer must be dropped
+    with a warning — never escalate into the receive loop's fatal
+    CHECK path."""
+    import dataclasses
+
+    msg, _, _ = _big_msg()
+    chunks = split_message(msg, 8192, xfer_id=6)
+    asm = ChunkAssembler()
+    asm.add(_roundtrip(chunks[0]))
+    evil = _roundtrip(chunks[1])
+    evil.meta.chunk = dataclasses.replace(
+        evil.meta.chunk, offset=msg.meta.data_size - 1
+    )
+    assert asm.add(evil) == []
+    assert len(asm) == 0  # transfer dropped, process alive
+
+
+def test_keys_only_push_reassembles_without_streaming():
+    """A streamable-looking push with an EMPTY vals segment (keys alone
+    exceed the chunk size) must reassemble fully with no partials (no
+    zero-stride division)."""
+    msg = Message()
+    m = msg.meta
+    m.sender, m.recver, m.request, m.push, m.app_id = 9, 8, True, True, 0
+    keys = np.arange(4096, dtype=np.uint64)  # 32 KB of keys
+    msg.add_data(SArray(keys))
+    msg.add_data(SArray(np.empty(0, np.float32)))
+    chunks = split_message(msg, 4096, xfer_id=8)
+    asm = ChunkAssembler()
+    outs = []
+    for c in chunks:
+        outs.extend(asm.add(_roundtrip(c)))
+    assert len(outs) == 1 and outs[0].meta.option != OPT_XFER_PART
+    assert np.array_equal(outs[0].data[0].numpy().view(np.uint64), keys)
+    assert len(asm) == 0
+
+
+def test_duplicate_chunk_ignored():
+    msg, keys, vals = _big_msg()
+    chunks = split_message(msg, 8192, xfer_id=2)
+    asm = ChunkAssembler()
+    outs = []
+    for c in chunks[:-1]:
+        outs.extend(asm.add(_roundtrip(c)))
+        outs.extend(asm.add(_roundtrip(c)))  # duplicate: no double count
+    outs.extend(asm.add(_roundtrip(chunks[-1])))
+    finals = [o for o in outs if o.meta.option != OPT_XFER_PART]
+    assert len(finals) == 1
+    assert np.array_equal(
+        finals[0].data[1].numpy().view(np.float32), vals
+    )
+
+
+# -- reclamation -------------------------------------------------------------
+
+
+def test_assembler_reclaims_dead_peer_and_stale_transfers():
+    msg, _, _ = _big_msg(sender=9)
+    msg2, _, _ = _big_msg(sender=11)
+    asm = ChunkAssembler(ttl_s=0.05)
+    asm.add(_roundtrip(split_message(msg, 8192, 1)[0]))
+    asm.add(_roundtrip(split_message(msg2, 8192, 2)[0]))
+    assert len(asm) == 2
+    assert asm.drop_peer(9) == 1
+    assert len(asm) == 1
+    time.sleep(0.1)
+    asm._sweep_stale()
+    assert len(asm) == 0  # TTL reclaims the abandoned transfer
+
+
+def test_recovered_sender_reuses_xfer_ids_after_drop_peer():
+    """drop_peer must purge COMPLETED-transfer tombstones too: a
+    restarted sender's xfer counter begins at 1 again, and a stale
+    tombstone would silently black-hole its first chunked pushes."""
+    msg, _, vals = _big_msg(sender=9)
+    chunks = split_message(msg, 8192, xfer_id=1)
+    asm = ChunkAssembler()
+    for c in chunks:
+        asm.add(_roundtrip(c))  # completes -> tombstoned
+    assert asm.add(_roundtrip(chunks[0])) == []  # dup still dropped
+    asm.drop_peer(9)  # the sender restarted
+    outs = []
+    for c in chunks:  # new incarnation reuses xfer id 1
+        outs.extend(asm.add(_roundtrip(c)))
+    finals = [o for o in outs if o.meta.option != OPT_XFER_PART]
+    assert len(finals) == 1
+    assert np.array_equal(finals[0].data[1].numpy().view(np.float32), vals)
+
+
+def test_chunking_works_with_telemetry_disabled():
+    """PS_TELEMETRY=0: the chunk path's new instruments no-op (node
+    snapshots stay empty) and the data plane stays correct."""
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_CHUNK_BYTES": "8192",
+                                    "PS_TELEMETRY": "0"})
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    keys = np.array([7], dtype=np.uint64)
+    vals = np.random.default_rng(2).normal(size=16384).astype(np.float32)
+    w.wait(w.push(keys, vals))
+    out = np.zeros_like(vals)
+    w.wait(w.pull(keys, out))
+    np.testing.assert_array_equal(out, vals)
+    snap = cl.workers[0].telemetry_snapshot()["metrics"]
+    assert not snap.get("counters")  # disabled: nothing recorded
+    _teardown(cl, [w], servers)
+
+
+def test_van_reclaims_partial_transfers_on_peer_death():
+    van = Van(_StubPo(Environment({})))
+    msg, _, _ = _big_msg(sender=9)
+    chunk = _roundtrip(split_message(msg, 8192, 1)[0])
+    van._assembler.add(chunk)
+    assert len(van._assembler) == 1
+    van.mark_peer_down(9)
+    assert len(van._assembler) == 0
+    van.clear_peer_down(9)
+    van._assembler.add(chunk)
+    van._reset_peer_sids(9)  # recovery path reclaims too
+    assert len(van._assembler) == 0
+
+
+# -- lane interleave ---------------------------------------------------------
+
+
+def test_priority_op_interleaves_between_chunks():
+    """A priority-1 message enqueued behind a chunked transfer must
+    dispatch before the transfer's remaining chunks."""
+    order = []
+    release = threading.Event()
+
+    class _RecordingVan(Van):
+        def send_msg(self, msg):
+            if not msg.meta.control.empty():
+                return 0
+            if msg.meta.chunk is not None:
+                order.append(("chunk", msg.meta.chunk.index))
+                release.wait(5)  # first chunk blocks until armed
+                release.set()
+            else:
+                order.append(("small", msg.meta.priority))
+            return msg.meta.data_size
+
+    van = _RecordingVan(_StubPo(Environment({"PS_CHUNK_BYTES": "4096"})))
+    big, _, _ = _big_msg(nkeys=16, val_len=1024, recver=8)
+    van.send(big)  # ~17 chunks into peer 8's lane
+    # Chunk 0 is mid-transmit (blocked on `release`) with the rest
+    # queued behind it — exactly the window a small priority op lands.
+    assert _wait_until(lambda: order[:1] == [("chunk", 0)])
+    small = Message()
+    small.meta.sender, small.meta.recver = 9, 8
+    small.meta.priority = 1
+    small.add_data(SArray(np.ones(4, np.float32)))
+    van.send(small)
+    release.set()
+    assert _wait_until(lambda: len(order) >= 18)
+    van._drain_send_lanes(timeout_s=5)
+    pos = order.index(("small", 1))
+    assert pos == 1, order  # right after the in-flight chunk, before the rest
+    # HOL accounting saw the wait behind chunk bytes.
+    assert van._h_hol_wait.count >= 1
+    assert van._c_chunks_sent.value >= 17
+
+
+# -- live cluster ------------------------------------------------------------
+
+
+def _mk_servers(cluster, handle_factory):
+    from pslite_tpu.kv.kv_app import KVServer
+
+    servers = []
+    for po in cluster.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(handle_factory())
+        servers.append(s)
+    return servers
+
+
+def _teardown(cluster, workers, servers):
+    for w in workers:
+        w.stop()
+    for s in servers:
+        s.stop()
+    cluster.finalize()
+
+
+def test_chunked_push_pull_loopback_bit_exact():
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(num_workers=1, num_servers=2,
+                         env_extra={"PS_CHUNK_BYTES": "8192"})
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    span = (1 << 64) // 32
+    keys = (np.arange(32, dtype=np.uint64) * span + 3).astype(np.uint64)
+    vals = np.random.default_rng(7).normal(size=32 * 2048).astype(np.float32)
+    w.wait(w.push(keys, vals))
+    w.wait(w.push(keys, vals))
+    out = np.zeros_like(vals)
+    w.wait(w.pull(keys, out))
+    np.testing.assert_array_equal(out, vals * 2)
+    wv = cl.workers[0].van
+    assert wv._c_chunks_sent.value > 0
+    assert wv._c_chunks_recv.value > 0  # pull response came back chunked
+    for po in cl.all_nodes():
+        assert len(po.van._assembler) == 0
+    for s in servers:
+        assert not s._streams
+    _teardown(cl, [w], servers)
+
+
+def _storm(env_extra, seed=42):
+    """Deterministic mixed storm; returns the final pulled state."""
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(num_workers=1, num_servers=2, env_extra=env_extra)
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    span = (1 << 64) // 8
+    big_keys = (np.arange(8, dtype=np.uint64) * span + 1).astype(np.uint64)
+    small_keys = (np.arange(8, dtype=np.uint64) * span + 2).astype(np.uint64)
+    rng = np.random.default_rng(seed)
+    big = rng.normal(size=8 * 4096).astype(np.float32)
+    small = rng.normal(size=8 * 16).astype(np.float32)
+    for i in range(6):
+        ts1 = w.push(big_keys, big)
+        ts2 = w.push(small_keys, small, priority=1)
+        w.wait(ts1)
+        w.wait(ts2)
+        if i % 2:
+            w.wait(w.push(big_keys, big, compress="int8"))
+    out_b = np.zeros_like(big)
+    out_s = np.zeros_like(small)
+    w.wait(w.pull(big_keys, out_b))
+    w.wait(w.pull(small_keys, out_s))
+    for po in cl.all_nodes():
+        assert len(po.van._assembler) == 0
+    _teardown(cl, [w], servers)
+    return out_b, out_s
+
+
+@pytest.mark.parametrize("replication", [False, True])
+def test_chunked_storm_matches_monolithic(replication):
+    """Acceptance: the chunked storm (incl. int8 compression and, in
+    one leg, PS_KV_REPLICATION=2) produces stores identical to
+    PS_CHUNK_BYTES=0."""
+    base = {"PS_KV_REPLICATION": "2"} if replication else {}
+    chunked = _storm(dict(base, PS_CHUNK_BYTES="8192"))
+    mono = _storm(dict(base, PS_CHUNK_BYTES="0"))
+    np.testing.assert_array_equal(chunked[0], mono[0])
+    np.testing.assert_array_equal(chunked[1], mono[1])
+
+
+def test_rechunked_forward_dedup_exactly_once():
+    """A worker retry of a chunked push that the primary already
+    forwarded must apply exactly once — on the primary (direct dedup)
+    AND on the replica (forward vs direct retry dedup)."""
+    from pslite_tpu.base import server_rank_to_id
+    from pslite_tpu.kv.kv_app import (
+        KVPairs, KVServerDefaultHandle, KVWorker,
+    )
+
+    cl = LoopbackCluster(num_workers=1, num_servers=2,
+                         env_extra={"PS_CHUNK_BYTES": "8192",
+                                    "PS_KV_REPLICATION": "2"})
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    keys = np.array([5], dtype=np.uint64)  # server rank 0's range
+    vals = np.random.default_rng(3).normal(size=8192).astype(np.float32)
+    w.wait(w.push(keys, vals))  # seed (chunked, forwarded)
+    # Craft ONE more push and deliver it twice to the primary (a
+    # resend of the same request) and once to the replica (a failover
+    # retry racing the primary's forward).
+    ts = w._customer.new_request(0, num_responses=3)
+    part = KVPairs(keys=keys, vals=vals)
+    primary = server_rank_to_id(0)
+    replica = server_rank_to_id(1)
+    for dest in (primary, primary, replica):
+        msg = w._slice_msg(ts, True, False, 0, part, 0, dest)
+        cl.workers[0].van.send(msg)
+    w.wait(ts)
+    by_id = {s.po.van.my_node.id: s for s in servers}
+    expected = vals * 2  # seed + exactly one retry application
+
+    def _store_val(server):
+        st = server._handle.store.get(5)
+        return None if st is None else st.copy()
+
+    assert _wait_until(
+        lambda: _store_val(by_id[primary]) is not None
+        and np.array_equal(_store_val(by_id[primary]), expected)
+    ), "primary applied the retry more than once (or not at all)"
+    assert _wait_until(
+        lambda: _store_val(by_id[replica]) is not None
+        and np.array_equal(_store_val(by_id[replica]), expected)
+    ), "replica saw the forward and the direct retry as distinct pushes"
+    _teardown(cl, [w], servers)
+
+
+def test_streaming_apply_overlaps_recv():
+    """Partial deliveries must reach the handler BEFORE the final chunk
+    arrives (apply overlaps the remaining wire time)."""
+    from pslite_tpu.kv.kv_app import KVServer, KVServerDefaultHandle
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_CHUNK_BYTES": "8192"})
+    cl.start()
+    server = KVServer(0, postoffice=cl.servers[0])
+    handle = KVServerDefaultHandle()
+    server.set_request_handle(handle)
+    svan = cl.servers[0].van
+    msg, keys, vals = _big_msg(nkeys=16, val_len=4096, sender=9,
+                               recver=svan.my_node.id)
+    msg.meta.app_id = 0
+    msg.meta.customer_id = 0
+    chunks = split_message(msg, 8192, xfer_id=77)
+    # Deliver all but the last chunk straight into the server's intake.
+    for c in chunks[:-1]:
+        svan._accept_data(_roundtrip(c))
+    assert _wait_until(lambda: len(handle.store) >= 8), (
+        "no keys applied while the tail of the transfer is still "
+        "'on the wire'"
+    )
+    assert len(svan._assembler) == 1
+    svan._accept_data(_roundtrip(chunks[-1]))
+    assert _wait_until(lambda: len(handle.store) == 16)
+    assert _wait_until(lambda: not server._streams)
+    assert len(svan._assembler) == 0
+    for k in keys:
+        np.testing.assert_array_equal(
+            handle.store[int(k)],
+            vals[int(k) * 4096:(int(k) + 1) * 4096],
+        )
+    server.stop()
+    cl.finalize()
+
+
+def test_server_reclaims_streams_on_worker_death():
+    from pslite_tpu.kv.kv_app import KVServer, KVServerDefaultHandle
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_CHUNK_BYTES": "8192"})
+    cl.start()
+    server = KVServer(0, postoffice=cl.servers[0])
+    server.set_request_handle(KVServerDefaultHandle())
+    svan = cl.servers[0].van
+    worker_id = cl.workers[0].van.my_node.id
+    msg, _, _ = _big_msg(nkeys=16, val_len=4096, sender=worker_id,
+                         recver=svan.my_node.id)
+    msg.meta.app_id = 0
+    chunks = split_message(msg, 8192, xfer_id=9)
+    for c in chunks[: len(chunks) // 2]:
+        svan._accept_data(_roundtrip(c))
+    assert _wait_until(lambda: len(server._streams) == 1)
+    assert len(svan._assembler) == 1
+    # The failure detector declares the worker dead: both the van's
+    # reassembly entry and the server's open stream must reclaim.
+    # (mark-then-notify is the production order, _process_node_failure.)
+    svan.mark_peer_down(worker_id)
+    cl.servers[0].notify_node_failure(worker_id, True)
+    assert _wait_until(lambda: not server._streams)
+    assert len(svan._assembler) == 0
+    server.stop()
+    cl.finalize()
+
+
+def test_server_reclaims_stalled_streams_by_ttl():
+    """A stream whose transfer died at the assembler (no final will
+    ever arrive) must be reclaimed by the server's TTL sweep."""
+    from pslite_tpu.kv.kv_app import KVServer, KVServerDefaultHandle
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_CHUNK_BYTES": "8192",
+                                    "PS_XFER_TIMEOUT": "0.05"})
+    cl.start()
+    server = KVServer(0, postoffice=cl.servers[0])
+    server.set_request_handle(KVServerDefaultHandle())
+    svan = cl.servers[0].van
+    msg, _, _ = _big_msg(nkeys=16, val_len=4096, sender=9,
+                         recver=svan.my_node.id)
+    msg.meta.app_id = 0
+    chunks = split_message(msg, 8192, xfer_id=13)
+    for c in chunks[: len(chunks) // 2]:
+        svan._accept_data(_roundtrip(c))
+    assert _wait_until(lambda: len(server._streams) == 1)
+    time.sleep(0.1)  # past the TTL
+    server._sweep_stale_streams()
+    assert not server._streams
+    server.stop()
+    cl.finalize()
+
+
+def test_failover_rechunks_whole_slice_to_replica():
+    """A chunked push to a dead rank fails over: the deadline sweeper
+    re-sends the WHOLE slice (fresh transfer) to the replica and the
+    wait completes; no reassembly residue anywhere."""
+    from pslite_tpu.base import server_rank_to_id
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(
+        num_workers=1, num_servers=2,
+        env_extra={
+            "PS_CHUNK_BYTES": "8192",
+            "PS_KV_REPLICATION": "2",
+            "PS_REQUEST_TIMEOUT": "0.5",
+            "PS_REQUEST_RETRIES": "4",
+        },
+    )
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    keys = np.array([5], dtype=np.uint64)
+    vals = np.random.default_rng(1).normal(size=16384).astype(np.float32)
+    w.wait(w.push(keys, vals))  # seed while everyone is alive
+    dead = server_rank_to_id(0)
+    # Declare rank 0 dead at the worker (detector broadcast analog).
+    cl.workers[0].van.mark_peer_down(dead)
+    cl.workers[0].notify_node_failure(dead, True)
+    w.wait(w.push(keys, vals))  # PeerDeadError -> sweeper -> replica
+    out = np.zeros_like(vals)
+    w.wait(w.pull(keys, out))  # routed to the replica too
+    np.testing.assert_array_equal(out, vals * 2)
+    for po in cl.all_nodes():
+        assert len(po.van._assembler) == 0
+    _teardown(cl, [w], servers)
+
+
+def test_multivan_stripes_one_transfer_across_rails():
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(
+        num_workers=1, num_servers=1, van_type="multi",
+        env_extra={"PS_CHUNK_BYTES": "16384", "DMLC_NUM_PORTS": "2"},
+    )
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    wvan = cl.workers[0].van
+    rails_hit = set()
+    orig = wvan._rail_index
+
+    def spy(msg):
+        rail = orig(msg)
+        if msg.meta.chunk is not None:
+            rails_hit.add(rail)
+        return rail
+
+    wvan._rail_index = spy
+    keys = np.array([7], dtype=np.uint64)
+    vals = np.random.default_rng(5).normal(size=128 * 1024).astype(
+        np.float32)
+    w.wait(w.push(keys, vals))
+    out = np.zeros_like(vals)
+    w.wait(w.pull(keys, out))
+    np.testing.assert_array_equal(out, vals)  # reassembly bit-exact
+    assert len(rails_hit) >= 2, f"chunks only observed on rails {rails_hit}"
+    assert len(wvan._assembler) == 0
+    _teardown(cl, [w], servers)
+
+
+def test_chaos_chunked_transfers_heal():
+    """Acceptance: drop/delay/dup chaos on CHUNKED transfers with
+    per-chunk retransmit (PS_RESEND) + deadlines + replication: every
+    wait completes and the store sums exactly; no reassembly residue
+    (a dropped chunk costs one chunk's resend, not the transfer)."""
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(
+        num_workers=1, num_servers=2, van_type="chaos+loopback",
+        env_extra={
+            "PS_CHAOS": "seed=7,drop=0.08,delay=0.3:2,dup=0.05",
+            "PS_RESEND": "1",
+            "PS_RESEND_TIMEOUT": "60",
+            "PS_CHUNK_BYTES": "4096",
+            "PS_KV_REPLICATION": "2",
+            "PS_REQUEST_TIMEOUT": "5",
+            "PS_REQUEST_RETRIES": "4",
+        },
+    )
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    keys = np.array([3, (1 << 63) + 9], dtype=np.uint64)  # both ranges
+    vals = np.ones(2 * 8192, dtype=np.float32)  # ~32 KB -> 16 chunks
+    rounds = 4
+    for _ in range(rounds):
+        w.wait(w.push(keys, vals))
+    out = np.zeros_like(vals)
+    w.wait(w.pull(keys, out))
+    np.testing.assert_allclose(out, rounds * vals)
+    injected = sum(
+        sum(po.van.chaos_stats.values()) for po in cl.all_nodes()
+    )
+    assert injected > 0, "chaos injected nothing"
+    assert cl.workers[0].van._c_chunks_sent.value > 0
+    assert _wait_until(
+        lambda: all(len(po.van._assembler) == 0 for po in cl.all_nodes())
+    ), "reassembly state leaked across the chaos run"
+    _teardown(cl, [w], servers)
+
+
+def test_traced_transfer_records_xfer_span():
+    """PS_TRACE_SAMPLE=1: a chunked push's trace must contain the
+    per-transfer reassembly span on the server, nested under the same
+    trace id as the worker's request span."""
+    from pslite_tpu.kv.kv_app import KVServerDefaultHandle, KVWorker
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_CHUNK_BYTES": "8192",
+                                    "PS_TRACE_SAMPLE": "1"})
+    cl.start()
+    servers = _mk_servers(cl, KVServerDefaultHandle)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    keys = np.array([7], dtype=np.uint64)
+    vals = np.ones(32768, np.float32)
+    w.wait(w.push(keys, vals))
+    tr = cl.servers[0].tracer
+    with tr._mu:
+        names = [e["name"] for e in tr._events]
+        spans = [e for e in tr._events if e["name"] == "xfer_recv"]
+    assert "xfer_recv" in names, names
+    wtr = cl.workers[0].tracer
+    with wtr._mu:
+        req_traces = {e["args"]["trace"] for e in wtr._events
+                      if e["name"] == "request"}
+    assert any(s["args"]["trace"] in req_traces for s in spans)
+    _teardown(cl, [w], servers)
+
+
+# -- priority receive queue --------------------------------------------------
+
+
+def test_priority_recv_queue_discipline():
+    from pslite_tpu.utils.queues import PriorityRecvQueue
+
+    q = PriorityRecvQueue(lambda item: item[0])
+    q.push((0, "a"))
+    q.push((0, "b"))
+    q.push((1, "jump"))
+    q.push((0, "c"))
+    q.push((None, "sentinel"), priority=-(1 << 30))
+    got = [q.wait_and_pop()[1] for _ in range(5)]
+    assert got == ["jump", "a", "b", "c", "sentinel"]
+    assert q.try_pop() is None
+    assert q.wait_and_pop(timeout=0.01) is None
+
+
+# -- recv pool satellite -----------------------------------------------------
+
+
+def test_recv_pool_budget_and_size_classes():
+    from pslite_tpu.telemetry.metrics import Registry
+    from pslite_tpu.vans.tcp_van import _RecvPool
+
+    reg = Registry()
+    pool = _RecvPool(reg, budget_mb=1)
+    held = [pool.acquire(64 << 10) for _ in range(4)]
+    assert pool.misses == 4
+    held = None  # noqa: F841 - release so the blocks go free
+    b = pool.acquire(64 << 10)
+    assert pool.hits == 1  # recycled a freed block
+    del b
+    # Size-class counters are on the registry.
+    counters = reg.counters_with_prefix("tcp.recv_pool.c")
+    cls = 64 << 10
+    assert counters.get(f"tcp.recv_pool.c{cls}.misses") == 4
+    assert counters.get(f"tcp.recv_pool.c{cls}.hits") == 1
+    # Budget pressure: a bigger class evicts FREE smaller blocks
+    # instead of staying permanently unpoolable.
+    big = pool.acquire(768 << 10)
+    del big
+    big2 = pool.acquire(768 << 10)
+    assert pool.hits == 2, "big class never became poolable"
+    del big2
+
+
+def test_recv_pool_env_budget_plumbs_through():
+    from pslite_tpu.vans.tcp_van import TcpVan
+
+    van = TcpVan(_StubPo(Environment({"PS_RECV_POOL_MB": "7",
+                                      "PS_NATIVE": "0"})))
+    assert van._recv_pool is not None
+    assert van._recv_pool._max_total == 7 << 20

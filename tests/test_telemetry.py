@@ -184,7 +184,7 @@ def test_psmon_table_against_live_cluster():
         servers, workers, _out = _run_storm(cluster, rounds=8)
         snap = psmon.collect(cluster.scheduler, timeout_s=10)
         table = psmon.format_table(snap)
-        for col in ("req_p50ms", "lane_q", "apply/s", "retx",
+        for col in ("req_p50ms", "lane_q", "xfers", "apply/s", "retx",
                     "repl_fwd", "per-role rollup", "hot keys"):
             assert col in table, table
         # One row per node.

@@ -82,7 +82,7 @@ def _apply_row(m: dict, uptime: float) -> tuple:
 def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     """Human-readable per-node table + per-role rollups."""
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
-           f"{'req_p99ms':>9} {'lane_q':>6} {'apply_n':>8} "
+           f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
            f"{'sent':>7} {'recv':>7}")
     lines = [hdr, "-" * len(hdr)]
@@ -95,6 +95,10 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         p50, p99 = _req_quantiles(m)
         apply_n, apply_rate, _apply_depth = _apply_row(m, uptime)
         lane_q = _g(m, "van.lane_depth")
+        # In-flight chunked transfers (partially reassembled) on this
+        # node — docs/chunking.md; a persistently nonzero value with
+        # idle traffic means leaked reassembly state.
+        xfers = _g(m, "van.xfers_inflight")
         retx = _c(m, "resender.retransmits")
         fwd = _c(m, "replication.forwards")
         lag = _g(m, "replication.lag")
@@ -103,7 +107,7 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         role = s.get("role", "?")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
-            f"{p99:>9.3f} {lane_q:>6.0f} {apply_n:>8} "
+            f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
             f"{sent:>7} {recv:>7}"
         )
