@@ -15,6 +15,7 @@
 
 #include <arpa/inet.h>
 #include <dirent.h>
+#include <pthread.h>
 #include <fcntl.h>
 #include <netdb.h>
 #include <poll.h>
@@ -30,6 +31,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <array>
 #include <atomic>
 #include <condition_variable>
@@ -37,6 +39,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -58,13 +61,51 @@ struct Frame {
   //   then data segments back to back
 };
 
+// ABI stamp: bumped whenever the C API surface changes so a stale .so
+// (make -C cpp not rerun after a source update) is rejected LOUDLY at
+// load time instead of silently falling back per-symbol.  Must match
+// pslite_tpu/vans/native.py ABI_VERSION.
+// 7: cross-rail direct-read reassembly — tcp_van no longer clamps
+// PS_NATIVE_REASSEMBLY to a single rail, so a pre-7 (per-connection
+// reassembly) library would wait forever for the other rails' stripes.
+constexpr int kAbiVersion = 7;
+
 // Fixed offsets inside the python wire format's meta block (wire.py
 // _META_FIXED, little-endian, no padding): enough to peek a frame's
-// send priority and control command for the express receive lane
-// without decoding the meta.  Keep in sync with wire.py.
+// send priority and control command for the express receive lane, and
+// to stamp the per-peer sid at transmit time, without decoding the
+// meta.  Keep in sync with wire.py (META_*_OFF constants).
+constexpr size_t kMetaSidOff = 58;       // i32, stamped at lane dispatch
 constexpr size_t kMetaPriorityOff = 70;  // i32
 constexpr size_t kMetaControlCmdOff = 84;  // u8; 0 == EMPTY (data plane)
 constexpr size_t kMetaFixedSize = 105;
+
+// EXT_CHUNK payload layout (wire.py _EXT_CHUNK_FIXED "<QIIQB"): the
+// native chunk splitter patches the per-chunk index and byte offset in
+// place; everything else in the template meta is shared by every chunk
+// of one transfer.
+constexpr size_t kChunkIndexOff = 8;   // u32 within the ext payload
+constexpr size_t kChunkTotalOff = 12;  // u32 within the ext payload
+constexpr size_t kChunkOffsetOff = 16;  // u64 within the ext payload
+constexpr size_t kChunkNsegOff = 24;   // u8 within the ext payload
+constexpr size_t kChunkFixedSize = 25;
+constexpr size_t kChunkSegEntry = 9;   // u64 len + u8 dtype code
+
+// More fixed meta offsets (wire.py _META_FIXED) used by the native
+// receive-side reassembly: sender id, and the variable-tail counters
+// needed to locate the extension blocks after the (empty) node list.
+constexpr size_t kMetaSenderOff = 17;     // i32
+constexpr size_t kMetaNumNodesOff = 97;   // u16
+constexpr size_t kMetaNumDtypesOff = 99;  // u16
+constexpr size_t kMetaBodyLenOff = 101;   // u32
+constexpr uint8_t kExtChunkTag = 2;       // wire.py EXT_CHUNK
+
+// ChunkInfo.index sentinel stamped on a NATIVELY-REASSEMBLED frame:
+// the payload is the COMPLETE transfer (original segments, original
+// lens table) and Python finalizes the message without touching its
+// ChunkAssembler.  Never produced by any sender, so it cannot collide
+// with a real chunk index (senders cap transfers far below 2^32).
+constexpr uint32_t kChunkCompleteIndex = 0xFFFFFFFFu;
 
 // True when this frame rides the express receive lane, mirroring the
 // pure-Python PriorityRecvQueue discipline (utils/queues.py,
@@ -128,17 +169,133 @@ struct WritePipe {
 };
 
 // Per-connection frame reassembly state machine.
+// Process-global recv-frame buffer pool.  A fresh malloc per frame
+// means every received byte lands in never-touched pages, and the soft
+// page faults HALVE large-transfer goodput (measured: 64 MiB frames at
+// ~6.7 Gbps fresh vs ~18 Gbps into recycled pages on loopback).
+// Buffers round up to power-of-two classes and recycle on
+// psl_frame_free.  Global and never torn down deliberately: Python
+// holds frame views past Core destruction and psl_frame_free carries
+// no core handle.  Bounded (PSL_FRAME_POOL_MB, default 256) — blocks
+// past the budget free() as before.
+class FramePool {
+ public:
+  static constexpr size_t kHdr = 16;  // capacity stash, keeps 16-align
+
+  static uint8_t* Alloc(size_t n) {
+    size_t cap = ClassOf(n);
+    {
+      std::lock_guard<std::mutex> lk(Mu());
+      auto& cls = Free()[cap];
+      if (!cls.empty()) {
+        uint8_t* base = cls.back();
+        cls.pop_back();
+        Total() -= cap;
+        return base + kHdr;
+      }
+    }
+    auto* base = static_cast<uint8_t*>(malloc(cap + kHdr));
+    if (base == nullptr) return nullptr;
+    memcpy(base, &cap, sizeof(cap));
+    return base + kHdr;
+  }
+
+  static void Release(uint8_t* p) {
+    if (p == nullptr) return;
+    uint8_t* base = p - kHdr;
+    size_t cap;
+    memcpy(&cap, base, sizeof(cap));
+    {
+      std::lock_guard<std::mutex> lk(Mu());
+      if (Total() + cap <= Budget()) {
+        Free()[cap].push_back(base);
+        Total() += cap;
+        return;
+      }
+    }
+    free(base);
+  }
+
+ private:
+  static size_t ClassOf(size_t n) {
+    size_t cap = 4096;
+    while (cap < n) cap <<= 1;
+    return cap;
+  }
+  // Function-local statics: safe from any thread, never destroyed
+  // before the last psl_frame_free (intentionally leaked at exit).
+  static std::mutex& Mu() {
+    static std::mutex* mu = new std::mutex();
+    return *mu;
+  }
+  static std::map<size_t, std::vector<uint8_t*>>& Free() {
+    static auto* f = new std::map<size_t, std::vector<uint8_t*>>();
+    return *f;
+  }
+  static size_t& Total() {
+    static size_t t = 0;
+    return t;
+  }
+  static size_t Budget() {
+    static size_t budget = [] {
+      const char* v = getenv("PSL_FRAME_POOL_MB");
+      long mb = v != nullptr ? atol(v) : 256;
+      if (mb < 0) mb = 0;
+      return static_cast<size_t>(mb) << 20;
+    }();
+    return budget;
+  }
+};
+
+// Receive-side reassembly state of one in-flight chunked transfer
+// (native scatter — docs/native_core.md): chunk payloads memcpy
+// straight into the final frame body at their byte offset, GIL-free,
+// and Python sees ONE complete frame per transfer instead of
+// total-chunks pump round trips.
+struct ConnXfer {
+  uint64_t total_bytes = 0;
+  uint32_t total = 0;
+  uint32_t got = 0;
+  uint32_t nseg = 0;
+  uint32_t meta_len = 0;
+  size_t body_size = 0;
+  uint8_t* buf = nullptr;  // FramePool block: lens | meta | data
+  std::vector<bool> received;
+  uint64_t seq = 0;  // insertion order, oldest-first eviction
+  // Cross-rail direct-read state (Core::xfers_mu_): pumps currently
+  // reading a payload into buf hold a reader ref — the entry (and
+  // buf) may not be evicted or freed until they finish.  dropped
+  // marks an inconsistent transfer whose buffer the LAST reader
+  // reclaims.
+  int readers = 0;
+  bool dropped = false;
+};
+
 struct Conn {
   int fd = -1;
-  // Stage 0: header; stage 1: body (lens+meta+data).
+  // Stage 0: header; stage 1: lens; stage 2: meta; stage 3: payload.
+  // Meta is read BEFORE the payload so a reassembling receiver can
+  // parse EXT_CHUNK and point the payload read STRAIGHT at the
+  // transfer buffer's byte range (direct-read scatter: the kernel
+  // copy-out is the only pass over the data — no intermediate frame
+  // buffer, no second memcpy).
   int stage = 0;
   size_t want = kHeaderSize;
   size_t got = 0;
   uint8_t header[kHeaderSize];
   Frame frame;
   size_t body_size = 0;
+  // Stage-3 direct-read scatter state (valid while stage == 3 and
+  // scatter_dst != nullptr): the payload destination inside the
+  // pending transfer's buffer, and the bookkeeping to finish the
+  // absorb when the last byte lands.  Same-io-thread only.
+  uint8_t* scatter_dst = nullptr;
+  bool drop_frame = false;   // consume payload, deliver nothing
+  bool dup_chunk = false;    // already-received index: bytes rewrite
+  uint32_t pending_index = 0;
+  std::pair<long long, unsigned long long> pending_key{0, 0};
 
-  ~Conn() { free(frame.buf); }
+  ~Conn() { FramePool::Release(frame.buf); }
 };
 
 struct ReadPipe {
@@ -148,6 +305,60 @@ struct ReadPipe {
   size_t map_len = 0;
   std::string path;
   Conn conn;  // reassembly state for this byte stream
+};
+
+// One queued data-plane send: the meta bytes are COPIED at enqueue (the
+// lanes patch sid/chunk fields in place at transmit time); the data
+// segments are NOT — they point into Python-owned buffers that the van
+// pins until the descriptor's ticket is reaped (docs/native_core.md,
+// buffer-ownership rules).
+struct SendDesc {
+  uint64_t ticket = 0;
+  int node_id = 0;
+  int priority = 0;
+  std::vector<uint8_t> meta;
+  std::vector<iovec> data;
+  uint64_t total_data = 0;
+  // Native chunk split (0 = one monolithic frame): the descriptor
+  // transmits as ceil(total_data / chunk_bytes) chunk frames, patching
+  // the EXT_CHUNK payload at meta[chunk_ext_off..] per chunk.
+  uint64_t chunk_bytes = 0;
+  int32_t chunk_ext_off = -1;
+  uint32_t next_index = 0;
+  uint64_t sent_offset = 0;
+  // Multi-rail bookkeeping (lane->mu): chunks of the ACTIVE descriptor
+  // are claimed by any rail thread; the descriptor completes (ticket
+  // reported, memory freed) only when fully claimed AND no rail is
+  // still mid-writev on one of its chunks.
+  int inflight = 0;
+  bool canceled = false;
+  long long error = 0;
+};
+
+// Per-peer native send lane: the GIL-free counterpart of the Python
+// van's _SendLane (van.py) — highest priority first, FIFO within a
+// level, one lazily-spawned sender thread per peer.  Completed tickets
+// park in `done` until Python reaps them (releasing its buffer pins).
+struct SendLane {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<int, std::deque<SendDesc*>, std::greater<int>> q;  // mu
+  std::vector<std::pair<uint64_t, long long>> done;           // mu
+  // Rail threads (PS_NATIVE_RAILS): rail 0 plus N-1 stripe threads.
+  // All rails claim chunks of the ONE active descriptor (strict
+  // FIFO-within-level descriptor order; only a strictly-higher
+  // priority descriptor overtakes), so per-level transfer order — and
+  // with it the server's apply order — matches the single-rail plane.
+  std::vector<std::thread> threads;
+  SendDesc* active = nullptr;  // mu: descriptor being claimed/transmitted
+  // Per-peer data sid, stamped into the meta at CLAIM time under the
+  // lane lock so the per-peer sid sequence equals the claim order (the
+  // Python lanes' sid-at-dispatch contract; across rails the sids of
+  // one transfer's chunks may land interleaved, which every consumer
+  // of chunked frames already tolerates).
+  std::atomic<int32_t> sid{0};
+  bool stop = false;    // mu
+  bool drained = false;  // mu: stop-drain ran (first rail to exit does it)
 };
 
 class Core {
@@ -180,11 +391,7 @@ class Core {
     socklen_t len = sizeof(addr);
     getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
     listen_fd_ = fd;
-    epoll_event ev{};
-    ev.events = EPOLLIN;
-    ev.data.fd = fd;
-    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
-    io_thread_ = std::thread([this] { IoLoop(); });
+    StartIo();
     return ntohs(addr.sin_port);
   }
 
@@ -211,11 +418,7 @@ class Core {
     }
     bound_path_ = path;
     listen_fd_ = fd;
-    epoll_event ev{};
-    ev.events = EPOLLIN;
-    ev.data.fd = fd;
-    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
-    io_thread_ = std::thread([this] { IoLoop(); });
+    StartIo();
     return 0;
   }
 
@@ -394,28 +597,12 @@ class Core {
     return 0;
   }
 
-  long long PipeSendFrame(WritePipe* p, const uint8_t* meta,
-                          uint32_t meta_len, uint32_t n_data,
-                          const uint8_t* const* data, const uint64_t* lens) {
-    uint8_t header[kHeaderSize];
-    memcpy(header, &kMagic, 4);
-    memcpy(header + 4, &meta_len, 4);
-    memcpy(header + 8, &n_data, 4);
-    std::vector<iovec> iov;
-    iov.reserve(3 + n_data);
-    iov.push_back({header, kHeaderSize});
-    iov.push_back({const_cast<uint64_t*>(lens), 8ull * n_data});
-    iov.push_back({const_cast<uint8_t*>(meta), meta_len});
-    long long total = kHeaderSize + 8ll * n_data + meta_len;
-    for (uint32_t i = 0; i < n_data; ++i) {
-      iov.push_back({const_cast<uint8_t*>(data[i]),
-                     static_cast<size_t>(lens[i])});
-      total += static_cast<long long>(lens[i]);
-    }
+  long long PipeSendFrame(WritePipe* p, const iovec* iov, size_t cnt,
+                          long long total) {
     // Whole frames are written under the pipe mutex: in-process sender
     // threads must not interleave bytes mid-frame.
     std::lock_guard<std::mutex> lk(p->mu);
-    int rc = PipeWriteVec(p, iov.data(), iov.size());
+    int rc = PipeWriteVec(p, iov, cnt);
     return rc < 0 ? rc : total;
   }
 
@@ -506,7 +693,10 @@ class Core {
     return 0;
   }
 
-  int Connect(int node_id, const char* host, int port, int timeout_ms) {
+  // Dial one outbound TCP connection (bounded connect: a black-holed
+  // peer must not stall the caller for the kernel's full SYN-retry
+  // period).  Returns the fd or -errno.
+  int DialTcp(const char* host, int port, int timeout_ms) {
     addrinfo hints{};
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -520,8 +710,6 @@ class Core {
       freeaddrinfo(res);
       return -errno;
     }
-    // Bounded connect: a black-holed peer must not stall the caller for
-    // the kernel's full SYN-retry period.
     int flags = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
     int rc = connect(fd, res->ai_addr, res->ai_addrlen);
@@ -548,6 +736,19 @@ class Core {
     fcntl(fd, F_SETFL, flags);
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int snd = sndbuf_.load();
+    if (snd > 0) {
+      // Same bounded-buffer discipline the Python van applies
+      // (PS_TCP_SNDBUF): without it the native and pure-Python planes
+      // would run against different kernel buffering.
+      setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &snd, sizeof(snd));
+    }
+    return fd;
+  }
+
+  int Connect(int node_id, const char* host, int port, int timeout_ms) {
+    int fd = DialTcp(host, port, timeout_ms);
+    if (fd < 0) return fd;
     std::lock_guard<std::mutex> lk(send_mu_);
     auto it = send_fds_.find(node_id);
     if (it != send_fds_.end()) close(it->second);
@@ -555,9 +756,66 @@ class Core {
     return 0;
   }
 
+  // Extra data rail to a peer (PS_NATIVE_RAILS, docs/native_core.md):
+  // rail `idx` (1-based beyond the main connection) carries a stripe of
+  // each chunked transfer so one lane's goodput is no longer bounded by
+  // a single TCP stream's per-byte kernel cost.  Re-dialing an index
+  // replaces the old fd (peer recovery redial).
+  int AddRail(int node_id, const char* host, int port, int timeout_ms,
+              int idx) {
+    if (idx < 1 || idx >= kMaxRails) return -EINVAL;
+    int fd = DialTcp(host, port, timeout_ms);
+    if (fd < 0) return fd;
+    std::lock_guard<std::mutex> lk(send_mu_);
+    auto& v = rail_fds_[node_id];
+    if (v.size() < static_cast<size_t>(idx)) v.resize(idx, -1);
+    if (v[idx - 1] >= 0) close(v[idx - 1]);
+    v[idx - 1] = fd;
+    return 0;
+  }
+
+  void SetRails(int n) {
+    if (n < 1) n = 1;
+    if (n > kMaxRails) n = kMaxRails;
+    rails_.store(n);
+  }
+
+  void SetSockBuf(int snd, int rcv) {
+    sndbuf_.store(snd > 0 ? snd : 0);
+    rcvbuf_.store(rcv > 0 ? rcv : 0);
+  }
+
   long long Send(int node_id, const uint8_t* meta, uint32_t meta_len,
                  uint32_t n_data, const uint8_t* const* data,
                  const uint64_t* lens) {
+    std::vector<iovec> div(n_data);
+    for (uint32_t i = 0; i < n_data; ++i) {
+      div[i] = {const_cast<uint8_t*>(data[i]), static_cast<size_t>(lens[i])};
+    }
+    return TransmitFrame(node_id, meta, meta_len, div.data(), n_data);
+  }
+
+  // Frame one message and write it to the peer's route (pipe or
+  // socket).  Shared by the synchronous control-plane Send() and the
+  // per-peer sender lanes (TransmitDesc) — both serialize on the same
+  // per-fd write locks, so lane frames and inline control frames never
+  // interleave mid-frame.
+  // The fd rail `rail` of a lane should transmit on, or -1 when the
+  // rail has no dedicated connection (fall back to the main path, which
+  // also serves pipes).  send_mu_.
+  int RailFd(int node_id, int rail) {
+    if (rail <= 0) return -1;
+    std::lock_guard<std::mutex> lk(send_mu_);
+    if (pipes_.count(node_id)) return -1;  // pipe = single ordered stream
+    auto it = rail_fds_.find(node_id);
+    if (it == rail_fds_.end()) return -1;
+    if (static_cast<size_t>(rail) > it->second.size()) return -1;
+    return it->second[rail - 1];
+  }
+
+  long long TransmitFrame(int node_id, const uint8_t* meta,
+                          uint32_t meta_len, const iovec* data_iov,
+                          uint32_t n_data, int rail_fd = -1) {
     // Gate against teardown: StopAndJoin must not free pipes while a
     // sender is mid-copy into the mapping.
     struct InflightGuard {
@@ -567,8 +825,8 @@ class Core {
     } guard(&inflight_sends_);
     if (stopped_) return -ECANCELED;
     WritePipe* pipe = nullptr;
-    int fd = -1;
-    {
+    int fd = rail_fd;
+    if (fd < 0) {
       std::lock_guard<std::mutex> lk(send_mu_);
       auto pit = pipes_.find(node_id);
       if (pit != pipes_.end()) {
@@ -579,10 +837,26 @@ class Core {
         fd = it->second;
       }
     }
+    uint8_t header[kHeaderSize];
+    memcpy(header, &kMagic, 4);
+    memcpy(header + 4, &meta_len, 4);
+    memcpy(header + 8, &n_data, 4);
+    std::vector<uint64_t> lens(n_data);
+    std::vector<iovec> iov;
+    iov.reserve(3 + n_data);
+    iov.push_back({header, kHeaderSize});
+    iov.push_back({lens.data(), 8ull * n_data});
+    iov.push_back({const_cast<uint8_t*>(meta), meta_len});
+    long long total = kHeaderSize + 8ull * n_data + meta_len;
+    for (uint32_t i = 0; i < n_data; ++i) {
+      lens[i] = data_iov[i].iov_len;
+      iov.push_back(data_iov[i]);
+      total += static_cast<long long>(lens[i]);
+    }
     // A connected pipe carries the WHOLE stream for this peer (mixing
     // pipe and socket frames would lose ordering).
     if (pipe != nullptr) {
-      long long rc = PipeSendFrame(pipe, meta, meta_len, n_data, data, lens);
+      long long rc = PipeSendFrame(pipe, iov.data(), iov.size(), total);
       if (rc != -EPIPE) return rc;
       // Reader declared dead (see PipeWriteVec): retire the pipe and
       // fall back to the socket connection, which connect_transport
@@ -595,22 +869,6 @@ class Core {
       auto it = send_fds_.find(node_id);
       if (it == send_fds_.end()) return -EPIPE;
       fd = it->second;
-    }
-    uint8_t header[kHeaderSize];
-    memcpy(header, &kMagic, 4);
-    memcpy(header + 4, &meta_len, 4);
-    memcpy(header + 8, &n_data, 4);
-
-    std::vector<iovec> iov;
-    iov.reserve(3 + n_data);
-    iov.push_back({header, kHeaderSize});
-    iov.push_back({const_cast<uint64_t*>(lens), 8ull * n_data});
-    iov.push_back({const_cast<uint8_t*>(meta), meta_len});
-    long long total = kHeaderSize + 8ull * n_data + meta_len;
-    for (uint32_t i = 0; i < n_data; ++i) {
-      iov.push_back({const_cast<uint8_t*>(data[i]),
-                     static_cast<size_t>(lens[i])});
-      total += lens[i];
     }
     // Serialize writers per peer socket (frames must not interleave).
     std::lock_guard<std::mutex> lk(per_fd_send_mu_[fd % kSendLocks]);
@@ -653,6 +911,141 @@ class Core {
     return sent_total;
   }
 
+  // -- per-peer native sender lanes (docs/native_core.md) -----------------
+
+  // Enqueue one data-plane frame (or, with chunk_bytes > 0, one whole
+  // chunked transfer) onto the destination's native lane and return a
+  // ticket (> 0) immediately; the lane thread transmits GIL-free.  The
+  // caller owns keeping the data buffers alive until the ticket is
+  // reaped.  chunk_ext_off locates the EXT_CHUNK payload inside the
+  // meta template for per-chunk patching.
+  long long EnqueueSend(int node_id, int priority, const uint8_t* meta,
+                        uint32_t meta_len, uint32_t n_data,
+                        const uint8_t* const* data, const uint64_t* lens,
+                        uint64_t chunk_bytes, int32_t chunk_ext_off) {
+    if (stopped_) return -ECANCELED;
+    if (chunk_bytes > 0 &&
+        (chunk_ext_off < 0 ||
+         static_cast<size_t>(chunk_ext_off) + kChunkFixedSize > meta_len)) {
+      return -EINVAL;
+    }
+    auto* d = new SendDesc();
+    d->ticket = ticket_seq_.fetch_add(1) + 1;
+    d->node_id = node_id;
+    d->priority = priority;
+    d->meta.assign(meta, meta + meta_len);
+    d->data.resize(n_data);
+    for (uint32_t i = 0; i < n_data; ++i) {
+      d->data[i] = {const_cast<uint8_t*>(data[i]),
+                    static_cast<size_t>(lens[i])};
+      d->total_data += lens[i];
+    }
+    d->chunk_bytes = chunk_bytes;
+    d->chunk_ext_off = chunk_ext_off;
+    SendLane* lane = LaneFor(node_id);
+    long long ticket = static_cast<long long>(d->ticket);
+    {
+      std::lock_guard<std::mutex> f(flush_mu_);
+      pending_descs_.fetch_add(1);
+    }
+    {
+      std::lock_guard<std::mutex> lk(lane->mu);
+      if (lane->stop) {
+        // Raced a shutdown: complete-as-canceled so the caller's
+        // buffer pin is released on its next reap.
+        lane->done.emplace_back(d->ticket, -ECANCELED);
+        delete d;
+        NoteDescDone();
+        return ticket;
+      }
+      lane->q[priority].push_back(d);
+    }
+    lane->cv.notify_all();
+    return ticket;
+  }
+
+  // Drain completed (ticket, status) pairs for one peer; status 0 = sent,
+  // negative = -errno (including -ECANCELED for shutdown/cancel drops).
+  int SendReap(int node_id, uint64_t* tickets, long long* status, int cap) {
+    SendLane* lane = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(lanes_mu_);
+      auto it = lanes_.find(node_id);
+      if (it == lanes_.end()) return 0;
+      lane = it->second;
+    }
+    std::lock_guard<std::mutex> lk(lane->mu);
+    int n = static_cast<int>(lane->done.size());
+    if (n > cap) n = cap;
+    for (int i = 0; i < n; ++i) {
+      tickets[i] = lane->done[i].first;
+      status[i] = lane->done[i].second;
+    }
+    lane->done.erase(lane->done.begin(), lane->done.begin() + n);
+    return n;
+  }
+
+  // Block until every lane has transmitted (or failed) every queued
+  // descriptor — the native analog of the Python _drain_send_lanes.
+  int SendFlush(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(flush_mu_);
+    auto pred = [&] { return pending_descs_.load() == 0 || stopped_; };
+    if (timeout_ms < 0) {
+      flush_cv_.wait(lk, pred);
+      return 0;
+    }
+    return flush_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                              pred)
+               ? 0
+               : -ETIMEDOUT;
+  }
+
+  // Drop every QUEUED descriptor for a dead peer (tickets complete as
+  // -ECANCELED so Python can fail the owning requests fast).  A
+  // descriptor already mid-transmit is not interrupted — its writev
+  // fails on the broken socket.
+  long long SendCancel(int node_id) {
+    SendLane* lane = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(lanes_mu_);
+      auto it = lanes_.find(node_id);
+      if (it == lanes_.end()) return 0;
+      lane = it->second;
+    }
+    long long n = 0;
+    {
+      std::lock_guard<std::mutex> lk(lane->mu);
+      for (auto& kv : lane->q) {
+        for (SendDesc* d : kv.second) {
+          if (d->inflight > 0) {
+            // A preempted transfer with a rail still mid-writev on one
+            // of its chunks: poison it — the last writer reports the
+            // ticket as canceled (deleting here would be a UAF).
+            d->canceled = true;
+          } else {
+            lane->done.emplace_back(d->ticket, -ECANCELED);
+            delete d;
+            ++n;
+          }
+        }
+      }
+      lane->q.clear();
+    }
+    lane->cv.notify_all();
+    for (long long i = 0; i < n; ++i) NoteDescDone();
+    return n;
+  }
+
+  // Peer recovery: a restarted peer expects the sid sequence to begin
+  // at 0 again (the Python _reset_peer_sids counterpart).
+  void SendResetSid(int node_id) {
+    std::lock_guard<std::mutex> lk(lanes_mu_);
+    auto it = lanes_.find(node_id);
+    if (it != lanes_.end()) it->second->sid.store(0);
+  }
+
+  void SetReassembly(int on) { reassemble_.store(on != 0); }
+
   // Returns 1 with a frame, 0 on timeout, -1 when stopped.  Express
   // frames (priority > 0 data — see FrameIsExpress) pop first so a
   // priority op never waits behind a bulk chunk backlog; each lane is
@@ -689,12 +1082,55 @@ class Core {
       unlink(bound_path_.c_str());
       bound_path_.clear();
     }
+    // Wake every sender lane (they drain-as-canceled and retire) and
+    // unwedge any writev blocked on a black-holed peer: the Python van
+    // flushes the lanes BEFORE stop, so anything still in flight here
+    // is already abandoned.
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      for (auto& kv : send_fds_) shutdown(kv.second, SHUT_RDWR);
+      for (auto& kv : rail_fds_) {
+        for (int fd : kv.second) {
+          if (fd >= 0) shutdown(fd, SHUT_RDWR);
+        }
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(lanes_mu_);
+      for (auto& kv : lanes_) kv.second->cv.notify_all();
+    }
+    flush_cv_.notify_all();
     queue_cv_.notify_all();
   }
 
   void StopAndJoin() {
     Stop();
+    // Sender lanes first: their threads write through pipes/sockets the
+    // teardown below frees.
+    std::vector<SendLane*> lanes;
+    {
+      std::lock_guard<std::mutex> lk(lanes_mu_);
+      for (auto& kv : lanes_) lanes.push_back(kv.second);
+      lanes_.clear();
+    }
+    for (SendLane* lane : lanes) {
+      {
+        std::lock_guard<std::mutex> lk(lane->mu);
+        lane->stop = true;
+      }
+      lane->cv.notify_all();
+    }
+    for (SendLane* lane : lanes) {
+      for (std::thread& t : lane->threads) {
+        if (t.joinable()) t.join();
+      }
+      delete lane;
+    }
     if (io_thread_.joinable()) io_thread_.join();
+    for (std::thread& t : io_threads_) {
+      if (t.joinable()) t.join();
+    }
+    io_threads_.clear();
     if (pipe_thread_.joinable()) pipe_thread_.join();
     // Wait for in-flight Sends to drain: freeing a pipe mapping under a
     // sender's memcpy would be a use-after-munmap (stopped_ makes them
@@ -729,26 +1165,258 @@ class Core {
     dead_write_pipes_.clear();
     for (auto& kv : send_fds_) close(kv.second);
     send_fds_.clear();
-    for (auto& kv : conns_) {
-      close(kv.second->fd);
-      delete kv.second;
+    for (auto& kv : rail_fds_) {
+      for (int fd : kv.second) {
+        if (fd >= 0) close(fd);
+      }
     }
-    conns_.clear();
+    rail_fds_.clear();
+    {
+      std::lock_guard<std::mutex> clk(conns_mu_);
+      for (auto& kv : conns_) {
+        close(kv.second->fd);
+        AbandonScatter(kv.second);
+        delete kv.second;
+      }
+      conns_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> xlk(xfers_mu_);
+      for (auto& kv : xfers_) FramePool::Release(kv.second.buf);
+      xfers_.clear();
+    }
     if (epfd_ >= 0) {
       close(epfd_);
       epfd_ = -1;
     }
+    for (int ep : extra_epfds_) close(ep);
+    extra_epfds_.clear();
     std::lock_guard<std::mutex> qlk(queue_mu_);
-    for (auto& f : queue_) free(f.buf);
+    for (auto& f : queue_) FramePool::Release(f.buf);
     queue_.clear();
-    for (auto& f : express_) free(f.buf);
+    for (auto& f : express_) FramePool::Release(f.buf);
     express_.clear();
   }
 
  private:
   static constexpr int kSendLocks = 64;
+  static constexpr int kMaxRails = 8;
+
+  SendLane* LaneFor(int node_id) {
+    std::lock_guard<std::mutex> lk(lanes_mu_);
+    auto it = lanes_.find(node_id);
+    if (it != lanes_.end()) return it->second;
+    auto* lane = new SendLane();
+    int n = rails_.load();
+    for (int r = 0; r < n; ++r) {
+      lane->threads.emplace_back([this, node_id, lane, r] {
+        RailLoop(node_id, lane, r);
+      });
+    }
+    lanes_[node_id] = lane;
+    return lane;
+  }
+
+  void NoteDescDone() {
+    {
+      std::lock_guard<std::mutex> f(flush_mu_);
+      pending_descs_.fetch_sub(1);
+    }
+    flush_cv_.notify_all();
+  }
+
+  void StampSid(uint8_t* meta, uint32_t meta_len, SendLane* lane) {
+    if (meta_len < kMetaFixedSize) return;
+    int32_t sid = lane->sid.fetch_add(1);
+    memcpy(meta + kMetaSidOff, &sid, sizeof(sid));
+  }
+
+  // Whether rail `rail` can make progress right now.  lane->mu held.
+  //
+  // A monolithic frame — and the FINAL chunk of every transfer — is
+  // reserved for rail 0: every descriptor's completion marker then
+  // rides one FIFO stream, so the receiver observes transfer
+  // completions (and with them the server's apply slots) in exactly
+  // the claim order, no matter how the rails' socket buffers drain.
+  bool RailHasClaim(SendLane* lane, int rail) {
+    SendDesc* d = lane->active;
+    if (d == nullptr) return !lane->q.empty();
+    if (!lane->q.empty() && lane->q.begin()->first > d->priority) {
+      return true;  // preemption is work for any rail
+    }
+    uint64_t remaining = d->total_data - d->sent_offset;
+    if (d->chunk_bytes == 0) return rail == 0;
+    if (remaining == 0) return false;  // fully claimed; writers draining
+    if (remaining <= d->chunk_bytes && rail != 0) return false;
+    return true;
+  }
+
+  // Claim-and-transmit loop of one rail thread (PS_NATIVE_RAILS rail
+  // threads per peer).  Rails cooperatively drain the ONE active
+  // descriptor: each claims the next chunk under the lane lock (sid
+  // stamped at claim, so sid order == claim order), patches a
+  // rail-local copy of the meta template, and writev's on its own
+  // connection — one transfer's chunks stream in parallel over N TCP
+  // streams while descriptor order stays strict FIFO-within-level.
+  // Frames are byte-identical to the single-rail plane's.
+  void RailLoop(int node_id, SendLane* lane, int rail) {
+    char name[16];
+    snprintf(name, sizeof(name), "psl-lane-%d.%d", node_id, rail);
+    pthread_setname_np(pthread_self(), name);
+    std::vector<uint8_t> tmeta;   // rail-local template copy
+    std::vector<iovec> slices;
+    std::unique_lock<std::mutex> lk(lane->mu);
+    while (true) {
+      lane->cv.wait(lk, [&] {
+        return stopped_ || lane->stop || RailHasClaim(lane, rail);
+      });
+      if (stopped_ || lane->stop) break;
+      // Promote the next descriptor / preempt a mid-transfer bulk.
+      if (lane->active == nullptr) {
+        auto it = lane->q.begin();  // highest priority, FIFO within
+        lane->active = it->second.front();
+        it->second.pop_front();
+        if (it->second.empty()) lane->q.erase(it);
+        // The promoted frame may be claimable only by ANOTHER rail
+        // (monolithic / final chunk → rail 0).
+        lane->cv.notify_all();
+      } else if (!lane->q.empty() &&
+                 lane->q.begin()->first > lane->active->priority) {
+        // Partially-claimed transfer back to the FRONT of its level —
+        // later same-priority sends still wait for the whole transfer
+        // (Python lane order), only higher priority jumps.
+        lane->q[lane->active->priority].push_front(lane->active);
+        auto it = lane->q.begin();
+        lane->active = it->second.front();
+        it->second.pop_front();
+        if (it->second.empty()) lane->q.erase(it);
+        lane->cv.notify_all();
+      }
+      if (!RailHasClaim(lane, rail)) continue;
+      SendDesc* d = lane->active;
+      // Claim the next chunk (a monolithic frame claims whole).
+      bool mono = d->chunk_bytes == 0;
+      uint64_t lo = d->sent_offset;
+      uint64_t hi = mono ? d->total_data : lo + d->chunk_bytes;
+      if (hi > d->total_data) hi = d->total_data;
+      uint32_t index = d->next_index;
+      d->sent_offset = hi;
+      d->next_index++;
+      if (d->sent_offset >= d->total_data) {
+        // Fully claimed: the next descriptor may start while this
+        // one's last writev is still in flight (its completion marker
+        // is already ordered ahead on rail 0).
+        lane->active = nullptr;
+        lane->cv.notify_all();
+      }
+      d->inflight++;
+      long long rc = 0;
+      if (d->error == 0 && !d->canceled) {
+        tmeta.assign(d->meta.begin(), d->meta.end());
+        uint32_t meta_len = static_cast<uint32_t>(tmeta.size());
+        StampSid(tmeta.data(), meta_len, lane);
+        if (mono) {
+          lk.unlock();
+          rc = TransmitFrame(node_id, tmeta.data(), meta_len,
+                             d->data.data(),
+                             static_cast<uint32_t>(d->data.size()));
+          lk.lock();
+        } else {
+          uint8_t* ext = tmeta.data() + d->chunk_ext_off;
+          memcpy(ext + kChunkIndexOff, &index, 4);
+          memcpy(ext + kChunkOffsetOff, &lo, 8);
+          // The byte range's slices of the original segments, in
+          // order — exactly split_message's per-chunk data list
+          // (wire.py lens table entries come out identical).
+          slices.clear();
+          uint64_t pos = 0;
+          for (const iovec& seg : d->data) {
+            uint64_t a = lo > pos ? lo : pos;
+            uint64_t b = pos + seg.iov_len < hi ? pos + seg.iov_len : hi;
+            if (a < b) {
+              slices.push_back(
+                  {static_cast<uint8_t*>(seg.iov_base) + (a - pos),
+                   static_cast<size_t>(b - a)});
+            }
+            pos += seg.iov_len;
+            if (pos >= hi) break;
+          }
+          lk.unlock();
+          rc = TransmitFrame(node_id, tmeta.data(), meta_len,
+                             slices.data(),
+                             static_cast<uint32_t>(slices.size()),
+                             RailFd(node_id, rail));
+          lk.lock();
+        }
+      }
+      d->inflight--;
+      if (rc < 0 && d->error == 0) d->error = rc;
+      bool poisoned = d->canceled || d->error != 0;
+      if (d->inflight == 0 &&
+          (poisoned || d->sent_offset >= d->total_data)) {
+        if (lane->active == d) {
+          lane->active = nullptr;
+          lane->cv.notify_all();
+        } else if (poisoned) {
+          // A poisoned descriptor that was PREEMPTED mid-transfer
+          // still sits at the front of its level's queue (the
+          // preemption push_front) — unlink it before the delete, or
+          // a later promotion pops freed memory (SendCancel clears
+          // the queue itself; a writev error on a broken socket
+          // reaches here with the descriptor still enqueued).
+          auto qit = lane->q.find(d->priority);
+          if (qit != lane->q.end()) {
+            auto pos = std::find(qit->second.begin(), qit->second.end(),
+                                 d);
+            if (pos != qit->second.end()) qit->second.erase(pos);
+            if (qit->second.empty()) lane->q.erase(qit);
+          }
+        }
+        lane->done.emplace_back(
+            d->ticket, d->canceled ? -ECANCELED
+                                   : (d->error < 0 ? d->error : 0));
+        delete d;
+        lk.unlock();
+        NoteDescDone();
+        lk.lock();
+      }
+    }
+    // Stop-drain: cancel the backlog so every ticket still completes
+    // (Python's reap releases the pinned buffers either way).  First
+    // rail to exit does it; descriptors with writers still in flight
+    // are only POISONED — their last writer reports the ticket.
+    if (!lane->drained) {
+      lane->drained = true;
+      long long dropped = 0;
+      for (auto& kv : lane->q) {
+        for (SendDesc* d : kv.second) {
+          if (d->inflight > 0) {
+            d->canceled = true;
+          } else {
+            lane->done.emplace_back(d->ticket, -ECANCELED);
+            delete d;
+            ++dropped;
+          }
+        }
+      }
+      lane->q.clear();
+      SendDesc* a = lane->active;
+      if (a != nullptr && a->inflight == 0 &&
+          a->sent_offset < a->total_data) {
+        lane->active = nullptr;
+        lane->done.emplace_back(a->ticket, -ECANCELED);
+        delete a;
+        ++dropped;
+      } else if (a != nullptr && a->inflight > 0) {
+        a->canceled = true;
+      }
+      lk.unlock();
+      for (long long i = 0; i < dropped; ++i) NoteDescDone();
+    }
+  }
 
   void PipeLoop() {
+    pthread_setname_np(pthread_self(), "psl-pipe");
     uint64_t idle_us = 0;
     uint64_t last_scan_ms = 0, last_live_ms = 0;
     while (!stopped_) {
@@ -945,12 +1613,17 @@ class Core {
       head += n;
       consumed += static_cast<long long>(n);
       rp->hdr->head.store(head, std::memory_order_release);
-      if (c->got == c->want && !OnStageComplete(c)) return -1;
+      // Same want == got transition loop as ReadConn: a meta-only
+      // frame's lens and payload stages are zero-length.
+      while (c->got == c->want) {
+        if (!OnStageComplete(c)) return -1;
+      }
     }
     return consumed;
   }
 
   void ClosePipe(ReadPipe* rp) {
+    AbandonScatter(&rp->conn);
     munmap(const_cast<uint8_t*>(
                reinterpret_cast<const uint8_t*>(rp->hdr)),
            rp->map_len);
@@ -958,26 +1631,49 @@ class Core {
     delete rp;
   }
 
-  void IoLoop() {
+  // Register the listener (sentinel data.ptr == nullptr) on the primary
+  // epoll and start the primary receive thread.  Further receive pumps
+  // spawn lazily, one per ACCEPTED connection (capped, PSL_IO_THREADS):
+  // round-robin sharding at accept used to put both of a 2-rail peer's
+  // data streams on the same pump whenever an idle control conn
+  // happened to occupy the other slot — a 50/50 accept-order lottery
+  // that degraded multi-rail receive to single-stream goodput
+  // (measured: the tcp bench's sticky ~13 vs ~18.5 Gbps modes).
+  void StartIo() {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    const char* cap = getenv("PSL_IO_THREADS");
+    max_io_threads_ = cap != nullptr ? atoi(cap) : 8;
+    if (max_io_threads_ < 1) max_io_threads_ = 1;
+    io_thread_ = std::thread([this] { IoLoop(epfd_); });
+  }
+
+  void IoLoop(int epfd) {
+    pthread_setname_np(pthread_self(), "psl-io");
     epoll_event events[64];
     while (!stopped_) {
-      int n = epoll_wait(epfd_, events, 64, 100);
+      int n = epoll_wait(epfd, events, 64, 100);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
       }
       for (int i = 0; i < n; ++i) {
-        int fd = events[i].data.fd;
-        if (fd == listen_fd_) {
-          AcceptAll();
-        } else {
-          auto it = conns_.find(fd);
-          if (it != conns_.end() && !ReadConn(it->second)) {
-            epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
-            close(fd);
-            delete it->second;
-            conns_.erase(it);
+        if (events[i].data.ptr == nullptr) {
+          AcceptAll();  // listener lives on the primary epoll only
+          continue;
+        }
+        auto* c = static_cast<Conn*>(events[i].data.ptr);
+        if (!ReadConn(c)) {
+          epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+          close(c->fd);
+          {
+            std::lock_guard<std::mutex> lk(conns_mu_);
+            conns_.erase(c->fd);
           }
+          AbandonScatter(c);
+          delete c;
         }
       }
     }
@@ -989,24 +1685,69 @@ class Core {
       if (fd < 0) break;
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      int rcv = rcvbuf_.load();
+      if (rcv > 0) {
+        setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcv, sizeof(rcv));
+      }
       auto* conn = new Conn();
       conn->fd = fd;
-      conns_[fd] = conn;
+      {
+        std::lock_guard<std::mutex> lk(conns_mu_);
+        conns_[fd] = conn;
+      }
+      // Each accepted conn gets its own epoll + pump thread while
+      // under the cap (every stream drains independently — no
+      // accept-order lottery pairing two hot rails on one pump);
+      // beyond the cap, round-robin over the existing pumps.  Each
+      // Conn is read by exactly one thread, so its frame state
+      // machine stays single-threaded.  Only this (primary) thread
+      // mutates extra_epfds_/io_threads_, and Stop() joins it first.
+      int ep = epfd_;
+      if (static_cast<int>(extra_epfds_.size()) < max_io_threads_ - 1) {
+        int nep = epoll_create1(0);
+        if (nep >= 0) {
+          extra_epfds_.push_back(nep);
+          io_threads_.emplace_back([this, nep] { IoLoop(nep); });
+          ep = nep;
+        }
+      } else if (!extra_epfds_.empty()) {
+        size_t slot = accept_rr_++ % (extra_epfds_.size() + 1);
+        if (slot > 0) ep = extra_epfds_[slot - 1];
+      }
       epoll_event ev{};
       ev.events = EPOLLIN;
-      ev.data.fd = fd;
-      epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+      ev.data.ptr = conn;
+      epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
     }
   }
 
   // Byte sink of the frame state machine for the current stage.
   static uint8_t* StageDst(Conn* c) {
-    return (c->stage == 0 ? c->header : c->frame.buf) + c->got;
+    if (c->stage == 0) return c->header + c->got;
+    if (c->stage == 3) {
+      // Payload: straight into the transfer buffer (direct-read
+      // scatter) or appended after lens+meta in the frame block.
+      if (c->scatter_dst != nullptr) return c->scatter_dst + c->got;
+      return c->frame.buf + 8ull * c->frame.n_data + c->frame.meta_len +
+             c->got;
+    }
+    return c->frame.buf + c->got;
   }
 
-  // Stage transition once got == want.  Returns false on protocol error.
-  // Shared by the fd reader and the shm-pipe pump: both are byte streams
-  // feeding the same reassembly.
+  static void ResetStage(Conn* c) {
+    c->scatter_dst = nullptr;
+    c->drop_frame = false;
+    c->dup_chunk = false;
+    c->stage = 0;
+    c->want = kHeaderSize;
+    c->got = 0;
+  }
+
+  // Stage transition once got == want.  Returns false on protocol
+  // error.  Shared by the fd reader and the shm-pipe pump: both are
+  // byte streams feeding the same reassembly.  A stage may complete
+  // with want == got (empty lens table, empty payload), so callers
+  // must re-invoke until want > got (see ReadConn/PumpPipe).
   bool OnStageComplete(Conn* c) {
     if (c->stage == 0) {
       uint32_t magic, meta_len, n_data;
@@ -1016,43 +1757,308 @@ class Core {
       if (magic != kMagic) return false;
       c->frame.meta_len = meta_len;
       c->frame.n_data = n_data;
-      // Read lens first to learn the body size.
+      // Lens + meta land in one right-sized block; the payload's
+      // destination is decided only after the meta is readable.
       c->body_size = 8ull * n_data + meta_len;
-      c->frame.buf = static_cast<uint8_t*>(malloc(c->body_size));
+      c->frame.buf = FramePool::Alloc(c->body_size);
       c->stage = 1;
       c->want = 8ull * n_data;  // lens arrive first
       c->got = 0;
-      if (c->want == 0) {
-        c->stage = 2;
-        c->want = meta_len;
-      }
     } else if (c->stage == 1) {
-      // Lens complete: total body = lens + meta + sum(data).
-      uint64_t total = 0;
-      const uint64_t* lens = reinterpret_cast<uint64_t*>(c->frame.buf);
-      for (uint32_t i = 0; i < c->frame.n_data; ++i) total += lens[i];
-      size_t full = 8ull * c->frame.n_data + c->frame.meta_len + total;
-      c->frame.buf = static_cast<uint8_t*>(realloc(c->frame.buf, full));
-      c->body_size = full;
+      // Lens complete: meta follows in the same block (got continues).
       c->stage = 2;
-      c->want = full;
-      // got already == 8*n_data
+      c->want = c->body_size;
+    } else if (c->stage == 2) {
+      return OnMetaComplete(c);
     } else {
-      // Frame complete.
-      {
-        std::lock_guard<std::mutex> lk(queue_mu_);
-        if (recv_priority_ && FrameIsExpress(c->frame)) {
-          express_.push_back(c->frame);
-        } else {
-          queue_.push_back(c->frame);
+      OnPayloadComplete(c);
+    }
+    return true;
+  }
+
+  // Meta complete: learn the payload size and route the payload bytes.
+  // A reassembly-eligible chunk frame's payload reads DIRECTLY into
+  // its transfer's buffer at the chunk's byte offset — the only pass
+  // over the data; everything else grows the frame block to the full
+  // body and delivers as-is.
+  bool OnMetaComplete(Conn* c) {
+    uint64_t payload = 0;
+    const uint64_t* lens = reinterpret_cast<uint64_t*>(c->frame.buf);
+    for (uint32_t i = 0; i < c->frame.n_data; ++i) payload += lens[i];
+    if (reassemble_ && payload > 0 && BeginChunkScatter(c, payload)) {
+      c->stage = 3;
+      c->want = payload;
+      c->got = 0;
+      return true;
+    }
+    if (payload == 0) {
+      // Meta-only frame (control, empty vals): deliver as-is.
+      EnqueueFrame(c->frame);
+      c->frame = Frame();
+      ResetStage(c);
+      return true;
+    }
+    // Pool-aware "realloc": move lens+meta into a full-body block.
+    size_t full = c->body_size + payload;
+    uint8_t* grown = FramePool::Alloc(full);
+    if (grown != nullptr && c->frame.buf != nullptr) {
+      memcpy(grown, c->frame.buf, c->body_size);
+    }
+    FramePool::Release(c->frame.buf);
+    c->frame.buf = grown;
+    c->stage = 3;
+    c->want = payload;
+    c->got = 0;
+    return true;
+  }
+
+  // Payload complete: finish the direct-read absorb (complete
+  // transfers deliver as ONE frame), discard a dropped frame, or
+  // deliver the ordinary full frame.  Marking received + enqueueing
+  // the completed transfer is ONE xfers_mu_ critical section: with
+  // chunks striped over rails, transfer N+1's last chunk lands
+  // strictly after transfer N's (per-rail FIFO + final-chunk-on-rail-0
+  // sender discipline), so serialized mark+enqueue keeps completion
+  // delivery in submission order.
+  void OnPayloadComplete(Conn* c) {
+    if (c->scatter_dst != nullptr) {
+      std::lock_guard<std::mutex> lk(xfers_mu_);
+      auto it = xfers_.find(c->pending_key);
+      if (it != xfers_.end()) {
+        ConnXfer& x = it->second;
+        x.readers--;
+        if (!c->dup_chunk && !x.dropped) {
+          x.received[c->pending_index] = true;
+          x.got++;
+        }
+        if (x.dropped) {
+          if (x.readers == 0) {
+            FramePool::Release(x.buf);
+            xfers_.erase(it);
+          }
+        } else if (x.got == x.total && x.readers == 0) {
+          Frame out;
+          out.buf = x.buf;
+          out.meta_len = x.meta_len;
+          out.n_data = x.nseg;
+          xfers_.erase(it);
+          EnqueueFrame(out);
         }
       }
-      queue_cv_.notify_one();
+      FramePool::Release(c->frame.buf);
       c->frame = Frame();
-      c->stage = 0;
-      c->want = kHeaderSize;
-      c->got = 0;
+    } else if (c->drop_frame) {
+      FramePool::Release(c->frame.buf);
+      c->frame = Frame();
+    } else {
+      EnqueueFrame(c->frame);
+      c->frame = Frame();
     }
+    ResetStage(c);
+  }
+
+  // A conn died mid-payload while direct-reading into a transfer
+  // buffer: release its reader ref so the entry can be evicted (the
+  // index was never marked received — the partial bytes are simply
+  // dead weight until then).
+  void AbandonScatter(Conn* c) {
+    if (c->stage != 3 || c->scatter_dst == nullptr) return;
+    std::lock_guard<std::mutex> lk(xfers_mu_);
+    auto it = xfers_.find(c->pending_key);
+    if (it == xfers_.end()) return;
+    ConnXfer& x = it->second;
+    x.readers--;
+    if (x.dropped && x.readers == 0) {
+      FramePool::Release(x.buf);
+      xfers_.erase(it);
+    }
+    c->scatter_dst = nullptr;
+  }
+
+  void EnqueueFrame(const Frame& f) {
+    {
+      std::lock_guard<std::mutex> lk(queue_mu_);
+      if (recv_priority_ && FrameIsExpress(f)) {
+        express_.push_back(f);
+      } else {
+        queue_.push_back(f);
+      }
+    }
+    queue_cv_.notify_one();
+  }
+
+  // The EXT_CHUNK payload inside a packed meta, or nullptr when the
+  // frame is not a (reassembly-eligible) chunk.  Data frames carry no
+  // node list, so the extension tail sits at a computable offset.
+  static const uint8_t* FindChunkExt(const uint8_t* meta,
+                                     uint32_t meta_len) {
+    if (meta_len < kMetaFixedSize) return nullptr;
+    if (meta[kMetaControlCmdOff] != 0) return nullptr;
+    uint16_t num_nodes;
+    memcpy(&num_nodes, meta + kMetaNumNodesOff, 2);
+    if (num_nodes != 0) return nullptr;
+    uint16_t ndt;
+    memcpy(&ndt, meta + kMetaNumDtypesOff, 2);
+    uint32_t body_len;
+    memcpy(&body_len, meta + kMetaBodyLenOff, 4);
+    size_t off = kMetaFixedSize + ndt + body_len;
+    while (off + 2 <= meta_len) {
+      uint8_t tag = meta[off];
+      uint8_t len = meta[off + 1];
+      off += 2;
+      if (off + len > meta_len) return nullptr;
+      if (tag == kExtChunkTag) {
+        if (len < kChunkFixedSize) return nullptr;
+        uint8_t nseg = meta[off + kChunkNsegOff];
+        if (len != kChunkFixedSize + nseg * kChunkSegEntry) return nullptr;
+        return meta + off;
+      }
+      off += len;  // unknown tags skip by length
+    }
+    return nullptr;
+  }
+
+  // Matches the Python ChunkAssembler's table cap.  Eviction of a
+  // LIVE transfer (a high-fan-in receiver with 256+ concurrent
+  // chunked pushes) loses it permanently — later chunks re-create a
+  // phantom entry that can never complete and the sender only
+  // recovers via its request deadline — so evictions warn loudly.
+  static constexpr size_t kMaxXfers = 256;
+
+  // Native receive-side DIRECT-READ scatter: called at meta-complete
+  // time (the payload is still in the kernel), so an eligible chunk
+  // frame's payload bytes can be read straight into the transfer's
+  // reassembly buffer at the chunk's byte offset — the chunk's payload
+  // is a contiguous byte range of the original segments'
+  // concatenation, which is exactly the frame body layout.  Returns
+  // true when stage 3 was routed (scatter_dst set, or drop_frame for
+  // an inconsistent chunk whose payload must be consumed and
+  // discarded); false leaves the ordinary deliver-raw path (not a
+  // chunk, or allocation failure — Python's assembler remains the
+  // fallback).
+  bool BeginChunkScatter(Conn* c, uint64_t payload) {
+    Frame& f = c->frame;
+    const uint8_t* meta = f.buf + 8ull * f.n_data;
+    const uint8_t* ext = FindChunkExt(meta, f.meta_len);
+    if (ext == nullptr) return false;
+    uint64_t xfer;
+    uint32_t index, total;
+    uint64_t offset;
+    memcpy(&xfer, ext, 8);
+    memcpy(&index, ext + kChunkIndexOff, 4);
+    memcpy(&total, ext + kChunkTotalOff, 4);
+    memcpy(&offset, ext + kChunkOffsetOff, 8);
+    uint8_t nseg = ext[kChunkNsegOff];
+    if (index == kChunkCompleteIndex || total == 0) return false;
+    int sender;
+    memcpy(&sender, meta + kMetaSenderOff, 4);
+    auto key = std::make_pair(static_cast<long long>(sender),
+                              static_cast<unsigned long long>(xfer));
+    std::lock_guard<std::mutex> lk(xfers_mu_);
+    auto it = xfers_.find(key);
+    if (it != xfers_.end() && it->second.dropped) {
+      // A rail already declared this transfer inconsistent: consume
+      // and discard this stripe too (no reader ref — the entry may
+      // reclaim under us otherwise).
+      size_t full = c->body_size + payload;
+      uint8_t* grown = FramePool::Alloc(full);
+      if (grown != nullptr && f.buf != nullptr) {
+        memcpy(grown, f.buf, c->body_size);
+      }
+      FramePool::Release(f.buf);
+      f.buf = grown;
+      c->drop_frame = true;
+      return true;
+    }
+    if (it == xfers_.end()) {
+      if (xfers_.size() >= kMaxXfers) {
+        // Evict the stalest partial with no active readers (a sender
+        // that died mid-transfer and reconnected would otherwise leak
+        // its old entries).
+        auto victim = xfers_.end();
+        for (auto jt = xfers_.begin(); jt != xfers_.end(); ++jt) {
+          if (jt->second.readers > 0) continue;
+          if (victim == xfers_.end() ||
+              jt->second.seq < victim->second.seq) {
+            victim = jt;
+          }
+        }
+        if (victim == xfers_.end()) return false;  // all active
+        fprintf(stderr,
+                "[pslite_core] W reassembly table full (%zu): evicting "
+                "partial xfer %llu from %lld (%u/%u chunks) — the "
+                "sender's request deadline will have to recover it\n",
+                xfers_.size(),
+                static_cast<unsigned long long>(victim->first.second),
+                victim->first.first, victim->second.got,
+                victim->second.total);
+        FramePool::Release(victim->second.buf);
+        xfers_.erase(victim);
+      }
+      ConnXfer x;
+      x.total = total;
+      x.nseg = nseg;
+      x.meta_len = f.meta_len;
+      for (uint8_t i = 0; i < nseg; ++i) {
+        uint64_t ln;
+        memcpy(&ln, ext + kChunkFixedSize + i * kChunkSegEntry, 8);
+        x.total_bytes += ln;
+      }
+      x.body_size = 8ull * nseg + f.meta_len + x.total_bytes;
+      x.buf = FramePool::Alloc(x.body_size);
+      if (x.buf == nullptr) return false;  // deliver raw, Python copes
+      // Lens table of the ORIGINAL segments, then the template meta
+      // with the index patched to the completion sentinel.
+      for (uint8_t i = 0; i < nseg; ++i) {
+        memcpy(x.buf + 8ull * i,
+               ext + kChunkFixedSize + i * kChunkSegEntry, 8);
+      }
+      memcpy(x.buf + 8ull * nseg, meta, f.meta_len);
+      size_t ext_off = static_cast<size_t>(ext - meta);
+      memcpy(x.buf + 8ull * nseg + ext_off + kChunkIndexOff,
+             &kChunkCompleteIndex, 4);
+      x.received.assign(total, false);
+      x.seq = ++xfer_seq_;
+      it = xfers_.emplace(key, std::move(x)).first;
+    }
+    ConnXfer& x = it->second;
+    if (index >= x.total || x.total != total || x.meta_len != f.meta_len ||
+        offset + payload > x.total_bytes) {
+      // Inconsistent chunk: drop the whole transfer (matching the
+      // Python assembler's bounds-check-before-scatter posture) —
+      // never deliver a torn payload.  The chunk's payload bytes
+      // still have to leave the stream: stage 3 consumes them into
+      // the grown frame block and discards the frame.
+      fprintf(stderr,
+              "[pslite_core] W inconsistent chunk (xfer %llu from %d); "
+              "dropping the transfer\n",
+              static_cast<unsigned long long>(xfer), sender);
+      if (x.readers == 0) {
+        FramePool::Release(x.buf);
+        xfers_.erase(it);
+      } else {
+        // Another rail is mid-read into x.buf: the last reader out
+        // reclaims (OnPayloadComplete/AbandonScatter).
+        x.dropped = true;
+      }
+      size_t full = c->body_size + payload;
+      uint8_t* grown = FramePool::Alloc(full);
+      if (grown != nullptr && f.buf != nullptr) {
+        memcpy(grown, f.buf, c->body_size);
+      }
+      FramePool::Release(f.buf);
+      f.buf = grown;
+      c->drop_frame = true;
+      return true;
+    }
+    // Duplicate index (reassembly runs only with the resender off, so
+    // a dup carries identical bytes): rewrite them in place, but do
+    // not advance the completion count.
+    c->dup_chunk = x.received[index];
+    c->pending_index = index;
+    c->pending_key = key;
+    c->scatter_dst = x.buf + 8ull * x.nseg + x.meta_len + offset;
+    x.readers++;
     return true;
   }
 
@@ -1066,8 +2072,12 @@ class Core {
         return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
       }
       c->got += static_cast<size_t>(n);
-      if (c->got < c->want) continue;
-      if (!OnStageComplete(c)) return false;
+      // A stage may complete with want == got (empty lens table of a
+      // meta-only frame, empty payload) — keep transitioning until the
+      // machine wants bytes again (ResetStage always wants a header).
+      while (c->got == c->want) {
+        if (!OnStageComplete(c)) return false;
+      }
     }
   }
 
@@ -1075,9 +2085,21 @@ class Core {
   int listen_fd_ = -1;
   std::string bound_path_;
   std::thread io_thread_;
+  // Extra receive pumps (lazily one per accepted conn, capped by
+  // PSL_IO_THREADS): each owns an epoll set.  Primary io thread only.
+  std::vector<int> extra_epfds_;
+  std::vector<std::thread> io_threads_;
+  size_t accept_rr_ = 0;  // primary io thread only
+  int max_io_threads_ = 8;
   std::atomic<bool> stopped_{false};
-  std::unordered_map<int, Conn*> conns_;  // io thread only
+  std::unordered_map<int, Conn*> conns_;  // conns_mu_ (reads io-threads)
+  std::mutex conns_mu_;
   std::unordered_map<int, int> send_fds_;
+  // Extra per-peer data connections (PS_NATIVE_RAILS).  send_mu_.
+  std::unordered_map<int, std::vector<int>> rail_fds_;
+  std::atomic<int> rails_{1};
+  std::atomic<int> sndbuf_{0};
+  std::atomic<int> rcvbuf_{0};
   std::unordered_map<int, WritePipe*> pipes_;                  // send_mu_
   std::unordered_map<std::string, WritePipe*> pipes_by_path_;  // send_mu_
   // Dead-reader pipes parked until shutdown (mapping must outlive any
@@ -1094,6 +2116,24 @@ class Core {
   std::atomic<int> inflight_sends_{0};
   std::mutex send_mu_;
   std::mutex per_fd_send_mu_[kSendLocks];
+  // Per-peer native sender lanes (EnqueueSend/LaneLoop).
+  std::unordered_map<int, SendLane*> lanes_;  // lanes_mu_
+  std::mutex lanes_mu_;
+  std::atomic<uint64_t> ticket_seq_{0};
+  std::atomic<long long> pending_descs_{0};
+  std::mutex flush_mu_;
+  std::condition_variable flush_cv_;
+  // Receive-side native reassembly (BeginChunkScatter): enabled by the
+  // van when its config is compatible (no resender, no force-order) —
+  // chunk-level ACK/ordering layers need to SEE the chunk frames, so
+  // they keep the Python assembler.  In-flight transfers are
+  // Core-level (xfers_mu_): chunks striped across rails land on
+  // different receive pumps but scatter into ONE shared buffer (the
+  // payload reads themselves are lock-free — disjoint byte ranges).
+  std::atomic<bool> reassemble_{false};
+  std::map<std::pair<long long, unsigned long long>, ConnXfer> xfers_;
+  uint64_t xfer_seq_ = 0;  // xfers_mu_
+  std::mutex xfers_mu_;
   std::deque<Frame> queue_;
   std::deque<Frame> express_;  // priority > 0 data frames pop first
   // PS_RECV_PRIORITY=0 restores the single strict-FIFO queue (process
@@ -1267,6 +2307,57 @@ long long psl_send(void* h, int node_id, const uint8_t* meta,
                                      lens);
 }
 
+int psl_abi_version() { return kAbiVersion; }
+
+long long psl_send_enqueue(void* h, int node_id, int priority,
+                           const uint8_t* meta, uint32_t meta_len,
+                           uint32_t n_data, const uint8_t* const* data,
+                           const uint64_t* lens, uint64_t chunk_bytes,
+                           int32_t chunk_ext_off) {
+  return static_cast<Core*>(h)->EnqueueSend(node_id, priority, meta,
+                                            meta_len, n_data, data, lens,
+                                            chunk_bytes, chunk_ext_off);
+}
+
+int psl_send_reap(void* h, int node_id, uint64_t* tickets, long long* status,
+                  int cap) {
+  return static_cast<Core*>(h)->SendReap(node_id, tickets, status, cap);
+}
+
+int psl_send_flush(void* h, int timeout_ms) {
+  return static_cast<Core*>(h)->SendFlush(timeout_ms);
+}
+
+long long psl_send_cancel(void* h, int node_id) {
+  return static_cast<Core*>(h)->SendCancel(node_id);
+}
+
+void psl_send_reset_sid(void* h, int node_id) {
+  static_cast<Core*>(h)->SendResetSid(node_id);
+}
+
+void psl_set_reassembly(void* h, int on) {
+  static_cast<Core*>(h)->SetReassembly(on);
+}
+
+// Multi-rail data plane (PS_NATIVE_RAILS, docs/native_core.md): call
+// psl_set_rails BEFORE the first data send (rail threads spawn with
+// the lane; receive pumps spawn per accepted conn, rail-agnostic);
+// psl_add_rail dials rail `idx` (1-based) to a peer.  psl_set_sockbuf
+// mirrors the Python van's PS_TCP_SNDBUF/PS_TCP_RCVBUF bounds onto
+// native sockets.
+void psl_set_rails(void* h, int n) { static_cast<Core*>(h)->SetRails(n); }
+
+int psl_add_rail(void* h, int node_id, const char* host, int port,
+                 int timeout_ms, int idx) {
+  return static_cast<Core*>(h)->AddRail(node_id, host, port, timeout_ms,
+                                        idx);
+}
+
+void psl_set_sockbuf(void* h, int snd, int rcv) {
+  static_cast<Core*>(h)->SetSockBuf(snd, rcv);
+}
+
 int psl_recv(void* h, psl_frame_view* out, int timeout_ms) {
   Frame f;
   int rc = static_cast<Core*>(h)->Recv(&f, timeout_ms);
@@ -1278,7 +2369,28 @@ int psl_recv(void* h, psl_frame_view* out, int timeout_ms) {
   return rc;
 }
 
-void psl_frame_free(uint8_t* buf) { free(buf); }
+void psl_frame_free(uint8_t* buf) { FramePool::Release(buf); }
+
+// Single-shot GIL-free kernels for the RECEIVE-side Python hot loops
+// (docs/native_core.md): ctypes releases the GIL around CDLL calls, so
+// routing the chunk-scatter memcpy and the server's in-place apply add
+// through these lets the van-recv thread, the apply shard threads, and
+// the meta decoder stream concurrently instead of serializing on one
+// GIL (numpy's copy/ufunc paths hold it).  The adds are plain
+// element-wise IEEE ops — results are bit-identical to numpy's
+// same-dtype in-place add, so enabling/disabling the native path can
+// never change stored values.
+void psl_memcpy(void* dst, const void* src, uint64_t n) {
+  memcpy(dst, src, n);
+}
+
+void psl_iadd_f32(float* dst, const float* src, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+void psl_iadd_f64(double* dst, const double* src, uint64_t n) {
+  for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
 
 void* psl_copy_pool_create(int n_threads) { return new CopyPool(n_threads); }
 

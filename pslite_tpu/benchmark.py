@@ -21,13 +21,14 @@ from __future__ import annotations
 
 import argparse
 import os
+import statistics
 import time
 from typing import Optional
 
 import numpy as np
 
 MODES = ("push_then_pull", "push_pull", "push_only", "pull_only",
-         "chunk_hol")
+         "chunk_hol", "lane_goodput")
 
 
 def _recv_buffer_mode() -> bool:
@@ -42,7 +43,10 @@ class BenchmarkHandle:
     store holding views into the block; pulls of the same slice echo the
     block with no per-pull allocation — matching the reference
     EmptyHandler's preallocated per-key buffers (test_benchmark.cc:131-203)
-    so the benchmark times the transport, not handler concatenation."""
+    so the benchmark times the transport, not handler concatenation.
+    (The one copy is load-bearing: a loopback van delivers views of the
+    sender's own array, so adopting ``data.vals`` zero-copy would alias
+    a buffer the worker may mutate between pushes.)"""
 
     def __init__(self):
         self.store = {}
@@ -133,6 +137,60 @@ def run_chunk_hol(worker, args) -> None:
     )
 
 
+def run_lane_goodput(worker, args) -> None:
+    """``--mode lane_goodput`` (docs/native_core.md): PIPELINED large
+    pushes — up to ``PS_BENCH_PIPELINE`` (default 3) outstanding — so
+    the wall clock measures the data plane's sustained single-lane
+    throughput instead of the per-push wait chain (wire + apply + RTT)
+    that ``chunk_hol``'s sequential pushes serialize on.  A foreground
+    thread samples small-pull latency concurrently, so the same run
+    prices the priority tail under the bulk storm."""
+    import threading
+
+    nk = args.num_keys
+    val_len = args.len // 4
+    big_keys = np.arange(100, 100 + nk, dtype=np.uint64)
+    big_vals = np.ones(nk * val_len, np.float32)
+    small_key = np.array([7], dtype=np.uint64)
+    small_vals = np.ones(256, np.float32)
+    small_out = np.zeros_like(small_vals)
+    worker.wait(worker.push(big_keys, big_vals))
+    worker.wait(worker.push(small_key, small_vals))
+    worker.wait(worker.pull(small_key, small_out, priority=1))
+    depth = int(os.environ.get("PS_BENCH_PIPELINE", "3"))
+    push_wall = [0.0]
+
+    def pusher():
+        t0 = time.perf_counter()
+        pending = []
+        for _ in range(args.repeat):
+            pending.append(worker.push(big_keys, big_vals, priority=0))
+            if len(pending) >= depth:
+                worker.wait(pending.pop(0))
+        for ts in pending:
+            worker.wait(ts)
+        push_wall[0] = time.perf_counter() - t0
+
+    t = threading.Thread(target=pusher, daemon=True)
+    lats = []
+    t.start()
+    while t.is_alive():
+        t0 = time.perf_counter()
+        worker.wait(worker.pull(small_key, small_out, priority=1))
+        lats.append((time.perf_counter() - t0) * 1e3)
+    t.join()
+    lats.sort()
+    gbps = (8.0 * args.repeat * big_vals.nbytes
+            / max(push_wall[0], 1e-9) / 1e9)
+    p50 = lats[len(lats) // 2] if lats else 0.0
+    p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0.0
+    print(
+        f"LANE_GOODPUT samples={len(lats)} pull_p50_ms={p50:.3f} "
+        f"pull_p99_ms={p99:.3f} push_gbps={gbps:.3f}",
+        flush=True,
+    )
+
+
 def run_worker(args) -> None:
     from . import postoffice
     from .kv.kv_app import KVWorker
@@ -142,6 +200,9 @@ def run_worker(args) -> None:
     worker = KVWorker(0, 0)
     if args.mode == "chunk_hol":
         run_chunk_hol(worker, args)
+        return
+    if args.mode == "lane_goodput":
+        run_lane_goodput(worker, args)
         return
     ranges = po.get_server_key_ranges()
     keys_per_server = args.num_keys
@@ -613,7 +674,8 @@ def fault_recovery_times(quick: bool = True) -> dict:
 
 
 def _chunk_run(push_mb: int, n_pushes: int,
-               chunk_bytes: str) -> dict:
+               chunk_bytes: str, extra_env: dict = None,
+               mode: str = "chunk_hol") -> dict:
     """One leg of the chunk_streaming bench: a REAL 1w+1s tcp cluster
     via the local tracker (one process per node — an in-process cluster
     would measure the shared-GIL convoy, not the transport), running
@@ -631,7 +693,7 @@ def _chunk_run(push_mb: int, n_pushes: int,
         sys.executable, "-m", "pslite_tpu.tracker.local",
         "-n", "1", "-s", "1", "--van", "tcp", "--",
         sys.executable, "-m", "pslite_tpu.benchmark",
-        "--mode", "chunk_hol",
+        "--mode", mode,
         "--len", str(push_mb * (1 << 20) // n_keys),
         "--num-keys", str(n_keys),
         "--repeat", str(n_pushes),
@@ -646,16 +708,22 @@ def _chunk_run(push_mb: int, n_pushes: int,
         # not the lane — add a fixed term to the priority pull's wait.
         PS_TCP_SNDBUF=str(256 << 10),
         PS_TCP_RCVBUF=str(256 << 10),
+        # Room for several in-flight 64 MiB reassembly buffers: blocks
+        # falling out of the pool would re-pay the fresh-page fault tax
+        # the pool exists to amortize (same setting both legs).
+        PS_RECV_POOL_MB="512",
     )
+    env.update(extra_env or {})
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
                        env=env)
+    tag = mode.upper()
     m = re.search(
-        r"CHUNK_HOL samples=(\d+) pull_p50_ms=([0-9.]+) "
+        tag + r" samples=(\d+) pull_p50_ms=([0-9.]+) "
         r"pull_p99_ms=([0-9.]+) push_gbps=([0-9.]+)", r.stdout,
     )
     if m is None:
         raise RuntimeError(
-            f"chunk_hol leg produced no result (rc={r.returncode}): "
+            f"{mode} leg produced no result (rc={r.returncode}): "
             f"{r.stdout[-500:]}\n{r.stderr[-500:]}"
         )
     return {
@@ -702,6 +770,101 @@ def chunk_streaming_bench(quick: bool = True) -> dict:
     return out
 
 
+def native_goodput_bench(quick: bool = True) -> dict:
+    """Native zero-copy data plane (docs/native_core.md) over a real
+    1w+1s tcp cluster (one process per node): 64 MiB push goodput with
+    the C++ sender lanes on (``PS_NATIVE=1``) vs the pure-Python path
+    (``PS_NATIVE=0``), plus the small-pull p99 under the same bulk
+    storm on both legs — the GIL-free plane must raise single-lane
+    goodput (ISSUE 6 target: >= 2x) WITHOUT moving the priority tail.
+    Both legs keep chunking on at the same size, so the ratio isolates
+    the encode/dispatch plane, not the pipelining win (priced by
+    chunk_streaming).  ``lane_goodput`` mode (pipelined pushes) rather
+    than ``chunk_hol``: sequential waited pushes serialize on the
+    per-push RTT + apply chain shared by both legs, which masks the
+    data-plane difference.  The window is SUSTAINED (>= 6 GiB):
+    goodput is a steady-state metric, and the two legs move in
+    OPPOSITE directions as the storm lengthens — the native leg climbs
+    as the frame/recv pools warm and the TCP windows grow (~17.4 Gbps
+    at 16 pushes -> ~19.6-22 at 96+), while the GIL-bound leg SLIDES
+    under the sustained convoy (~10.5 -> ~9-9.9) — so a short window
+    underprices exactly the gap this section exists to price.  Each
+    leg runs ``rounds`` times and reports the MEDIAN (per-round values
+    attached): residual noise is one-sided scheduler luck and the
+    median is robust to one lucky/unlucky draw where best-of-N would
+    chase the outlier."""
+    from .vans import native as _native_mod
+
+    class _ForceOn:  # availability probe must ignore the parent's env
+        @staticmethod
+        def find(key, default=None):
+            return "1"
+
+    if _native_mod.load(_ForceOn()) is None:
+        # Without this guard the PS_NATIVE=1 child silently falls back
+        # to pure Python and the section emits a bogus ~1.0 ratio that
+        # reads "native gives no win" instead of "native absent".
+        return {"skipped": "native core unavailable (libpslite_core.so "
+                           "missing or ABI-stale; build with `make "
+                           "native`)"}
+    push_mb = 64
+    n_pushes = 96 if quick else 128
+    rounds = 3
+    chunk_bytes = 2 << 20
+    leg_runs = {"native": [], "python": []}
+    # Rounds INTERLEAVE the two legs (native, python, native, ...):
+    # host-load drift over the section's wall time then lands on both
+    # legs symmetrically instead of biasing whichever leg ran last.
+    for _ in range(rounds):
+        for tag, ps_native in (("native", "1"), ("python", "0")):
+            leg_runs[tag].append(_chunk_run(
+                push_mb, n_pushes, str(chunk_bytes),
+                # _chunk_run's 256 KiB socket-buffer caps stay: bounded
+                # kernel buffering is what makes this a DATA-PLANE
+                # measurement.  With autotuned (multi-MiB) buffers the
+                # kernel pipelines around the GIL-bound leg's slow
+                # encode (measured: the Python leg jumps ~11 -> ~15
+                # Gbps while native holds ~19-20) and the ratio prices
+                # the kernel knob, not the plane.  Under bounded
+                # buffers throughput tracks how fast each side REFILLS/
+                # DRAINS its window — exactly the send/recv hot path.
+                extra_env={"PS_NATIVE": ps_native,
+                           "PS_BENCH_PIPELINE": "4"},
+                mode="lane_goodput",
+            ))
+    legs = {}
+    med = statistics.median
+    for tag, runs in leg_runs.items():
+        legs[tag] = {
+            "push_gbps": med(r["push_gbps"] for r in runs),
+            "pull_p99_ms": med(r["pull_p99_ms"] for r in runs),
+            "pull_samples": sum(r["pull_samples"] for r in runs),
+            "rounds_gbps": [round(r["push_gbps"], 2) for r in runs],
+        }
+    nat, py = legs["native"], legs["python"]
+    return {
+        "push_mb": push_mb,
+        "chunk_bytes": chunk_bytes,
+        "rounds": rounds,
+        "native_push_gbps": round(nat["push_gbps"], 2),
+        "python_push_gbps": round(py["push_gbps"], 2),
+        "native_rounds_gbps": nat["rounds_gbps"],
+        "python_rounds_gbps": py["rounds_gbps"],
+        "native_pull_p99_ms": round(nat["pull_p99_ms"], 3),
+        "python_pull_p99_ms": round(py["pull_p99_ms"], 3),
+        "pull_samples": [nat["pull_samples"], py["pull_samples"]],
+        # Headline: single-lane goodput, GIL-free vs GIL-bound.
+        "goodput_ratio": (
+            round(nat["push_gbps"] / py["push_gbps"], 2)
+            if py["push_gbps"] > 0 else None),
+        # Guard: the native lanes must preserve the priority
+        # discipline (<= 1 means the tail improved or held).
+        "p99_ratio_native_vs_python": (
+            round(nat["pull_p99_ms"] / py["pull_p99_ms"], 2)
+            if py["pull_p99_ms"] > 0 else None),
+    }
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A
@@ -722,6 +885,54 @@ def register_push_buffers(server, args) -> None:
         )
 
 
+def _start_thread_cpu_sampler(role: str) -> None:
+    """``PS_BENCH_RUSAGE=1``: a daemon thread prints per-thread CPU
+    seconds (``/proc/self/task/*/stat``) every 2 s to stderr — Python
+    threads resolved to their ``threading`` names via ``native_id``,
+    native core threads by their pthread name (psl-io / psl-lane-N /
+    psl-pipe).  Diagnostic only: attributes a leg's bottleneck thread
+    without an external profiler (the bench children live in their own
+    PID namespace on some CI sandboxes, so outside-in sampling can't
+    see them)."""
+    if not int(os.environ.get("PS_BENCH_RUSAGE", "0")):
+        return
+    import glob
+    import sys
+    import threading
+
+    hz = os.sysconf("SC_CLK_TCK")
+
+    def dump():
+        while True:
+            time.sleep(2.0)
+            names = {
+                t.native_id: t.name
+                for t in threading.enumerate()
+                if t.native_id is not None
+            }
+            rows = []
+            for st in glob.glob("/proc/self/task/[0-9]*/stat"):
+                try:
+                    head, tail = open(st).read().rsplit(")", 1)
+                    comm = head.split("(", 1)[1]
+                    f = tail.split()
+                    cpu = (int(f[11]) + int(f[12])) / hz
+                    tid = int(st.split("/")[4])
+                except (OSError, ValueError, IndexError):
+                    continue  # thread exited mid-scan
+                if cpu >= 0.05:
+                    rows.append((cpu, names.get(tid, comm), tid))
+            rows.sort(reverse=True)
+            print(
+                f"BENCH_THREAD_CPU role={role} "
+                + " ".join(f"{n}:{c:.1f}s" for c, n, _ in rows[:12]),
+                file=sys.stderr, flush=True,
+            )
+
+    threading.Thread(target=dump, daemon=True,
+                     name="bench-rusage").start()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--len", type=int, default=1024000,
@@ -735,13 +946,14 @@ def main(argv=None) -> int:
     from . import KVServer, finalize, start_ps
 
     role = os.environ["DMLC_ROLE"]
+    _start_thread_cpu_sampler(role)
     start_ps()
     server = None
     if role in ("server", "joint"):
         server = KVServer(0)
-        if args.mode == "chunk_hol":
+        if args.mode in ("chunk_hol", "lane_goodput"):
             # Shard-capable handle: the apply pool (and the streaming
-            # apply of chunked pushes) is part of what chunk_hol prices.
+            # apply of chunked pushes) is part of what these modes price.
             from .kv.kv_app import KVServerDefaultHandle
 
             server.set_request_handle(KVServerDefaultHandle())

@@ -46,6 +46,7 @@ from ..range import Range, find_range
 from ..sarray import SArray
 from ..utils import logging as log
 from ..utils.bounded import BoundedKeySet
+from ..vans import native
 from .apply_shards import ApplyShardPool
 
 
@@ -1259,6 +1260,11 @@ class KVServer:
             self._apply_pool.stop()
             self._apply_pool = None
         self._handle = handle
+        # Hand the handle this node's Environment so its apply path
+        # (native.try_iadd) honors a per-node PS_NATIVE=0 override in
+        # in-process clusters, like every other native.load() caller.
+        if hasattr(handle, "apply_shard"):
+            handle._env = self.po.env
         if self._apply_shards > 0 and callable(
             getattr(handle, "apply_shard", None)
         ):
@@ -1799,7 +1805,15 @@ class KVServerDefaultHandle:
                         f"push dtype {seg.dtype} != stored dtype "
                         f"{cur.dtype} for key {key}",
                     )
-                    cur += seg
+                    # Large f32/f64 adds run GIL-free in the native
+                    # core (bit-identical to numpy's in-place add) so
+                    # apply shards overlap the receive pump's decode.
+                    # _env: set by set_request_handle so a per-node
+                    # PS_NATIVE=0 override disables this path too.
+                    if not native.try_iadd(cur, seg,
+                                           env=getattr(self, "_env",
+                                                       None)):
+                        cur += seg
         if meta.pull:
             parts = []
             for key in keys:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import tempfile
 import threading
 from typing import List, Optional
 
@@ -39,7 +40,11 @@ class Tracer:
         self.active = self.sample > 0.0
         self.role = role
         self.node_id = -1  # assigned at bootstrap (export-time pid)
-        self._dir = env.find("PS_TRACE_DIR") or "."
+        # Default export into the system tempdir, NOT the cwd: traced
+        # clusters launched from a checkout were littering (and once
+        # committing) pslite_trace_*.json at the repo root.  The files
+        # are also gitignored; set PS_TRACE_DIR to collect them.
+        self._dir = env.find("PS_TRACE_DIR") or tempfile.gettempdir()
         self._mu = threading.Lock()
         self._events: List[dict] = []
         self.dropped = 0

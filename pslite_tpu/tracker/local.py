@@ -71,6 +71,17 @@ class LocalLauncher:
         self.root_port = root_port or get_available_port()
         self.root_uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         self._procs: List[tuple] = []  # (role, Popen)
+        # PS_CPU_PIN=N: give each spawned node its own disjoint block of
+        # N CPUs (sched_setaffinity in the child, Linux only).  Bench
+        # harnesses use it for run-to-run reproducibility: free-floating
+        # nodes land on scheduler-chosen cores, and a bad draw (worker
+        # IO threads sharing cores with the server's pump) shows up as a
+        # sticky whole-run throughput mode rather than noise.
+        try:
+            self._pin_cpus = int(os.environ.get("PS_CPU_PIN", "0") or 0)
+        except ValueError:
+            self._pin_cpus = 0
+        self._pin_next = 0
 
     def _spawn(self, role: str) -> None:
         env = build_env(
@@ -78,7 +89,31 @@ class LocalLauncher:
             self.root_port, self.van, self.group_size,
         )
         env.setdefault("DMLC_NODE_HOST", self.root_uri)
-        proc = subprocess.Popen(self.cmd, env=env)
+        preexec = None
+        if self._pin_cpus > 0 and hasattr(os, "sched_setaffinity"):
+            avail = sorted(os.sched_getaffinity(0))
+            if self._pin_next + self._pin_cpus > len(avail):
+                # Wrapping silently would hand this node cores already
+                # pinned to an earlier node — deterministically
+                # re-creating the shared-core interference mode the
+                # knob exists to eliminate.  Warn so an over-subscribed
+                # run is never mistaken for a disjoint one.
+                print(
+                    f"[tracker] W PS_CPU_PIN={self._pin_cpus}: node "
+                    f"#{self._pin_next // self._pin_cpus} wraps past "
+                    f"{len(avail)} available CPUs — pinned blocks now "
+                    f"OVERLAP earlier nodes",
+                    file=sys.stderr, flush=True,
+                )
+            cpus = frozenset(
+                avail[(self._pin_next + j) % len(avail)]
+                for j in range(min(self._pin_cpus, len(avail)))
+            )
+            self._pin_next += self._pin_cpus
+
+            def preexec(cpus=cpus):
+                os.sched_setaffinity(0, cpus)
+        proc = subprocess.Popen(self.cmd, env=env, preexec_fn=preexec)
         self._procs.append((role, proc))
 
     def run(self) -> int:

@@ -234,6 +234,12 @@ def chaos_class(inner_cls: type) -> type:
             super().__init__(postoffice)
             self.chaos = ChaosPolicy(self.env.find("PS_CHAOS") or "")
             self.chaos_stats = _ChaosStats(self.metrics)
+            if getattr(self, "_native", None) is not None:
+                # Chaos drop/delay/dup operate on DELIVERED messages;
+                # native reassembly would collapse a whole transfer
+                # into one delivery and change per-chunk fault
+                # semantics — keep the Python assembler in the loop.
+                self._native.set_reassembly(False)
             # Reorder holdback + redelivery queue: only the (single)
             # receive-loop thread touches these.
             self._chaos_held: Optional[Message] = None
@@ -244,6 +250,13 @@ def chaos_class(inner_cls: type) -> type:
             return self.chaos.crashed
 
         # -- send path ---------------------------------------------------
+
+        def _native_submit(self, msg: Message):
+            """Chaos injection wraps ``send_msg``; the native sender
+            lanes would transmit around it, silently disabling every
+            send-side fault — chaos vans always take the Python path
+            (ISSUE 6: chaos van unchanged)."""
+            return None
 
         def send_msg(self, msg: Message) -> int:
             chaos = self.chaos

@@ -49,7 +49,13 @@ from ..message import (
 from ..sarray import SArray
 from ..utils import logging as log
 from ..utils.bounded import BoundedKeySet
-from ..wire import CHUNK_MAX_SEGS
+from ..wire import (
+    CHUNK_MAX_SEGS,
+    FRAME_HEADER_SIZE,
+    chunk_ext_payload_size,
+    pack_meta,
+)
+from .native import COPY_KERNEL_MIN as _COPY_KERNEL_MIN
 
 _UINT64_CODE = 8  # wire dtype code of the keys segment
 
@@ -129,8 +135,119 @@ def split_message(msg: Message, chunk_bytes: int,
             a, b = max(lo, bounds[si]), min(hi, bounds[si + 1])
             if a < b:
                 cmsg.add_data(SArray(raws[si][a - bounds[si]:b - bounds[si]]))
+        # Canonical chunk meta: add_data stamped this chunk's segment
+        # count/bytes into data_type/data_size, which made per-chunk
+        # metas differ in LENGTH.  Clear both — receivers default raw
+        # chunk slices to uint8 (wire.rebuild_message) and the
+        # assembler re-derives the real table from EXT_CHUNK — so every
+        # chunk of a transfer packs to the same meta bytes except
+        # sid/index/offset, the exact template contract the native
+        # splitter patches in place (byte-identical frames).
+        cm.data_type = []
+        cm.data_size = 0
         out.append(cmsg)
     return out
+
+
+class NativeDescriptor:
+    """One data message prepared for the native sender lanes
+    (docs/native_core.md): the packed meta template, the pinned
+    contiguous payload arrays, and the chunk-split parameters the C++
+    side patches per chunk.  Built by :func:`native_descriptor`."""
+
+    __slots__ = ("meta_buf", "arrs", "chunk_bytes", "ext_off", "n_chunks",
+                 "wire_bytes")
+
+    def __init__(self, meta_buf, arrs, chunk_bytes, ext_off, n_chunks,
+                 wire_bytes):
+        self.meta_buf = meta_buf
+        self.arrs = arrs          # MUST stay referenced until reaped
+        self.chunk_bytes = chunk_bytes
+        self.ext_off = ext_off    # EXT_CHUNK payload offset in meta_buf
+        self.n_chunks = n_chunks
+        self.wire_bytes = wire_bytes
+
+
+def native_descriptor(msg: Message, chunk_bytes: int,
+                      xfer_seq) -> NativeDescriptor:
+    """Prepare one data message for a native sender lane: the meta
+    template bytes (sid stamped natively at transmit), the contiguous
+    payload arrays the lane transmits zero-copy, and — when the message
+    is chunk-eligible under exactly :func:`split_message`'s rules — the
+    EXT_CHUNK template whose index/offset fields the native splitter
+    patches per chunk, so native frames are byte-identical to the
+    Python splitter's (``xfer_seq`` is consumed only then).
+
+    ``wire_bytes`` is the exact on-wire byte count of every frame of
+    the transfer (headers + lens tables + metas + payload), matching
+    what the Python path's per-frame ``send_msg`` returns summed.
+    """
+    m = msg.meta
+    arrs = [_flat_u8(d.data) for d in msg.data]
+    seg_lens = [a.nbytes for a in arrs]
+    total = sum(seg_lens)
+    n_data = len(arrs)
+    chunkable = (
+        chunk_bytes > 0 and m.chunk is None and 0 < n_data <= CHUNK_MAX_SEGS
+        and total > chunk_bytes
+    )
+    if not chunkable:
+        meta_buf = pack_meta(m)
+        wire = FRAME_HEADER_SIZE + 8 * n_data + len(meta_buf) + total
+        return NativeDescriptor(meta_buf, arrs, 0, -1, 1, wire)
+    seg_types = tuple(m.data_type[i] if i < len(m.data_type) else 2
+                      for i in range(n_data))
+    cm = copy.copy(m)
+    cm.control = Control()
+    cm.data_type = []
+    cm.data_size = 0
+    n_chunks = (total + chunk_bytes - 1) // chunk_bytes
+    cm.chunk = ChunkInfo(
+        xfer=next(xfer_seq), index=0, total=n_chunks, offset=0,
+        seg_lens=tuple(seg_lens), seg_types=seg_types,
+    )
+    meta_buf = pack_meta(cm)
+    # pack_meta appends EXT_CHUNK last, so the payload is the trailing
+    # bytes of the template (asserted byte-identical in the parity
+    # test).
+    ext_off = len(meta_buf) - chunk_ext_payload_size(n_data)
+    bounds = [0]
+    for ln in seg_lens:
+        bounds.append(bounds[-1] + ln)
+    wire = total + n_chunks * (FRAME_HEADER_SIZE + len(meta_buf))
+    for idx in range(n_chunks):
+        lo, hi = idx * chunk_bytes, min((idx + 1) * chunk_bytes, total)
+        wire += 8 * sum(
+            1 for si in range(n_data)
+            if max(lo, bounds[si]) < min(hi, bounds[si + 1])
+        )
+    return NativeDescriptor(meta_buf, arrs, chunk_bytes, ext_off,
+                            n_chunks, wire)
+
+
+# ChunkInfo.index sentinel on a frame the NATIVE CORE already
+# reassembled (cpp/pslite_core.cc AbsorbChunk): the payload is the
+# complete transfer; finalize_native_transfer turns it into the
+# original message without touching the Python assembler.
+NATIVE_XFER_COMPLETE = 0xFFFFFFFF
+
+
+def finalize_native_transfer(msg: Message) -> Message:
+    """Rebuild the original message from a natively-reassembled frame:
+    the data segments are already the original segments (zero-copy
+    uint8 views over the native frame buffer) — re-view them by the
+    EXT_CHUNK dtype table and restore the canonical meta fields the
+    chunk template blanked."""
+    ck = msg.meta.chunk
+    msg.meta.chunk = None
+    msg.meta.data_type = list(ck.seg_types)
+    msg.meta.data_size = sum(int(ln) for ln in ck.seg_lens)
+    for i, seg in enumerate(msg.data):
+        raw = seg.data if isinstance(seg, SArray) else seg
+        if not isinstance(raw, np.ndarray):
+            raw = np.frombuffer(raw, np.uint8)
+        msg.data[i] = SArray(raw.view(code_dtype(ck.seg_types[i])))
+    return msg
 
 
 class _Xfer:
@@ -142,13 +259,19 @@ class _Xfer:
         "streamable", "emitted_keys", "t_last", "t0_us",
     )
 
-    def __init__(self, ck: ChunkInfo, meta):
+    def __init__(self, ck: ChunkInfo, meta, alloc=None):
         self.meta = meta  # original meta (chunk stripped, option kept)
         self.seg_lens = ck.seg_lens
         self.seg_types = ck.seg_types
         self.total = ck.total
         self.total_bytes = sum(ck.seg_lens)
-        self.bufs = [np.empty(int(ln), np.uint8) for ln in ck.seg_lens]
+        # Reassembly buffers through the van's allocator when it has a
+        # pooled receive arena (chunk scatter then lands in recycled
+        # blocks); numpy otherwise.
+        if alloc is None:
+            self.bufs = [np.empty(int(ln), np.uint8) for ln in ck.seg_lens]
+        else:
+            self.bufs = [alloc(int(ln)) for ln in ck.seg_lens]
         self.received = [False] * ck.total
         self.ends = [0] * ck.total  # end offset of each received chunk
         self.got = 0
@@ -194,7 +317,12 @@ class ChunkAssembler:
     """
 
     def __init__(self, tracer=None, max_entries: int = 256,
-                 ttl_s: float = 120.0):
+                 ttl_s: float = 120.0, alloc=None, copy_kernel=None):
+        self._alloc = alloc
+        # Optional GIL-free copy kernel (native.memcpy_kernel): the
+        # scatter's big slice-assigns run outside the GIL so frame
+        # decode and the apply shards stream concurrently.
+        self._copy = copy_kernel
         self._mu = threading.Lock()
         self._xfers: Dict[Tuple[int, int], _Xfer] = {}
         # Tombstones of recently COMPLETED transfers: a stale duplicate
@@ -263,7 +391,7 @@ class ChunkAssembler:
                 meta.chunk = None
                 meta.data_type = list(ck.seg_types)
                 meta.data_size = sum(ck.seg_lens)
-                x = _Xfer(ck, meta)
+                x = _Xfer(ck, meta, self._alloc)
                 if (self._tracer is not None and meta.trace
                         and self._tracer.active):
                     x.t0_us = self._tracer.now_us()
@@ -334,7 +462,11 @@ class ChunkAssembler:
                 log.check(si < len(x.bufs), "chunk bytes beyond transfer")
                 take = min(raw.nbytes - done, bounds[si + 1] - pos)
                 b0 = pos - bounds[si]
-                x.bufs[si][b0:b0 + take] = raw[done:done + take]
+                if self._copy is not None and take >= _COPY_KERNEL_MIN:
+                    self._copy(x.bufs[si].ctypes.data + b0,
+                               raw.ctypes.data + done, take)
+                else:
+                    x.bufs[si][b0:b0 + take] = raw[done:done + take]
                 done += take
                 pos += take
             total += raw.nbytes

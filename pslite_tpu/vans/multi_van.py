@@ -69,6 +69,12 @@ class MultiVan(Van):
                 # two rails resizing/unlinking ONE shared segment file
                 # under each other's cached mmaps would corrupt payloads.
                 rail._ns = f"{rail._ns}r{i}"
+            if getattr(rail, "_native", None) is not None:
+                # A striped transfer lands chunk-by-chunk across SEVERAL
+                # rails; no single rail's core ever sees every chunk, so
+                # receive-side native reassembly must stay off and the
+                # shared Python assembler rebuilds (docs/native_core.md).
+                rail._native.set_reassembly(False)
         # Merge queue keeps the rails' priority discipline (chunk
         # backlogs from one rail must not delay another rail's priority
         # frames) — same knob as the rails' own intake queues.

@@ -12,7 +12,10 @@ import ctypes
 import os
 import struct
 import threading
+import weakref
 from typing import List, Optional, Tuple
+
+import numpy as np
 
 _LIB_PATHS = [
     # Source tree: cpp/ build output (make -C cpp).
@@ -23,7 +26,15 @@ _LIB_PATHS = [
     "libpslite_core.so",
 ]
 
+# Must match kAbiVersion in cpp/pslite_core.cc: a stale .so (make -C cpp
+# not rerun after a source update) is rejected LOUDLY at load time —
+# the old posture silently fell back per-symbol, which left half-built
+# hosts running the pure-Python path with no hint why.
+ABI_VERSION = 7
+
 _lib = None
+_load_warned = False
+_load_failed = False  # negative load() result cache (process lifetime)
 
 
 class _FrameView(ctypes.Structure):
@@ -34,31 +45,93 @@ class _FrameView(ctypes.Structure):
     ]
 
 
-def load() -> Optional[ctypes.CDLL]:
-    """The native library, or None when unavailable/disabled."""
-    global _lib
+class _NativeFrame(np.ndarray):
+    """ndarray view over a pooled native frame buffer.  Exists solely
+    because plain ndarrays reject weak references: recv() attaches the
+    psl_frame_free finalizer to this subclass view, and every segment
+    sliced from it keeps it alive through the base chain."""
+
+
+# Writable zero-copy memoryview over foreign memory (the pooled frame):
+# avoids minting a ctypes array TYPE per distinct frame length.
+_PyMemoryView_FromMemory = ctypes.pythonapi.PyMemoryView_FromMemory
+_PyMemoryView_FromMemory.restype = ctypes.py_object
+_PyMemoryView_FromMemory.argtypes = [
+    ctypes.c_void_p, ctypes.c_ssize_t, ctypes.c_int,
+]
+_PyBUF_WRITE = 0x200
+
+
+def _warn_once(msg: str) -> None:
+    global _load_warned
+    if _load_warned:
+        return
+    _load_warned = True
+    from ..utils import logging as log
+
+    log.warning(msg)
+
+
+def load(env=None) -> Optional[ctypes.CDLL]:
+    """The native library, or None when unavailable/disabled.
+
+    ``env`` (an :class:`~..environment.Environment`) routes the
+    ``PS_NATIVE`` check through the CALLER's per-node override map —
+    in-process multi-node clusters give each node its own Environment,
+    and a node-level ``PS_NATIVE=0`` must force the pure-Python path
+    for that node even when the process environment allows native.
+    """
+    if env is not None:
+        enabled = env.find("PS_NATIVE", "1")
+    else:
+        enabled = os.environ.get("PS_NATIVE", "1")
+    if enabled in ("0", "false"):
+        return None
+    global _lib, _load_failed
     if _lib is not None:
         return _lib
-    if os.environ.get("PS_NATIVE", "1") in ("0", "false"):
+    # Cache the NEGATIVE result too: try_iadd calls load() per applied
+    # key on the server's push hot path, and re-walking the candidate
+    # paths through failed dlopens on every call silently taxes exactly
+    # the pure-Python deployment that has no .so to find.
+    if _load_failed:
         return None
     for path in _LIB_PATHS:
         try:
             lib = ctypes.CDLL(os.path.abspath(path)
                               if os.path.sep in path else path)
+        except OSError:
+            continue
+        try:
             _declare(lib)
-        except (OSError, AttributeError):
-            # AttributeError = stale .so missing a symbol (make -C cpp not
-            # rerun after an update): fall through to the next candidate
-            # or the pure-Python path rather than breaking every van.
+        except AttributeError as exc:
+            # Stale .so missing a symbol (make -C cpp not rerun after an
+            # update): reject the WHOLE library loudly — per-symbol
+            # fallback would mix two ABI generations in one process.
+            _warn_once(
+                f"stale libpslite_core.so at {path} ({exc}); rebuild "
+                f"with `make native` — falling back to pure Python"
+            )
+            continue
+        stamp = lib.psl_abi_version()
+        if stamp != ABI_VERSION:
+            _warn_once(
+                f"libpslite_core.so at {path} has ABI stamp {stamp}, "
+                f"expected {ABI_VERSION}; rebuild with `make native` — "
+                f"falling back to pure Python"
+            )
             continue
         _lib = lib
         return _lib
+    _load_failed = True
     return None
 
 
 def _declare(lib: ctypes.CDLL) -> None:
     """Declare every symbol's signature; a stale .so missing one raises
     AttributeError here (caught by load's candidate loop)."""
+    lib.psl_abi_version.restype = ctypes.c_int
+    lib.psl_abi_version.argtypes = []
     lib.psl_create.restype = ctypes.c_void_p
     lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     lib.psl_connect.argtypes = [
@@ -84,18 +157,153 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32, ctypes.c_uint32,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
     ]
+    lib.psl_send_enqueue.restype = ctypes.c_longlong
+    lib.psl_send_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_int32,
+    ]
+    lib.psl_send_reap.restype = ctypes.c_int
+    lib.psl_send_reap.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int,
+    ]
+    lib.psl_send_flush.restype = ctypes.c_int
+    lib.psl_send_flush.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.psl_send_cancel.restype = ctypes.c_longlong
+    lib.psl_send_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.psl_send_reset_sid.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.psl_set_reassembly.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.psl_set_rails.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.psl_add_rail.restype = ctypes.c_int
+    lib.psl_add_rail.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.psl_set_sockbuf.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int
+    ]
     lib.psl_recv.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(_FrameView), ctypes.c_int
     ]
     lib.psl_frame_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     lib.psl_stop.argtypes = [ctypes.c_void_p]
     lib.psl_destroy.argtypes = [ctypes.c_void_p]
+    lib.psl_memcpy.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
+    ]
+    lib.psl_iadd_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
+    ]
+    lib.psl_iadd_f64.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
+    ]
     lib.psl_copy_pool_create.restype = ctypes.c_void_p
     lib.psl_copy_pool_create.argtypes = [ctypes.c_int]
     lib.psl_copy_pool_copy.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
     ]
     lib.psl_copy_pool_destroy.argtypes = [ctypes.c_void_p]
+
+
+# -- single-shot GIL-free kernels ------------------------------------------
+#
+# ctypes releases the GIL around CDLL calls, so routing the receive-side
+# hot loops' big numpy ops (chunk-scatter copies, the server's in-place
+# apply adds) through the core lets the van-recv pump, the apply shard
+# threads, and frame decode stream concurrently instead of serializing
+# on one GIL.  Both kernels are bit-identical to the numpy ops they
+# replace (memcpy / element-wise IEEE add on the same dtype), so the
+# native path can never change stored values — it only removes GIL
+# contention.  Calls cost a ctypes trampoline (~1 us), so callers only
+# divert work above a size floor.
+
+#: Below this many bytes a numpy slice-assign beats the ctypes call.
+COPY_KERNEL_MIN = 64 << 10
+#: Below this many elements numpy's ufunc dispatch is cheaper.
+IADD_KERNEL_MIN = 4096
+
+_IADD_SYMS = {"float32": "psl_iadd_f32", "float64": "psl_iadd_f64"}
+
+
+def memcpy_kernel(env=None):
+    """The raw ``psl_memcpy(dst_ptr, src_ptr, nbytes)`` ctypes function,
+    or None when the native core is unavailable or ``PS_NATIVE=0`` for
+    this node.  The caller owns pointer validity and overlap rules
+    (memcpy semantics: ranges must not overlap)."""
+    lib = load(env)
+    return lib.psl_memcpy if lib is not None else None
+
+
+def scatter_copy_kernel(env=None):
+    """A ``(dst_ptr, src_ptr, nbytes)`` copy kernel for the chunk
+    assembler's scatter: multi-MiB copies split across the process-wide
+    :class:`CopyPool` threads (``PS_COPY_THREADS``, default 4) so the
+    receive pump's dominant cost — landing each chunk in the reassembly
+    buffer — runs at parallel-memcpy speed; sub-MiB copies degrade to
+    one inline native memcpy inside the pool call.  Falls back to the
+    single-threaded ``psl_memcpy`` when the pool cannot start, or None
+    when the core is unavailable/disabled for this node."""
+    lib = load(env)
+    if lib is None:
+        return None
+    n = 4
+    if env is not None:
+        n = env.find_int("PS_COPY_THREADS", 4)
+    else:
+        try:
+            n = int(os.environ.get("PS_COPY_THREADS", "4"))
+        except ValueError:
+            n = 4
+    if n <= 0:
+        return lib.psl_memcpy
+
+    # The pool threads spawn LAZILY on the first real scatter: every
+    # Van constructs an assembler (schedulers, control-only nodes,
+    # PS_CHUNK_BYTES=0 vans), and eagerly starting a process-wide
+    # 4-thread pool for nodes that never receive a chunk wastes
+    # threads.  Benign if two pumps race the first call —
+    # shared_copy_pool is process-wide idempotent under its own lock.
+    state: dict = {}
+
+    def kernel(dst_addr, src_addr, nbytes):
+        fn = state.get("fn")
+        if fn is None:
+            pool = shared_copy_pool(n, env)
+            fn = pool.copy if pool is not None else lib.psl_memcpy
+            state["fn"] = fn
+        fn(dst_addr, src_addr, nbytes)
+
+    return kernel
+
+
+def try_iadd(dst: np.ndarray, src: np.ndarray, env=None) -> bool:
+    """GIL-free in-place ``dst += src`` when eligible; returns False
+    (caller must run the numpy path) for small/odd-dtype/unaligned/
+    non-contiguous operands or when the core is unavailable.  Result
+    bits are identical to numpy's same-dtype in-place add."""
+    if dst.size < IADD_KERNEL_MIN or dst.dtype != src.dtype:
+        return False
+    sym = _IADD_SYMS.get(dst.dtype.name)
+    if sym is None:
+        return False
+    lib = load(env)
+    if lib is None:
+        return False
+    if (not dst.flags.c_contiguous or not src.flags.c_contiguous
+            or dst.size != src.size):
+        return False
+    align = dst.dtype.itemsize
+    dp, sp = dst.ctypes.data, src.ctypes.data
+    if dp % align or sp % align:
+        # The payload view may start at an arbitrary wire offset; the
+        # C loop dereferences typed pointers, so misaligned operands
+        # stay on the numpy path rather than risk UB.
+        return False
+    getattr(lib, sym)(dp, sp, dst.size)
+    return True
 
 
 class CopyPool:
@@ -133,14 +341,14 @@ _shared_pool: Optional[CopyPool] = None
 _shared_pool_mu = threading.Lock()
 
 
-def shared_copy_pool(n_threads: int) -> Optional[CopyPool]:
+def shared_copy_pool(n_threads: int, env=None) -> Optional[CopyPool]:
     """One process-wide pool, like the reference's single
     BYTEPS_IPC_COPY_NUM_THREADS pool: co-located vans share its threads
     (Copy serializes jobs internally), and its lifetime is the process —
     individual van shutdown never races a peer van's in-flight copy.
     The first caller's thread count wins."""
     global _shared_pool
-    if load() is None:
+    if load(env) is None:
         return None
     with _shared_pool_mu:
         if _shared_pool is None:
@@ -227,35 +435,138 @@ class NativeTransport:
             raise OSError(-rc, os.strerror(-rc))
         return int(rc)
 
-    def recv(self, timeout_ms: int = -1) -> Optional[Tuple[bytes, List[bytes]]]:
+    # -- descriptor handoff: native sender lanes (docs/native_core.md) -------
+
+    def send_enqueue(self, node_id: int, priority: int, meta: bytes,
+                     arrs: List[np.ndarray], chunk_bytes: int = 0,
+                     chunk_ext_off: int = -1) -> int:
+        """Enqueue one data frame (or a whole chunked transfer) onto the
+        peer's native sender lane; returns a ticket immediately.  The
+        CALLER owns keeping ``arrs`` (contiguous ndarrays) alive and
+        unmutated until the ticket is reaped — the native side records
+        raw pointers, copying only the small meta template."""
+        n = len(arrs)
+        bufs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        for i, a in enumerate(arrs):
+            bufs[i] = a.ctypes.data
+            lens[i] = a.nbytes
+        rc = self._lib.psl_send_enqueue(
+            self._h, node_id, priority, meta, len(meta), n, bufs, lens,
+            chunk_bytes, chunk_ext_off,
+        )
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return int(rc)
+
+    _REAP_CAP = 256
+
+    def send_reap(self, node_id: int) -> List[Tuple[int, int]]:
+        """Completed (ticket, status) pairs for one peer; status 0 =
+        transmitted, negative = -errno (the frame was abandoned)."""
+        out: List[Tuple[int, int]] = []
+        tickets = (ctypes.c_uint64 * self._REAP_CAP)()
+        status = (ctypes.c_longlong * self._REAP_CAP)()
+        while True:
+            n = self._lib.psl_send_reap(
+                self._h, node_id, tickets, status, self._REAP_CAP
+            )
+            out.extend((int(tickets[i]), int(status[i])) for i in range(n))
+            if n < self._REAP_CAP:
+                return out
+
+    def send_flush(self, timeout_ms: int = -1) -> bool:
+        """Wait until every lane transmitted (or abandoned) its queue."""
+        return self._lib.psl_send_flush(self._h, timeout_ms) == 0
+
+    def send_cancel(self, node_id: int) -> int:
+        """Drop the peer's queued descriptors (tickets reap as errors)."""
+        return int(self._lib.psl_send_cancel(self._h, node_id))
+
+    def send_reset_sid(self, node_id: int) -> None:
+        self._lib.psl_send_reset_sid(self._h, node_id)
+
+    def set_rails(self, n: int) -> None:
+        """PS_NATIVE_RAILS: stripe each chunked transfer over ``n`` TCP
+        connections per peer (docs/native_core.md).  Must be called
+        before ``bind`` (receive pumps spawn there) and before the
+        first data send (rail threads spawn with the lane)."""
+        self._lib.psl_set_rails(self._h, n)
+
+    def add_rail(self, node_id: int, host: str, port: int,
+                 timeout_ms: int = 30000, idx: int = 1) -> None:
+        """Dial data rail ``idx`` (1-based beyond the main connection)
+        to a peer; re-dialing an index replaces the old connection."""
+        rc = self._lib.psl_add_rail(
+            self._h, node_id, host.encode(), port, timeout_ms, idx
+        )
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def set_sockbuf(self, snd: int, rcv: int) -> None:
+        """Apply the van's PS_TCP_SNDBUF/PS_TCP_RCVBUF bounds to native
+        sockets (0 = OS default) — the same bounded-buffer discipline
+        the Python transport runs under."""
+        self._lib.psl_set_sockbuf(self._h, snd, rcv)
+
+    def set_reassembly(self, on: bool) -> None:
+        """Toggle receive-side native chunk reassembly: chunk frames
+        scatter GIL-free into one pooled buffer per transfer, and recv
+        delivers a single complete frame whose ChunkInfo.index is the
+        NATIVE_XFER_COMPLETE sentinel (vans/chunking.py).  Leave OFF
+        when a layer must see individual chunk frames (resender ACKs,
+        force-order sids, multi-rail striping)."""
+        self._lib.psl_set_reassembly(self._h, 1 if on else 0)
+
+    def recv(self, timeout_ms: int = -1) -> Optional[Tuple[bytes, List]]:
         """(meta_bytes, data_segments) — None when stopped; raises
-        TimeoutError on timeout."""
+        TimeoutError on timeout.  Data segments are zero-copy writable
+        uint8 ndarray views over the native frame buffer; the buffer is
+        freed when the last derived view is garbage-collected (numpy's
+        base chain pins the ctypes holder, whose finalizer calls
+        psl_frame_free) — the native counterpart of the pure-Python
+        pooled-arena delivery."""
         view = _FrameView()
         rc = self._lib.psl_recv(self._h, ctypes.byref(view), timeout_ms)
         if rc == -1:
             return None
         if rc == 0:
             raise TimeoutError
+        n_data = view.n_data
+        base = ctypes.addressof(view.buf.contents)
+        ptr = ctypes.cast(base, ctypes.POINTER(ctypes.c_uint8))
+        fin = None
         try:
-            n_data = view.n_data
-            lens_bytes = ctypes.string_at(view.buf, 8 * n_data)
-            lens = struct.unpack(f"<{n_data}Q", lens_bytes)
-            off = 8 * n_data
-            meta = ctypes.string_at(
-                ctypes.addressof(view.buf.contents) + off, view.meta_len
+            lens = struct.unpack(
+                f"<{n_data}Q", ctypes.string_at(view.buf, 8 * n_data)
             )
+            total = 8 * n_data + view.meta_len + sum(lens)
+            # A memoryview over the raw frame (NOT a per-length ctypes
+            # array type: ctypes interns one array type per distinct
+            # length forever — size-diverse traffic would grow the
+            # interpreter's type cache without bound), viewed as a
+            # weakref-able ndarray subclass so the finalizer can
+            # return the buffer to the FramePool when the last derived
+            # view dies.
+            mv = _PyMemoryView_FromMemory(base, total, _PyBUF_WRITE)
+            frame = np.frombuffer(mv, dtype=np.uint8).view(_NativeFrame)
+            fin = weakref.finalize(frame, self._lib.psl_frame_free, ptr)
+            off = 8 * n_data
+            meta = frame[off:off + view.meta_len].tobytes()
             off += view.meta_len
             segs = []
-            base = ctypes.addressof(view.buf.contents)
             for ln in lens:
-                # Writable copies: receivers may mutate payloads in place
-                # (e.g. a server handle averaging pushed gradients), which
-                # the pure-Python path permits too.
-                segs.append(bytearray(ctypes.string_at(base + off, ln)))
+                segs.append(frame[off:off + ln])
                 off += ln
             return meta, segs
-        finally:
-            self._lib.psl_frame_free(view.buf)
+        except BaseException:
+            # The frame must not leak whatever failed mid-build; fin()
+            # is idempotent with the GC-time finalizer.
+            if fin is not None:
+                fin()
+            else:
+                self._lib.psl_frame_free(ptr)
+            raise
 
     def stop(self) -> None:
         if self._h:
@@ -265,3 +576,4 @@ class NativeTransport:
         if self._h:
             self._lib.psl_destroy(self._h)
             self._h = None
+

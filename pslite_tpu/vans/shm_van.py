@@ -107,8 +107,10 @@ class ShmVan(TcpVan):
         if n_copy > 0 and self._native_allowed:
             from . import native as _native_mod
 
-            if _native_mod.load() is not None:
-                self._copy_pool = _native_mod.shared_copy_pool(n_copy)
+            if _native_mod.load(self.env) is not None:
+                self._copy_pool = _native_mod.shared_copy_pool(
+                    n_copy, self.env
+                )
         # PS_SHM_RING=1: same-host peers exchange their WHOLE meta stream
         # through shared-memory SPSC byte pipes instead of TCP — the
         # reference's in-process lock-free SPSC queue (spsc_queue.h,
@@ -134,7 +136,7 @@ class ShmVan(TcpVan):
                 # PS_NATIVE=0 forces the pure-Python path, per node.
                 from . import native as _native_mod
 
-                if _native_mod.load() is not None:
+                if _native_mod.load(self.env) is not None:
                     self._native = _native_mod.NativeTransport()
             if self._native is not None:
                 self._pipe_mode = True
@@ -322,6 +324,13 @@ class ShmVan(TcpVan):
         meta_only.data = [msg.data[0]] + list(msg.data[2:])
         return super().send_msg(meta_only) + arr.nbytes
 
+    def _native_submit(self, msg: Message):
+        """The shm data plane owns payload routing (segment placement,
+        zpull descriptors, ring pipes) INSIDE send_msg — the native
+        sender lanes would bypass all of it, so this van always takes
+        the Python path (ISSUE 6: shm van unchanged)."""
+        return None
+
     def send_msg(self, msg: Message) -> int:
         m = msg.meta
         sent = self._try_zpull_send(msg)
@@ -371,7 +380,11 @@ class ShmVan(TcpVan):
         desc = {
             "seg": name,
             "lens": [d.nbytes for d in msg.data],
-            "codes": list(m.data_type),
+            # Chunk messages carry a canonical EMPTY data_type (their
+            # slices are raw uint8, code 2 — chunking.split_message);
+            # pad so the receive side rebuilds every segment.
+            "codes": [m.data_type[i] if i < len(m.data_type) else 2
+                      for i in range(len(msg.data))],
         }
         if m.body:
             # Preserve a user body riding alongside data segments — the

@@ -37,12 +37,24 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import wire
-from ..message import Message, Node, OPT_COMPRESS_INT8, OPT_XFER_PART
+from ..base import is_scheduler_id
+from ..message import (
+    Message,
+    Node,
+    OPT_COMPRESS_INT8,
+    OPT_XFER_PART,
+    OPT_ZPULL,
+)
 from ..sarray import SArray
 from ..utils import logging as log
 from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
-from .chunking import recv_priority
-from .van import Van
+from .chunking import (
+    NATIVE_XFER_COMPLETE,
+    finalize_native_transfer,
+    native_descriptor,
+    recv_priority,
+)
+from .van import PeerDeadError, Van
 
 
 def _local_sock_path(port: int) -> str:
@@ -95,7 +107,11 @@ class _RecvPool:
     """
 
     _MAX_ENTRIES = 64          # distinct pooled blocks
-    _MAX_BLOCK = 32 << 20      # larger requests bypass the pool
+    # Blocks beyond this bypass the pool.  128 MB so a 64 MiB transfer
+    # (the bench headline, and any large reassembly buffer) recycles:
+    # fresh pages per frame cost soft page faults that HALVE loopback
+    # goodput (measured ~6.7 vs ~18 Gbps — docs/native_core.md).
+    _MAX_BLOCK = 128 << 20
 
     def __init__(self, metrics=None, budget_mb: int = 128):
         from ..telemetry.metrics import enabled_registry
@@ -226,6 +242,7 @@ class TcpVan(Van):
         # reference's always-native posture, zmq_van.h:344-394),
         # PS_NATIVE=0 forces Python regardless of cores.
         self._native = None
+        self._native_rails = 1
         # Consulted via the PER-NODE Environment (not os.environ): in-
         # process multi-node tests give each node its own override map,
         # and PS_NATIVE=0 must force pure Python for THAT node even when
@@ -247,8 +264,73 @@ class TcpVan(Van):
         if want_native:
             from . import native as _native_mod
 
-            if _native_mod.load() is not None:
+            # load(self.env): the load-time PS_NATIVE gate must see the
+            # same per-node Environment override map _native_allowed
+            # consulted — in-process clusters set PS_NATIVE per node.
+            if _native_mod.load(self.env) is not None:
                 self._native = _native_mod.NativeTransport()
+                # Multi-rail data plane (PS_NATIVE_RAILS, default 2):
+                # each chunked transfer stripes across N TCP
+                # connections per peer, with every transfer's FINAL
+                # chunk (and all monolithic frames) on rail 0 so the
+                # receiver observes transfer completions in submission
+                # order — one stream's per-byte kernel cost stops
+                # capping single-lane goodput.  Clamped to 1 when a
+                # layer assumes one FIFO stream per peer: the resender
+                # ACKs/dedups by per-fd arrival, and force-order
+                # replays strictly by sid.
+                rails = max(1, min(4, self.env.find_int(
+                    "PS_NATIVE_RAILS", 2)))
+                if (self.env.find_int("PS_RESEND", 0)
+                        or self._force_order):
+                    rails = 1
+                self._native_rails = rails
+                self._native.set_rails(rails)
+                # Receive-side native reassembly (docs/native_core.md):
+                # chunk payloads DIRECT-READ from the socket straight
+                # into the transfer's reassembly buffer at their byte
+                # offset (the core parses EXT_CHUNK from the meta,
+                # which arrives before the payload) — the kernel
+                # copy-out is the receiver's only pass over the data —
+                # and recv hands Python ONE complete frame per
+                # transfer instead of total-chunks pump round trips.
+                # Works across rails (the in-flight transfer table is
+                # core-level, shared by the per-stream receive pumps;
+                # payload reads are lock-free, disjoint byte ranges).
+                # OPT-IN (PS_NATIVE_REASSEMBLY=1): +6% storm goodput
+                # (18.5 vs 17.4 Gbps, 2 rails) but collapsing a
+                # transfer to one delivery forfeits the streaming-
+                # apply overlap (docs/chunking.md), so a small pull
+                # under the storm waits a whole post-arrival apply
+                # burst (p99 ~6.5 -> ~8.7 ms measured) — wrong trade
+                # for the default mixed KV workload, right one for raw
+                # message sinks / pull-free bulk flows.
+                # Hard-off when a Python layer must see the chunk
+                # frames: the resender ACKs/dedups per chunk,
+                # force-order tracks per-chunk sids, and MultiVan
+                # rails each see only a stripe (multi_van disables on
+                # rails — each rail van is its own core, so stripes
+                # would never meet in one transfer table).
+                reassemble = (
+                    not self.env.find_int("PS_RESEND", 0)
+                    and not self._force_order
+                    and self.env.find_int("PS_NATIVE_REASSEMBLY", 0) != 0
+                )
+                self._native.set_reassembly(reassemble)
+        # Native data plane (docs/native_core.md): data messages hand a
+        # descriptor to the core's per-peer sender lanes and return;
+        # frame encode, chunk split, and the writev drain run GIL-free.
+        # Python keeps the pinned payload arrays in _nat_pins until the
+        # lane reaps the ticket (buffer-ownership rule: the caller's
+        # don't-mutate-until-wait contract spans the pin).
+        self._nat_mu = threading.Lock()
+        self._nat_pins: Dict[int, tuple] = {}   # ticket -> (msg, desc)
+        self._nat_peers: set = set()
+        self._nat_wake = threading.Event()
+        self._nat_reaper: Optional[threading.Thread] = None
+        self._c_native_sends = self.metrics.counter("tcp.native_sends")
+        self._node_metrics.gauge("tcp.native_pins",
+                                 fn=lambda: len(self._nat_pins))
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._reader_threads: list = []
@@ -303,6 +385,11 @@ class TcpVan(Van):
         # just like send-side ones.  Applied to the LISTENER before
         # listen() so accepted connections inherit it.
         self._rcvbuf = self.env.find_int("PS_TCP_RCVBUF", 0)
+        if self._native is not None:
+            # The native sockets run under the same bounded-buffer
+            # discipline as the Python ones (fairness: PS_NATIVE=0 vs 1
+            # must differ only in the plane, not the kernel knobs).
+            self._native.set_sockbuf(self._sndbuf, self._rcvbuf)
         # (sender_id, key) -> pre-registered push receive buffer — the
         # zmq van's registered-buffer recv hook (zmq_van.h:206-218,
         # 243-263): push payloads for the pair are placed at this
@@ -468,6 +555,21 @@ class TcpVan(Van):
                 ),
                 deadline,
             )
+            # Extra data rails (PS_NATIVE_RAILS) to peers that can
+            # receive bulk data — the scheduler only ever sees control
+            # frames, which stay on the main connection.  Id, not role:
+            # the redial path reconstructs peers as bare Node(id=...),
+            # and rails must re-dial there too (stale rail fds would
+            # fail the first striped transfer after a peer restart).
+            if self._native_rails > 1 and not is_scheduler_id(node.id):
+                for idx in range(1, self._native_rails):
+                    self._retry_connect(
+                        lambda i=idx: self._native.add_rail(
+                            node.id, node.hostname, node.port,
+                            int(timeout_s * 1000), i,
+                        ),
+                        deadline,
+                    )
             with self._socks_mu:
                 # Remembered for send-failure redial (reconnect path).
                 self._send_addrs[node.id] = (node.hostname, node.port)
@@ -676,6 +778,156 @@ class TcpVan(Van):
                       f"tcp: not connected to node {recver}")
             return self._sendv(sock, wire.pack_frame(msg))
 
+    # -- native data plane (docs/native_core.md) -----------------------------
+
+    def _native_submit(self, msg: Message) -> Optional[int]:
+        """Hand one data message to the core's per-peer sender lanes:
+        Python packs the meta template (sid stamped natively at
+        transmit), pins the contiguous payload arrays, and returns —
+        the lane thread encodes, chunk-splits, and ``writev``s GIL-free
+        with the same priority discipline as the Python lanes.
+
+        Declines (``None`` → portable Python path) when: native off,
+        the resender is on (its sid-at-dispatch buffering and per-chunk
+        retransmit bookkeeping are control-plane Python by design),
+        sync-send mode (``PS_SEND_LANES=0`` promises inline dispatch),
+        a drain is underway, or the payload rides shared memory."""
+        if (self._native is None or self.resender is not None
+                or not self._send_async or self._lane_stop):
+            return None
+        m = msg.meta
+        if m.shm_data:
+            return None
+        # ZPULL payloads are placement-routed per message on the
+        # receive side — never chunk them (same rule as Van.send).
+        chunk_bytes = 0 if m.option == OPT_ZPULL else self._chunk_bytes
+        desc = native_descriptor(msg, chunk_bytes, self._xfer_seq)
+        with self._nat_mu:
+            # Enqueue UNDER the pin lock: the lane can transmit and the
+            # reaper pop the completion before this thread registers the
+            # pin — a completion popped with no pin is dropped, and its
+            # orphaned pin would wedge the reaper (and the shutdown
+            # join) forever.
+            ticket = self._native.send_enqueue(
+                m.recver, m.priority, desc.meta_buf, desc.arrs,
+                desc.chunk_bytes, desc.ext_off,
+            )
+            self._nat_pins[ticket] = (msg, desc)
+            self._nat_peers.add(m.recver)
+            if self._nat_reaper is None or not self._nat_reaper.is_alive():
+                t = threading.Thread(target=self._native_reaper_loop,
+                                     name="tcp-native-reap", daemon=True)
+                self._nat_reaper = t
+                t.start()
+        self._c_native_sends.inc()
+        self._nat_wake.set()
+        log.vlog(2, lambda: f"NSEND {msg.debug_string()}")
+        return 0  # bytes accounted at reap, like the lanes' dispatch
+
+    def _reap_native(self, peers=None) -> None:
+        """Drain completed tickets: successful frames account bytes and
+        counters (exactly what the Python dispatch path records);
+        failed frames fail their owning request fast via
+        ``_delivery_failed`` — unless the van is shutting down, where a
+        canceled backlog only logs (matching the lane-abort posture)."""
+        nt = self._native
+        if nt is None:
+            return
+        with self._nat_mu:
+            targets = list(self._nat_peers) if peers is None else list(peers)
+        for peer in targets:
+            try:
+                done = nt.send_reap(peer)
+            except Exception:  # noqa: BLE001 - teardown race
+                continue
+            for ticket, status in done:
+                with self._nat_mu:
+                    pin = self._nat_pins.pop(ticket, None)
+                if pin is None:
+                    continue
+                msg, desc = pin
+                if status == 0:
+                    with self._bytes_mu:
+                        self.send_bytes += desc.wire_bytes
+                    self._c_sent_msgs.inc(desc.n_chunks)
+                    self._c_sent_bytes.inc(desc.wire_bytes)
+                    if desc.n_chunks > 1:
+                        self._c_chunks_sent.inc(desc.n_chunks)
+                    self.profiler.record(msg.meta.key, "send",
+                                         msg.meta.push)
+                    continue
+                if self._closing or self._lane_stop:
+                    log.warning(
+                        f"native lane abandoned send to node {peer} at "
+                        f"shutdown (status {status})"
+                    )
+                    continue
+                if self.is_peer_down(peer):
+                    exc: Exception = PeerDeadError(
+                        f"node {peer} declared dead with message queued "
+                        f"in its native send lane"
+                    )
+                else:
+                    exc = OSError(-status, os.strerror(-status))
+                self._delivery_failed(msg, exc)
+
+    def _native_reaper_loop(self) -> None:
+        """One reaper thread per van: polls completions while pins are
+        outstanding (releasing Python's buffer pins and surfacing lane
+        errors), parks on the wake event when idle, exits at close."""
+        while True:
+            if self._closing:
+                # Exit PROMPTLY even with pins outstanding (one final
+                # reap): post_stop joins this thread before destroying
+                # the core, and a stuck pin must not turn that join
+                # into a timeout + use-after-free in a late reap call.
+                self._reap_native()
+                return
+            if self._nat_pins:
+                self._reap_native()
+                time.sleep(0.002)
+                continue
+            self._nat_wake.wait(timeout=0.2)
+            self._nat_wake.clear()
+
+    def _drain_send_lanes(self, timeout_s: float = 10.0) -> None:
+        # Python lanes first (they can feed inline native control
+        # sends), then the native lanes: TERMINATE must not overtake
+        # queued data in either plane.
+        super()._drain_send_lanes(timeout_s)
+        if self._native is not None and self._nat_pins:
+            if not self._native.send_flush(int(timeout_s * 1000)):
+                log.warning("native send lanes did not drain before "
+                            "shutdown; abandoning the backlog")
+            self._reap_native()
+
+    def mark_peer_down(self, node_id: int) -> None:
+        super().mark_peer_down(node_id)
+        if self._native is not None:
+            try:
+                self._native.send_cancel(node_id)
+            except Exception:  # noqa: BLE001 - core may be stopping
+                pass
+            self._reap_native([node_id])
+
+    def _reset_peer_sids(self, node_id: int) -> None:
+        super()._reset_peer_sids(node_id)
+        if self._native is not None:
+            try:
+                self._native.send_reset_sid(node_id)
+            except Exception:  # noqa: BLE001 - core may be stopping
+                pass
+
+    def _chunk_recv_alloc(self, nbytes: int) -> np.ndarray:
+        """Chunk reassembly buffers from the pooled receive arena: the
+        scatter lands in recycled blocks, and the pool's refcount probe
+        reclaims them once the rebuilt message dies (the slice keeps
+        every derived view's base collapsed onto the block)."""
+        pool = getattr(self, "_recv_pool", None)
+        if pool is not None and nbytes > 0:
+            return pool.acquire(nbytes)[:nbytes]
+        return np.empty(nbytes, np.uint8)
+
     # -- registered recv buffers (RegisterRecvBuffer, van.h:114-116) ---------
 
     def register_recv_buffer(self, sender_id: int, key: int,
@@ -746,13 +998,21 @@ class TcpVan(Van):
             if res is None:
                 return None
             meta_buf, segs = res
-            return wire.rebuild_message(wire.unpack_meta(meta_buf), segs)
+            msg = wire.rebuild_message(wire.unpack_meta(meta_buf), segs)
+            ck = msg.meta.chunk
+            if ck is not None and ck.index == NATIVE_XFER_COMPLETE:
+                # The core reassembled the whole transfer GIL-free;
+                # count its chunks and deliver the original message.
+                self._c_chunks_recv.inc(ck.total)
+                return finalize_native_transfer(msg)
+            return msg
         return self._queue.wait_and_pop()
 
     def stop_transport(self) -> None:
         """Unblock recv_msg and tear the sockets down (the recv thread is
         joined right after this returns, so it must wake here)."""
         self._closing = True
+        self._nat_wake.set()  # reaper exits (final reap) once closing
         if self._native is not None:
             self._native.stop()  # psl_recv returns -1 -> recv_msg None
         if self._listener is not None:
@@ -781,6 +1041,14 @@ class TcpVan(Van):
         self._queue.push(None)  # wakes the pure-Python recv path
 
     def post_stop(self) -> None:
+        # Reaper first: destroy() frees the core the reaper polls, so
+        # it must retire (draining the canceled backlog) before the
+        # handle dies.
+        reaper = self._nat_reaper
+        if reaper is not None and reaper.is_alive():
+            self._nat_wake.set()
+            reaper.join(timeout=5)
+        self._nat_reaper = None
         # Safe only after the receive thread joined: frees the native core
         # (io thread, epoll fd, every socket).
         if self._native is not None:

@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from .. import environment
 from ..base import (
     ALL_GROUP,
@@ -79,6 +81,7 @@ from ..utils import logging as log
 from ..utils.network import get_ip
 from ..utils.profiling import Profiler
 from ..utils.queues import LaneQueue
+from . import native
 from .chunking import ChunkAssembler, split_message
 from .resender import Resender
 
@@ -158,6 +161,8 @@ class Van:
         self._assembler = ChunkAssembler(
             tracer=self.tracer,
             ttl_s=self.env.find_float("PS_XFER_TIMEOUT", 120.0),
+            alloc=self._chunk_recv_alloc,
+            copy_kernel=native.scatter_copy_kernel(self.env),
         )
         self._c_chunks_sent = self._node_metrics.counter("van.chunks_sent")
         self._c_chunks_recv = self._node_metrics.counter("van.chunks_recv")
@@ -234,6 +239,21 @@ class Van:
     def post_stop(self) -> None:
         """Final teardown after the receive thread has joined (resources a
         blocked recv_msg might still be using)."""
+
+    def _native_submit(self, msg: Message) -> Optional[int]:
+        """Transport hook: hand a DATA message to a native sender lane
+        (descriptor enqueue, GIL-free transmit — docs/native_core.md)
+        and return the accounted byte count, or None to take the
+        pure-Python lane/dispatch path.  Called after the down-peer
+        check; implementations own sid assignment, chunk splitting,
+        byte counters, and failure reporting for what they accept."""
+        return None
+
+    def _chunk_recv_alloc(self, nbytes: int) -> np.ndarray:
+        """Reassembly-buffer allocator for the ChunkAssembler.
+        Transports with a pooled receive arena override this so chunk
+        scatter lands in recycled blocks instead of fresh allocations."""
+        return np.empty(nbytes, np.uint8)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -478,6 +498,14 @@ class Van:
                 f"node {msg.meta.recver} was declared dead by the "
                 f"failure detector"
             )
+        if msg.meta.control.empty():
+            # Native data plane (docs/native_core.md): transports with
+            # native sender lanes take the whole hot path — frame
+            # encode, chunk split, priority drain — off the GIL; the
+            # Python lanes below are the portable fallback.
+            nbytes = self._native_submit(msg)
+            if nbytes is not None:
+                return nbytes
         if (self._chunk_bytes > 0 and msg.meta.control.empty()
                 and msg.meta.chunk is None
                 and msg.meta.data_size > self._chunk_bytes
@@ -911,9 +939,16 @@ class Van:
                 continue
             if msg is None:
                 break
-            self.recv_bytes += msg.meta.data_size
+            # Chunk frames carry a canonical meta (data_size 0 — the
+            # native/python splitters' fixed template); count their
+            # actual payload so transfer bytes land in the accounting.
+            nbytes = (
+                sum(d.nbytes for d in msg.data)
+                if msg.meta.chunk is not None else msg.meta.data_size
+            )
+            self.recv_bytes += nbytes
             self._c_recv_msgs.inc()
-            self._c_recv_bytes.inc(msg.meta.data_size)
+            self._c_recv_bytes.inc(nbytes)
             ctrl = msg.meta.control
             if (
                 self._drop_rate > 0

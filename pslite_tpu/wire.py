@@ -62,6 +62,24 @@ _META_FIXED = struct.Struct(
     "H H I"  # num_nodes num_data_types body_len
 )
 
+# Fixed byte offsets inside _META_FIXED consumed by the native core
+# (cpp/pslite_core.cc): the sender lanes stamp ``sid`` at transmit time
+# and patch the chunk extension per chunk, the express receive lane
+# peeks ``priority``/``control_cmd``.  Asserted against the struct
+# layout in tests/test_wire.py — keep in sync with the kMeta* constants
+# in pslite_core.cc.
+META_SID_OFF = 58
+META_PRIORITY_OFF = 70
+META_CONTROL_CMD_OFF = 84
+META_FIXED_SIZE = _META_FIXED.size  # 105
+
+
+def chunk_ext_payload_size(nseg: int) -> int:
+    """Byte length of an EXT_CHUNK payload with ``nseg`` segments —
+    the native chunk splitter locates the extension as the trailing
+    ``payload`` bytes of the packed meta (pack_meta appends it last)."""
+    return _EXT_CHUNK_FIXED.size + nseg * _EXT_CHUNK_SEG.size
+
 _NODE_FIXED = struct.Struct("<B i i B i H H H H")  # role id customer_id
 # is_recovery aux_id hostname_len num_ports num_devs endpoint_len
 

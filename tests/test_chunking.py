@@ -104,7 +104,13 @@ def test_split_reassemble_bit_exact(order):
     msg, keys, vals = _big_msg(nkeys=16, val_len=1024)
     chunks = split_message(msg, 4096, xfer_id=5)
     assert len(chunks) > 8
-    assert sum(c.meta.data_size for c in chunks) == msg.meta.data_size
+    assert sum(sum(d.nbytes for d in c.data)
+               for c in chunks) == msg.meta.data_size
+    # Canonical chunk metas (native template contract): data_type and
+    # data_size stay empty/0 so every chunk of a transfer packs to the
+    # same meta bytes except sid/index/offset.
+    assert all(c.meta.data_size == 0 and c.meta.data_type == []
+               for c in chunks)
     if order == "reversed":
         chunks = chunks[::-1]
     elif order == "shuffled":
@@ -317,7 +323,9 @@ def test_priority_op_interleaves_between_chunks():
                 release.set()
             else:
                 order.append(("small", msg.meta.priority))
-            return msg.meta.data_size
+            # Real transports return wire bytes (chunk metas carry
+            # data_size 0 — the canonical template).
+            return sum(d.nbytes for d in msg.data)
 
     van = _RecordingVan(_StubPo(Environment({"PS_CHUNK_BYTES": "4096"})))
     big, _, _ = _big_msg(nkeys=16, val_len=1024, recver=8)

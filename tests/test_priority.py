@@ -12,6 +12,7 @@ priority unconditionally now).
 
 import collections
 import threading
+import time
 
 import numpy as np
 
@@ -130,6 +131,17 @@ def test_priority_order_within_lane_while_peers_concurrent():
                             dtype=np.uint64)
             vals = np.ones(len(keys) * 4, np.float32)
             tss = [kv.push(keys, vals, priority=0)]  # heads block
+            # All three heads must be IN the transport before more
+            # pushes queue: a lazily-spawned lane thread that starts
+            # late (loaded host) would otherwise find {0,2,9,5} queued
+            # and correctly drain the priority-0 head LAST.
+            deadline = time.monotonic() + 30
+            while True:
+                with mu:
+                    if len(first) == 3:
+                        break
+                assert time.monotonic() < deadline, "heads never sent"
+                time.sleep(0.001)
             for prio in (2, 9, 5):
                 tss.append(kv.push(keys, vals, priority=prio))
             gate.set()

@@ -125,3 +125,43 @@ def test_rebuild_message_accepts_ndarray_segments():
     out = wire.rebuild_message(meta, [block[: vals.nbytes]])
     np.testing.assert_array_equal(out.data[0].numpy(), vals)
     assert out.data[0].numpy().base is block
+
+
+def test_meta_fixed_offsets_match_native_constants():
+    """The native core peeks/stamps fields of the packed meta at FIXED
+    byte offsets (cpp/pslite_core.cc kMeta* constants, mirrored by
+    wire.META_*_OFF).  Derive every offset from _META_FIXED's actual
+    struct format so a layout reorder fails HERE instead of silently
+    corrupting frames (the lane stamps sid through these offsets)."""
+    import struct
+
+    # Field order of wire._META_FIXED (see its format comment).
+    fields = [
+        ("version", "B"), ("head", "i"), ("app_id", "i"),
+        ("customer_id", "i"), ("timestamp", "i"), ("sender", "i"),
+        ("recver", "i"), ("flags", "B"), ("key", "Q"), ("addr", "Q"),
+        ("val_len", "q"), ("option", "q"), ("sid", "i"),
+        ("data_size", "q"), ("priority", "i"), ("src_dev_type", "b"),
+        ("src_dev_id", "i"), ("dst_dev_type", "b"), ("dst_dev_id", "i"),
+        ("control_cmd", "B"), ("barrier_group", "i"), ("msg_sig", "Q"),
+        ("num_nodes", "H"), ("num_data_types", "H"), ("body_len", "I"),
+    ]
+    fmt = "<" + "".join(f for _, f in fields)
+    assert struct.calcsize(fmt) == wire._META_FIXED.size, (
+        "field list drifted from _META_FIXED"
+    )
+    off = {}
+    pos = 0
+    for name, f in fields:
+        off[name] = pos
+        pos += struct.calcsize("<" + f)
+    # The constants the C++ core mirrors (kMetaSidOff & co).
+    assert off["sid"] == wire.META_SID_OFF == 58
+    assert off["priority"] == wire.META_PRIORITY_OFF == 70
+    assert off["control_cmd"] == wire.META_CONTROL_CMD_OFF == 84
+    assert wire._META_FIXED.size == wire.META_FIXED_SIZE == 105
+    # Receive-side constants (sender id + variable-tail counters).
+    assert off["sender"] == 17      # kMetaSenderOff
+    assert off["num_nodes"] == 97   # kMetaNumNodesOff
+    assert off["num_data_types"] == 99  # kMetaNumDtypesOff
+    assert off["body_len"] == 101   # kMetaBodyLenOff
