@@ -22,7 +22,7 @@ ifeq ($(TSAN), 1)
 CPPFLAGS_EXTRA = CXXFLAGS="-O1 -g -std=c++17 -fPIC -Wall -Wextra -pthread -fsanitize=thread"
 endif
 
-.PHONY: all native test tier1 bench lint clean
+.PHONY: all native test tier1 bench bench-check lint clean
 
 all: native
 
@@ -41,6 +41,12 @@ tier1:
 
 bench: native
 	python bench.py
+
+# Trajectory guard (tools/bench_diff.py, referenced from
+# tests/test_bench_smoke.py): compares the two newest BENCH_r*.json
+# and fails on >25% regression in any always-on transport metric.
+bench-check:
+	python tools/bench_diff.py
 
 lint:
 	python -m compileall -q pslite_tpu tests bench.py __graft_entry__.py

@@ -612,6 +612,16 @@ def _transport_sections(quick: bool) -> list:
         ng = native_goodput_bench(quick=quick)
         return {f"native_{k}": v for k, v in ng.items()}
 
+    def sec_quantized_push():
+        # Quantized transport tier (docs/compression.md): effective
+        # goodput (raw bytes/s) of the 64 MiB push storm, uncompressed
+        # vs int8 / fp8_e4m3 / int8+EF, same 1w+1s tcp harness as
+        # native_goodput, plus the priority small-pull p99 guard.
+        from pslite_tpu.benchmark import quantized_push_bench
+
+        qp = quantized_push_bench(quick=quick)
+        return {f"quantized_{k}": v for k, v in qp.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -667,6 +677,7 @@ def _transport_sections(quick: bool) -> list:
         ("server_apply", sec_server_apply),
         ("chunk_streaming", sec_chunk_streaming),
         ("native_goodput", sec_native_goodput),
+        ("quantized_push", sec_quantized_push),
         ("kv_telemetry", sec_kv_telemetry),
         ("fault_recovery", sec_fault_recovery),
     ]

@@ -34,6 +34,11 @@
 #include <algorithm>
 #include <array>
 #include <atomic>
+#include <cmath>
+#if defined(__x86_64__)
+#include <immintrin.h>
+#include <cpuid.h>
+#endif
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
@@ -68,7 +73,10 @@ struct Frame {
 // 7: cross-rail direct-read reassembly — tcp_van no longer clamps
 // PS_NATIVE_REASSEMBLY to a single rail, so a pre-7 (per-connection
 // reassembly) library would wait forever for the other rails' stripes.
-constexpr int kAbiVersion = 7;
+// 8: fused wire-codec kernels (psl_codec_encode/decode + the fp8 table
+// registration) backing the quantized transport tier
+// (docs/compression.md).
+constexpr int kAbiVersion = 8;
 
 // Fixed offsets inside the python wire format's meta block (wire.py
 // _META_FIXED, little-endian, no padding): enough to peek a frame's
@@ -2262,6 +2270,175 @@ class CopyPool {
 
 }  // namespace
 
+// ---- wire codec kernels (docs/compression.md) ------------------------------
+//
+// Fused single-pass blockwise quantize for the Python codec tier
+// (pslite_tpu/ops/codecs.py): one read of the span computes the block
+// max AND stages the (optionally EF-folded) values in an L1-resident
+// block buffer; the second loop quantizes from L1, writes the 1/4-width
+// codes, and updates the error-feedback residual — ~5 bytes of memory
+// traffic per element (13 with EF) where the numpy fallback's separate
+// abs/max/mul/rint/clip/cast passes move ~40+.  Called per span from
+// the codec thread pool (ctypes releases the GIL), so spans scale
+// across cores while the caller's Python threads stay responsive.
+//
+// BIT-IDENTICAL to the numpy fallback by construction: same op order
+// (finite-masked block max, scale = max(fmax, 1e-12)/qmax, y = eff *
+// (1.0f/scale), rint/clip for int8; clip + f32->f16 RNE + the
+// ml_dtypes-derived 64K lookup for fp8), every step an exactly-rounded
+// IEEE f32 op — so mixed native/pure-Python clusters produce the same
+// wire bytes (asserted in tests/test_ops.py).
+
+namespace {
+
+uint8_t g_fp8_enc_lut[65536];
+float g_fp8_dec_lut[256];
+std::atomic<int> g_fp8_tables_ready{0};
+
+// Software f32 -> f16 bit conversion, exact round-to-nearest-even for
+// normal f16 results.  Values below the f16 normal range all map to
+// e4m3 code 0 through the lookup (e4m3's smallest nonzero is 2^-9, and
+// ties round even at 2^-10), so sub-subnormal rounding minutiae cannot
+// change the emitted byte — see the parity test.
+inline uint16_t F32ToF16Bits(float f) {
+  uint32_t x;
+  memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  uint32_t exp = (x >> 23) & 0xFFu;
+  uint32_t man = x & 0x7FFFFFu;
+  if (exp == 0xFFu) {  // inf / nan
+    return static_cast<uint16_t>(
+        sign | 0x7C00u | (man ? (0x0200u | (man >> 13)) : 0));
+  }
+  int32_t e = static_cast<int32_t>(exp) - 127 + 15;
+  if (e >= 31) return static_cast<uint16_t>(sign | 0x7C00u);  // -> inf
+  if (e <= 0) return static_cast<uint16_t>(sign);  // below e4m3 range
+  uint16_t h = static_cast<uint16_t>(sign | (static_cast<uint32_t>(e) << 10) |
+                                     (man >> 13));
+  uint32_t rem = man & 0x1FFFu;
+  if (rem > 0x1000u || (rem == 0x1000u && (h & 1u))) ++h;  // RNE (carry ok)
+  return h;
+}
+
+constexpr uint64_t kCodecMaxBlock = 1024;
+
+#if defined(__x86_64__)
+__attribute__((target("f16c")))
+void F32ToF16SpanF16C(const float* src, uint16_t* dst, uint64_t m) {
+  uint64_t i = 0;
+  for (; i + 8 <= m; i += 8) {
+    __m256 v = _mm256_loadu_ps(src + i);
+    __m128i h =
+        _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i), h);
+  }
+  for (; i < m; ++i) dst[i] = F32ToF16Bits(src[i]);
+}
+#endif
+
+// Hardware vs software f32->f16: identical FINAL e4m3 bytes either way
+// — normals round RNE in both, every f16 subnormal result maps to
+// e4m3 code 0 through the lookup, and all NaN payloads collapse onto
+// the single e4m3fn NaN — so runtime dispatch cannot break the
+// mixed-cluster bit-exactness contract.
+// Persistent worker pool for the codec kernels: the Python tier makes
+// ONE ctypes call per payload (GIL released once) and the spans fan
+// out on C++ threads — dispatching spans from Python instead pays a
+// GIL handoff per span, which under a busy receive pump stretches a
+// ~2 ms decode into tens of ms (measured via the trace tier).
+class CodecSpanPool {
+ public:
+  static CodecSpanPool& Get() {
+    static CodecSpanPool* p = new CodecSpanPool();
+    return *p;
+  }
+
+  // Run fn over block-aligned spans of [0, n); serializes concurrent
+  // callers (they would only fight for memory bandwidth anyway).
+  void Run(uint64_t n, uint64_t block, int nthreads,
+           const std::function<void(uint64_t, uint64_t)>& fn) {
+    if (nthreads <= 1 || n * 4 < (1u << 21)) {
+      fn(0, n);
+      return;
+    }
+    std::lock_guard<std::mutex> run_lk(run_mu_);
+    std::unique_lock<std::mutex> lk(mu_);
+    EnsureThreadsLocked(nthreads - 1);  // caller works too
+    const uint64_t blocks = (n + block - 1) / block;
+    const uint64_t per =
+        (blocks + static_cast<uint64_t>(nthreads) - 1) / nthreads * block;
+    spans_.clear();
+    for (uint64_t a = 0; a < n; a += per)
+      spans_.emplace_back(a, std::min(a + per, n));
+    fn_ = &fn;
+    next_ = 0;
+    remaining_ = spans_.size();
+    cv_.notify_all();
+    // The caller drains spans alongside the workers.
+    DrainLocked(lk);
+    done_cv_.wait(lk, [&] { return remaining_ == 0; });
+    fn_ = nullptr;
+  }
+
+ private:
+  void EnsureThreadsLocked(int n) {
+    while (static_cast<int>(threads_.size()) < n) {
+      threads_.emplace_back([this] { Loop(); });
+      threads_.back().detach();
+    }
+  }
+
+  void DrainLocked(std::unique_lock<std::mutex>& lk) {
+    while (fn_ && next_ < spans_.size()) {
+      const auto span = spans_[next_++];
+      const auto* fn = fn_;
+      lk.unlock();
+      (*fn)(span.first, span.second);
+      lk.lock();
+      if (--remaining_ == 0) done_cv_.notify_all();
+    }
+  }
+
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      cv_.wait(lk, [&] { return fn_ && next_ < spans_.size(); });
+      DrainLocked(lk);
+    }
+  }
+
+  std::mutex run_mu_;  // one payload at a time
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  std::vector<std::thread> threads_;
+  std::vector<std::pair<uint64_t, uint64_t>> spans_;
+  const std::function<void(uint64_t, uint64_t)>* fn_ = nullptr;
+  size_t next_ = 0;
+  size_t remaining_ = 0;
+};
+
+#if defined(__x86_64__)
+inline bool CpuHasF16C() {
+  unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+  if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+  return (ecx >> 29) & 1u;  // CPUID.1:ECX.F16C
+}
+#endif
+
+inline void F32ToF16Span(const float* src, uint16_t* dst, uint64_t m) {
+#if defined(__x86_64__)
+  static const bool kHasF16C = CpuHasF16C();
+  if (kHasF16C) {
+    F32ToF16SpanF16C(src, dst, m);
+    return;
+  }
+#endif
+  for (uint64_t i = 0; i < m; ++i) dst[i] = F32ToF16Bits(src[i]);
+}
+
+}  // namespace
+
 extern "C" {
 
 struct psl_frame_view {
@@ -2386,6 +2563,211 @@ void psl_memcpy(void* dst, const void* src, uint64_t n) {
 
 void psl_iadd_f32(float* dst, const float* src, uint64_t n) {
   for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];
+}
+
+// Register the fp8_e4m3fn lookup tables (built Python-side from
+// ml_dtypes so both planes share ONE rounding definition): enc maps a
+// f16 bit pattern to the e4m3 byte, dec maps the byte back to f32.
+void psl_codec_set_fp8_tables(const uint8_t* enc, const float* dec) {
+  memcpy(g_fp8_enc_lut, enc, sizeof(g_fp8_enc_lut));
+  memcpy(g_fp8_dec_lut, dec, sizeof(g_fp8_dec_lut));
+  g_fp8_tables_ready.store(1, std::memory_order_release);
+}
+
+// Encode one block-aligned span: kind 0 = int8 (NaN -> reserved -128,
+// reported in the returned flag bit 1), kind 1 = fp8_e4m3fn (NaN is a
+// native encoding).  ``resid`` (nullable) fuses error feedback: the
+// effective value is x + resid and resid is left holding the new
+// quantization error (0 where the input was non-finite).  Returns the
+// flag bits, or -1 when this call cannot run natively (unsupported
+// block / fp8 tables not registered) and the caller must take the
+// numpy fallback.
+int psl_codec_encode(int kind, const float* x, float* resid, uint64_t n,
+                     uint64_t block, uint8_t* codes, float* scales) {
+  if (block == 0 || block > kCodecMaxBlock) return -1;
+  if (kind != 0 && kind != 1) return -1;
+  if (kind == 1 && !g_fp8_tables_ready.load(std::memory_order_acquire))
+    return -1;
+  const float qmax = (kind == 1) ? 448.0f : 127.0f;
+  int flags = 0;
+  float eff[kCodecMaxBlock];
+  for (uint64_t b0 = 0; b0 < n; b0 += block) {
+    const uint64_t m = (n - b0 < block) ? (n - b0) : block;
+    const float* xs = x + b0;
+    float* rs = resid ? resid + b0 : nullptr;
+    float fmax = 0.0f;
+    for (uint64_t i = 0; i < m; ++i) {
+      const float e = rs ? xs[i] + rs[i] : xs[i];
+      eff[i] = e;
+      const float a = fabsf(e);
+      if (std::isfinite(a) && a > fmax) fmax = a;  // finite-masked max
+    }
+    const float scale = ((fmax > 1e-12f) ? fmax : 1e-12f) / qmax;
+    const float inv = 1.0f / scale;
+    scales[b0 / block] = scale;
+    uint8_t* cs = codes + b0;
+    if (kind == 0) {
+      for (uint64_t i = 0; i < m; ++i) {
+        const float q = rintf(eff[i] * inv);  // RNE, same as np.rint
+        int8_t c;
+        if (std::isnan(q)) {
+          c = -128;
+          flags |= 1;
+        } else if (q > 127.0f) {
+          c = 127;
+        } else if (q < -127.0f) {
+          c = -127;
+        } else {
+          c = static_cast<int8_t>(q);
+        }
+        cs[i] = static_cast<uint8_t>(c);
+        if (rs) {
+          // Matches the numpy EF path: reconstruct (the -128 sentinel
+          // decodes as -128*scale there too) and zero non-finite
+          // error so NaN/Inf inputs cannot poison later rounds.
+          const float r2 = eff[i] - static_cast<float>(c) * scale;
+          rs[i] = std::isfinite(r2) ? r2 : 0.0f;
+        }
+      }
+    } else {
+      float y[kCodecMaxBlock];
+      uint16_t h16[kCodecMaxBlock];
+      for (uint64_t i = 0; i < m; ++i) {
+        float v = eff[i] * inv;
+        if (v > 448.0f) {
+          v = 448.0f;  // +/-Inf saturates; NaN falls through (np.clip)
+        } else if (v < -448.0f) {
+          v = -448.0f;
+        }
+        y[i] = v;
+      }
+      F32ToF16Span(y, h16, m);
+      if (rs) {
+        for (uint64_t i = 0; i < m; ++i) {
+          const uint8_t c = g_fp8_enc_lut[h16[i]];
+          cs[i] = c;
+          const float r2 = eff[i] - g_fp8_dec_lut[c] * scale;
+          rs[i] = std::isfinite(r2) ? r2 : 0.0f;
+        }
+      } else {
+        for (uint64_t i = 0; i < m; ++i) cs[i] = g_fp8_enc_lut[h16[i]];
+      }
+    }
+  }
+  return flags;
+}
+
+// Decode one block-aligned span (inverse of psl_codec_encode; the
+// int8 NaN sentinel is honored only when the encode flagged it, like
+// the numpy decode).  Returns -1 -> caller falls back to numpy.
+int psl_codec_decode(int kind, const uint8_t* codes, const float* scales,
+                     uint64_t n, uint64_t block, int flags, float* out) {
+  if (block == 0 || block > kCodecMaxBlock) return -1;
+  if (kind != 0 && kind != 1) return -1;
+  if (kind == 1 && !g_fp8_tables_ready.load(std::memory_order_acquire))
+    return -1;
+  for (uint64_t b0 = 0; b0 < n; b0 += block) {
+    const uint64_t m = (n - b0 < block) ? (n - b0) : block;
+    const float scale = scales[b0 / block];
+    const uint8_t* cs = codes + b0;
+    float* os = out + b0;
+    if (kind == 0) {
+      if (flags & 1) {
+        for (uint64_t i = 0; i < m; ++i) {
+          const int8_t c = static_cast<int8_t>(cs[i]);
+          os[i] = (c == -128) ? NAN : static_cast<float>(c) * scale;
+        }
+      } else {
+        for (uint64_t i = 0; i < m; ++i) {
+          os[i] = static_cast<float>(static_cast<int8_t>(cs[i])) * scale;
+        }
+      }
+    } else {
+      for (uint64_t i = 0; i < m; ++i) {
+        os[i] = g_fp8_dec_lut[cs[i]] * scale;
+      }
+    }
+  }
+  return 0;
+}
+
+// Whole-payload variants: ONE call from Python (one GIL release), the
+// block-aligned span fan-out runs on the persistent CodecSpanPool —
+// span boundaries never straddle a scale block, so the output is
+// bit-identical to the single-threaded call for every thread count.
+int psl_codec_encode_mt(int kind, const float* x, float* resid, uint64_t n,
+                        uint64_t block, uint8_t* codes, float* scales,
+                        int nthreads) {
+  if (block == 0 || block > kCodecMaxBlock) return -1;
+  if (kind != 0 && kind != 1) return -1;
+  if (kind == 1 && !g_fp8_tables_ready.load(std::memory_order_acquire))
+    return -1;
+  std::atomic<int> flags{0};
+  CodecSpanPool::Get().Run(n, block, nthreads,
+                           [&](uint64_t a, uint64_t b) {
+    const int f =
+        psl_codec_encode(kind, x + a, resid ? resid + a : nullptr, b - a,
+                         block, codes + a, scales + a / block);
+    if (f > 0) flags.fetch_or(f, std::memory_order_relaxed);
+  });
+  return flags.load();
+}
+
+// Decode arbitrary element ranges of a payload (scales indexed by
+// GLOBAL element position, so ranges need not align to scale blocks):
+// the server's apply shards decode only their own keys' segments, in
+// parallel on the shard threads, instead of serializing one whole-
+// payload decode on the receive pump.  Output is written back to back
+// in range order; values are bit-identical to the full decode.
+int psl_codec_decode_ranges(int kind, const uint8_t* codes,
+                            const float* scales, const uint64_t* starts,
+                            const uint64_t* ends, int nranges,
+                            uint64_t block, int flags, float* out) {
+  if (block == 0) return -1;
+  if (kind != 0 && kind != 1) return -1;
+  if (kind == 1 && !g_fp8_tables_ready.load(std::memory_order_acquire))
+    return -1;
+  uint64_t off = 0;
+  for (int r = 0; r < nranges; ++r) {
+    uint64_t j = starts[r];
+    const uint64_t e = ends[r];
+    while (j < e) {
+      // One scale block at a time: hoists the j/block divide out of
+      // the element loop.
+      const uint64_t bend = std::min(e, (j / block + 1) * block);
+      const float scale = scales[j / block];
+      if (kind == 0) {
+        if (flags & 1) {
+          for (; j < bend; ++j, ++off) {
+            const int8_t c = static_cast<int8_t>(codes[j]);
+            out[off] = (c == -128) ? NAN : static_cast<float>(c) * scale;
+          }
+        } else {
+          for (; j < bend; ++j, ++off)
+            out[off] = static_cast<float>(static_cast<int8_t>(codes[j]))
+                       * scale;
+        }
+      } else {
+        for (; j < bend; ++j, ++off) out[off] = g_fp8_dec_lut[codes[j]] * scale;
+      }
+    }
+  }
+  return 0;
+}
+
+int psl_codec_decode_mt(int kind, const uint8_t* codes, const float* scales,
+                        uint64_t n, uint64_t block, int flags, float* out,
+                        int nthreads) {
+  if (block == 0 || block > kCodecMaxBlock) return -1;
+  if (kind != 0 && kind != 1) return -1;
+  if (kind == 1 && !g_fp8_tables_ready.load(std::memory_order_acquire))
+    return -1;
+  CodecSpanPool::Get().Run(n, block, nthreads,
+                           [&](uint64_t a, uint64_t b) {
+    psl_codec_decode(kind, codes + a, scales + a / block, b - a, block,
+                     flags, out + a);
+  });
+  return 0;
 }
 
 void psl_iadd_f64(double* dst, const double* src, uint64_t n) {

@@ -28,7 +28,7 @@ from typing import Optional
 import numpy as np
 
 MODES = ("push_then_pull", "push_pull", "push_only", "pull_only",
-         "chunk_hol", "lane_goodput")
+         "chunk_hol", "lane_goodput", "quantized_push")
 
 
 def _recv_buffer_mode() -> bool:
@@ -137,24 +137,42 @@ def run_chunk_hol(worker, args) -> None:
     )
 
 
-def run_lane_goodput(worker, args) -> None:
+def run_lane_goodput(worker, args, tag: str = "LANE_GOODPUT",
+                     codec: Optional[str] = None) -> None:
     """``--mode lane_goodput`` (docs/native_core.md): PIPELINED large
     pushes — up to ``PS_BENCH_PIPELINE`` (default 3) outstanding — so
     the wall clock measures the data plane's sustained single-lane
     throughput instead of the per-push wait chain (wire + apply + RTT)
     that ``chunk_hol``'s sequential pushes serialize on.  A foreground
     thread samples small-pull latency concurrently, so the same run
-    prices the priority tail under the bulk storm."""
+    prices the priority tail under the bulk storm.
+
+    ``codec`` (the ``quantized_push`` mode, docs/compression.md) runs
+    the same storm with the pushes codec-encoded; the printed
+    ``push_gbps`` stays defined over the RAW payload bytes, so it IS
+    the effective goodput (pre-compression bytes delivered per
+    second)."""
     import threading
 
     nk = args.num_keys
     val_len = args.len // 4
     big_keys = np.arange(100, 100 + nk, dtype=np.uint64)
-    big_vals = np.ones(nk * val_len, np.float32)
+    # Realistic gradient-like payload: constant vals would quantize
+    # losslessly and flatter the codec legs.
+    big_vals = np.random.default_rng(11).normal(
+        size=nk * val_len
+    ).astype(np.float32)
     small_key = np.array([7], dtype=np.uint64)
     small_vals = np.ones(256, np.float32)
     small_out = np.zeros_like(small_vals)
-    worker.wait(worker.push(big_keys, big_vals))
+    # Warm the path end to end before timing: codec legs additionally
+    # need the codec buffer pools (worker codes / server decode
+    # buffers) and the core's span threads populated — the first cold
+    # encodes/decodes pay page faults worth tens of ms that would
+    # otherwise read as steady-state tail (seen as 26-31 ms first
+    # decodes in the trace tier vs 2-3 ms warm).
+    for _ in range(4 if codec else 1):
+        worker.wait(worker.push(big_keys, big_vals, codec=codec))
     worker.wait(worker.push(small_key, small_vals))
     worker.wait(worker.pull(small_key, small_out, priority=1))
     depth = int(os.environ.get("PS_BENCH_PIPELINE", "3"))
@@ -164,7 +182,8 @@ def run_lane_goodput(worker, args) -> None:
         t0 = time.perf_counter()
         pending = []
         for _ in range(args.repeat):
-            pending.append(worker.push(big_keys, big_vals, priority=0))
+            pending.append(worker.push(big_keys, big_vals, priority=0,
+                                       codec=codec))
             if len(pending) >= depth:
                 worker.wait(pending.pop(0))
         for ts in pending:
@@ -185,10 +204,20 @@ def run_lane_goodput(worker, args) -> None:
     p50 = lats[len(lats) // 2] if lats else 0.0
     p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] if lats else 0.0
     print(
-        f"LANE_GOODPUT samples={len(lats)} pull_p50_ms={p50:.3f} "
+        f"{tag} samples={len(lats)} pull_p50_ms={p50:.3f} "
         f"pull_p99_ms={p99:.3f} push_gbps={gbps:.3f}",
         flush=True,
     )
+
+
+def run_quantized_push(worker, args) -> None:
+    """``--mode quantized_push`` (docs/compression.md): the
+    ``lane_goodput`` storm with the bulk pushes encoded by the codec
+    named in ``PS_BENCH_CODEC`` (empty = uncompressed baseline leg).
+    Effective goodput keeps the raw-bytes definition, so the
+    compressed/uncompressed ratio is the codec tier's end-to-end win."""
+    codec = os.environ.get("PS_BENCH_CODEC", "").strip() or None
+    run_lane_goodput(worker, args, tag="QUANTIZED_PUSH", codec=codec)
 
 
 def run_worker(args) -> None:
@@ -203,6 +232,9 @@ def run_worker(args) -> None:
         return
     if args.mode == "lane_goodput":
         run_lane_goodput(worker, args)
+        return
+    if args.mode == "quantized_push":
+        run_quantized_push(worker, args)
         return
     ranges = po.get_server_key_ranges()
     keys_per_server = args.num_keys
@@ -865,6 +897,86 @@ def native_goodput_bench(quick: bool = True) -> dict:
     }
 
 
+def quantized_push_bench(quick: bool = True) -> dict:
+    """Quantized transport tier (docs/compression.md) over the real
+    1w+1s tcp cluster: the 64 MiB ``quantized_push`` storm (pipelined
+    pushes + concurrent priority small-pulls) uncompressed vs int8 vs
+    fp8_e4m3, all legs sharing the van settings of ``native_goodput``
+    (2 MiB chunks, bounded socket buffers, pipeline depth 4).
+
+    Headline: ``goodput_ratio_<codec>`` — EFFECTIVE goodput (raw
+    payload bytes per second, i.e. pre-compression) relative to the
+    uncompressed leg — with the concurrent priority small-pull p99
+    ratio as the tail guard (acceptance: >= 2x at p99 <= 1.3x).
+
+    The headline codec legs run with error feedback OFF
+    (``PS_CODEC_EF=0``): EF's fold+decode+update roughly doubles the
+    encode memory traffic, and its convergence value is priced by the
+    dedicated guard test, not this throughput section.  The ``int8_ef``
+    leg re-runs int8 with EF ON so the bench records what the
+    convergence-preserving configuration actually costs."""
+    from .ops import codecs as codecs_mod
+
+    push_mb = 64
+    n_pushes = 32 if quick else 96
+    rounds = 1 if quick else 3
+    chunk_bytes = 2 << 20
+    base_env = {
+        "PS_BENCH_PIPELINE": "4",
+        # Enough pooled decode buffers for the pipeline depth (the
+        # first cold 64 MiB allocations cost tens of ms of page
+        # faults; see _BufPool) — the warmup pushes then prime them.
+        "PS_CODEC_POOL_MB": "1024",
+    }
+    legs_spec = [("raw", "", "0"), ("int8", "int8", "0")]
+    if "fp8_e4m3" in codecs_mod.names():
+        legs_spec.append(("fp8_e4m3", "fp8_e4m3", "0"))
+    legs_spec.append(("int8_ef", "int8", "1"))
+    leg_runs = {tag: [] for tag, _, _ in legs_spec}
+    # Interleaved rounds (the native_goodput lesson): host-load drift
+    # lands on every leg symmetrically instead of biasing the last.
+    for _ in range(rounds):
+        for tag, codec, ef in legs_spec:
+            env = dict(base_env, PS_BENCH_CODEC=codec, PS_CODEC_EF=ef)
+            leg_runs[tag].append(_chunk_run(
+                push_mb, n_pushes, str(chunk_bytes),
+                extra_env=env, mode="quantized_push",
+            ))
+    med = statistics.median
+    legs = {}
+    for tag, runs in leg_runs.items():
+        legs[tag] = {
+            "push_gbps": med(r["push_gbps"] for r in runs),
+            "pull_p99_ms": med(r["pull_p99_ms"] for r in runs),
+            "pull_samples": sum(r["pull_samples"] for r in runs),
+        }
+    raw = legs["raw"]
+    out = {
+        "push_mb": push_mb,
+        "chunk_bytes": chunk_bytes,
+        "rounds": rounds,
+        "raw_push_gbps": round(raw["push_gbps"], 2),
+        "raw_pull_p99_ms": round(raw["pull_p99_ms"], 3),
+    }
+    for tag, _, ef in legs_spec:
+        if tag == "raw":
+            continue
+        leg = legs[tag]
+        out[f"{tag}_push_gbps"] = round(leg["push_gbps"], 2)
+        out[f"{tag}_pull_p99_ms"] = round(leg["pull_p99_ms"], 3)
+        # Effective goodput ratio: raw-bytes throughput compressed vs
+        # uncompressed (the >= 2x acceptance headline).
+        out[f"goodput_ratio_{tag}"] = (
+            round(leg["push_gbps"] / raw["push_gbps"], 2)
+            if raw["push_gbps"] > 0 else None)
+        # Tail guard: the priority small-pull p99 must not degrade
+        # beyond 1.3x under the compressed storm.
+        out[f"p99_ratio_{tag}"] = (
+            round(leg["pull_p99_ms"] / raw["pull_p99_ms"], 2)
+            if raw["pull_p99_ms"] > 0 else None)
+    return out
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A
@@ -951,7 +1063,7 @@ def main(argv=None) -> int:
     server = None
     if role in ("server", "joint"):
         server = KVServer(0)
-        if args.mode in ("chunk_hol", "lane_goodput"):
+        if args.mode in ("chunk_hol", "lane_goodput", "quantized_push"):
             # Shard-capable handle: the apply pool (and the streaming
             # apply of chunked pushes) is part of what these modes price.
             from .kv.kv_app import KVServerDefaultHandle

@@ -27,7 +27,7 @@ import traceback
 from typing import Callable, Dict, List, Optional
 
 from .message import Message
-from .utils.queues import ThreadsafeQueue
+from .utils.queues import PriorityRecvQueue, ThreadsafeQueue
 
 
 class Customer:
@@ -57,7 +57,22 @@ class Customer:
         self._next_ts = 0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._queue: ThreadsafeQueue[Optional[Message]] = ThreadsafeQueue()
+        # Priority intake (PS_RECV_PRIORITY, same knob as the van's
+        # receive queues — docs/chunking.md): a priority op must not
+        # wait behind the queued handling of earlier bulk messages
+        # (e.g. the codec tier's payload decode, docs/compression.md)
+        # any more than it waits behind their frames on the wire.
+        # FIFO within a level preserves per-sender arrival order for
+        # same-priority traffic — the apply pool's bit-exactness
+        # contract; the shutdown sentinel drains LAST, preserving the
+        # deliver-queued-traffic-before-retiring contract.
+        env = getattr(postoffice, "env", None)
+        prio = (env.find_int("PS_RECV_PRIORITY", 1) != 0
+                if env is not None else True)
+        self._queue = (
+            PriorityRecvQueue(self._recv_priority) if prio
+            else ThreadsafeQueue()
+        )
         self._hooks: Dict[int, List[Callable[[], None]]] = {}
         if executor_workers is None:
             env = getattr(postoffice, "env", None)
@@ -184,6 +199,21 @@ class Customer:
             return list(self._hooks.get(timestamp, ()))
 
     # -- receive pump --------------------------------------------------------
+
+    @staticmethod
+    def _recv_priority(msg: Optional[Message]) -> int:
+        """Intake level: None (shutdown sentinel) and TERMINATE drain
+        last; data messages use their wire priority."""
+        if msg is None:
+            return -(1 << 30)
+        c = msg.meta.control
+        if not c.empty():
+            from .message import Command
+
+            if c.cmd == Command.TERMINATE:
+                return -(1 << 30)
+            return 1 << 20
+        return msg.meta.priority
 
     def accept(self, msg: Message) -> None:
         self._queue.push(msg)

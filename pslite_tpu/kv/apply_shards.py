@@ -45,7 +45,7 @@ import numpy as np
 from ..telemetry.metrics import enabled_registry
 from ..telemetry.tracing import NULL_TRACER
 from ..utils import logging as log
-from ..utils.queues import ThreadsafeQueue
+from ..utils.queues import PriorityRecvQueue
 
 # Queue-item task tags.
 _ALL = ("all",)        # whole request lands on one shard (no subsetting)
@@ -110,12 +110,31 @@ class ApplyShardPool:
         self.handle = handle
         self.num_shards = num_shards
         self._server = server
-        self._queues: List[ThreadsafeQueue] = [
-            ThreadsafeQueue() for _ in range(num_shards)
+        # Priority-aware shard queues (the lane discipline, one more
+        # hop in): a priority pull's per-shard snapshot must not wait
+        # behind queued bulk apply segments — highest meta.priority
+        # first, FIFO within a level (so same-priority per-key apply
+        # order still matches arrival order bit-for-bit), the stop
+        # sentinel drains last.  Cross-priority traffic keeps only
+        # PER-KEY ordering (each key's ops still serialize on its one
+        # shard thread in pop order) — the same relaxation the send
+        # lanes and receive queues already made.
+        self._queues: List[PriorityRecvQueue] = [
+            PriorityRecvQueue(self._task_priority)
+            for _ in range(num_shards)
         ]
         # Per-sender FIFO ticket gate: responses leave in arrival order.
         self._order_mu = threading.Lock()
         self._order: Dict[int, Deque[_Pending]] = {}
+        # Emission pipeline: responses selected by the gate queue here
+        # (under _order_mu) and are SENT outside it under _emit_mu —
+        # a codec pull response encodes multi-MB payloads in _emit
+        # (KVServer._encode_response), and doing that under _order_mu
+        # would block every shard thread's completion behind one bulk
+        # encode.  The deque + single drainer keep the send order
+        # exactly the selection order.
+        self._emit_mu = threading.Lock()
+        self._emit_q: Deque[_Pending] = collections.deque()
         # Observability (docs/observability.md): registry-backed
         # counters (the sharded_requests/global_requests properties
         # below keep the historical read surface), per-shard queue-depth
@@ -144,6 +163,33 @@ class ApplyShardPool:
         ]
         for t in self._threads:
             t.start()
+
+    # Target bytes of one shard task group (decode + apply quantum).
+    _TASK_BYTES = 2 << 20
+
+    def _task_groups(self, kvs, positions) -> int:
+        """How many bounded-byte groups one shard's positions split
+        into (>= 1; a group never splits below one key)."""
+        n = len(kvs.keys)
+        if n == 0:
+            return 1
+        enc = getattr(kvs, "enc", None)
+        total = (enc[2].raw_len if enc is not None
+                 else kvs.vals.nbytes)
+        per_key = total // n
+        bytes_here = per_key * len(positions)
+        if bytes_here <= self._TASK_BYTES:
+            return 1
+        return min(len(positions),
+                   (bytes_here + self._TASK_BYTES - 1) // self._TASK_BYTES)
+
+    @staticmethod
+    def _task_priority(item) -> int:
+        """Shard-queue level: the request's wire priority; the stop
+        sentinel (None) drains after all queued work."""
+        if item is None:
+            return -(1 << 30)
+        return item[0].meta.priority
 
     @property
     def sharded_requests(self) -> int:
@@ -174,6 +220,8 @@ class ApplyShardPool:
             # a wait=True pump would hang forever) — dispatch inline,
             # the send-lanes "late sends dispatch inline" analog.
             try:
+                if getattr(kvs, "enc", None) is not None:
+                    kvs.materialize()  # plain __call__ needs flat vals
                 self.handle(meta, kvs, self._server)
             except Exception as exc:
                 log.warning(
@@ -197,19 +245,38 @@ class ApplyShardPool:
             self._c_global.inc()
             pending.remaining = self.num_shards
             pending.barrier = threading.Event()
+            # fence=True: a barrier op parks every other shard thread
+            # until the last shard pops it — later higher-priority
+            # tasks must not overtake it on ANY queue, or a sustained
+            # priority stream on one shard wedges all the others.
             for q in self._queues:
-                q.push((pending, _GLOBAL))
-        elif len(plan) == 1:
+                q.push((pending, _GLOBAL), fence=True)
+        elif len(plan) == 1 and self._task_groups(kvs, plan[0][1]) <= 1:
             # Every key maps to one shard (1-key messages, clustered key
             # sets): skip the positions machinery and its copies.
             self._c_sharded.inc()
             pending.remaining = 1
             self._queues[plan[0][0]].push((pending, _ALL))
         else:
+            # Bulk requests split into bounded-byte task groups per
+            # shard (~_TASK_BYTES each): the shard queues are priority
+            # queues, but a queued priority op still waits out the
+            # task IN FLIGHT — one monolithic decode+apply of a
+            # multi-MB slice is a multi-ms non-preemptible quantum,
+            # which is exactly the head-of-line stall the chunked wire
+            # bounded to ~one chunk (docs/chunking.md).  Same-priority
+            # groups keep FIFO order per shard, so per-key apply order
+            # is unchanged.
             self._c_sharded.inc()
-            pending.remaining = len(plan)
+            tasks = []
             for sid, positions in plan:
-                self._queues[sid].push((pending, ("slice", positions)))
+                ngrp = self._task_groups(kvs, positions)
+                for grp in np.array_split(positions, ngrp):
+                    if len(grp):
+                        tasks.append((sid, grp))
+            pending.remaining = len(tasks)
+            for sid, grp in tasks:
+                self._queues[sid].push((pending, ("slice", grp)))
         if wait:
             # Bounded: stop()'s strand sweep releases a pump caught in
             # the submit-vs-stop window; the timeout is a last-resort
@@ -302,7 +369,9 @@ class ApplyShardPool:
         n = len(keys)
         if n == 0 or kvs.lens is not None:
             return None
-        if len(kvs.vals) % n:
+        enc = getattr(kvs, "enc", None)
+        total = (enc[2].raw_len // 4) if enc is not None else len(kvs.vals)
+        if total % n:
             return None  # malformed shape: let the full handler raise it
         shard_of = (keys % self.num_shards).astype(np.intp)
         plan = []
@@ -363,9 +432,22 @@ class ApplyShardPool:
             keys = kvs.keys
         else:
             keys = kvs.keys[positions]
-        # Zero-copy per-key views of the payload (built on the shard
-        # thread, so even the slicing overlaps across shards).
-        segs = _push_segs(meta, kvs.keys, kvs.vals, positions)
+        enc = getattr(kvs, "enc", None)
+        if enc is not None and meta.push:
+            # Shard-side codec decode (docs/compression.md): this shard
+            # decodes exactly ITS keys' value segments from the wire
+            # payload — shards decode in parallel, and a priority op
+            # can jump the shard queue ahead of the bulk decode.
+            from ..ops import codecs as codecs_mod
+
+            segs = codecs_mod.decode_key_ranges(
+                enc[0], enc[1], enc[2], len(kvs.keys), positions
+            )
+        else:
+            # Zero-copy per-key views of the payload (built on the
+            # shard thread, so even the slicing overlaps across
+            # shards).
+            segs = _push_segs(meta, kvs.keys, kvs.vals, positions)
         t0 = time.monotonic()
         parts = self.handle.apply_shard(meta, keys, segs)
         dur = time.monotonic() - t0
@@ -397,6 +479,8 @@ class ApplyShardPool:
             return
         try:
             t0 = time.monotonic()
+            if getattr(pending.kvs, "enc", None) is not None:
+                pending.kvs.materialize()  # full handler needs vals
             self.handle(pending.meta, pending.kvs,
                         _CaptureResponder(self._server, pending))
             self._h_latency.observe(time.monotonic() - t0)
@@ -473,19 +557,57 @@ class ApplyShardPool:
 
     def _finish(self, pending: _Pending) -> None:
         """Mark done and flush the sender's ticket queue in order.
-        Emission happens UNDER the order lock so two shard threads
-        completing back-to-back requests cannot interleave their
-        sends."""
+        Responses are SELECTED under the order lock (so two shard
+        threads completing back-to-back requests cannot interleave the
+        order) but SENT outside it via the emission deque — a codec
+        pull response encodes its payload inside _emit, and holding
+        _order_mu through a multi-MB encode would stall every shard
+        completion in the pool.
+
+        Priority overtake: a completed response whose priority is
+        strictly higher than every unfinished request ahead of it
+        emits immediately instead of waiting out the FIFO — the gate's
+        arrival-order contract is a same-priority guarantee, exactly
+        like the send lanes and receive queues (docs/chunking.md).
+        Without this, a priority small pull's response parks behind the
+        multi-ms decode+apply of earlier bulk pushes (the codec tier's
+        storm, docs/compression.md) even though the request itself
+        jumped every queue on the way in."""
         with self._order_mu:
             pending.done = True
             dq = self._order.get(pending.meta.sender)
             while dq and dq[0].done:
-                head = dq.popleft()
+                self._emit_q.append(dq.popleft())
+            if dq:
+                blocked_prio = None
+                for p in list(dq):
+                    if not p.done:
+                        bp = p.meta.priority
+                        blocked_prio = (bp if blocked_prio is None
+                                        else max(blocked_prio, bp))
+                    elif (blocked_prio is not None
+                          and p.meta.priority > blocked_prio):
+                        dq.remove(p)
+                        self._emit_q.append(p)
+            if dq is not None and not dq:
+                del self._order[pending.meta.sender]
+        self._drain_emit_q()
+
+    def _drain_emit_q(self) -> None:
+        """Send queued responses in selection order.  _emit_mu admits
+        one drainer at a time and the deque is FIFO, so the wire order
+        equals the gate's selection order even when several shard
+        threads race here; _order_mu is re-taken only for the popleft,
+        never across a send/encode."""
+        while True:
+            with self._emit_mu:
+                with self._order_mu:
+                    if not self._emit_q:
+                        return
+                    head = self._emit_q.popleft()
                 self._emit(head)
                 if head.emitted is not None:
                     head.emitted.set()  # unblock a submit(wait=True) pump
-            if dq is not None and not dq:
-                del self._order[pending.meta.sender]
 
     def _emit(self, pending: _Pending) -> None:
         kind = pending.response[0]

@@ -26,6 +26,7 @@ import itertools
 import os
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -35,6 +36,7 @@ from .. import ps as ps_mod
 from ..base import SERVER_GROUP, is_server_id, server_rank_to_id
 from ..customer import Customer
 from ..message import (
+    CodecInfo,
     Message,
     OPT_APPLY_ERROR,
     OPT_REPLICA,
@@ -42,6 +44,7 @@ from ..message import (
     OPT_XFER_PART,
     Role,
 )
+from ..ops import codecs as codecs_mod
 from ..range import Range, find_range
 from ..sarray import SArray
 from ..utils import logging as log
@@ -58,9 +61,27 @@ class KVPairs:
     vals: np.ndarray = field(default_factory=lambda: np.empty(0, np.float32))
     lens: Optional[np.ndarray] = None
     priority: int = 0
+    # Lazily-decoded codec payload (docs/compression.md): when set,
+    # ``vals`` is empty and ``enc = (codes, scales, CodecInfo)`` — the
+    # apply pool's shard threads decode exactly their own keys'
+    # segments in parallel (codecs.decode_key_ranges) instead of one
+    # whole-payload decode serializing the server's receive pump.
+    enc: Optional[tuple] = None
 
     def empty(self) -> bool:
         return len(self.keys) == 0
+
+    def materialize(self) -> None:
+        """Eagerly decode a lazy codec payload into ``vals`` (callers
+        that need the whole flat payload: global ops, handlers without
+        ``apply_shard``, registered-buffer placement)."""
+        if self.enc is None:
+            return
+        codes, scales, info = self.enc
+        codec = codecs_mod.by_wire_id(info.codec)
+        self.vals = codec.decode(codes, scales, info.raw_len // 4,
+                                 flags=info.flags)
+        self.enc = None
 
 
 @dataclass
@@ -82,10 +103,16 @@ class KVMeta:
     # originating worker sampled this request; carried so server-side
     # apply/respond spans join the same trace.
     trace: int = 0
+    # Wire-codec marker (docs/compression.md): the request's CodecInfo.
+    # On a pull request (raw_len == 0) it names the codec the worker
+    # wants the response encoded with; on a decoded push it records
+    # what the payload traveled as (replication forwards re-send it).
+    codec: object = None
 
 
-# Re-exported from message.py (transports consume it there without
-# importing the app layer; kept here for existing importers).
+# Legacy re-export (the one-off int8 option marker): wire compression
+# now rides the codec registry + EXT_CODEC extension instead
+# (ops/codecs.py — docs/compression.md); kept for existing importers.
 from ..message import OPT_COMPRESS_INT8  # noqa: E402,F401
 # Zero-copy pull (is_worker_zpull_, kv_app.h:727-792): the transport
 # delivers each server's pull-response slice directly into the worker's
@@ -141,6 +168,19 @@ def default_slicer(
 
 
 @dataclass
+class _EncodedSlice:
+    """One slice's codec-encoded payload (docs/compression.md).  Built
+    ONCE at send time so deadline-sweeper retries and replica failovers
+    re-send byte-identical compressed data — re-encoding on retry would
+    double-fold the error-feedback residual."""
+
+    codes: np.ndarray        # uint8 wire payload
+    scales: np.ndarray       # float32 scale table (empty for bf16)
+    lens: Optional[np.ndarray]
+    info: CodecInfo
+
+
+@dataclass
 class _PendingSlice:
     """One per-server slice of an in-flight bounded request."""
 
@@ -149,6 +189,7 @@ class _PendingSlice:
     dest: int
     sent_msg: Optional[Message] = None  # for resender forget on re-route
     responded: bool = False
+    enc: Optional[_EncodedSlice] = None  # codec payload (encode-once)
     # Set when THIS slice's delivery is known failed (send raised, or
     # the van synthesized OPT_SEND_FAILED): the sweeper retries it
     # immediately — and ONLY it, so one bad destination cannot trigger
@@ -174,7 +215,7 @@ class _PendingReq:
     slices: List[_PendingSlice] = field(default_factory=list)
     val_dtype: object = None
     val_nbytes: int = 0
-    compress: Optional[str] = None
+    codec: Optional[str] = None
     zpull: Optional[dict] = None
 
 
@@ -217,6 +258,20 @@ class KVWorker:
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
         self._dense_routes: Dict[Tuple[int, int, int], str] = {}
+        # Quantized transport tier (docs/compression.md): per-bucket
+        # default codec ((nkeys, first, last) -> (keys, codec name),
+        # registered via register_bucket) and the worker-side error-
+        # feedback bank — push quantization error folds into the NEXT
+        # push of the same slice before encoding (PS_CODEC_EF=0 off).
+        self._bucket_codecs: Dict[Tuple[int, int, int],
+                                  Tuple[np.ndarray, Optional[str]]] = {}
+        self._codec_ef = (
+            codecs_mod.ErrorFeedback(codecs_mod.ef_slots(self.po.env),
+                                     metrics=self.po.metrics)
+            if codecs_mod.ef_enabled(self.po.env) else None
+        )
+        self._c_codec_raw = self.po.metrics.counter("codec.raw_bytes")
+        self._c_codec_wire = self.po.metrics.counter("codec.wire_bytes")
         self._device_results: Dict[int, object] = {}
         self._engine_pool = None  # lazy completion executor (engine path)
         # Last completion per pinned bucket: the next pinned pull joins it
@@ -261,6 +316,77 @@ class KVWorker:
     def set_slicer(self, slicer) -> None:
         """Custom slicer hook (kv_app.h:256-265)."""
         self._slicer = slicer
+
+    # -- quantized transport tier (docs/compression.md) ----------------------
+
+    def register_bucket(self, keys, codec: Optional[str] = None) -> None:
+        """Register a default wire codec for exactly these keys: every
+        ``push``/``pull`` of this key set then travels codec-encoded
+        (``'int8'``, ``'fp8_e4m3'``, ``'bf16'``) unless the call
+        overrides with ``codec=`` (``codec='raw'`` forces uncompressed).
+        ``codec=None`` unregisters.  Message-path only — the collective
+        (ICI) plane needs no wire compression and ignores it."""
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        log.check(len(keys) > 0, "register_bucket: empty key set")
+        if codec is not None:
+            codecs_mod.get_codec(codec)  # fail loudly on unknown names
+        sig = (len(keys), int(keys[0]), int(keys[-1]))
+        with self._mu:
+            if codec is None:
+                self._bucket_codecs.pop(sig, None)
+            else:
+                self._bucket_codecs[sig] = (keys, codec)
+
+    def _resolve_codec(self, keys: np.ndarray,
+                       codec: Optional[str],
+                       compress: Optional[str]) -> Optional[str]:
+        """Effective codec of one op: explicit ``codec=`` (or the
+        legacy ``compress=`` alias) wins, then the registered bucket
+        default; ``'raw'`` forces uncompressed."""
+        if codec is None:
+            codec = compress  # legacy alias (kept for callers/docs)
+        if codec == "raw":
+            return None
+        if codec is not None:
+            codecs_mod.get_codec(codec)
+            return codec
+        if len(keys) == 0:
+            return None
+        sig = (len(keys), int(keys[0]), int(keys[-1]))
+        with self._mu:
+            ent = self._bucket_codecs.get(sig)
+        if ent is not None and np.array_equal(ent[0], keys):
+            return ent[1]
+        return None
+
+    def _encode_part(self, codec_name: str, group_rank: int,
+                     part: KVPairs) -> _EncodedSlice:
+        """Encode one slice's payload (once — retries re-send these
+        exact bytes), folding in the worker-side EF residual for this
+        (destination, slice)."""
+        codec = codecs_mod.get_codec(codec_name)
+        lens = (None if part.lens is None
+                else np.asarray(part.lens, dtype=np.int64))
+        if self._codec_ef is not None:
+            # Slot identity must pin the EXACT key set: two buckets
+            # sharing (rank, first key, size) would otherwise cross-
+            # fold each other's residuals — crc32 over the key bytes
+            # is ~C-speed and collision-safe in practice.
+            key = (group_rank, int(part.keys[0]),
+                   zlib.crc32(part.keys), int(part.vals.size))
+            resid, lock = self._codec_ef.slot(key, int(part.vals.size))
+            with lock:
+                codes, scales, flags = codec.encode(
+                    part.vals, lens=lens, resid=resid
+                )
+        else:
+            codes, scales, flags = codec.encode(part.vals, lens=lens)
+        self._c_codec_raw.inc(part.vals.nbytes)
+        self._c_codec_wire.inc(codes.nbytes + scales.nbytes)
+        info = CodecInfo(codec=codec.wire_id, raw_len=part.vals.nbytes,
+                         block=codec.block, flags=flags)
+        return _EncodedSlice(codes=codes, scales=scales, lens=part.lens,
+                             info=info)
 
     # -- zero-copy pull (is_worker_zpull_) -----------------------------------
 
@@ -556,28 +682,34 @@ class KVWorker:
         callback: Optional[Callable[[], None]] = None,
         priority: int = 0,
         compress: Optional[str] = None,
+        codec: Optional[str] = None,
     ) -> int:
         """Zero-copy push; caller must not mutate buffers until wait(ts)
         (kv_app.h:210-231).
 
-        ``compress='int8'`` quarters wire bytes on the message path
-        (blockwise symmetric quantization, decompressed server-side before
-        the handler).  Ignored on the collective path — ICI needs no wire
-        compression — and incompatible with ``lens``.
+        ``codec=`` selects a wire codec from the registry
+        (``ops/codecs.py`` — ``'int8'``, ``'fp8_e4m3'``, ``'bf16'``;
+        docs/compression.md): the payload travels compressed and is
+        decoded server-side before the handler, with worker-side error
+        feedback folding each push's quantization error into the next
+        (``PS_CODEC_EF=0`` disables).  Defaults to the bucket codec
+        registered via :meth:`register_bucket` for these exact keys;
+        ``codec='raw'`` forces uncompressed.  ``compress=`` is the
+        legacy alias of ``codec=``.  Ragged ``lens`` payloads are
+        supported via per-key blockwise scaling.  Ignored on the
+        collective (ICI) path, which needs no wire compression.
         """
-        if compress is not None:
-            log.check(compress == "int8", f"unknown compression {compress!r}")
-            log.check(lens is None, "compress requires fixed-length values")
         route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
                                    lens)
         if route is not None:
             token = self.engine.push(route, vals)
             return self._engine_dispatch(token, callback=callback)
         kvs = _as_kvs(keys, vals, lens, priority)
-        if compress is not None:
+        codec = self._resolve_codec(kvs.keys, codec, compress)
+        if codec is not None:
             log.check(
                 kvs.vals.dtype == np.float32,
-                f"compress='int8' requires float32 values, got "
+                f"codec {codec!r} requires float32 values, got "
                 f"{kvs.vals.dtype}",
             )
         ts = self._customer.new_request(SERVER_GROUP)
@@ -586,7 +718,7 @@ class KVWorker:
             with self._mu:
                 self._callbacks[ts] = callback
         self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs,
-                   compress=compress, trace=trace)
+                   codec=codec, trace=trace)
         return ts
 
     def pull(
@@ -598,20 +730,25 @@ class KVWorker:
         callback: Optional[Callable[[], None]] = None,
         priority: int = 0,
         compress: Optional[str] = None,
+        codec: Optional[str] = None,
     ) -> int:
         """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792).
 
-        ``compress='int8'`` quarters pull-response wire bytes (the
-        server quantizes blockwise before sending; decompressed here).
-        float32 fixed-length values only; ignored on the collective path
-        and mutually exclusive with registered zero-copy pull buffers.
+        ``codec=`` asks each server to encode its response slice with a
+        registry codec (``ops/codecs.py``; docs/compression.md) — the
+        server folds its per-(key, worker) error-feedback residual in
+        before encoding, and the response is decoded here.  Defaults to
+        the bucket codec registered via :meth:`register_bucket`;
+        ``codec='raw'`` forces uncompressed; ``compress=`` is the
+        legacy alias.  float32 values only; ignored on the collective
+        path and mutually exclusive with registered zero-copy pull
+        buffers.
         """
         keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
-        if compress is not None:
-            log.check(compress == "int8", f"unknown compression {compress!r}")
-            log.check(lens is None, "compress requires fixed-length values")
+        codec = self._resolve_codec(keys, codec, compress)
+        if codec is not None:
             log.check(vals.dtype == np.float32,
-                      "compress='int8' requires float32 values")
+                      f"codec {codec!r} requires float32 values")
         route = self._engine_route(keys, cmd, lens)
         if route is not None:
             pinned = self.engine.pinned_pull_buffer(route) is not None
@@ -639,7 +776,7 @@ class KVWorker:
         trace = self._track_request(ts, pull=True)
         zpull = (
             self._zpull_lookup(keys, vals)
-            if lens is None and compress is None else None
+            if lens is None and codec is None else None
         )
         with self._mu:
             if callback is not None:
@@ -650,7 +787,7 @@ class KVWorker:
         kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
                    val_dtype=vals.dtype, val_nbytes=vals.nbytes,
-                   zpull=zpull, compress=compress, trace=trace)
+                   zpull=zpull, codec=codec, trace=trace)
         return ts
 
     def push_pull(
@@ -662,8 +799,17 @@ class KVWorker:
         cmd: int = 0,
         callback: Optional[Callable[[], None]] = None,
         priority: int = 0,
+        compress: Optional[str] = None,
+        codec: Optional[str] = None,
     ) -> int:
-        """Fused push+pull round trip (the benchmark hot path)."""
+        """Fused push+pull round trip (the benchmark hot path).
+
+        The PUSH leg honors the bucket/explicit codec
+        (docs/compression.md) like :meth:`push`; the fused RESPONSE
+        always travels raw — it must be eligible for in-place
+        registered-buffer delivery, and the request's EXT_CODEC marker
+        already describes the pushed payload, not a response wish.
+        """
         route = self._engine_route(np.asarray(keys, dtype=np.uint64), cmd,
                                    lens)
         if route is not None:
@@ -671,6 +817,13 @@ class KVWorker:
             return self._engine_dispatch(result, out=outs, callback=callback,
                                          keep_result=True)
         kvs = _as_kvs(keys, vals, lens, priority)
+        codec = self._resolve_codec(kvs.keys, codec, compress)
+        if codec is not None:
+            log.check(
+                kvs.vals.dtype == np.float32,
+                f"codec {codec!r} requires float32 values, got "
+                f"{kvs.vals.dtype}",
+            )
         ts = self._customer.new_request(SERVER_GROUP)
         trace = self._track_request(ts, pull=True)
         # Registered pull buffers apply to the fused round trip too: the
@@ -685,7 +838,7 @@ class KVWorker:
             if zpull is not None:
                 self._zpull_ts.add(ts)
         self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs, zpull=zpull,
-                   trace=trace)
+                   codec=codec, trace=trace)
         return ts
 
     def wait(self, timestamp: int) -> None:
@@ -851,7 +1004,7 @@ class KVWorker:
                 msg = self._slice_msg(
                     req.ts, req.push, req.pull, req.cmd, sl.part,
                     sl.group_rank, dest, req.val_dtype, req.val_nbytes,
-                    req.compress, req.zpull, req.trace,
+                    req.codec, req.zpull, req.trace, enc=sl.enc,
                 )
                 try:
                     self.po.van.send(msg)
@@ -882,12 +1035,15 @@ class KVWorker:
         dest: int,
         val_dtype=None,
         val_nbytes: int = 0,
-        compress: Optional[str] = None,
+        codec: Optional[str] = None,
         zpull: Optional[dict] = None,
         trace: int = 0,
+        enc: Optional[_EncodedSlice] = None,
     ) -> Message:
         """Build one per-server slice message (shared by the initial
-        send and the deadline sweeper's failover retries)."""
+        send and the deadline sweeper's failover retries).  ``enc`` is
+        the slice's encode-once codec payload — a retry re-sends the
+        exact original bytes."""
         msg = Message()
         m = msg.meta
         m.trace = trace
@@ -915,20 +1071,26 @@ class KVWorker:
                 | zpull["offsets"][group_rank]
             )
         else:
-            if compress == "int8" and pull and not push:
-                # Ask the server to quantize its response slice.
-                m.option = OPT_COMPRESS_INT8
+            if codec is not None and pull and not push:
+                # Ask the server to encode its response slice with this
+                # codec (raw_len=0 marks the request direction).
+                c = codecs_mod.get_codec(codec)
+                m.codec = CodecInfo(codec=c.wire_id, raw_len=0,
+                                    block=c.block)
             m.addr = id(part.vals)  # same-process fast-path token
         msg.add_data(SArray(part.keys))
-        if compress == "int8" and push:  # dtype validated in push()
-            from ..ops.quantize import np_quantize_int8
-
-            q, scales, _n = np_quantize_int8(part.vals)
-            m.option = OPT_COMPRESS_INT8
-            # m.val_len already holds the uncompressed byte count (set
-            # above); the server derives n = val_len // 4 from it.
-            msg.add_data(SArray(q.reshape(-1)))
-            msg.add_data(SArray(scales))
+        if enc is not None and push:
+            # Codec payload (docs/compression.md): codes + scale table
+            # (+ per-key lens); the codec identity rides the EXT_CODEC
+            # meta extension so it survives re-chunking and replication
+            # forwards.  m.val_len already holds the raw byte count.
+            m.codec = enc.info
+            msg.add_data(SArray(enc.codes))
+            msg.add_data(SArray(enc.scales))
+            if enc.lens is not None:
+                msg.add_data(
+                    SArray(np.asarray(enc.lens, dtype=np.int32))
+                )
         else:
             msg.add_data(SArray(part.vals))
             if part.lens is not None:
@@ -946,7 +1108,7 @@ class KVWorker:
         kvs: KVPairs,
         val_dtype=None,
         val_nbytes: int = 0,
-        compress: Optional[str] = None,
+        codec: Optional[str] = None,
         zpull: Optional[dict] = None,
         trace: int = 0,
     ) -> None:
@@ -963,6 +1125,15 @@ class KVWorker:
             for group_rank, part in enumerate(sliced)
             if part is not None and not part.empty()
         ]
+        # Encode ONCE, before any send can fail: a sweeper retry (or
+        # replica failover) re-sends the identical compressed bytes —
+        # re-encoding would double-fold the error-feedback residual
+        # and break the matrix bit-exactness contract.
+        encs: List[Optional[_EncodedSlice]] = [
+            self._encode_part(codec, gr, part)
+            if codec is not None and push else None
+            for gr, part, _dest in parts
+        ]
         req: Optional[_PendingReq] = None
         if self._req_timeout > 0:
             # Built COMPLETE before publication: a sweeper tick racing
@@ -973,11 +1144,12 @@ class KVWorker:
                 deadline=time.monotonic() + self._req_timeout,
                 trace=trace,
                 slices=[
-                    _PendingSlice(group_rank=gr, part=part, dest=dest)
-                    for gr, part, dest in parts
+                    _PendingSlice(group_rank=gr, part=part, dest=dest,
+                                  enc=enc)
+                    for (gr, part, dest), enc in zip(parts, encs)
                 ],
                 val_dtype=val_dtype, val_nbytes=val_nbytes,
-                compress=compress, zpull=zpull,
+                codec=codec, zpull=zpull,
             )
             with self._mu:
                 self._pending[ts] = req
@@ -985,8 +1157,8 @@ class KVWorker:
         for idx, (group_rank, part, dest) in enumerate(parts):
             sl = req.slices[idx] if req is not None else None
             msg = self._slice_msg(ts, push, pull, cmd, part, group_rank,
-                                  dest, val_dtype, val_nbytes, compress,
-                                  zpull, trace)
+                                  dest, val_dtype, val_nbytes, codec,
+                                  zpull, trace, enc=encs[idx])
             try:
                 self.po.van.send(msg)
                 if sl is not None:
@@ -1076,16 +1248,23 @@ class KVWorker:
             with self._mu:
                 self._error_ts.add(ts)
         if msg.meta.pull and len(msg.data) >= 2:
-            if msg.meta.option == OPT_COMPRESS_INT8 and len(msg.data) >= 3:
-                # Server quantized the response slice; val_len carries
-                # the slice's uncompressed byte count.
-                from ..ops.quantize import decode_int8_payload
-
+            ci = msg.meta.codec
+            if ci is not None and ci.raw_len > 0 and len(msg.data) >= 3:
+                # The server encoded its response slice (EXT_CODEC);
+                # raw_len sizes the decode, data[3] carries per-key
+                # lens for ragged payloads.
+                codec = codecs_mod.by_wire_id(ci.codec)
+                codecs_mod.check_block(ci)
+                lens = (msg.data[3].astype_view(np.int32).numpy()
+                        if len(msg.data) > 3 else None)
                 kvs = KVPairs(
                     keys=msg.data[0].astype_view(np.uint64).numpy(),
-                    vals=decode_int8_payload(
-                        msg.data[1], msg.data[2], msg.meta.val_len
+                    vals=codec.decode(
+                        msg.data[1].astype_view(np.uint8).numpy(),
+                        msg.data[2].astype_view(np.float32).numpy(),
+                        ci.raw_len // 4, lens=lens, flags=ci.flags,
                     ),
+                    lens=lens,
                 )
             else:
                 kvs = KVPairs(
@@ -1232,6 +1411,14 @@ class KVServer:
         self._c_pull_reqs = self.po.metrics.counter("kv.server_pull_requests")
         self._hot_keys = self.po.metrics.topk("kv.hot_keys")
         self._h_serial_apply = self.po.metrics.histogram("apply.latency_s")
+        # Quantized transport tier (docs/compression.md): the server is
+        # the ENCODER of codec pull responses — its per-(key, worker)
+        # error-feedback residuals live on the handle (ef_bank, created
+        # lazily in _encode_response) so they share the store's
+        # lifetime; PS_CODEC_EF=0 disables.
+        self._codec_ef_enabled = codecs_mod.ef_enabled(self.po.env)
+        self._c_codec_raw = self.po.metrics.counter("codec.raw_bytes")
+        self._c_codec_wire = self.po.metrics.counter("codec.wire_bytes")
         rep = self.po.env.find_int("PS_KV_REPLICATION", 1)
         if rep >= 2 and self.po.num_servers >= 2:
             from .replication import Replicator
@@ -1391,35 +1578,90 @@ class KVServer:
         msg = self._response_msg(req)
         m = msg.meta
         if res is not None and not res.empty():
+            ci = getattr(req, "codec", None)
             if (
                 req.pull
-                and req.option == OPT_COMPRESS_INT8
-                and res.lens is None
+                and ci is not None
+                and ci.raw_len == 0  # request marker, not a push echo
+                and isinstance(res.vals, np.ndarray)
                 and res.vals.dtype == np.float32
+                and res.vals.size > 0
             ):
-                # Pull-side wire compression (the worker asked via the
-                # request option): quantize the response slice; val_len
-                # carries the slice's uncompressed byte count so the
-                # worker can size the dequantize.
-                from ..ops.quantize import np_quantize_int8
-
-                q, scales, _n = np_quantize_int8(res.vals)
-                m.val_len = res.vals.nbytes
-                msg.add_data(SArray(res.keys))
-                msg.add_data(SArray(q.reshape(-1)))
-                msg.add_data(SArray(scales))
-                self.po.van.send(msg)
-                return
-            if m.option == OPT_COMPRESS_INT8:
-                # Declined to compress (lens / non-float32): the echoed
-                # option must not claim quantized data or the worker
-                # would misdecode the plain payload.
-                m.option = 0
+                # Pull-side wire compression (docs/compression.md): the
+                # worker asked for this codec via the request's
+                # EXT_CODEC marker.  The per-(key, worker) error-
+                # feedback residual folds in before encoding; ragged
+                # lens payloads scale per key.  Declines (non-float32 /
+                # empty) fall through uncompressed with meta.codec
+                # unset, which the worker decodes as plain.
+                enc = self._encode_response(ci, req, res)
+                if enc is not None:
+                    codes, scales, info = enc
+                    m.codec = info
+                    m.val_len = res.vals.nbytes
+                    msg.add_data(SArray(res.keys))
+                    msg.add_data(SArray(codes))
+                    msg.add_data(SArray(scales))
+                    if res.lens is not None:
+                        msg.add_data(
+                            SArray(np.asarray(res.lens, dtype=np.int32))
+                        )
+                    self.po.van.send(msg)
+                    return
             msg.add_data(SArray(res.keys))
             msg.add_data(SArray(res.vals))
             if res.lens is not None:
                 msg.add_data(SArray(np.asarray(res.lens, dtype=np.int32)))
         self.po.van.send(msg)
+
+    def _encode_response(self, ci, req: KVMeta, res: KVPairs):
+        """Encode a pull-response slice with the request's codec,
+        folding in the handle's per-(worker, key-slice) EF residual
+        (``KVServerDefaultHandle.ef_bank``, created lazily here so it
+        shares the store's lifetime).  Returns (codes, scales,
+        CodecInfo), or None to decline (unknown codec id — the
+        response then travels uncompressed)."""
+        try:
+            codec = codecs_mod.by_wire_id(ci.codec)
+        except Exception:  # noqa: BLE001 - unknown id: decline loudly
+            log.warning(f"pull requested unknown codec id {ci.codec}; "
+                        f"responding uncompressed")
+            return None
+        lens = (None if res.lens is None
+                else np.asarray(res.lens, dtype=np.int64))
+        resid = lock = None
+        if self._codec_ef_enabled and self._handle is not None:
+            bank = getattr(self._handle, "ef_bank", None)
+            if bank is None:
+                try:
+                    bank = codecs_mod.ErrorFeedback(
+                        codecs_mod.ef_slots(self.po.env),
+                        metrics=self.po.metrics,
+                    )
+                    self._handle.ef_bank = bank
+                except (AttributeError, TypeError):
+                    bank = None  # handle refuses attributes: no EF
+            if bank is not None:
+                # Pin the exact key set (see KVWorker._encode_part):
+                # (sender, first, crc(keys), size) — aliased slots
+                # would cross-fold residuals between unrelated pulls.
+                key = (req.sender,
+                       int(res.keys[0]) if len(res.keys) else req.key,
+                       zlib.crc32(np.ascontiguousarray(res.keys)),
+                       int(res.vals.size))
+                resid, lock = bank.slot(key, int(res.vals.size))
+        if lock is not None:
+            with lock:
+                codes, scales, flags = codec.encode(res.vals, lens=lens,
+                                                    resid=resid)
+        else:
+            codes, scales, flags = codec.encode(res.vals, lens=lens)
+        self._c_codec_raw.inc(res.vals.nbytes)
+        self._c_codec_wire.inc(codes.nbytes + scales.nbytes)
+        return codes, scales, CodecInfo(
+            codec=codec.wire_id, raw_len=res.vals.nbytes,
+            block=codec.block, flags=flags,
+        )
 
     def response_error(self, req: KVMeta) -> None:
         """Empty ``OPT_APPLY_ERROR``-marked response: the waiting worker
@@ -1612,20 +1854,67 @@ class KVServer:
             option=msg.meta.option,
             priority=msg.meta.priority,
             trace=msg.meta.trace,
+            codec=msg.meta.codec,
         )
         if meta.push:
             self._c_push_reqs.inc()
         if meta.pull:
             self._c_pull_reqs.inc()
         kvs = KVPairs()
+        # Compressed wire payload of a codec push, kept as received so
+        # replication can forward the COMPRESSED bytes down the chain
+        # (each replica decodes once; re-sending decompressed would pay
+        # decompress+recompress and 4x wire on every hop).
+        wire_payload = None
+        ci = msg.meta.codec
         if len(msg.data) >= 2:
             kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
-            if meta.option == OPT_COMPRESS_INT8 and meta.push:
-                from ..ops.quantize import decode_int8_payload
-
-                kvs.vals = decode_int8_payload(
-                    msg.data[1], msg.data[2], meta.val_len
+            if (ci is not None and ci.raw_len > 0 and meta.push
+                    and len(msg.data) >= 3):
+                codec = codecs_mod.by_wire_id(ci.codec)
+                codecs_mod.check_block(ci)
+                lens_arr = (msg.data[3].astype_view(np.int32).numpy()
+                            if len(msg.data) > 3 else None)
+                codes_arr = msg.data[1].astype_view(np.uint8).numpy()
+                scales_arr = msg.data[2].astype_view(np.float32).numpy()
+                kvs.lens = lens_arr
+                wire_payload = (msg.data[1], msg.data[2], lens_arr, ci)
+                n_el = ci.raw_len // 4
+                # Shard-side decode (docs/compression.md): a fixed-k
+                # push headed for the apply pool defers its decode to
+                # the shard threads (each decodes exactly its own
+                # keys' segments, in parallel) — one whole-payload
+                # decode here would serialize the receive pump and
+                # head-of-line-block priority ops behind it.  Ragged /
+                # registered-buffer / serial-path pushes decode
+                # eagerly.
+                lazy = (
+                    lens_arr is None and not meta.pull
+                    and self._apply_pool is not None
+                    and getattr(codec, "_kind", -1) >= 0
+                    and len(kvs.keys) > 0
+                    and n_el % len(kvs.keys) == 0
+                    and (meta.sender, int(kvs.keys[0]))
+                    not in self._recv_buffers
                 )
+                if lazy:
+                    kvs.enc = (codes_arr, scales_arr, ci)
+                else:
+                    t0 = time.monotonic()
+                    kvs.vals = codec.decode(
+                        codes_arr, scales_arr, n_el, lens=lens_arr,
+                        flags=ci.flags,
+                    )
+                    if meta.trace and self.po.tracer.active:
+                        dur = time.monotonic() - t0
+                        now = self.po.tracer.now_us()
+                        self.po.tracer.span(
+                            meta.trace, "codec_decode", now - dur * 1e6,
+                            dur * 1e6,
+                            args={"codec": codec.name,
+                                  "raw_mb": round(ci.raw_len / 2**20,
+                                                  1)},
+                        )
             else:
                 kvs.vals = msg.data[1].numpy()
                 if len(msg.data) > 2:
@@ -1687,7 +1976,11 @@ class KVServer:
                     # registered-buffer payload is snapshotted: the pump
                     # overwrites the shared buffer on the sender's next
                     # push while the replica lane may still serialize.
-                    self._replicator.forward(meta, kvs, copy=reg is not None)
+                    # Codec pushes forward their COMPRESSED wire bytes
+                    # (wire=); the replica decodes once on arrival.
+                    self._replicator.forward(meta, kvs,
+                                             copy=reg is not None,
+                                             wire=wire_payload)
         if self._apply_pool is not None:
             # Sharded apply: returns immediately — the response is
             # emitted (in per-sender arrival order) by whichever shard
@@ -1780,6 +2073,11 @@ class KVServerDefaultHandle:
     def __init__(self, val_len: Optional[int] = None):
         self.store: Dict[int, np.ndarray] = {}
         self.val_len = val_len
+        # Per-(worker, key-slice) error-feedback residuals for codec
+        # pull responses (docs/compression.md): created lazily by
+        # KVServer._encode_response so the bank shares the store's
+        # lifetime and the node's PS_CODEC_EF / telemetry settings.
+        self.ef_bank = None
 
     def apply_shard(self, meta: KVMeta, keys: np.ndarray,
                     segs) -> Optional[List[np.ndarray]]:
@@ -1872,6 +2170,7 @@ class KVServerOptimizerHandle:
         self._m: Dict[int, np.ndarray] = {}
         self._v: Dict[int, np.ndarray] = {}
         self._t: Dict[int, int] = {}
+        self.ef_bank = None  # codec pull-response EF (compression.md)
 
     def init(self, key: int, value: np.ndarray) -> None:
         self.store[int(key)] = np.asarray(value, np.float32).copy()

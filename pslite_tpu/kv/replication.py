@@ -162,7 +162,8 @@ class Replicator:
 
     # -- forwarding (primary side) -------------------------------------------
 
-    def forward(self, meta, kvs, copy: bool = False) -> None:
+    def forward(self, meta, kvs, copy: bool = False,
+                wire=None) -> None:
         """Chain-forward an accepted worker push to the next k-1
         servers.  Runs on the server's single request-processing thread,
         so forwards enter each replica's send lane in arrival order;
@@ -173,6 +174,17 @@ class Replicator:
         alias a registered recv buffer, which the pump overwrites with
         the sender's next push while the replica lane may still be
         serializing this one.
+
+        ``wire`` (docs/compression.md) is a codec push's COMPRESSED
+        payload as received: ``(codes, scales, lens|None, CodecInfo)``.
+        When present the forward re-sends those exact bytes with the
+        EXT_CODEC extension — the replica decodes once on arrival —
+        instead of the decoded float32 vals, which paid
+        decompress+recompress and ~4x wire on every chain hop.  The
+        segments alias the receive frame; the SArray refs keep the
+        pooled block alive until the lane serialized them (the same
+        lifetime rule as the uncompressed path), and a registered recv
+        buffer never backs them, so ``copy`` does not apply.
 
         Chunking interplay (docs/chunking.md): a large forward is
         RE-CHUNKED by ``van.send`` under the forwarding server's own
@@ -186,7 +198,9 @@ class Replicator:
         pushes apply only after full reassembly, exactly like the
         monolithic path."""
         van = self.po.van
-        vals = kvs.vals.copy() if copy else kvs.vals
+        vals = None
+        if wire is None:
+            vals = kvs.vals.copy() if copy else kvs.vals
         for rid in self.replica_ids():
             if van.is_peer_down(rid):
                 continue
@@ -210,9 +224,24 @@ class Replicator:
             # recv/apply spans land under the same trace id.
             m.trace = getattr(meta, "trace", 0)
             msg.add_data(SArray(kvs.keys))
-            msg.add_data(SArray(vals))
-            if kvs.lens is not None:
-                msg.add_data(SArray(np.asarray(kvs.lens, dtype=np.int32)))
+            if wire is not None:
+                codes, scales, lens_arr, ci = wire
+                m.codec = ci
+                m.val_len = ci.raw_len
+                msg.add_data(codes if isinstance(codes, SArray)
+                             else SArray(codes))
+                msg.add_data(scales if isinstance(scales, SArray)
+                             else SArray(scales))
+                if lens_arr is not None:
+                    msg.add_data(
+                        SArray(np.asarray(lens_arr, dtype=np.int32))
+                    )
+            else:
+                msg.add_data(SArray(vals))
+                if kvs.lens is not None:
+                    msg.add_data(
+                        SArray(np.asarray(kvs.lens, dtype=np.int32))
+                    )
             try:
                 van.send(msg)
                 self._c_forwarded.inc()

@@ -120,6 +120,27 @@ OPT_XFER_PART = 6
 
 
 @dataclass(frozen=True)
+class CodecInfo:
+    """Wire-compression extension (docs/compression.md): the payload's
+    vals travel as ``[codes(u8), scales(f32)(, lens(i32))]`` encoded by
+    the codec registry (``ops/codecs.py``).  Rides the tagged
+    ``EXT_CODEC`` meta extension — NOT ``meta.option`` — so it composes
+    with OPT_REPLICA forwards, OPT_ZPULL, and re-chunking, and the
+    native lanes' template packing carries it untouched (EXT_CHUNK
+    stays the trailing extension).
+
+    On a pull REQUEST, ``raw_len == 0`` means "encode your response
+    slice with this codec"; on a push request / pull response,
+    ``raw_len`` is the uncompressed payload byte count the decoder
+    sizes from."""
+
+    codec: int = 0     # registry wire id (codecs.by_wire_id)
+    raw_len: int = 0   # uncompressed vals byte count (0 = request)
+    block: int = 0     # elements per scale block (0 = scale-free)
+    flags: int = 0     # codecs.FLAG_* bits (e.g. int8 NaN sentinels)
+
+
+@dataclass(frozen=True)
 class ChunkInfo:
     """Chunked-transfer wire extension (docs/chunking.md): one large
     data message travels as ``total`` chunk messages, each carrying a
@@ -230,6 +251,12 @@ class Meta:
     # message as ONE chunk of a larger transfer.  Travels as a tagged
     # wire extension like ``trace`` — old decoders skip it by length.
     chunk: Optional[ChunkInfo] = None
+    # Wire compression (docs/compression.md): non-None marks the vals
+    # payload as codec-encoded (or, on a pull request with raw_len=0,
+    # asks the server to encode its response).  Tagged EXT_CODEC
+    # extension, packed BEFORE the chunk extension so EXT_CHUNK stays
+    # the meta's trailing bytes (the native splitter's patch contract).
+    codec: Optional[CodecInfo] = None
     src_dev_type: int = int(DeviceType.UNK)
     src_dev_id: int = -1
     dst_dev_type: int = int(DeviceType.UNK)

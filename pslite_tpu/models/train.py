@@ -265,6 +265,71 @@ def make_pp_train_step(cfg: ModelConfig, mesh, lr: float = 0.1,
     return step, (stacked, head), token_sharding
 
 
+def kv_train_loop(worker, cfg: ModelConfig, steps: int = 30,
+                  lr: float = 0.5, batch: int = 8, seq: int = 16,
+                  codec=None, pull_codec="raw", seed: int = 0,
+                  data_seed: int = 1, val_len: int = 1024):
+    """Train the toy LM over the MESSAGE-PATH parameter server: the
+    flat parameter vector lives in the KV store (``KVServerDefaultHandle``
+    on the server side), and each step pulls params, computes the
+    gradient locally (jit), and pushes ``-lr * grad`` as the delta —
+    the async-PS loop of the reference, on the wire instead of the
+    collective plane.
+
+    ``codec`` compresses the gradient-delta PUSHES through the
+    quantized transport tier (docs/compression.md) — the classic
+    EF-SGD setting; ``pull_codec`` (default ``"raw"``) optionally
+    compresses the parameter pulls too (each gradient is then computed
+    at a perturbed point, which shifts the trajectory beyond what
+    error feedback alone corrects — see the guard test).  The initial
+    parameter seed always travels raw so compressed and uncompressed
+    runs start from identical state.  This is the convergence-guard
+    harness: with ``fp8_e4m3`` + error feedback the final loss must
+    land within tolerance of the uncompressed run
+    (tests/test_model_train.py).
+
+    Returns the per-step loss list.
+    """
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .transformer import loss_fn
+
+    params0 = init_params(jax.random.PRNGKey(seed), cfg)
+    flat0, unravel = jax.flatten_util.ravel_pytree(params0)
+    flat0 = np.asarray(flat0, np.float32)
+    n = flat0.size
+    pad = (-n) % val_len
+    flat_pad = np.concatenate([flat0, np.zeros(pad, np.float32)])
+    keys = np.arange(flat_pad.size // val_len, dtype=np.uint64)
+
+    @jax.jit
+    def grad_fn(flat, inp, tgt):
+        loss, g = jax.value_and_grad(
+            lambda f: loss_fn(unravel(f[:n]), inp, tgt, cfg)
+        )(flat)
+        return loss, g
+
+    inputs, targets = toy_batch(cfg, batch, seq, seed=data_seed)
+    # Seed the store with the exact initial params (raw: both runs of a
+    # comparison must start bit-identical), then train through the
+    # registered bucket codec.
+    worker.wait(worker.push(keys, flat_pad, codec="raw"))
+    worker.register_bucket(keys, codec=codec)
+    buf = np.empty_like(flat_pad)
+    losses = []
+    for _ in range(steps):
+        worker.wait(worker.pull(keys, buf, codec=pull_codec))
+        loss, g = grad_fn(jnp.asarray(buf), inputs, targets)
+        # g is padded-length (grad of the padded flat vector; the pad
+        # tail is exactly zero since loss only reads f[:n]).
+        worker.wait(worker.push(keys, (-lr) * np.asarray(g, np.float32)))
+        losses.append(float(loss))
+    return losses
+
+
 def toy_batch(cfg: ModelConfig, batch: int, seq: int, seed: int = 1):
     """Deterministic toy LM data: predict (token + 1) mod vocab."""
     import numpy as np

@@ -1,0 +1,904 @@
+"""Unified wire-compression codec tier (docs/compression.md).
+
+The message path's one-off ``compress='int8'`` grew into a registry of
+codecs usable on BOTH planes and at every layer of the transport:
+
+- ``int8``   — blockwise symmetric int8 (4x), the EQuARX-style trade.
+- ``fp8_e4m3`` — blockwise-scaled float8 e4m3fn (4x), finer small-value
+  resolution than int8 at the same wire cost.
+- ``bf16``   — round-to-nearest bfloat16 truncation (2x), scale-free.
+
+Wire layout (both directions): ``data = [keys, codes(u8), scales(f32)
+(, lens(i32))]`` with the codec identity riding the ``EXT_CODEC`` meta
+extension (:class:`~..message.CodecInfo`) — NOT ``meta.option`` — so it
+survives replication forwards (which use ``OPT_REPLICA``), re-chunking,
+rail striping, and the native lanes' template packing unchanged.
+
+Blockwise scaling: flat fixed-``k`` payloads use one fp32 scale per
+``block`` (128) elements (last block ragged, nothing padded on the
+wire).  Ragged ``lens`` payloads scale **per key**: each key's segment
+gets its own ceil(len/block) blocks, so one key's outlier can never
+flatten a neighbour's resolution.
+
+Error feedback (:class:`ErrorFeedback`): per-destination residual
+accumulators — the quantization error of round N is folded into round
+N+1 before encoding (EF-SGD), which is what keeps async training loss
+at parity with the uncompressed run (the convergence guard in
+``tests/test_model_train.py``).  ``PS_CODEC_EF=0`` disables.
+
+Throughput: encode/decode parallelize across a process-wide thread
+pool (``PS_CODEC_THREADS``, default ``min(12, cpus)``) on block-aligned
+spans — numpy releases the GIL for the large ops, so spans scale to
+memory bandwidth (int8 encode ~7 GB/s on a 24-core host vs ~0.25
+single-thread).  Span boundaries never straddle a scale block, so the
+output is bit-identical for every thread count, including serial.
+
+NaN/Inf policy (tested in ``tests/test_ops.py``): NaN propagates
+(bf16/fp8 natively; int8 via the reserved ``-128`` code, flagged in
+``CodecInfo.flags`` so the decode fast path stays mask-free); +/-Inf
+saturates to the block's max representable magnitude (bf16 keeps Inf).
+Scales are always computed over the FINITE magnitudes only, so one bad
+element cannot zero out its whole block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import logging as log
+
+try:  # pragma: no cover - availability depends on environment
+    import ml_dtypes as _mld
+
+    _BF16 = np.dtype(_mld.bfloat16)
+    _FP8 = getattr(_mld, "float8_e4m3fn", None)
+    _FP8 = np.dtype(_FP8) if _FP8 is not None else None
+except ImportError:  # pragma: no cover
+    _mld = None
+    _BF16 = None
+    _FP8 = None
+
+BLOCK = 128  # elements per scale block (matches ops/quantize.py lanes)
+
+# CodecInfo.flags bits.
+FLAG_HAS_NAN = 1  # int8 payload contains -128 NaN sentinels
+
+_PAR_MIN_BYTES = 1 << 21  # parallelize encode/decode above 2 MiB
+
+
+# -- span thread pool --------------------------------------------------------
+
+_pool = None
+_pool_mu = threading.Lock()
+_tls = threading.local()
+
+
+def codec_threads() -> int:
+    """Worker count of the span pool (``PS_CODEC_THREADS``; 0=serial)."""
+    raw = os.environ.get("PS_CODEC_THREADS", "")
+    if raw.strip():
+        return max(0, int(raw))
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n = os.cpu_count() or 1
+    return min(12, n)
+
+
+def _get_pool():
+    global _pool
+    with _pool_mu:
+        if _pool is None:
+            import concurrent.futures
+
+            _pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, codec_threads()),
+                thread_name_prefix="codec-span",
+            )
+        return _pool
+
+
+def _scratch(n: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-thread scratch (two float32 + one float16) of >= n elements.
+    Fresh per-call allocations of multi-MB temporaries convoy every
+    span thread on the kernel's mmap lock (measured 3-6x slowdown);
+    persistent thread-local scratch keeps the kernels at memory
+    bandwidth."""
+    s = getattr(_tls, "bufs", None)
+    if s is None or s[0].size < n:
+        s = (np.empty(n, np.float32), np.empty(n, np.float32),
+             np.empty(n, np.float16))
+        _tls.bufs = s
+    return s
+
+
+def _spans(n_elems: int, min_elems: int) -> List[Tuple[int, int]]:
+    """Block-aligned span partition of ``n_elems`` across the pool (or
+    one span when small/serial)."""
+    nt = codec_threads()
+    if nt <= 1 or n_elems * 4 < _PAR_MIN_BYTES:
+        return [(0, n_elems)]
+    blocks = (n_elems + min_elems - 1) // min_elems
+    per = (blocks + nt - 1) // nt * min_elems
+    return [(a, min(a + per, n_elems))
+            for a in range(0, n_elems, per)]
+
+
+def _run_spans(fn, spans) -> None:
+    if len(spans) == 1:
+        fn(*spans[0])
+        return
+    list(_get_pool().map(lambda ab: fn(*ab), spans))
+
+
+# -- output buffer pool ------------------------------------------------------
+
+
+def _free_block_refcount() -> int:
+    """Calibrated CPython refcount of a block referenced only by the
+    pool list + the probe argument (the tcp _RecvPool idiom): an
+    interpreter that counts temporaries differently degrades to
+    never-reuse (safe), not use-after-reuse."""
+    import sys
+
+    probe = [np.empty(0, np.uint8)]
+    return sys.getrefcount(probe[0])
+
+
+_FREE_REFS = _free_block_refcount()
+
+
+class _BufPool:
+    """Recycles the codec tier's LARGE outputs (encode codes, decode
+    vals).  A fresh multi-MB ``np.empty`` per call costs soft page
+    faults on first touch that dominate the kernels (measured: 64 MiB
+    decode 1.9 GB/s fresh vs 22.9 GB/s into warm pages — the same
+    effect PR 6's FramePool fixed on the receive path).  Safety is the
+    refcount probe: a block is handed out again only when every derived
+    view (message SArrays, kvs.vals, store segs) is dead."""
+
+    _MAX_ENTRIES = 32
+
+    def __init__(self, budget_mb: int):
+        self._mu = threading.Lock()
+        self._entries: List[np.ndarray] = []
+        self._total = 0
+        self._budget = budget_mb << 20  # <= 0 disables pooling
+
+    def take(self, nbytes: int) -> np.ndarray:
+        """A uint8 block of >= nbytes (callers slice + view it; the
+        view's base ref is what marks the block busy)."""
+        import sys
+
+        cls = 1 << max(16, (max(nbytes, 1) - 1).bit_length())
+        if cls > self._budget:
+            return np.empty(nbytes, np.uint8)
+        with self._mu:
+            best = -1
+            for i in range(len(self._entries)):
+                if (self._entries[i].nbytes >= nbytes
+                        and sys.getrefcount(self._entries[i])
+                        == _FREE_REFS
+                        and (best < 0 or self._entries[i].nbytes
+                             < self._entries[best].nbytes)):
+                    best = i
+            if best >= 0:
+                return self._entries[best]
+            block = np.empty(cls, np.uint8)
+            if (self._total + cls > self._budget
+                    or len(self._entries) >= self._MAX_ENTRIES):
+                # Evict free smaller blocks, smallest first, to admit
+                # the new size class (direct indexing: a local binding
+                # would perturb the free baseline).
+                for i in sorted(range(len(self._entries)),
+                                key=lambda j: self._entries[j].nbytes):
+                    if (self._total + cls <= self._budget
+                            and len(self._entries) < self._MAX_ENTRIES):
+                        break
+                    if (self._entries[i] is not None
+                            and self._entries[i].nbytes < cls
+                            and sys.getrefcount(self._entries[i])
+                            == _FREE_REFS):
+                        self._total -= self._entries[i].nbytes
+                        self._entries[i] = None
+                self._entries = [e for e in self._entries
+                                 if e is not None]
+            if (len(self._entries) < self._MAX_ENTRIES
+                    and self._total + cls <= self._budget):
+                self._entries.append(block)
+                self._total += cls
+            return block
+
+
+_buf_pool: Optional[_BufPool] = None
+
+
+def _take_buf(nbytes: int) -> np.ndarray:
+    """Process-global pooled block (``PS_CODEC_POOL_MB``, default 256;
+    0 disables pooling)."""
+    global _buf_pool
+    if _buf_pool is None:
+        with _pool_mu:
+            if _buf_pool is None:
+                _buf_pool = _BufPool(int(
+                    os.environ.get("PS_CODEC_POOL_MB", "256") or "256"
+                ))
+    return _buf_pool.take(nbytes)
+
+
+# -- native fused kernels ----------------------------------------------------
+
+_native_lib = None
+_native_probed = False
+
+
+def _native_codec():
+    """The C core's fused codec kernels (``psl_codec_encode/decode``,
+    docs/compression.md), or None (pure numpy).  One span call does
+    block-max + quantize + EF update in a single pass over the data —
+    ~5 bytes of traffic per element vs the numpy fallback's ~40 — and
+    ctypes releases the GIL for its duration.  Output is BIT-IDENTICAL
+    to the numpy path by construction (asserted in tests/test_ops.py),
+    so mixed native/pure-Python clusters stay interoperable.
+    ``PS_CODEC_NATIVE=0`` forces numpy (PS_NATIVE=0 also applies, via
+    ``vans.native.load``)."""
+    global _native_lib, _native_probed
+    if _native_probed:
+        return _native_lib
+    with _pool_mu:
+        if _native_probed:
+            return _native_lib
+        lib = None
+        if os.environ.get("PS_CODEC_NATIVE", "1") not in ("0", "false"):
+            try:
+                from ..vans import native as native_mod
+
+                lib = native_mod.load()
+            except Exception:  # noqa: BLE001 - loader must never raise here
+                lib = None
+        if lib is not None and _FP8 is not None:
+            enc, dec = Fp8E4M3Codec._luts()
+            lib.psl_codec_set_fp8_tables(enc.ctypes.data,
+                                         dec.ctypes.data)
+        _native_lib = lib
+        _native_probed = True
+    return _native_lib
+
+
+# -- blockwise scale helpers -------------------------------------------------
+
+
+def n_blocks(n_elems: int, lens=None) -> int:
+    """Scale count of a payload: flat blocks, or per-key blocks when
+    ``lens`` (per-key element counts) is given."""
+    if lens is None:
+        return (n_elems + BLOCK - 1) // BLOCK
+    lens = np.asarray(lens, dtype=np.int64)
+    return int(((lens + BLOCK - 1) // BLOCK).sum())
+
+
+def _key_block_starts(lens: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(block start offsets, block sizes) of a per-key blockwise layout:
+    each key's ragged segment is cut into its own ceil(len/BLOCK)
+    blocks, so scales never mix neighbouring keys."""
+    lens = np.asarray(lens, dtype=np.int64)
+    nb = (lens + BLOCK - 1) // BLOCK
+    nb0 = np.maximum(nb, 0)
+    total = int(nb0.sum())
+    key_starts = np.concatenate(([0], np.cumsum(lens)))[:-1]
+    kidx = np.repeat(np.arange(len(lens)), nb0)
+    first = np.concatenate(([0], np.cumsum(nb0)))[:-1]
+    within = np.arange(total) - np.repeat(first, nb0)
+    starts = key_starts[kidx] + within * BLOCK
+    ends = np.minimum(starts + BLOCK,
+                      np.repeat(key_starts + lens, nb0))
+    return starts.astype(np.int64), (ends - starts).astype(np.int64)
+
+
+class Codec:
+    """One compression scheme: float32 payload <-> (codes u8, scales
+    f32).  ``encode`` optionally FUSES error feedback: when ``resid``
+    is given, the effective payload is ``vals + resid`` and ``resid``
+    is updated in place to the new quantization error."""
+
+    name: str = ""
+    wire_id: int = 0
+    block: int = BLOCK
+    code_bytes_per_elem: int = 1
+
+    def encode(self, vals: np.ndarray, lens=None,
+               resid: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """-> (codes uint8, scales float32, flags)."""
+        raise NotImplementedError
+
+    def decode(self, codes: np.ndarray, scales: np.ndarray, n: int,
+               lens=None, flags: int = 0) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- shared validation ---------------------------------------------------
+
+    def _check_input(self, vals: np.ndarray, lens) -> np.ndarray:
+        if vals is None or vals.size == 0:
+            raise ValueError(
+                f"codec {self.name!r}: cannot encode empty vals"
+            )
+        log.check(
+            vals.dtype == np.float32,
+            f"codec {self.name!r} requires float32 values, got "
+            f"{vals.dtype}",
+        )
+        v = vals.reshape(-1)
+        if lens is not None:
+            lens = np.asarray(lens, dtype=np.int64)
+            log.check(
+                int(lens.sum()) == v.size,
+                f"codec {self.name!r}: lens sum {int(lens.sum())} != "
+                f"vals size {v.size}",
+            )
+        return np.ascontiguousarray(v)
+
+
+class _BlockCodec(Codec):
+    """Shared machinery of the blockwise-scaled codecs (int8 / fp8):
+    span-parallel, allocation-free flat path (thread-local scratch, all
+    ufuncs ``out=``-targeted — the kernels are memory-bandwidth-bound,
+    so stray temporaries cost real throughput); per-key reduceat path
+    for ``lens``."""
+
+    qmax: float = 0.0
+
+    # subclass hooks ---------------------------------------------------------
+
+    def _quantize_into(self, y: np.ndarray, out_u8: np.ndarray,
+                       maybe_nonfinite: bool) -> bool:
+        """y: scaled values (mutable scratch, |y| <= qmax except
+        non-finite); write codes into out_u8; returns True when NaN
+        sentinels were emitted."""
+        raise NotImplementedError
+
+    def _reconstruct_into(self, codes_u8: np.ndarray,
+                          out_f32: np.ndarray) -> None:
+        """codes -> unscaled float32 values (NaN decoding deferred)."""
+        raise NotImplementedError
+
+    def _reconstruct(self, codes_u8: np.ndarray) -> np.ndarray:
+        out = np.empty(codes_u8.size, np.float32)
+        self._reconstruct_into(codes_u8, out)
+        return out
+
+    # -- encode --------------------------------------------------------------
+
+    def encode(self, vals, lens=None, resid=None):
+        v = self._check_input(vals, lens)
+        if resid is not None:
+            log.check(resid.size == v.size,
+                      "error-feedback residual shape drifted")
+        if lens is not None:
+            return self._encode_ragged(v, lens, resid)
+        n = v.size
+        codes = _take_buf(n)[:n]
+        scales = np.empty(n_blocks(n), np.float32)
+        lib = _native_codec() if self._kind >= 0 else None
+        if lib is not None:
+            # ONE call for the whole payload: the span fan-out runs on
+            # the core's persistent thread pool behind a single GIL
+            # release — Python-side span dispatch pays a GIL handoff
+            # per span, which a busy pump stretches by ~5 ms each.
+            rc = lib.psl_codec_encode_mt(
+                self._kind, v.ctypes.data,
+                resid.ctypes.data if resid is not None else 0,
+                n, BLOCK, codes.ctypes.data, scales.ctypes.data,
+                codec_threads(),
+            )
+            if rc >= 0:
+                return codes, scales, rc
+        spans = _spans(n, BLOCK)
+        flags = [False] * len(spans)
+
+        def one(si, a, b):
+            flags[si] = self._encode_span(v, a, b, codes, scales, resid)
+
+        if len(spans) == 1:
+            one(0, *spans[0])
+        else:
+            list(_get_pool().map(
+                lambda t: one(t[0], t[1][0], t[1][1]), enumerate(spans)
+            ))
+        return codes, scales, (FLAG_HAS_NAN if any(flags) else 0)
+
+    def _span_scales(self, y_abs: np.ndarray, full: int, m: int
+                     ) -> Tuple[np.ndarray, bool]:
+        """Per-block scales of one span from its |x| scratch; returns
+        (scales, maybe_nonfinite).  Non-finite inputs surface as
+        non-finite block maxes (NaN/Inf propagate through max) and are
+        recomputed over finite entries only — the rare path pays, the
+        hot path stays one reduction."""
+        parts = []
+        bad_any = False
+        if full:
+            sl = y_abs[:full].reshape(-1, BLOCK).max(axis=1)
+            bad = ~np.isfinite(sl)
+            if bad.any():
+                bad_any = True
+                rows = np.nonzero(bad)[0]
+                ya = y_abs[:full].reshape(-1, BLOCK)[rows]
+                sl[rows] = np.where(np.isfinite(ya), ya, 0.0).max(axis=1)
+            parts.append(sl)
+        if m > full:
+            t = float(y_abs[full:m].max())
+            if not np.isfinite(t):
+                bad_any = True
+                ya = y_abs[full:m]
+                fin = ya[np.isfinite(ya)]
+                t = float(fin.max()) if fin.size else 0.0
+            parts.append(np.array([t], np.float32))
+        sl_all = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        np.maximum(sl_all, 1e-12, out=sl_all)
+        sl_all /= self.qmax
+        return sl_all.astype(np.float32, copy=False), bad_any
+
+    # C-kernel codec id (psl_codec_encode/decode); -1 = numpy only.
+    _kind = -1
+
+    def _encode_span(self, v, a, b, codes, scales, resid) -> bool:
+        """Encode [a, b) (block-aligned start): scale, quantize, and —
+        when ``resid`` is given — fold + update the residual, all on
+        this span's slice with zero fresh allocations (the numpy
+        fallback of the fused C kernel; bit-identical by construction,
+        asserted in tests/test_ops.py)."""
+        m = b - a
+        full = m - (m % BLOCK)
+        eff_b, y_b, _ = _scratch(m)
+        if resid is not None:
+            eff = eff_b[:m]
+            np.add(v[a:b], resid[a:b], out=eff)
+        else:
+            eff = v[a:b]
+        y = y_b[:m]
+        np.abs(eff, out=y)
+        sl, maybe_bad = self._span_scales(y, full, m)
+        sb = a // BLOCK
+        scales[sb: sb + sl.size] = sl
+        # Scale into the y scratch (multiply by reciprocal: measurably
+        # faster than divide at these sizes), then quantize in place.
+        if full:
+            np.multiply(eff[:full].reshape(-1, BLOCK),
+                        (np.float32(1.0) / sl[: full // BLOCK])[:, None],
+                        out=y[:full].reshape(-1, BLOCK))
+        if m > full:
+            np.multiply(eff[full:], np.float32(1.0) / sl[-1],
+                        out=y[full:])
+        has_nan = self._quantize_into(y, codes[a:b], maybe_bad)
+        if resid is not None:
+            # Reconstruct into the y scratch (the quantized floats are
+            # spent) and leave the new residual in place.
+            dec = y
+            self._reconstruct_into(codes[a:b], dec)
+            if full:
+                d2 = dec[:full].reshape(-1, BLOCK)
+                d2 *= sl[: full // BLOCK, None]
+            if m > full:
+                dec[full:] *= sl[-1]
+            np.subtract(eff, dec, out=resid[a:b])
+            if maybe_bad or has_nan:
+                # NaN/Inf inputs must not poison later rounds through
+                # the residual: their error is defined as zero.
+                r = resid[a:b]
+                r[~np.isfinite(r)] = 0.0
+        return has_nan
+
+    def _encode_ragged(self, v, lens, resid):
+        """Per-key blockwise path (``lens`` payloads): reduceat over
+        key-local block boundaries — no padding, scales never straddle
+        keys."""
+        if resid is not None:
+            eff = v + resid
+        else:
+            eff = v
+        starts, sizes = _key_block_starts(np.asarray(lens))
+        absx = np.abs(eff)
+        bad = not bool(np.isfinite(absx).all())
+        if bad:
+            absx = np.where(np.isfinite(absx), absx, 0.0)
+        sl = np.maximum.reduceat(absx, starts).astype(np.float32)
+        np.maximum(sl, 1e-12, out=sl)
+        sl /= self.qmax
+        per_elem = np.repeat(sl, sizes)
+        y = eff / per_elem
+        codes = _take_buf(v.size)[: v.size]
+        has_nan = self._quantize_into(y, codes, bad)
+        if resid is not None:
+            dec = self._reconstruct(codes)
+            dec *= per_elem
+            err = eff - dec
+            if bad or has_nan:
+                err[~np.isfinite(err)] = 0.0
+            resid[:] = err
+        return codes, sl, (FLAG_HAS_NAN if has_nan else 0)
+
+    # -- decode --------------------------------------------------------------
+
+    def decode(self, codes, scales, n, lens=None, flags=0):
+        codes = np.ascontiguousarray(codes).reshape(-1)[:n]
+        scales = np.ascontiguousarray(scales, np.float32).reshape(-1)
+        log.check(codes.size == n,
+                  f"codec {self.name!r}: short payload "
+                  f"({codes.size} codes for {n} values)")
+        expect = n_blocks(n, lens)
+        log.check(scales.size >= expect,
+                  f"codec {self.name!r}: scale table too short "
+                  f"({scales.size} < {expect})")
+        out = _take_buf(4 * n)[: 4 * n].view(np.float32)
+        if lens is not None:
+            starts, sizes = _key_block_starts(np.asarray(lens))
+            self._reconstruct_into(codes, out)
+            out *= np.repeat(scales[:expect], sizes)
+            if flags & FLAG_HAS_NAN:
+                self._apply_nan(codes, out)
+            return out
+
+        lib = _native_codec() if self._kind >= 0 else None
+        if lib is not None:
+            rc = lib.psl_codec_decode_mt(
+                self._kind, codes.ctypes.data, scales.ctypes.data,
+                n, BLOCK, flags, out.ctypes.data, codec_threads(),
+            )
+            if rc >= 0:
+                return out
+
+        def one(a, b):
+            m = b - a
+            full = m - (m % BLOCK)
+            seg = out[a:b]
+            self._reconstruct_into(codes[a:b], seg)
+            if full:
+                d2 = seg[:full].reshape(-1, BLOCK)
+                d2 *= scales[a // BLOCK: a // BLOCK + full // BLOCK,
+                             None]
+            if m > full:
+                seg[full:] *= scales[(a + full) // BLOCK]
+
+        _run_spans(one, _spans(n, BLOCK))
+        if flags & FLAG_HAS_NAN:
+            self._apply_nan(codes, out)
+        return out
+
+    def _apply_nan(self, codes, out) -> None:
+        """Restore NaN for sentinel codes (int8 only; fp8/bf16 decode
+        NaN natively so this is a no-op there)."""
+
+
+class Int8Codec(_BlockCodec):
+    """Blockwise symmetric int8: code = clip(rint(x/scale), -127, 127)
+    with scale = finite-max|block| / 127.  NaN rides the reserved -128
+    code; +/-Inf saturates to +/-127."""
+
+    name = "int8"
+    wire_id = 1
+    qmax = 127.0
+    _kind = 0  # psl_codec_* kernel id
+
+    def _quantize_into(self, y, out_u8, maybe_nonfinite):
+        np.rint(y, out=y)
+        np.clip(y, -127, 127, out=y)  # Inf saturates; NaN passes
+        has_nan = False
+        if maybe_nonfinite and not np.isfinite(y).all():
+            nan = np.isnan(y)
+            has_nan = bool(nan.any())
+            y[nan] = -128.0
+        out_u8.view(np.int8)[:] = y  # float->int8 cast, no temporary
+        return has_nan
+
+    def _reconstruct_into(self, codes_u8, out_f32):
+        out_f32[:] = codes_u8.view(np.int8)
+
+    def _apply_nan(self, codes, out) -> None:
+        out[codes.view(np.int8) == -128] = np.nan
+
+
+class Fp8E4M3Codec(_BlockCodec):
+    """Blockwise-scaled float8 e4m3fn: x/scale clipped into [-448, 448]
+    then cast RNE (via a float16 intermediate + 64K lookup table — the
+    direct ml_dtypes cast is ~2x slower and the double rounding moves
+    <0.3% of values by half an e4m3 ulp).  NaN propagates natively
+    (0x7f); +/-Inf saturates to +/-448*scale."""
+
+    name = "fp8_e4m3"
+    wire_id = 2
+    qmax = 448.0
+    _kind = 1  # psl_codec_* kernel id
+    _enc_lut: Optional[np.ndarray] = None
+    _dec_lut: Optional[np.ndarray] = None
+
+    @classmethod
+    def _luts(cls):
+        if cls._enc_lut is None:
+            h = np.arange(65536, dtype=np.uint16).view(np.float16)
+            with np.errstate(invalid="ignore"):  # f16 NaN patterns
+                cls._enc_lut = np.ascontiguousarray(
+                    h.astype(np.float32).astype(_FP8).view(np.uint8)
+                )
+            cls._dec_lut = np.ascontiguousarray(
+                np.arange(256, dtype=np.uint8).view(_FP8).astype(
+                    np.float32
+                )
+            )
+        return cls._enc_lut, cls._dec_lut
+
+    def _quantize_into(self, y, out_u8, maybe_nonfinite):
+        enc, _ = self._luts()
+        np.clip(y, -self.qmax, self.qmax, out=y)  # Inf saturates
+        _, _, h_b = _scratch(y.size)
+        y16 = h_b[: y.size]
+        with np.errstate(invalid="ignore"):  # NaN passes through
+            y16[:] = y  # f32 -> f16 RNE cast into scratch
+        np.take(enc, y16.view(np.uint16), out=out_u8)
+        return False  # NaN is a native e4m3fn encoding
+
+    def _reconstruct_into(self, codes_u8, out_f32):
+        _, dec = self._luts()
+        np.take(dec, codes_u8, out=out_f32)
+
+
+class Bf16Codec(Codec):
+    """Round-to-nearest-even bfloat16 (2 bytes/element, no scales).
+    NaN and +/-Inf propagate exactly."""
+
+    name = "bf16"
+    wire_id = 3
+    block = 0
+    code_bytes_per_elem = 2
+
+    def encode(self, vals, lens=None, resid=None):
+        v = self._check_input(vals, lens)
+        n = v.size
+        codes = _take_buf(2 * n)[: 2 * n]
+        if _BF16 is not None:
+            c16 = codes.view(_BF16)
+        else:  # numpy fallback: RNE truncation with NaN guard
+            c16 = codes.view(np.uint16)
+
+        def one(a, b):
+            if resid is not None:
+                eff_b, _, _ = _scratch(b - a)
+                eff = eff_b[: b - a]
+                np.add(v[a:b], resid[a:b], out=eff)
+            else:
+                eff = v[a:b]
+            if _BF16 is not None:
+                c16[a:b] = eff.astype(_BF16)
+                if resid is not None:
+                    dec = c16[a:b].astype(np.float32)
+                    np.subtract(eff, dec, out=dec)
+                    bad = ~np.isfinite(dec)
+                    if bad.any():
+                        dec[bad] = 0.0
+                    resid[a:b] = dec
+            else:
+                u = eff.view(np.uint32)
+                r = ((u >> 16) & 1) + 0x7FFF
+                out = ((u + r) >> 16).astype(np.uint16)
+                nan = np.isnan(eff)
+                if nan.any():
+                    out[nan] = 0x7FC0 | (out[nan] & 0x8000)
+                c16[a:b] = out
+                if resid is not None:
+                    dec = (
+                        out.astype(np.uint32) << 16
+                    ).view(np.float32).astype(np.float32)
+                    err = eff - dec
+                    err[~np.isfinite(err)] = 0.0
+                    resid[a:b] = err
+
+        _run_spans(one, _spans(n, 1024))
+        return codes, np.empty(0, np.float32), 0
+
+    def decode(self, codes, scales, n, lens=None, flags=0):
+        codes = np.ascontiguousarray(codes).reshape(-1)[: 2 * n]
+        log.check(codes.size == 2 * n,
+                  f"codec bf16: short payload ({codes.size} bytes for "
+                  f"{n} values)")
+        out = _take_buf(4 * n)[: 4 * n].view(np.float32)
+        c16u = codes.view(np.uint16)
+
+        def one(a, b):
+            # Exact bit widening (bf16 is the top half of f32 —
+            # subnormals, NaN and Inf included): zero-extend into the
+            # output's own memory, then shift in place.  No temporaries
+            # and ~8x faster than the elementwise ml_dtypes cast.
+            u = out[a:b].view(np.uint32)
+            u[:] = c16u[a:b]
+            u <<= 16
+
+        _run_spans(one, _spans(n, 1024))
+        return out
+
+
+_REGISTRY: Dict[str, Codec] = {}
+_BY_WIRE_ID: Dict[int, Codec] = {}
+
+
+def _register(c: Codec) -> None:
+    _REGISTRY[c.name] = c
+    _BY_WIRE_ID[c.wire_id] = c
+
+
+_register(Int8Codec())
+_register(Bf16Codec())
+if _FP8 is not None:  # fp8 needs ml_dtypes' e4m3fn
+    _register(Fp8E4M3Codec())
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_codec(name: str) -> Codec:
+    c = _REGISTRY.get(name)
+    log.check(
+        c is not None,
+        f"unknown codec {name!r} (available: {', '.join(names())})",
+    )
+    return c
+
+
+def by_wire_id(wire_id: int) -> Codec:
+    c = _BY_WIRE_ID.get(wire_id)
+    log.check(c is not None, f"unknown codec wire id {wire_id}")
+    return c
+
+
+def check_block(info) -> None:
+    """Fail LOUDLY if a wire CodecInfo carries a scale-block length
+    this build cannot decode: the decoders index scales by the local
+    ``BLOCK``, so silently accepting a foreign block size would apply
+    scales at wrong boundaries and produce garbage values."""
+    log.check(
+        info.block in (0, BLOCK),
+        f"wire codec block {info.block} != local {BLOCK}; peers must "
+        f"agree on the scale-block length",
+    )
+
+
+# -- sharded (range) decode --------------------------------------------------
+
+
+def decode_key_ranges(codes, scales, info, n_keys: int,
+                      positions=None) -> List[np.ndarray]:
+    """Decode only the given keys' value segments of a fixed-``k``
+    codec payload (``info``: the wire CodecInfo) — one owned float32
+    segment per key, values bit-identical to the corresponding slices
+    of the full decode.
+
+    This is what lets the apply pool decode ON THE SHARD THREADS
+    (docs/compression.md): each shard decodes exactly its keys, in
+    parallel, instead of one whole-payload decode serializing the
+    server's receive pump — and a priority op can jump the shard queue
+    ahead of the bulk decode work."""
+    codec = by_wire_id(info.codec)
+    check_block(info)
+    n = info.raw_len // 4
+    k = n // max(n_keys, 1)
+    log.check(n_keys > 0 and n % n_keys == 0,
+              "decode_key_ranges needs a fixed-k payload")
+    log.check(getattr(codec, "_kind", -1) >= 0,
+              f"codec {codec.name!r} has no range decode")
+    if positions is None:
+        pos = np.arange(n_keys, dtype=np.int64)
+    else:
+        pos = np.asarray(positions, dtype=np.int64)
+    m = int(pos.size) * k
+    out = _take_buf(4 * m)[: 4 * m].view(np.float32)
+    codes = np.ascontiguousarray(codes).reshape(-1)
+    scales = np.ascontiguousarray(scales, np.float32).reshape(-1)
+    lib = _native_codec() if getattr(codec, "_kind", -1) >= 0 else None
+    done = False
+    if lib is not None and m:
+        starts = (pos * k).astype(np.uint64)
+        ends = starts + np.uint64(k)
+        rc = lib.psl_codec_decode_ranges(
+            codec._kind, codes.ctypes.data, scales.ctypes.data,
+            starts.ctypes.data, ends.ctypes.data, int(pos.size),
+            BLOCK, info.flags, out.ctypes.data,
+        )
+        done = rc >= 0
+    if not done:
+        off = 0
+        for p in pos:
+            s = int(p) * k
+            seg = out[off: off + k]
+            codec._reconstruct_into(codes[s: s + k], seg)
+            seg *= scales[(np.arange(s, s + k) // BLOCK)]
+            if info.flags & FLAG_HAS_NAN and codec._kind == 0:
+                seg[codes[s: s + k].view(np.int8) == -128] = np.nan
+            off += k
+    return [out[i * k: (i + 1) * k] for i in range(int(pos.size))]
+
+
+# -- error feedback ----------------------------------------------------------
+
+
+class ErrorFeedback:
+    """Bounded per-destination residual accumulators (EQuARX-style EF).
+
+    One slot per (destination, key-slice) holds the float32 quantization
+    error of the last encode toward that destination; the next encode of
+    the same slice folds it back in (``Codec.encode(..., resid=slot)``).
+    Residuals live where the ENCODER runs — the worker for pushes, the
+    server (``KVServerDefaultHandle.ef_bank``) for pull responses,
+    sharded naturally by the apply pool's per-sender response gate.
+
+    Memory is bounded to ``max_slots`` slices (``PS_CODEC_EF_SLOTS``,
+    default 64); eviction is LRU and LOUD — a dropped residual means one
+    round's quantization error is lost, which EF-SGD tolerates but the
+    operator should know about.  ``residual_norm()`` backs the
+    ``ef.residual_norm`` telemetry gauge.
+    """
+
+    def __init__(self, max_slots: int = 64, metrics=None):
+        self._mu = threading.Lock()
+        self._slots: Dict[tuple, np.ndarray] = {}
+        self._locks: Dict[tuple, threading.Lock] = {}
+        self._lru: List[tuple] = []
+        self.max_slots = max(1, max_slots)
+        self.evictions = 0
+        if metrics is not None:
+            metrics.gauge("ef.residual_norm", fn=self.residual_norm)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._slots)
+
+    def slot(self, key: tuple, n: int) -> Tuple[np.ndarray,
+                                                threading.Lock]:
+        """The residual array (created zero) + its lock.  A size change
+        under the same key (re-registered bucket) resets the slot."""
+        with self._mu:
+            r = self._slots.get(key)
+            if r is None or r.size != n:
+                if r is None and len(self._slots) >= self.max_slots:
+                    victim = self._lru.pop(0)
+                    self._slots.pop(victim, None)
+                    self._locks.pop(victim, None)
+                    self.evictions += 1
+                    log.warning(
+                        f"error-feedback slot table full "
+                        f"({self.max_slots}): evicted residual for "
+                        f"{victim} — one round's quantization error "
+                        f"is lost (raise PS_CODEC_EF_SLOTS)"
+                    )
+                r = np.zeros(n, np.float32)
+                self._slots[key] = r
+                self._locks.setdefault(key, threading.Lock())
+            if key in self._lru:
+                self._lru.remove(key)
+            self._lru.append(key)
+            return r, self._locks[key]
+
+    def residual_norm(self) -> float:
+        """L2 norm over every live residual (sampled lazily by the
+        telemetry gauge — never on the encode hot path)."""
+        with self._mu:
+            slots = list(self._slots.values())
+        if not slots:
+            return 0.0
+        return float(np.sqrt(sum(float(np.dot(r, r)) for r in slots)))
+
+
+def ef_enabled(env=None) -> bool:
+    """``PS_CODEC_EF`` gate (default ON) through a node Environment
+    when given, the process env otherwise."""
+    if env is not None:
+        return env.find_int("PS_CODEC_EF", 1) != 0
+    return int(os.environ.get("PS_CODEC_EF", "1") or "1") != 0
+
+
+def ef_slots(env=None) -> int:
+    if env is not None:
+        return env.find_int("PS_CODEC_EF_SLOTS", 64)
+    return int(os.environ.get("PS_CODEC_EF_SLOTS", "64") or "64")

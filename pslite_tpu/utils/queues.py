@@ -105,14 +105,44 @@ class PriorityRecvQueue(Generic[T]):
         self._heap: List[Tuple[int, int, T]] = []
         self._seq = 0
         self._priority_fn = priority_fn
+        # Fence sequence numbers (push(..., fence=True)): while a fence
+        # item is queued, nothing pushed AFTER it may overtake it —
+        # pops are restricted to items at or before the earliest live
+        # fence.  This is what keeps an all-shard barrier op (the apply
+        # pool's global requests) starvation-free under a sustained
+        # higher-priority stream: without it, one flooded shard could
+        # park every sibling shard behind the barrier forever.
+        self._fences: set = set()
 
-    def push(self, item: T, priority: Optional[int] = None) -> None:
+    def push(self, item: T, priority: Optional[int] = None,
+             fence: bool = False) -> None:
         if priority is None:
             priority = self._priority_fn(item)
         with self._cv:
             heapq.heappush(self._heap, (-priority, self._seq, item))
+            if fence:
+                self._fences.add(self._seq)
             self._seq += 1
             self._cv.notify()
+
+    def _pop_locked(self) -> T:
+        if self._fences:
+            fmin = min(self._fences)
+            if self._heap[0][1] > fmin:
+                # The heap top was pushed after the earliest fence:
+                # pop the best ELIGIBLE entry instead (highest
+                # priority, FIFO within a level, seq <= fence).  Rare
+                # path — only while a barrier op is queued — so the
+                # linear scan + re-heapify stays off the hot pops.
+                best = min(e for e in self._heap if e[1] <= fmin)
+                self._heap.remove(best)
+                heapq.heapify(self._heap)
+                self._fences.discard(best[1])
+                return best[2]
+            entry = heapq.heappop(self._heap)
+            self._fences.discard(entry[1])
+            return entry[2]
+        return heapq.heappop(self._heap)[2]
 
     def wait_and_pop(self, timeout: Optional[float] = None) -> Optional[T]:
         with self._cv:
@@ -126,13 +156,13 @@ class PriorityRecvQueue(Generic[T]):
                     if remaining <= 0 or not self._cv.wait(remaining):
                         if not self._heap:
                             return None
-            return heapq.heappop(self._heap)[2]
+            return self._pop_locked()
 
     def try_pop(self) -> Optional[T]:
         with self._mu:
             if not self._heap:
                 return None
-            return heapq.heappop(self._heap)[2]
+            return self._pop_locked()
 
     def __len__(self) -> int:
         with self._mu:

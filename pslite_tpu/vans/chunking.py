@@ -22,11 +22,12 @@ large data messages into fixed-size chunk messages:
   reassembled message when the last chunk lands.
 
 Partial-emission eligibility is deliberately narrow: plain push
-requests (no pull half, no compression/replica/zpull option, fixed
+requests (no pull half, no codec/replica/zpull marker, fixed
 ``k`` values, exactly keys+vals segments).  Everything else — pull
-responses, int8 payloads (their scales segment lands last), lens'd
-pushes — reassembles fully and takes the normal path, so chunking
-never changes apply semantics, only when bytes move.
+responses, codec-compressed payloads (their scales segment lands
+last, docs/compression.md), lens'd pushes — reassembles fully and
+takes the normal path, so chunking never changes apply semantics,
+only when bytes move.
 """
 
 from __future__ import annotations
@@ -283,7 +284,8 @@ class _Xfer:
         m = meta
         self.streamable = bool(
             m.push and m.request and not m.pull and not m.simple_app
-            and m.option == 0 and len(ck.seg_lens) == 2
+            and m.option == 0 and m.codec is None
+            and len(ck.seg_lens) == 2
             and ck.seg_types[0] == _UINT64_CODE
             and ck.seg_lens[0] > 0 and ck.seg_lens[0] % 8 == 0
         )

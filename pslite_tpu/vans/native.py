@@ -30,7 +30,7 @@ _LIB_PATHS = [
 # not rerun after a source update) is rejected LOUDLY at load time —
 # the old posture silently fell back per-symbol, which left half-built
 # hosts running the pure-Python path with no hint why.
-ABI_VERSION = 7
+ABI_VERSION = 8  # 8: fused wire-codec kernels (docs/compression.md)
 
 _lib = None
 _load_warned = False
@@ -206,6 +206,41 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64
     ]
     lib.psl_copy_pool_destroy.argtypes = [ctypes.c_void_p]
+    # Fused wire-codec kernels (ops/codecs.py — docs/compression.md).
+    lib.psl_codec_set_fp8_tables.restype = None
+    lib.psl_codec_set_fp8_tables.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.psl_codec_encode.restype = ctypes.c_int
+    lib.psl_codec_encode.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.psl_codec_decode.restype = ctypes.c_int
+    lib.psl_codec_decode.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_void_p,
+    ]
+    lib.psl_codec_encode_mt.restype = ctypes.c_int
+    lib.psl_codec_encode_mt.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.psl_codec_decode_mt.restype = ctypes.c_int
+    lib.psl_codec_decode_mt.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.psl_codec_decode_ranges.restype = ctypes.c_int
+    lib.psl_codec_decode_ranges.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
+    ]
 
 
 # -- single-shot GIL-free kernels ------------------------------------------

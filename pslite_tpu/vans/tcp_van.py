@@ -953,6 +953,7 @@ class TcpVan(Van):
         performs the placement)."""
         if not (meta.push and meta.request and meta.control.empty()
                 and meta.option not in (OPT_COMPRESS_INT8, OPT_XFER_PART)
+                and meta.codec is None  # codec payload is codes, not vals
                 and n_data >= 2):
             return None
         return self._push_recv_bufs.get((meta.sender, meta.key))

@@ -21,6 +21,7 @@ import numpy as np
 
 from .message import (
     ChunkInfo,
+    CodecInfo,
     Command,
     Control,
     Message,
@@ -51,6 +52,12 @@ EXT_CHUNK = 2
 _EXT_CHUNK_FIXED = struct.Struct("<QIIQB")  # xfer index total offset nseg
 _EXT_CHUNK_SEG = struct.Struct("<QB")       # seg byte len, dtype code
 CHUNK_MAX_SEGS = (255 - _EXT_CHUNK_FIXED.size) // _EXT_CHUNK_SEG.size
+# Wire compression (docs/compression.md): codec id, flag bits, scale
+# block length (elements), uncompressed payload byte count.  ALWAYS
+# packed before EXT_CHUNK: the native chunk splitter patches the meta's
+# trailing bytes as the chunk extension, so EXT_CHUNK must stay last.
+EXT_CODEC = 3
+_EXT_CODEC_PAYLOAD = struct.Struct("<BBHQ")  # codec flags block raw_len
 
 _META_FIXED = struct.Struct(
     "<B"  # version
@@ -184,6 +191,13 @@ def pack_meta(meta: Meta) -> bytes:
     if meta.trace:
         parts.append(_EXT_HDR.pack(EXT_TRACE, _EXT_TRACE_PAYLOAD.size))
         parts.append(_EXT_TRACE_PAYLOAD.pack(meta.trace % (1 << 64)))
+    if meta.codec is not None:
+        cd = meta.codec
+        parts.append(_EXT_HDR.pack(EXT_CODEC, _EXT_CODEC_PAYLOAD.size))
+        parts.append(_EXT_CODEC_PAYLOAD.pack(
+            cd.codec & 0xFF, cd.flags & 0xFF, cd.block & 0xFFFF,
+            cd.raw_len % (1 << 64),
+        ))
     if meta.chunk is not None:
         ck = meta.chunk
         nseg = len(ck.seg_lens)
@@ -241,6 +255,7 @@ def unpack_meta(buf: bytes) -> Meta:
         nodes.append(node)
     trace = 0
     chunk = None
+    codec = None
     while off + _EXT_HDR.size <= len(view):
         tag, ext_len = _EXT_HDR.unpack_from(view, off)
         off += _EXT_HDR.size
@@ -248,6 +263,12 @@ def unpack_meta(buf: bytes) -> Meta:
             break  # truncated tail: ignore, extensions are optional
         if tag == EXT_TRACE and ext_len == _EXT_TRACE_PAYLOAD.size:
             (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(view, off)
+        elif tag == EXT_CODEC and ext_len == _EXT_CODEC_PAYLOAD.size:
+            c_id, c_flags, c_block, c_raw = _EXT_CODEC_PAYLOAD.unpack_from(
+                view, off
+            )
+            codec = CodecInfo(codec=c_id, raw_len=c_raw, block=c_block,
+                              flags=c_flags)
         elif tag == EXT_CHUNK and ext_len >= _EXT_CHUNK_FIXED.size:
             xfer, index, total, c_off, nseg = _EXT_CHUNK_FIXED.unpack_from(
                 view, off
@@ -292,6 +313,7 @@ def unpack_meta(buf: bytes) -> Meta:
         priority=priority,
         trace=trace,
         chunk=chunk,
+        codec=codec,
         src_dev_type=src_dt,
         src_dev_id=src_di,
         dst_dev_type=dst_dt,

@@ -377,3 +377,35 @@ def test_apply_storm_helper_smoke():
     rate = apply_storm_rates(2, n_workers=2, msgs_per_worker=3,
                              keys_per_msg=4, val_len=256, rounds=1)
     assert rate > 0
+
+
+def test_priority_queue_fence_blocks_overtaking():
+    """PriorityRecvQueue fences (the apply pool's barrier-op guard): a
+    fence item pops in priority order among what was queued BEFORE it,
+    but nothing pushed AFTER it may overtake it — a sustained stream
+    of higher-priority arrivals cannot starve a queued global op (and
+    through its all-shard barrier, wedge the sibling shards)."""
+    from pslite_tpu.utils.queues import PriorityRecvQueue
+
+    q = PriorityRecvQueue(lambda item: item[0])
+    q.push((0, "bulk1"))
+    q.push((0, "global"), fence=True)
+    q.push((5, "prio-after-1"))
+    q.push((5, "prio-after-2"))
+    # Pre-fence items still pop by priority; post-fence priority
+    # arrivals wait their turn behind the fence.
+    assert q.try_pop() == (0, "bulk1")
+    assert q.try_pop() == (0, "global")
+    # Fence cleared: priority order resumes.
+    q.push((0, "bulk2"))
+    assert q.try_pop() == (5, "prio-after-1")
+    assert q.try_pop() == (5, "prio-after-2")
+    assert q.try_pop() == (0, "bulk2")
+    assert q.try_pop() is None
+    # A higher-priority item queued BEFORE the fence overtakes it.
+    q.push((1, "prio-before"))
+    q.push((0, "global2"), fence=True)
+    q.push((9, "after"))
+    assert q.try_pop() == (1, "prio-before")
+    assert q.try_pop() == (0, "global2")
+    assert q.try_pop() == (9, "after")

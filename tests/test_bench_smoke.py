@@ -221,6 +221,80 @@ def test_chunk_hol_harness():
     assert r["pull_p50_ms"] >= 0 and r["pull_p99_ms"] >= r["pull_p50_ms"]
 
 
+def test_quantized_push_harness():
+    """The quantized_push section's harness: one subprocess leg of
+    ``--mode quantized_push`` with a codec set (real tcp cluster via
+    the local tracker) must produce the measurement line; goodput is
+    defined over RAW bytes (effective goodput)."""
+    from pslite_tpu.benchmark import _chunk_run
+
+    r = _chunk_run(8, 1, str(256 << 10),
+                   extra_env={"PS_BENCH_CODEC": "int8",
+                              "PS_CODEC_EF": "0"},
+                   mode="quantized_push")
+    assert r["push_gbps"] > 0
+    assert r["pull_p99_ms"] >= r["pull_p50_ms"] >= 0
+
+
+def _bench_record(**over):
+    rec = {
+        "chunk_chunked_push_gbps": 10.0,
+        "native_goodput_ratio": 2.0,
+        "quantized_goodput_ratio_int8": 2.5,
+        "kv_storm_msgs_per_s": 1000.0,
+        "fault_recovery_detect_s": 1.0,
+        "some_untracked_wall_s": 5.0,
+    }
+    rec.update(over)
+    return rec
+
+
+def test_bench_diff_guard(tmp_path):
+    """tools/bench_diff.py (``make bench-check``): per-section deltas,
+    exit 0 within threshold, exit nonzero on a >25% regression in a
+    guarded transport metric — direction-aware (a LOWER detect time
+    passes, a lower goodput ratio fails), untracked fields never
+    gate."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    old = tmp_path / "BENCH_r07.json"
+    new = tmp_path / "BENCH_r08.json"
+    old.write_text(json.dumps(_bench_record()))
+    # Within threshold + an improvement + untracked field regressing.
+    new.write_text(json.dumps(_bench_record(
+        chunk_chunked_push_gbps=9.0,     # -10%: ok
+        fault_recovery_detect_s=0.5,     # lower = better
+        some_untracked_wall_s=50.0,      # untracked: ignored
+    )))
+    assert bench_diff.main([str(old), str(new)]) == 0
+    # Newest-two discovery inside a directory.
+    assert bench_diff.main(["--dir", str(tmp_path)]) == 0
+    # A guarded ratio collapsing fails the check.
+    new.write_text(json.dumps(_bench_record(
+        quantized_goodput_ratio_int8=1.0,  # -60%: regression
+    )))
+    assert bench_diff.main([str(old), str(new)]) == 1
+    # Direction awareness: detect time ballooning fails too.
+    new.write_text(json.dumps(_bench_record(
+        fault_recovery_detect_s=2.0,
+    )))
+    assert bench_diff.main([str(old), str(new)]) == 1
+    # Threshold is configurable.
+    assert bench_diff.main(
+        [str(old), str(new), "--threshold", "1.5"]
+    ) == 0
+    # A guarded metric VANISHING from the newer record fails loudly —
+    # a crashed section must never read as a pass (the r04/r05 blind-
+    # record failure mode).
+    rec = _bench_record()
+    del rec["quantized_goodput_ratio_int8"]
+    new.write_text(json.dumps(rec))
+    assert bench_diff.main([str(old), str(new)]) == 1
+
+
 def test_send_lanes_fanout_harness():
     """The send_lanes section's harness: laned fan-out must beat the
     serialized (PS_SEND_LANES=0) replay on a stub transport with a

@@ -1,0 +1,276 @@
+"""Quantized transport tier, end to end (docs/compression.md).
+
+The codec math is covered in test_ops.py; this file proves the TIER:
+bucket registration, the EXT_CODEC framing surviving chunking /
+replication forwards / the native plane, compressed-forward wire
+savings, the bit-identical end-state matrix, and the telemetry
+surface (codec counters, ef gauge, psmon's compression column).
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tests")
+from helpers import LoopbackCluster  # noqa: E402
+
+from pslite_tpu.kv.kv_app import (  # noqa: E402
+    KVServer,
+    KVServerDefaultHandle,
+    KVWorker,
+)
+from pslite_tpu.ops import codecs  # noqa: E402
+
+
+def _cluster_run(env_extra=None, codec="int8", pushes=3, seed=11,
+                 num_servers=2, val_len=4096, pulls=True):
+    """Deterministic compressed push/pull storm; returns (final pulled
+    vals, per-node van byte counters snapshot)."""
+    cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
+                         env_extra=env_extra or {})
+    cl.start()
+    servers = []
+    out = None
+    try:
+        for po in cl.servers:
+            s = KVServer(0, postoffice=po)
+            s.set_request_handle(KVServerDefaultHandle())
+            servers.append(s)
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        span = (1 << 64) // max(num_servers, 1)
+        keys = np.sort(np.array(
+            [r * span + off
+             for r in range(num_servers) for off in (3, 1000)],
+            dtype=np.uint64,
+        ))
+        rng = np.random.default_rng(seed)
+        w.register_bucket(keys, codec=codec)
+        for _ in range(pushes):
+            vals = rng.normal(size=len(keys) * val_len).astype(
+                np.float32
+            )
+            w.wait(w.push(keys, vals))
+        out = np.zeros(len(keys) * val_len, np.float32)
+        if pulls:
+            w.wait(w.pull(keys, out, codec="raw"))
+        stats = {
+            f"server{i}": po.van.send_bytes
+            for i, po in enumerate(cl.servers)
+        }
+        stats["worker"] = cl.workers[0].van.send_bytes
+        w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cl.finalize()
+    return out, stats
+
+
+def test_register_bucket_routes_and_overrides():
+    """register_bucket makes the codec the default for exactly those
+    keys; per-call codec='raw' overrides; unknown codecs fail loudly."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1)
+    cl.start()
+    try:
+        srv = KVServer(0, postoffice=cl.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        keys = np.array([5, 9], dtype=np.uint64)
+        vals = np.linspace(-1, 1, 2 * 512).astype(np.float32)
+        with pytest.raises(Exception):
+            w.register_bucket(keys, codec="no_such_codec")
+        w.register_bucket(keys, codec="int8")
+        before = cl.workers[0].van.send_bytes
+        w.wait(w.push(keys, vals))  # bucket codec applies
+        wire_compressed = cl.workers[0].van.send_bytes - before
+        assert wire_compressed < vals.nbytes / 3
+        before = cl.workers[0].van.send_bytes
+        w.wait(w.push(keys, vals, codec="raw"))  # explicit override
+        wire_raw = cl.workers[0].van.send_bytes - before
+        assert wire_raw > vals.nbytes
+        # Different keys: no bucket match, travels raw.
+        other = np.array([7], dtype=np.uint64)
+        before = cl.workers[0].van.send_bytes
+        w.wait(w.push(other, np.ones(512, np.float32)))
+        assert cl.workers[0].van.send_bytes - before > 512 * 4
+        # Unregister restores raw.
+        w.register_bucket(keys, codec=None)
+        before = cl.workers[0].van.send_bytes
+        w.wait(w.push(keys, vals))
+        assert cl.workers[0].van.send_bytes - before > vals.nbytes
+        w.stop()
+        srv.stop()
+    finally:
+        cl.finalize()
+
+
+@pytest.mark.parametrize("codec", ["int8", "fp8_e4m3", "bf16"])
+def test_chunked_vs_monolithic_compressed_bit_exact(codec):
+    """Satellite (ISSUE 7): compressed pushes/pulls under small
+    PS_CHUNK_BYTES (scales land in the LAST chunks, any arrival order)
+    must decode bit-identically to monolithic sends."""
+    if codec not in codecs.names():
+        pytest.skip(f"{codec} unavailable")
+    mono, _ = _cluster_run(env_extra={"PS_CHUNK_BYTES": "0"},
+                           codec=codec)
+    chunked, _ = _cluster_run(env_extra={"PS_CHUNK_BYTES": "4096"},
+                              codec=codec)
+    np.testing.assert_array_equal(mono, chunked)
+
+
+def test_compressed_replication_forwards_compressed_bytes():
+    """Satellite (ISSUE 7): with k=2 replication, the forward hop
+    re-sends the COMPRESSED payload — the primary's wire bytes toward
+    its replica shrink ~4x vs the old decompress-and-resend — and the
+    replica's store stays bit-exact with the primary's."""
+    env = {"PS_KV_REPLICATION": "2"}
+    cl = LoopbackCluster(num_workers=1, num_servers=2, env_extra=env)
+    cl.start()
+    servers = []
+    try:
+        for po in cl.servers:
+            s = KVServer(0, postoffice=po)
+            s.set_request_handle(KVServerDefaultHandle())
+            servers.append(s)
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        # One key on server rank 0 only: its primary forwards every
+        # accepted push to rank 1.
+        keys = np.array([3], dtype=np.uint64)
+        n = 256 * 1024
+        vals = np.random.default_rng(0).normal(size=n).astype(
+            np.float32
+        )
+        # Raw leg: forward re-sends the full float32 payload.
+        before = cl.servers[0].van.send_bytes
+        w.wait(w.push(keys, vals, codec="raw"))
+        raw_fwd = cl.servers[0].van.send_bytes - before
+        # Compressed leg: the forward carries codes+scales verbatim.
+        before = cl.servers[0].van.send_bytes
+        w.wait(w.push(keys, vals, codec="int8"))
+        comp_fwd = cl.servers[0].van.send_bytes - before
+        assert raw_fwd > vals.nbytes  # sanity: it really forwarded
+        assert comp_fwd < raw_fwd / 3, (comp_fwd, raw_fwd)
+        # Replica store bit-exact with the primary's.
+        import time
+
+        primary = servers[0]._handle.store[3]
+        for _ in range(100):
+            replica = servers[1]._handle.store.get(3)
+            if replica is not None and len(replica) == len(primary):
+                break
+            time.sleep(0.02)
+        np.testing.assert_array_equal(primary, replica)
+        w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cl.finalize()
+
+
+def test_matrix_bit_identical_end_state():
+    """Acceptance (ISSUE 7): for a fixed input, compressed pushes
+    produce BIT-IDENTICAL end state across PS_CHUNK_BYTES in
+    {0, small}, PS_KV_REPLICATION in {1, 2}, and PS_NATIVE in {0, 1}
+    — encode-once + deterministic codecs + arrival-order apply."""
+    results = {}
+    for chunk in ("0", "8192"):
+        for repl in ("1", "2"):
+            for nat in ("0", "1"):
+                env = {
+                    "PS_CHUNK_BYTES": chunk,
+                    "PS_KV_REPLICATION": repl,
+                    "PS_NATIVE": nat,
+                }
+                out, _ = _cluster_run(env_extra=env, codec="int8",
+                                      pushes=2, val_len=2048)
+                results[(chunk, repl, nat)] = out
+    ref = results[("0", "1", "0")]
+    for key, out in results.items():
+        np.testing.assert_array_equal(ref, out, err_msg=str(key))
+
+
+def test_push_pull_honors_bucket_codec_on_push_leg():
+    """register_bucket's contract covers the fused round trip: the
+    PUSH leg travels encoded (wire shrinks ~4x), the response comes
+    back raw, and the aggregated value lands within quantization
+    error."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1)
+    cl.start()
+    try:
+        srv = KVServer(0, postoffice=cl.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        keys = np.array([5], dtype=np.uint64)
+        n = 64 * 1024
+        vals = np.random.default_rng(2).normal(size=n).astype(np.float32)
+        out = np.zeros_like(vals)
+        w.register_bucket(keys, codec="int8")
+        before = cl.workers[0].van.send_bytes
+        w.wait(w.push_pull(keys, vals, out))
+        wire = cl.workers[0].van.send_bytes - before
+        assert wire < vals.nbytes / 3  # push leg compressed
+        step = np.repeat(
+            np.abs(vals).reshape(-1, 128).max(axis=1) / 127.0, 128
+        )
+        assert np.all(np.abs(out - vals) <= step * 0.51 + 1e-6)
+        w.stop()
+        srv.stop()
+    finally:
+        cl.finalize()
+
+
+# psmon lives in tools/; make it importable like test_telemetry does.
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def test_codec_telemetry_and_psmon_column():
+    """Satellite (ISSUE 7): per-node codec.raw_bytes / codec.wire_bytes
+    counters and the ef.residual_norm gauge land in the registry
+    snapshot; psmon renders the compression-ratio column."""
+    import psmon
+
+    cl = LoopbackCluster(num_workers=1, num_servers=1)
+    cl.start()
+    try:
+        srv = KVServer(0, postoffice=cl.servers[0])
+        srv.set_request_handle(KVServerDefaultHandle())
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        keys = np.array([5], dtype=np.uint64)
+        vals = np.random.default_rng(1).normal(size=64 * 1024).astype(
+            np.float32
+        )
+        out = np.zeros_like(vals)
+        w.register_bucket(keys, codec="int8")
+        for _ in range(3):
+            w.wait(w.push(keys, vals))
+        w.wait(w.pull(keys, out))  # bucket codec: server encodes + EF
+        wsnap = cl.workers[0].metrics.snapshot()
+        raw = wsnap["counters"]["codec.raw_bytes"]
+        wire_b = wsnap["counters"]["codec.wire_bytes"]
+        assert raw == 3 * vals.nbytes
+        assert 0 < wire_b < raw / 3
+        # Worker-side EF bank registered its residual-norm gauge (3
+        # pushes folded residuals; norm is nonzero mid-stream).
+        assert wsnap["gauges"]["ef.residual_norm"] >= 0.0
+        ssnap = cl.servers[0].metrics.snapshot()
+        assert ssnap["counters"]["codec.raw_bytes"] == vals.nbytes
+        assert ssnap["gauges"]["ef.residual_norm"] > 0.0
+        # psmon: compression-ratio column present and populated.
+        table = psmon.format_table(
+            psmon.collect(cl.scheduler, timeout_s=10)
+        )
+        assert "cmpr" in table.splitlines()[0]
+        rows = [ln for ln in table.splitlines() if " worker" in ln]
+        assert rows and any(
+            field not in ("-",) and float(field) > 2.0
+            for ln in rows
+            for field in [ln.split()[12]]
+        ), table
+        w.stop()
+        srv.stop()
+    finally:
+        cl.finalize()

@@ -317,10 +317,11 @@ def test_compressed_pull():
         cluster.finalize()
 
 
-def test_compressed_pull_declined_for_variable_length():
-    """A server whose handle responds with variable-length values (lens)
-    declines to quantize; the echoed option must then NOT claim
-    compressed data or the worker would misdecode the plain payload."""
+def test_compressed_pull_variable_length_quantizes_per_key():
+    """Ragged (lens) responses now ride the codec tier too — per-key
+    blockwise scaling (docs/compression.md), where the old one-off int8
+    path declined and fell back to raw float32.  The response must land
+    within quantization error and the worker must receive the lens."""
     from pslite_tpu.kv.kv_app import KVPairs
 
     cluster = LoopbackCluster(num_workers=1, num_servers=1)
@@ -346,7 +347,12 @@ def test_compressed_pull_declined_for_variable_length():
         keys = np.array([3], dtype=np.uint64)
         out = np.zeros_like(vals)
         worker.wait(worker.pull(keys, out, compress="int8"))
-        np.testing.assert_allclose(out, vals)  # exact: not quantized
+        # Quantized: within half a step of the per-key 128-elem blocks.
+        step = np.repeat(
+            np.abs(vals).reshape(-1, 128).max(axis=1) / 127.0, 128
+        )
+        assert np.all(np.abs(out - vals) <= step * 0.51 + 1e-6)
+        assert not np.array_equal(out, vals)  # it really was quantized
     finally:
         for s in servers:
             s.stop()

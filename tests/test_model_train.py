@@ -66,6 +66,77 @@ def test_train_step_ulysses_strategy():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_quantized_transport_convergence_guard():
+    """Acceptance (docs/compression.md): training over the loopback
+    message-path cluster with ``fp8_e4m3`` + error feedback reaches a
+    final loss within 2% of the uncompressed run.  The same run with
+    EF disabled is recorded alongside it, documenting the gap in this
+    regime (on this fully-converging toy both land close — the
+    mechanism-level gap EF closes, persistent quantization bias, is
+    pinned deterministically by
+    ``tests/test_ops.py::test_error_feedback_removes_quantization_bias``).
+    One worker, deterministic data/seeds — the runs differ only in the
+    wire codec, so the comparison is reproducible bit-for-bit."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from helpers import LoopbackCluster
+
+    from pslite_tpu.kv.kv_app import (KVServer, KVServerDefaultHandle,
+                                      KVWorker)
+    from pslite_tpu.models.train import kv_train_loop
+
+    cfg = ModelConfig(vocab=32, dim=32, heads=2, layers=1)
+
+    def run(codec, ef):
+        cluster = LoopbackCluster(
+            num_workers=1, num_servers=1,
+            env_extra={"PS_CODEC_EF": "1" if ef else "0"},
+        )
+        cluster.start()
+        servers = []
+        try:
+            srv = KVServer(0, postoffice=cluster.servers[0])
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+            worker = KVWorker(0, 0, postoffice=cluster.workers[0])
+            losses = kv_train_loop(worker, cfg, steps=150, lr=0.1,
+                                   codec=codec)
+            worker.stop()
+        finally:
+            for s in servers:
+                s.stop()
+            cluster.finalize()
+        return losses
+
+    def tail(losses):  # mean of the last few steps: step noise damped
+        return float(np.mean(losses[-5:]))
+
+    base = run(codec=None, ef=True)
+    fp8_ef = run(codec="fp8_e4m3", ef=True)
+    fp8_noef = run(codec="fp8_e4m3", ef=False)
+    assert np.isfinite(base).all() and np.isfinite(fp8_ef).all()
+    # The uncompressed run must actually learn, or parity is vacuous.
+    assert tail(base) < base[0] * 0.1, base
+    # Convergence guard: fp8+EF within 2% of the uncompressed final
+    # loss.
+    gap_ef = abs(tail(fp8_ef) - tail(base)) / tail(base)
+    gap_noef = abs(tail(fp8_noef) - tail(base)) / tail(base)
+    assert gap_ef <= 0.02, (
+        f"fp8_e4m3+EF final loss {tail(fp8_ef):.4f} vs uncompressed "
+        f"{tail(base):.4f} (gap {gap_ef:.1%} > 2%); EF-disabled gap "
+        f"for reference: {gap_noef:.1%}"
+    )
+    # Documented: the EF-disabled gap in this regime (both runs must
+    # at least train to convergence; the bias EF removes is asserted
+    # at the codec level in test_ops).
+    assert np.isfinite(fp8_noef).all() and tail(fp8_noef) < base[0] * 0.2, (
+        f"fp8_e4m3 without EF failed to train: final "
+        f"{tail(fp8_noef):.4f} (EF gap {gap_ef:.2%}, "
+        f"no-EF gap {gap_noef:.2%})"
+    )
+
+
 def test_train_step_remat_matches():
     """cfg.remat trades FLOPs for activation memory without changing the
     math: losses match the non-remat config."""

@@ -58,10 +58,26 @@ def _msg(segs, push=True, option=0, trace=0, sender=9, recver=_PEER,
 def _variants():
     """(name, message, chunk_bytes) — every encoder feature the parity
     contract covers: plain, empty-vals, int8 options, trace extension
-    tails, and the chunk extension (chunked transfer)."""
+    tails, the chunk extension (chunked transfer), and the EXT_CODEC
+    tail of the quantized transport tier (docs/compression.md) —
+    monolithic AND re-chunked, where EXT_CHUNK must stay the meta's
+    trailing bytes with the codec ext intact ahead of it."""
+    from pslite_tpu.message import CodecInfo
+    from pslite_tpu.ops import codecs
+
     rng = np.random.default_rng(7)
     keys = np.arange(16, dtype=np.uint64)
     vals = rng.normal(size=16 * 256).astype(np.float32)
+    big_vals = rng.normal(size=16 * 2048).astype(np.float32)
+    codec = codecs.get_codec("int8")
+    codes, scales, flags = codec.encode(big_vals)
+    cmsg = _msg([keys, np.ascontiguousarray(codes), scales],
+                trace=0x77AA)
+    cmsg.meta.codec = CodecInfo(codec=codec.wire_id,
+                                raw_len=big_vals.nbytes,
+                                block=codec.block, flags=flags)
+    cmsg2 = _msg([keys, np.ascontiguousarray(codes), scales])
+    cmsg2.meta.codec = cmsg.meta.codec
     out = [
         ("plain_push", _msg([keys, vals]), 0),
         ("empty_vals", _msg([keys, np.empty(0, np.float32)]), 0),
@@ -72,6 +88,8 @@ def _variants():
         ("traced_chunked", _msg([keys, vals], trace=0x1234), 4096),
         ("chunked_with_lens",
          _msg([keys, vals, np.full(16, 256, np.int32)]), 4096),
+        ("codec_ext_mono", cmsg, 0),
+        ("codec_ext_chunked", cmsg2, 8192),
     ]
     return out
 

@@ -67,6 +67,40 @@ def test_empty_meta_roundtrip():
     assert out == meta
 
 
+def test_codec_extension_roundtrip():
+    """EXT_CODEC (docs/compression.md) rides the tagged tail like
+    trace/chunk: full CodecInfo round-trips, composes with the other
+    extensions, and EXT_CHUNK stays the meta's TRAILING bytes (the
+    native splitter patches the tail in place — a codec ext packed
+    after it would be corrupted by the per-chunk patch)."""
+    from pslite_tpu.message import ChunkInfo, CodecInfo
+
+    meta = _sample_meta()
+    meta.control = Control()
+    meta.trace = 0x1234
+    meta.codec = CodecInfo(codec=2, raw_len=1 << 26, block=128, flags=1)
+    meta.chunk = ChunkInfo(xfer=5, index=1, total=3, offset=4096,
+                           seg_lens=(128, 65536, 2048),
+                           seg_types=(8, 2, 10))
+    buf = wire.pack_meta(meta)
+    out = wire.unpack_meta(buf)
+    assert out.codec == meta.codec
+    assert out.chunk == meta.chunk
+    assert out.trace == meta.trace
+    # EXT_CHUNK must be the trailing extension: its payload occupies
+    # exactly the last chunk_ext_payload_size bytes of the packed meta.
+    tail = wire.chunk_ext_payload_size(3)
+    ck_fixed = buf[len(buf) - tail:len(buf) - tail + 8 + 4 + 4 + 8 + 1]
+    import struct
+
+    xfer, index, total, offset, nseg = struct.unpack("<QIIQB", ck_fixed)
+    assert (xfer, index, total, offset, nseg) == (5, 1, 3, 4096, 3)
+    # Codec alone (no chunk) round-trips too.
+    meta.chunk = None
+    out2 = wire.unpack_meta(wire.pack_meta(meta))
+    assert out2.codec == meta.codec and out2.chunk is None
+
+
 def test_frame_roundtrip():
     msg = Message(meta=Meta(app_id=3, timestamp=5, request=True, push=True))
     keys = np.array([1, 2, 3], dtype=np.uint64)

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""bench_diff — compare the two newest ``BENCH_r*.json`` records.
+
+Prints per-section deltas for the always-on transport sections (the
+ones ``bench.py`` runs regardless of device availability) and exits
+nonzero when any DIRECTIONAL metric regressed by more than the
+threshold (default 25%) — the trajectory guard ``make bench-check``
+runs, referenced from ``tests/test_bench_smoke.py``.
+
+Only metrics listed in ``TRANSPORT_METRICS`` gate the exit status:
+each entry knows which direction is good, so a higher p99 fails while
+a higher goodput passes.  Everything else numeric is printed as
+context but never fails the check (absolute walls move with host
+load; the curated list holds the ratios and rates that are
+host-comparable).
+
+Usage::
+
+    python tools/bench_diff.py                 # newest two BENCH_r*.json
+    python tools/bench_diff.py OLD.json NEW.json
+    python tools/bench_diff.py --threshold 0.4
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# metric -> "higher" (bigger is better) or "lower".  Grouped by the
+# bench section that emits them; every section here is always-on
+# (bench.py runs it with or without a device backend).
+TRANSPORT_METRICS: Dict[str, str] = {
+    # send_lanes
+    "send_lanes_overlap_x": "higher",
+    # server_apply
+    "server_apply_sharded_msgs_per_s": "higher",
+    "server_apply_speedup_x": "higher",
+    # chunk_streaming
+    "chunk_chunked_push_gbps": "higher",
+    "chunk_hol_p99_ratio": "higher",
+    # native_goodput
+    "native_native_push_gbps": "higher",
+    "native_goodput_ratio": "higher",
+    # quantized_push (docs/compression.md) — BOTH halves of the
+    # acceptance: effective goodput up, priority-pull tail bounded.
+    "quantized_int8_push_gbps": "higher",
+    "quantized_fp8_e4m3_push_gbps": "higher",
+    "quantized_goodput_ratio_int8": "higher",
+    "quantized_goodput_ratio_fp8_e4m3": "higher",
+    "quantized_p99_ratio_int8": "lower",
+    "quantized_p99_ratio_fp8_e4m3": "lower",
+    # kv_telemetry
+    "kv_storm_msgs_per_s": "higher",
+    # fault_recovery
+    "fault_recovery_detect_s": "lower",
+    "fault_recovery_failover_pull_s": "lower",
+}
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def newest_two(directory: str) -> Optional[Tuple[str, str]]:
+    """(older, newer) of the two highest-numbered BENCH_r*.json."""
+    recs = sorted(
+        (p for p in glob.glob(os.path.join(directory, "BENCH_r*.json"))
+         if _round_of(p) >= 0),
+        key=_round_of,
+    )
+    if len(recs) < 2:
+        return None
+    return recs[-2], recs[-1]
+
+
+def _numeric_items(rec: dict) -> Dict[str, float]:
+    out = {}
+    for k, v in rec.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[k] = float(v)
+    return out
+
+
+def compare(old: dict, new: dict,
+            threshold: float = 0.25) -> Tuple[List[str], List[str]]:
+    """(report lines, regression lines)."""
+    o, n = _numeric_items(old), _numeric_items(new)
+    lines: List[str] = []
+    regressions: List[str] = []
+    for key in sorted(set(o) & set(n)):
+        ov, nv = o[key], n[key]
+        if ov == 0:
+            continue
+        delta = (nv - ov) / abs(ov)
+        direction = TRANSPORT_METRICS.get(key)
+        tag = ""
+        if direction is not None:
+            adverse = -delta if direction == "higher" else delta
+            if adverse > threshold:
+                tag = "  << REGRESSION"
+                regressions.append(
+                    f"{key}: {ov:g} -> {nv:g} "
+                    f"({delta:+.1%}, {direction} is better)"
+                )
+            else:
+                tag = "  [guarded]"
+        lines.append(f"  {key:<44} {ov:>12g} -> {nv:>12g} "
+                     f"({delta:+7.1%}){tag}")
+    # A guarded metric that VANISHED from the newer record is the
+    # worst regression of all — a crashed/blind section (the r04/r05
+    # failure mode this tool exists to catch) must not read as a pass.
+    for key in sorted(set(TRANSPORT_METRICS) & set(o) - set(n)):
+        regressions.append(
+            f"{key}: {o[key]:g} -> MISSING (section absent or failed "
+            f"in the newer record)"
+        )
+        lines.append(f"  {key:<44} {o[key]:>12g} ->      MISSING"
+                     f"  << REGRESSION")
+    # Sections that disappeared or newly failed are worth a loud note.
+    for field in ("sections_failed",):
+        if new.get(field):
+            lines.append(f"  note: {field} = {new[field]}")
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("files", nargs="*",
+                    help="explicit OLD NEW records (default: the two "
+                         "newest BENCH_r*.json in --dir)")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="adverse fractional change that fails the "
+                         "check (default 0.25)")
+    args = ap.parse_args(argv)
+    if args.files:
+        if len(args.files) != 2:
+            ap.error("pass exactly two files (OLD NEW) or none")
+        old_path, new_path = args.files
+    else:
+        pair = newest_two(args.dir)
+        if pair is None:
+            print("bench_diff: fewer than two BENCH_r*.json records in "
+                  f"{args.dir}; nothing to compare")
+            return 0
+        old_path, new_path = pair
+    old = json.load(open(old_path))
+    new = json.load(open(new_path))
+    print(f"bench_diff: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"(threshold {args.threshold:.0%} on "
+          f"{len(TRANSPORT_METRICS)} guarded transport metrics)")
+    lines, regressions = compare(old, new, args.threshold)
+    print("\n".join(lines) if lines else "  (no shared numeric fields)")
+    if regressions:
+        print(f"\nbench_diff: {len(regressions)} transport "
+              f"regression(s) > {args.threshold:.0%}:")
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print("\nbench_diff: no guarded transport metric regressed beyond "
+          f"{args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

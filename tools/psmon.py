@@ -84,7 +84,7 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
-           f"{'sent':>7} {'recv':>7}")
+           f"{'cmpr':>6} {'sent':>7} {'recv':>7}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
     hot_lines: List[str] = []
@@ -104,12 +104,18 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         lag = _g(m, "replication.lag")
         sent = _c(m, "van.sent_messages")
         recv = _c(m, "van.recv_messages")
+        # Wire-compression ratio this node ENCODED at (codec tier,
+        # docs/compression.md): raw payload bytes / wire bytes.  "-"
+        # when the node encoded nothing (or PS_TELEMETRY=0).
+        craw = _c(m, "codec.raw_bytes")
+        cwire = _c(m, "codec.wire_bytes")
+        cmpr = f"{craw / cwire:>6.1f}" if cwire > 0 else f"{'-':>6}"
         role = s.get("role", "?")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{sent:>7} {recv:>7}"
+            f"{cmpr} {sent:>7} {recv:>7}"
         )
         r = rollup.setdefault(role, {"nodes": 0, "sent": 0, "recv": 0,
                                      "apply": 0, "retx": 0, "fwd": 0})
