@@ -528,7 +528,14 @@ def _transport_sections(quick: bool) -> list:
     device backend required.  These run (and emit real numbers) even
     when the TPU tunnel is down: BENCH json was blind device-side from
     r04 on, so the transport trajectory must never depend on device
-    availability (device sections skip with a reason instead)."""
+    availability (device sections skip with a reason instead).
+
+    ``PS_BENCH_SKIP`` (comma-separated section names) records an
+    explicit ``<name>_skipped`` marker instead of running — used by
+    the tier-1 CLI-contract smoke to keep heavyweight sections (which
+    have their own dedicated harness tests) out of the suite's wall
+    budget; bench_diff treats the marker as absent, never a vanished
+    metric."""
 
     def sec_send_lanes():
         # Per-peer send-lane overlap (the fan-out serialization the
@@ -622,6 +629,19 @@ def _transport_sections(quick: bool) -> list:
         qp = quantized_push_bench(quick=quick)
         return {f"quantized_{k}": v for k, v in qp.items()}
 
+    def sec_multi_tenant():
+        # Multi-tenant serving QoS (docs/qos.md): weighted-fair lanes
+        # + admission + the worker hot-key cache.  Real tcp processes:
+        # a bulk tenant at ~10x capacity vs the serving tenant's
+        # small-pull p99 (acceptance <= 2x uncontended), and the DLRM
+        # Zipf pull storm with the hot cache (acceptance >= 5x p50,
+        # hit rate >= 60%), plus the loopback admission probe (sheds
+        # fail fast with OPT_OVERLOAD, stores bit-exact).
+        from pslite_tpu.benchmark import multi_tenant_bench
+
+        mt = multi_tenant_bench(quick=quick)
+        return {f"multi_tenant_{k}": v for k, v in mt.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -678,11 +698,36 @@ def _transport_sections(quick: bool) -> list:
         ("chunk_streaming", sec_chunk_streaming),
         ("native_goodput", sec_native_goodput),
         ("quantized_push", sec_quantized_push),
+        ("multi_tenant", sec_multi_tenant),
         ("kv_telemetry", sec_kv_telemetry),
         ("fault_recovery", sec_fault_recovery),
     ]
     if not quick:
         secs.insert(0, ("van_latency", sec_van_latency))
+    skip = {
+        s.strip()
+        for s in os.environ.get("PS_BENCH_SKIP", "").split(",")
+        if s.strip()
+    }
+    if skip:
+        # Marker key per section = the section's METRIC prefix (what a
+        # section's own ``{"skipped": ...}`` return produces through
+        # its field-prefixing), so bench_diff._section_skipped
+        # recognizes it — a raw "<section>_skipped" would read as a
+        # vanished metric for sections whose name != metric prefix.
+        marker = {
+            "chunk_streaming": "chunk_skipped",
+            "native_goodput": "native_skipped",
+            "quantized_push": "quantized_skipped",
+            "kv_telemetry": "kv_skipped",
+            "van_latency": "van_skipped",
+        }
+        secs = [
+            (name, fn) if name not in skip
+            else (name, (lambda k=marker.get(name, f"{name}_skipped"):
+                         {k: "PS_BENCH_SKIP"}))
+            for name, fn in secs
+        ]
     return secs
 
 

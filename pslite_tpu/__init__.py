@@ -15,12 +15,14 @@ from .base import (
     WORKER_GROUP,
 )
 from .kv import (
+    HotKeyCache,
     KVMeta,
     KVPairs,
     KVServer,
     KVServerDefaultHandle,
     KVServerOptimizerHandle,
     KVWorker,
+    OverloadError,
     SimpleApp,
 )
 from .message import Command, Control, Message, Meta, Node, Role
@@ -44,6 +46,7 @@ __all__ = [
     "Control",
     "DeviceType",
     "Finalize",
+    "HotKeyCache",
     "KVMeta",
     "KVPairs",
     "KVServer",
@@ -51,6 +54,7 @@ __all__ = [
     "KVServerOptimizerHandle",
     "KVWorker",
     "Message",
+    "OverloadError",
     "Meta",
     "Node",
     "Postoffice",

@@ -69,9 +69,21 @@ class Customer:
         env = getattr(postoffice, "env", None)
         prio = (env.find_int("PS_RECV_PRIORITY", 1) != 0
                 if env is not None else True)
+        # Tenant weights (docs/qos.md): bulk intake dequeues weighted-
+        # fair across tenants, like the lanes and the van queues —
+        # sharing ONE tenant/cost model (vans/chunking.py) so the two
+        # intake hops can never diverge.
+        from .tenants import table_for
+        from .vans.chunking import recv_cost, recv_tenant
+
+        tenant_table = table_for(env)
         self._queue = (
-            PriorityRecvQueue(self._recv_priority) if prio
-            else ThreadsafeQueue()
+            PriorityRecvQueue(
+                self._recv_priority, tenant_fn=recv_tenant,
+                cost_fn=recv_cost,
+                weights=(tenant_table.weights_by_id()
+                         if tenant_table.enabled else None),
+            ) if prio else ThreadsafeQueue()
         )
         self._hooks: Dict[int, List[Callable[[], None]]] = {}
         if executor_workers is None:

@@ -1,14 +1,17 @@
+from .hot_cache import HotKeyCache
 from .kv_app import (KVMeta, KVPairs, KVServer, KVServerDefaultHandle,
-                     KVServerOptimizerHandle, KVWorker)
+                     KVServerOptimizerHandle, KVWorker, OverloadError)
 from .simple_app import SimpleApp, SimpleData
 
 __all__ = [
+    "HotKeyCache",
     "KVMeta",
     "KVPairs",
     "KVServer",
     "KVServerDefaultHandle",
     "KVServerOptimizerHandle",
     "KVWorker",
+    "OverloadError",
     "SimpleApp",
     "SimpleData",
 ]

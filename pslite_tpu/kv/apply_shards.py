@@ -60,7 +60,7 @@ class _Pending:
 
     __slots__ = (
         "meta", "kvs", "mu", "remaining", "parts", "error",
-        "done", "response", "arrived", "barrier", "emitted",
+        "done", "response", "arrived", "barrier", "emitted", "tracked",
     )
 
     def __init__(self, meta, kvs):
@@ -76,6 +76,9 @@ class _Pending:
         self.arrived = 0
         self.barrier: Optional[threading.Event] = None
         self.emitted: Optional[threading.Event] = None  # wait=True only
+        # Counted in the pool's per-tenant backlog (admission control,
+        # docs/qos.md): set by submit(), released once in _finish.
+        self.tracked = False
 
 
 class _CaptureResponder:
@@ -119,10 +122,33 @@ class ApplyShardPool:
         # PER-KEY ordering (each key's ops still serialize on its one
         # shard thread in pop order) — the same relaxation the send
         # lanes and receive queues already made.
+        po = getattr(server, "po", None)
+        from ..tenants import table_for
+
+        env = getattr(po, "env", None)
+        self._tenants = table_for(env)
+        weights = (self._tenants.weights_by_id()
+                   if self._tenants.enabled else None)
+        # Apply quantum (PS_APPLY_TASK_BYTES): bulk requests split into
+        # groups of ~this many bytes per shard task.  Smaller quanta
+        # shorten the non-preemptible in-service wait a priority/express
+        # op can experience (docs/qos.md) at the cost of per-task
+        # dispatch overhead.
+        self._task_bytes = (
+            env.find_int("PS_APPLY_TASK_BYTES", self._TASK_BYTES)
+            if env is not None else self._TASK_BYTES
+        )
         self._queues: List[PriorityRecvQueue] = [
-            PriorityRecvQueue(self._task_priority)
+            PriorityRecvQueue(self._task_priority,
+                              tenant_fn=self._task_tenant,
+                              weights=weights)
             for _ in range(num_shards)
         ]
+        # Per-tenant in-flight request count (admission control,
+        # docs/qos.md): incremented at submit, released at _finish —
+        # KVServer sheds a tenant's new requests past its bound.
+        self._backlog_mu = threading.Lock()
+        self._tenant_backlog: Dict[int, int] = {}
         # Per-sender FIFO ticket gate: responses leave in arrival order.
         self._order_mu = threading.Lock()
         self._order: Dict[int, Deque[_Pending]] = {}
@@ -164,8 +190,22 @@ class ApplyShardPool:
         for t in self._threads:
             t.start()
 
-    # Target bytes of one shard task group (decode + apply quantum).
+    # Default target bytes of one shard task group (decode + apply
+    # quantum); per-pool override via PS_APPLY_TASK_BYTES.
     _TASK_BYTES = 2 << 20
+
+    @staticmethod
+    def _payload_bytes(kvs) -> int:
+        enc = getattr(kvs, "enc", None)
+        return enc[2].raw_len if enc is not None else kvs.vals.nbytes
+
+    def _task_cost(self, kvs, n_positions: int) -> int:
+        """Weighted-fair clock charge of one shard task: its share of
+        the request's payload bytes."""
+        n = len(kvs.keys)
+        if n == 0:
+            return 1
+        return max(1, self._payload_bytes(kvs) * n_positions // n)
 
     def _task_groups(self, kvs, positions) -> int:
         """How many bounded-byte groups one shard's positions split
@@ -178,10 +218,10 @@ class ApplyShardPool:
                  else kvs.vals.nbytes)
         per_key = total // n
         bytes_here = per_key * len(positions)
-        if bytes_here <= self._TASK_BYTES:
+        if bytes_here <= self._task_bytes:
             return 1
         return min(len(positions),
-                   (bytes_here + self._TASK_BYTES - 1) // self._TASK_BYTES)
+                   (bytes_here + self._task_bytes - 1) // self._task_bytes)
 
     @staticmethod
     def _task_priority(item) -> int:
@@ -190,6 +230,20 @@ class ApplyShardPool:
         if item is None:
             return -(1 << 30)
         return item[0].meta.priority
+
+    @staticmethod
+    def _task_tenant(item) -> int:
+        """Shard-queue tenant (docs/qos.md): the request's wire tenant;
+        the stop sentinel is tenantless."""
+        if item is None:
+            return 0
+        return getattr(item[0].meta, "tenant", 0)
+
+    def tenant_backlog(self, tenant: int) -> int:
+        """In-flight (submitted, not yet response-selected) requests of
+        one tenant — the admission-control probe KVServer reads."""
+        with self._backlog_mu:
+            return self._tenant_backlog.get(tenant, 0)
 
     @property
     def sharded_requests(self) -> int:
@@ -237,6 +291,12 @@ class ApplyShardPool:
         pending = _Pending(meta, kvs)
         if wait:
             pending.emitted = threading.Event()
+        pending.tracked = True
+        tid = getattr(meta, "tenant", 0)
+        with self._backlog_mu:
+            self._tenant_backlog[tid] = (
+                self._tenant_backlog.get(tid, 0) + 1
+            )
         with self._order_mu:
             self._order.setdefault(meta.sender,
                                    collections.deque()).append(pending)
@@ -256,7 +316,9 @@ class ApplyShardPool:
             # sets): skip the positions machinery and its copies.
             self._c_sharded.inc()
             pending.remaining = 1
-            self._queues[plan[0][0]].push((pending, _ALL))
+            self._queues[plan[0][0]].push(
+                (pending, _ALL), cost=self._task_cost(kvs, len(kvs.keys))
+            )
         else:
             # Bulk requests split into bounded-byte task groups per
             # shard (~_TASK_BYTES each): the shard queues are priority
@@ -276,7 +338,10 @@ class ApplyShardPool:
                         tasks.append((sid, grp))
             pending.remaining = len(tasks)
             for sid, grp in tasks:
-                self._queues[sid].push((pending, ("slice", grp)))
+                self._queues[sid].push(
+                    (pending, ("slice", grp)),
+                    cost=self._task_cost(kvs, len(grp)),
+                )
         if wait:
             # Bounded: stop()'s strand sweep releases a pump caught in
             # the submit-vs-stop window; the timeout is a last-resort
@@ -327,7 +392,8 @@ class ApplyShardPool:
         for sid, positions in plan:
             self._queues[sid].push(
                 (pending,
-                 ("feed", kvs, None if len(plan) == 1 else positions))
+                 ("feed", kvs, None if len(plan) == 1 else positions)),
+                cost=self._task_cost(kvs, len(positions)),
             )
 
     def _close_stream(self, pending, error, respond: bool) -> None:
@@ -335,6 +401,15 @@ class ApplyShardPool:
             with pending.mu:
                 if pending.error is None:
                     pending.error = error
+        if not respond:
+            # Aborted stream (dead sender / reclaim): fed slices may
+            # have partially APPLIED with no response ever leaving —
+            # the server's push-version must still bump so hot caches
+            # can't keep serving values from before the partial write
+            # (kv/hot_cache.py; no-op for servers without the hook).
+            done = getattr(self._server, "_qos_push_done", None)
+            if done is not None:
+                done(pending.meta)
         if respond and self._stopping:
             # Gate may never flush again; answer directly, best-effort.
             with pending.mu:
@@ -573,6 +648,18 @@ class ApplyShardPool:
         multi-ms decode+apply of earlier bulk pushes (the codec tier's
         storm, docs/compression.md) even though the request itself
         jumped every queue on the way in."""
+        if pending.tracked:
+            # Release the admission-control slot (docs/qos.md) exactly
+            # once: _finish runs once per pending, when its response is
+            # selected for emission.
+            pending.tracked = False
+            tid = getattr(pending.meta, "tenant", 0)
+            with self._backlog_mu:
+                n = self._tenant_backlog.get(tid, 0) - 1
+                if n > 0:
+                    self._tenant_backlog[tid] = n
+                else:
+                    self._tenant_backlog.pop(tid, None)
         with self._order_mu:
             pending.done = True
             dq = self._order.get(pending.meta.sender)

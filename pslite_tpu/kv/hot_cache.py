@@ -1,0 +1,191 @@
+"""Worker-side hot-key pull cache (docs/qos.md).
+
+"RPC Considered Harmful" (PAPERS.md): for the head of a Zipf key
+distribution the round trip itself — not bytes — is the dominant
+serving cost.  This cache lets ``KVWorker.pull`` answer a repeat pull
+of hot keys locally, with staleness bounded by a *push-driven version
+stamp* piggybacked on every server response:
+
+- The server keeps a per-node **push version** — bumped after each push
+  has fully applied, *before* its response is emitted — and stamps
+  every response with it.  A pull response's stamp is read at request
+  intake, so it is a version every value in the response is guaranteed
+  to have observed (never ahead of the snapshot).
+- The worker records the newest stamp it has seen per server
+  (``observe``).  A cached entry is served only while its fill stamp is
+  still the newest known for its server — ANY completed push the
+  worker hears about (its own pushes above all) invalidates older
+  fills, so a worker can never read its own writes stale, and a racing
+  fill whose response predates a known push parks invalid on arrival.
+- Cross-worker writes the local worker has not heard about are bounded
+  by ``PS_HOT_CACHE_TTL_S`` (async-PS serving semantics: a bounded-age
+  parameter read, exactly what the DLRM inference path tolerates).
+
+The cache is a byte-bounded LRU (``PS_HOT_CACHE_MB``); ``seed``
+restricts admission to a hot set (``KVWorker.seed_hot_cache`` fills it
+from the servers' ``kv.hot_keys`` top-k) — unseeded, every smallish
+pulled value is admitted and the LRU keeps whatever repeats.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional, Set
+
+import numpy as np
+
+
+class HotKeyCache:
+    """Bounded LRU of per-key pull values with stamp + TTL validity."""
+
+    def __init__(self, max_bytes: int, ttl_s: float = 1.0,
+                 max_val_bytes: int = 1 << 20, metrics=None):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.max_val_bytes = int(max_val_bytes)
+        self._mu = threading.Lock()
+        # key -> (vals copy, server id, fill stamp, fill monotonic time)
+        self._entries: "collections.OrderedDict[int, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        # Newest push-version stamp seen per server node id.
+        self._latest: Dict[int, int] = {}
+        # Admission hot set (None = admit everything).
+        self._hot: Optional[Set[int]] = None
+        if metrics is not None:
+            self._c_hits = metrics.counter("kv.hot_cache.hits")
+            self._c_misses = metrics.counter("kv.hot_cache.misses")
+            self._c_invalidations = metrics.counter(
+                "kv.hot_cache.invalidations")
+            metrics.gauge("kv.hot_cache.bytes", fn=lambda: self._bytes)
+            metrics.gauge("kv.hot_cache.entries",
+                          fn=lambda: len(self._entries))
+        else:  # stub harnesses
+            class _N:  # noqa: D401 - trivial no-op counter
+                def inc(self, n=1):
+                    pass
+            self._c_hits = self._c_misses = self._c_invalidations = _N()
+
+    # -- stamps ---------------------------------------------------------------
+
+    def observe(self, server: int, stamp: int) -> None:
+        """Record a response stamp.  A newer stamp than previously seen
+        from this server invalidates (lazily) every older fill — the
+        push-driven invalidation path."""
+        if stamp <= 0:
+            return
+        with self._mu:
+            cur = self._latest.get(server, 0)
+            if stamp > cur:
+                self._latest[server] = stamp
+                if cur:
+                    self._c_invalidations.inc()
+
+    # -- seeding --------------------------------------------------------------
+
+    def seed(self, keys) -> None:
+        """Restrict admission to (the union of) seeded hot keys —
+        ``KVWorker.seed_hot_cache`` feeds it the servers' ``kv.hot_keys``
+        top-k.  Never seeded, everything is admissible."""
+        with self._mu:
+            if self._hot is None:
+                self._hot = set()
+            self._hot.update(int(k) for k in np.asarray(keys).reshape(-1))
+
+    # -- fill / serve ---------------------------------------------------------
+
+    def fill(self, server: int, stamp: int, keys: np.ndarray,
+             vals: np.ndarray) -> None:
+        """Admit one pull-response slice (fixed-k payloads only; the
+        caller checked divisibility).  Values are COPIED — response
+        buffers live in pooled receive arenas that recycle."""
+        n = len(keys)
+        if n == 0 or stamp <= 0:
+            return
+        k = len(vals) // n
+        if k * n != len(vals):
+            return
+        seg_bytes = k * vals.itemsize
+        if seg_bytes > self.max_val_bytes:
+            return
+        now = time.monotonic()
+        with self._mu:
+            if stamp < self._latest.get(server, 0):
+                # The response predates a push we already know about
+                # (the invalidation race): filling it would resurrect a
+                # stale value behind a fresh-looking lookup path — the
+                # entry would be born invalid anyway, so skip the copy.
+                return
+            hot = self._hot
+            for i, key in enumerate(keys):
+                key = int(key)
+                if hot is not None and key not in hot:
+                    continue
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._bytes -= old[0].nbytes
+                seg = np.array(vals[i * k:(i + 1) * k])  # owned copy
+                self._entries[key] = (seg, server, stamp, now)
+                self._bytes += seg.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (seg, *_rest) = self._entries.popitem(last=False)
+                self._bytes -= seg.nbytes
+
+    def serve(self, keys: np.ndarray, out: np.ndarray) -> bool:
+        """All-or-nothing local serve: when EVERY requested key has a
+        live (stamp-fresh, TTL-fresh) entry, copy the values into
+        ``out`` in key order and return True.  Partial hits return
+        False untouched — the request then takes the normal round trip
+        (whose response re-fills the cache)."""
+        n = len(keys)
+        if n == 0:
+            return False
+        now = time.monotonic()
+        with self._mu:
+            segs = []
+            total = 0
+            for key in keys:
+                e = self._entries.get(int(key))
+                if e is None:
+                    self._c_misses.inc()
+                    return False
+                seg, server, stamp, t_fill = e
+                if (stamp < self._latest.get(server, 0)
+                        or (self.ttl_s > 0 and now - t_fill > self.ttl_s)):
+                    # Invalid (superseded by a push, or aged out): drop
+                    # it now so the table doesn't hold dead weight.
+                    self._entries.pop(int(key), None)
+                    self._bytes -= seg.nbytes
+                    self._c_misses.inc()
+                    return False
+                segs.append(seg)
+                total += seg.size
+            flat = out.reshape(-1)
+            if total != flat.size:
+                self._c_misses.inc()
+                return False  # caller's buffer shape disagrees: miss
+            off = 0
+            for key, seg in zip(keys, segs):
+                flat[off:off + seg.size] = seg
+                off += seg.size
+                self._entries.move_to_end(int(key))  # LRU touch
+            self._c_hits.inc()
+            return True
+
+    # -- introspection --------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+            self._bytes = 0

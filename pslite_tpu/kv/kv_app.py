@@ -33,12 +33,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import ps as ps_mod
+from .. import tenants as tenants_mod
 from ..base import SERVER_GROUP, is_server_id, server_rank_to_id
 from ..customer import Customer
 from ..message import (
     CodecInfo,
     Message,
     OPT_APPLY_ERROR,
+    OPT_OVERLOAD,
     OPT_REPLICA,
     OPT_SEND_FAILED,
     OPT_XFER_PART,
@@ -51,6 +53,22 @@ from ..utils import logging as log
 from ..utils.bounded import BoundedKeySet
 from ..vans import native
 from .apply_shards import ApplyShardPool
+from .hot_cache import HotKeyCache
+
+# meta.head marker of the hot-key introspection pull (docs/qos.md): the
+# server answers with its ``kv.hot_keys`` top-k — keys + counts — which
+# the worker uses to seed its hot-key pull cache.  Distinct from the
+# replication plane's REPLICA_FETCH_CMD (0x5EED).
+HOT_KEYS_CMD = 0x407C
+
+
+class OverloadError(RuntimeError):
+    """The server SHED this request under per-tenant admission control
+    (``OPT_OVERLOAD`` — docs/qos.md).  Nothing was applied; this is a
+    RETRYABLE backoff signal, not a failure: back off (the attribute
+    below is a reasonable floor) and re-issue the request."""
+
+    retry_after_s = 0.005
 
 
 @dataclass
@@ -108,6 +126,15 @@ class KVMeta:
     # wants the response encoded with; on a decoded push it records
     # what the payload traveled as (replication forwards re-send it).
     codec: object = None
+    # Multi-tenant QoS (docs/qos.md): the request's tenant id — echoed
+    # on the response, scheduled by weight in every contended queue,
+    # and bounded by per-tenant admission control.
+    tenant: int = 0
+    # Hot-cache version stamp (kv/hot_cache.py): on a pull, the server
+    # push-version captured at request intake (what the response
+    # piggybacks); on a push, set by the server's one-shot version bump
+    # as the response leaves.
+    stamp: int = 0
 
 
 # Legacy re-export (the one-off int8 option marker): wire compression
@@ -217,6 +244,7 @@ class _PendingReq:
     val_nbytes: int = 0
     codec: Optional[str] = None
     zpull: Optional[dict] = None
+    tenant: int = 0
 
 
 class KVWorker:
@@ -254,6 +282,34 @@ class KVWorker:
         # would evict arbitrarily — possibly the very ts a caller is
         # about to wait on).
         self._error_ts = BoundedKeySet(4096)
+        # Timestamps whose response carried OPT_OVERLOAD (the server
+        # shed the request under per-tenant admission control —
+        # docs/qos.md): wait(ts) raises the RETRYABLE OverloadError.
+        self._overload_ts = BoundedKeySet(4096)
+        # Multi-tenant QoS (docs/qos.md): this worker's default tenant
+        # (PS_TENANT names it; per-op tenant= overrides) and the shared
+        # tenant table.
+        self.tenants = tenants_mod.table_for(self.po.env)
+        self._tenant = self.tenants.resolve(
+            self.po.env.find("PS_TENANT") or None
+        )
+        # Hot-key pull cache (kv/hot_cache.py, PS_HOT_CACHE=1): repeat
+        # pulls of hot keys answer locally, invalidated by the push-
+        # version stamp piggybacked on responses.
+        self._hot_cache: Optional[HotKeyCache] = None
+        if self.po.env.find_int("PS_HOT_CACHE", 0):
+            self._hot_cache = HotKeyCache(
+                max_bytes=int(self.po.env.find_float(
+                    "PS_HOT_CACHE_MB", 64.0) * (1 << 20)),
+                ttl_s=self.po.env.find_float("PS_HOT_CACHE_TTL_S", 1.0),
+                metrics=self.po.metrics,
+            )
+        # Raw-response timestamps (fetch_hot_keys): _finish stashes the
+        # per-server response KVPairs instead of scattering them into a
+        # destination buffer.
+        self._raw_ts: set = set()
+        self._raw_results: Dict[int, List[KVPairs]] = {}
+        self._c_overloads = self.po.metrics.counter("kv.overloads")
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
@@ -336,6 +392,13 @@ class KVWorker:
                 self._bucket_codecs.pop(sig, None)
             else:
                 self._bucket_codecs[sig] = (keys, codec)
+
+    def _resolve_tenant(self, tenant) -> int:
+        """Effective tenant id of one op: the explicit ``tenant=``
+        (name or id) when given, else this worker's PS_TENANT default."""
+        if tenant is None:
+            return self._tenant
+        return self.tenants.resolve(tenant)
 
     def _resolve_codec(self, keys: np.ndarray,
                        codec: Optional[str],
@@ -486,6 +549,58 @@ class KVWorker:
         if not np.array_equal(reg["keys"], keys):
             return None
         return reg
+
+    # -- hot-key cache (kv/hot_cache.py) -------------------------------------
+
+    @property
+    def hot_cache(self) -> Optional[HotKeyCache]:
+        """The worker's hot-key pull cache (None unless PS_HOT_CACHE=1)."""
+        return self._hot_cache
+
+    def fetch_hot_keys(self, k: int = 16,
+                       timeout: Optional[float] = None) -> np.ndarray:
+        """Ask every server for its ``kv.hot_keys`` top-k (the
+        telemetry tracker's Space-Saving estimate) and seed the hot
+        cache's admission set with the union.  Returns the keys.  The
+        message-path analog of reading psmon's "hot keys" column —
+        one tiny pull per server, cmd=HOT_KEYS_CMD."""
+        ranges = self.po.get_server_key_ranges()
+        ts = self._customer.new_request(SERVER_GROUP)
+        with self._mu:
+            self._raw_ts.add(ts)
+        try:
+            for group_rank in range(len(ranges)):
+                msg = Message()
+                m = msg.meta
+                m.app_id = self._customer.app_id
+                m.customer_id = self._customer.customer_id
+                m.request = True
+                m.pull = True
+                m.head = HOT_KEYS_CMD
+                m.timestamp = ts
+                m.recver = self._route(group_rank)
+                m.val_len = int(k)  # how many hot keys we want back
+                m.key = int(ranges[group_rank].begin)
+                msg.add_data(SArray(np.array([ranges[group_rank].begin],
+                                             dtype=np.uint64)))
+                msg.add_data(SArray(np.empty(0, np.float32)))
+                self.po.van.send(msg)
+            self._customer.wait_request(ts, timeout)
+        finally:
+            with self._mu:
+                chunks = self._raw_results.pop(ts, [])
+                self._raw_ts.discard(ts)
+        keys = (np.concatenate([c.keys for c in chunks])
+                if chunks else np.empty(0, np.uint64))
+        if self._hot_cache is not None and len(keys):
+            self._hot_cache.seed(keys)
+        return keys
+
+    def seed_hot_cache(self, k: int = 16) -> np.ndarray:
+        """Fetch the servers' hot keys AND warm the cache: one pull of
+        nothing (the fetch) plus the first real pulls of those keys by
+        the caller fill it.  Returns the seeded keys."""
+        return self.fetch_hot_keys(k=k)
 
     # -- ICI collective fast path -------------------------------------------
 
@@ -683,9 +798,14 @@ class KVWorker:
         priority: int = 0,
         compress: Optional[str] = None,
         codec: Optional[str] = None,
+        tenant=None,
     ) -> int:
         """Zero-copy push; caller must not mutate buffers until wait(ts)
         (kv_app.h:210-231).
+
+        ``tenant=`` (a ``PS_TENANTS`` name or id — docs/qos.md) labels
+        the request for weighted-fair scheduling and per-tenant
+        admission; defaults to this worker's ``PS_TENANT``.
 
         ``codec=`` selects a wire codec from the registry
         (``ops/codecs.py`` — ``'int8'``, ``'fp8_e4m3'``, ``'bf16'``;
@@ -718,7 +838,8 @@ class KVWorker:
             with self._mu:
                 self._callbacks[ts] = callback
         self._send(ts, push=True, pull=False, cmd=cmd, kvs=kvs,
-                   codec=codec, trace=trace)
+                   codec=codec, trace=trace,
+                   tenant=self._resolve_tenant(tenant))
         return ts
 
     def pull(
@@ -731,8 +852,15 @@ class KVWorker:
         priority: int = 0,
         compress: Optional[str] = None,
         codec: Optional[str] = None,
+        tenant=None,
     ) -> int:
         """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792).
+
+        With the hot-key cache on (``PS_HOT_CACHE=1`` —
+        kv/hot_cache.py), a plain fixed-k pull whose every key has a
+        live cached value is answered LOCALLY: no message leaves the
+        worker and the returned timestamp is already complete.
+        ``tenant=`` labels the request for QoS (docs/qos.md).
 
         ``codec=`` asks each server to encode its response slice with a
         registry codec (``ops/codecs.py``; docs/compression.md) — the
@@ -772,6 +900,21 @@ class KVWorker:
             if pinned and holder:
                 self._pinned_pull_futs[route] = holder[0]
             return ts
+        if (self._hot_cache is not None and lens is None
+                and codec is None and cmd == 0
+                and isinstance(vals, np.ndarray)
+                and self._hot_cache.serve(keys, vals)):
+            # Local hit: every key was cached fresh (stamp + TTL) and
+            # the values are already in the caller's buffer.  Hand back
+            # a zero-expected timestamp so wait(ts) completes
+            # immediately — the round trip is the saved cost.
+            ts = self._customer.new_request(SERVER_GROUP,
+                                            num_responses=0)
+            self._c_pulls.inc()
+            self._h_pull_lat.observe(0.0)
+            if callback is not None:
+                callback()
+            return ts
         ts = self._customer.new_request(SERVER_GROUP)
         trace = self._track_request(ts, pull=True)
         zpull = (
@@ -787,7 +930,8 @@ class KVWorker:
         kvs = KVPairs(keys=keys, vals=np.empty(0, vals.dtype), priority=priority)
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
                    val_dtype=vals.dtype, val_nbytes=vals.nbytes,
-                   zpull=zpull, codec=codec, trace=trace)
+                   zpull=zpull, codec=codec, trace=trace,
+                   tenant=self._resolve_tenant(tenant))
         return ts
 
     def push_pull(
@@ -801,6 +945,7 @@ class KVWorker:
         priority: int = 0,
         compress: Optional[str] = None,
         codec: Optional[str] = None,
+        tenant=None,
     ) -> int:
         """Fused push+pull round trip (the benchmark hot path).
 
@@ -838,7 +983,8 @@ class KVWorker:
             if zpull is not None:
                 self._zpull_ts.add(ts)
         self._send(ts, push=True, pull=True, cmd=cmd, kvs=kvs, zpull=zpull,
-                   codec=codec, trace=trace)
+                   codec=codec, trace=trace,
+                   tenant=self._resolve_tenant(tenant))
         return ts
 
     def wait(self, timestamp: int) -> None:
@@ -848,6 +994,14 @@ class KVWorker:
             self._timeout_ts.discard(timestamp)
             failed = timestamp in self._error_ts
             self._error_ts.discard(timestamp)
+            shed = timestamp in self._overload_ts
+            self._overload_ts.discard(timestamp)
+        if shed:
+            raise OverloadError(
+                f"request {timestamp} was shed by the server under "
+                f"per-tenant admission control (OPT_OVERLOAD); back "
+                f"off and retry"
+            )
         if timed_out:
             raise TimeoutError(
                 f"request {timestamp} was abandoned: no response within "
@@ -1005,6 +1159,7 @@ class KVWorker:
                     req.ts, req.push, req.pull, req.cmd, sl.part,
                     sl.group_rank, dest, req.val_dtype, req.val_nbytes,
                     req.codec, req.zpull, req.trace, enc=sl.enc,
+                    tenant=req.tenant,
                 )
                 try:
                     self.po.van.send(msg)
@@ -1039,6 +1194,7 @@ class KVWorker:
         zpull: Optional[dict] = None,
         trace: int = 0,
         enc: Optional[_EncodedSlice] = None,
+        tenant: int = 0,
     ) -> Message:
         """Build one per-server slice message (shared by the initial
         send and the deadline sweeper's failover retries).  ``enc`` is
@@ -1048,6 +1204,7 @@ class KVWorker:
         m = msg.meta
         m.trace = trace
         m.priority = part.priority
+        m.tenant = tenant
         m.app_id = self._customer.app_id
         m.customer_id = self._customer.customer_id
         m.request = True
@@ -1111,6 +1268,7 @@ class KVWorker:
         codec: Optional[str] = None,
         zpull: Optional[dict] = None,
         trace: int = 0,
+        tenant: int = 0,
     ) -> None:
         ranges = self.po.get_server_key_ranges()
         sliced = self._slicer(kvs, ranges)
@@ -1149,7 +1307,7 @@ class KVWorker:
                     for (gr, part, dest), enc in zip(parts, encs)
                 ],
                 val_dtype=val_dtype, val_nbytes=val_nbytes,
-                codec=codec, zpull=zpull,
+                codec=codec, zpull=zpull, tenant=tenant,
             )
             with self._mu:
                 self._pending[ts] = req
@@ -1158,7 +1316,8 @@ class KVWorker:
             sl = req.slices[idx] if req is not None else None
             msg = self._slice_msg(ts, push, pull, cmd, part, group_rank,
                                   dest, val_dtype, val_nbytes, codec,
-                                  zpull, trace, enc=encs[idx])
+                                  zpull, trace, enc=encs[idx],
+                                  tenant=tenant)
             try:
                 self.po.van.send(msg)
                 if sl is not None:
@@ -1247,6 +1406,18 @@ class KVWorker:
         if msg.meta.option == OPT_APPLY_ERROR:
             with self._mu:
                 self._error_ts.add(ts)
+        elif msg.meta.option == OPT_OVERLOAD:
+            # The server shed this slice under admission control
+            # (docs/qos.md): the request completes FAST — wait(ts)
+            # raises the retryable OverloadError, never hangs.
+            self._c_overloads.inc()
+            with self._mu:
+                self._overload_ts.add(ts)
+        if self._hot_cache is not None and msg.meta.stamp:
+            # Push-driven invalidation (kv/hot_cache.py): every stamped
+            # response advances the newest-known version of its server,
+            # invalidating older cached fills.
+            self._hot_cache.observe(msg.meta.sender, msg.meta.stamp)
         if msg.meta.pull and len(msg.data) >= 2:
             ci = msg.meta.codec
             if ci is not None and ci.raw_len > 0 and len(msg.data) >= 3:
@@ -1275,6 +1446,19 @@ class KVWorker:
                 )
             with self._mu:
                 self._recv_kvs.setdefault(ts, []).append(kvs)
+                zp = ts in self._zpull_ts
+            if (not zp and self._hot_cache is not None and msg.meta.stamp
+                    and msg.meta.option == 0 and msg.meta.head == 0
+                    and kvs.lens is None
+                    and len(kvs.keys)
+                    and len(kvs.vals) % len(kvs.keys) == 0):
+                # Fill the hot cache from this server slice (copies —
+                # response buffers recycle).  The fill stamp was read
+                # at the server's request intake, so it never claims
+                # freshness past what the snapshot actually observed;
+                # fills older than a known push park invalid.
+                self._hot_cache.fill(msg.meta.sender, msg.meta.stamp,
+                                     kvs.keys, kvs.vals)
         # The Customer increments the response count *after* this handle, so
         # "last response" is expected-1 (reference: kv_app.h:686-710).
         expected = self.po.num_servers
@@ -1289,6 +1473,13 @@ class KVWorker:
             self._zpull_ts.discard(ts)
             self._pending.pop(ts, None)  # retire deadline tracking
             track = self._req_track.pop(ts, None)
+            if ts in self._raw_ts:
+                # Raw-response request (fetch_hot_keys): the caller
+                # wants the per-server KVPairs as-is, not a scatter
+                # into a destination buffer.
+                self._raw_ts.discard(ts)
+                self._raw_results[ts] = chunks
+                chunks = []
         if track is not None:
             t0, was_pull, trace, t0_us = track
             dur = time.monotonic() - t0
@@ -1333,11 +1524,13 @@ class KVWorker:
     def _run_callback(self, ts: int) -> None:
         with self._mu:
             cb = self._callbacks.pop(ts, None)
-            # An error- or timeout-marked response means this request's
-            # data never (fully) landed: running the completion callback
-            # would hand the caller a partially-written buffer as if it
-            # were good.  The marks stay recorded for wait(ts) to raise.
-            errored = ts in self._error_ts or ts in self._timeout_ts
+            # An error-, timeout-, or overload-marked response means
+            # this request's data never (fully) landed: running the
+            # completion callback would hand the caller a partially-
+            # written buffer as if it were good.  The marks stay
+            # recorded for wait(ts) to raise.
+            errored = (ts in self._error_ts or ts in self._timeout_ts
+                       or ts in self._overload_ts)
         if cb is not None and not errored:
             cb()
 
@@ -1411,6 +1604,36 @@ class KVServer:
         self._c_pull_reqs = self.po.metrics.counter("kv.server_pull_requests")
         self._hot_keys = self.po.metrics.topk("kv.hot_keys")
         self._h_serial_apply = self.po.metrics.histogram("apply.latency_s")
+        # Multi-tenant QoS (docs/qos.md): the tenant table, per-tenant
+        # request/shed counters (psmon's tenant rollup rows), and the
+        # admission bound — a tenant whose apply backlog exceeds
+        # PS_TENANT_QUEUE_LIMIT gets an OPT_OVERLOAD fast-fail instead
+        # of unbounded queueing.  Default: 1024 in-flight requests per
+        # tenant when PS_TENANTS is configured, off otherwise.
+        self.tenants = tenants_mod.table_for(self.po.env)
+        self._admit_limit = self.po.env.find_int(
+            "PS_TENANT_QUEUE_LIMIT",
+            1024 if self.tenants.enabled else 0,
+        )
+        self._c_shed = self.po.metrics.counter("qos.shed_requests")
+        self._tenant_counters: Dict[int, tuple] = {}
+        # Hot-key cache support (kv/hot_cache.py): the push-version
+        # stamp.  Bumped AFTER a push fully applies (as its response
+        # leaves); read at pull intake, so a pull response's stamp
+        # never claims a version its snapshot might not have observed.
+        # Starts at 1: stamp 0 means "unstamped" on the wire, and a
+        # push-free serving store must still hand out cacheable pulls.
+        # GATED: stamping engages only when some QoS feature is
+        # configured (PS_TENANTS / PS_HOT_CACHE / explicit
+        # PS_QOS_STAMPS=1) — default deployments keep every frame
+        # byte-identical to pre-tenant builds (no EXT_QOS tail).
+        self._qos_mu = threading.Lock()
+        self._push_version = 1
+        self._qos_stamps = bool(
+            self.tenants.enabled
+            or self.po.env.find_int("PS_HOT_CACHE", 0)
+            or self.po.env.find_int("PS_QOS_STAMPS", 0)
+        )
         # Quantized transport tier (docs/compression.md): the server is
         # the ENCODER of codec pull responses — its per-(key, worker)
         # error-feedback residuals live on the handle (ef_bank, created
@@ -1558,6 +1781,13 @@ class KVServer:
         # Echo the request's priority: the response carries the bulk
         # bytes on a pull, so scheduling must apply where they travel.
         m.priority = req.priority
+        # Echo the tenant (docs/qos.md): a bulk tenant's pull response
+        # carries the bulk bytes — weighted-fair shares must hold on
+        # the return path too.
+        m.tenant = getattr(req, "tenant", 0)
+        # Hot-cache stamp (kv/hot_cache.py): a pull's intake-time
+        # version, or the one-shot bump a completed push just earned.
+        m.stamp = getattr(req, "stamp", 0)
         # Echo the trace id so the response's wire/recv spans (and the
         # worker's completion) join the request's trace.
         m.trace = req.trace
@@ -1567,8 +1797,23 @@ class KVServer:
                                          "ts": req.timestamp})
         return msg
 
+    def _qos_push_done(self, req) -> None:
+        """One-shot push-version bump (kv/hot_cache.py): called as an
+        applied push's response leaves (and on aborted streams, which
+        may have partially applied).  The bump lands on ``req.stamp``
+        so the response piggybacks it — a worker that saw this push
+        complete can never again serve a cache fill that predates it.
+        No-op unless stamping is configured (see ``_qos_stamps``)."""
+        if not self._qos_stamps:
+            return
+        if getattr(req, "push", False) and getattr(req, "stamp", 1) == 0:
+            with self._qos_mu:
+                self._push_version += 1
+                req.stamp = self._push_version
+
     def response(self, req: KVMeta, res: Optional[KVPairs] = None) -> None:
         """Reply to a request (kv_app.h:536-564)."""
+        self._qos_push_done(req)
         if req.option == OPT_REPLICA:
             # Replica-forwarded pushes are fire-and-forget at the app
             # level (van-level ACKs cover delivery under PS_RESEND): a
@@ -1667,6 +1912,10 @@ class KVServer:
         """Empty ``OPT_APPLY_ERROR``-marked response: the waiting worker
         still gets its response counted (so ``wait`` unblocks) and its
         ``wait`` raises instead of hanging until timeout."""
+        # A failed push may have applied PARTIALLY (a shard raised
+        # midway): bump the version anyway — conservative invalidation
+        # is correct, a skipped one is not.
+        self._qos_push_done(req)
         if req.option == OPT_REPLICA:
             return  # no app-level responses on the replication plane
         msg = self._response_msg(req)
@@ -1677,6 +1926,35 @@ class KVServer:
         msg.meta.addr = 0
         msg.meta.val_len = 0
         self.po.van.send(msg)
+
+    def response_overload(self, req: KVMeta) -> None:
+        """Empty ``OPT_OVERLOAD``-marked response (docs/qos.md): this
+        request was SHED under per-tenant admission control — nothing
+        was applied (so no version bump), and the worker's ``wait``
+        raises the retryable ``OverloadError`` instead of hanging."""
+        if req.option == OPT_REPLICA:
+            return  # the replication plane must never shed (see intake)
+        msg = self._response_msg(req)
+        msg.meta.option = OPT_OVERLOAD
+        msg.meta.addr = 0
+        msg.meta.val_len = 0
+        # Sheds are the control signal of an overloaded system: they
+        # must not queue behind the very backlog they report — ride
+        # the express band.
+        msg.meta.priority = max(msg.meta.priority, 1)
+        self.po.van.send(msg)
+
+    def _tenant_counter(self, tid: int, kind: str):
+        """Lazily created per-tenant counters (psmon's tenant rollup):
+        ``tenant.<name>.requests`` / ``tenant.<name>.shed``."""
+        ent = self._tenant_counters.get(tid)
+        if ent is None:
+            name = self.tenants.name(tid)
+            ent = self._tenant_counters[tid] = (
+                self.po.metrics.counter(f"tenant.{name}.requests"),
+                self.po.metrics.counter(f"tenant.{name}.shed"),
+            )
+        return ent[0] if kind == "requests" else ent[1]
 
     def _request_error(self, msg: Message, exc: Exception) -> None:
         """Customer hook: the handler raised while processing ``msg`` on
@@ -1762,6 +2040,22 @@ class KVServer:
             and not self.po.van.is_peer_down(m.sender)
         )
 
+    def _admission_overloaded(self, tenant: int) -> bool:
+        """Per-tenant admission probe (docs/qos.md): in-flight apply
+        backlog plus this tenant's OPEN STREAMS (a streaming chunked
+        push occupies server capacity from its first partial, long
+        before its pending enters the pool's ledger)."""
+        if self._admit_limit <= 0 or self._apply_pool is None:
+            return False
+        n = self._apply_pool.tenant_backlog(tenant)
+        if n < self._admit_limit:
+            with self._streams_mu:
+                n += sum(
+                    1 for h in self._streams.values()
+                    if getattr(h.pending.meta, "tenant", 0) == tenant
+                )
+        return n >= self._admit_limit
+
     def _stream_part(self, msg: Message) -> None:
         """One OPT_XFER_PART partial: feed the newly completed whole-key
         slice to this transfer's open stream (opening it on first
@@ -1779,11 +2073,18 @@ class KVServer:
             m = msg.meta
             if not self._stream_eligible(m):
                 return
+            if (m.head == 0 and m.option != OPT_REPLICA
+                    and self._admission_overloaded(m.tenant)):
+                # Over the tenant's bound: don't open the stream —
+                # partials drop, and the FINAL reassembled message
+                # sheds atomically at the normal admission check
+                # (nothing applied, OPT_OVERLOAD fast-fail).
+                return
             meta = KVMeta(
                 cmd=m.head, push=True, pull=False, sender=m.sender,
                 timestamp=m.timestamp, customer_id=m.customer_id,
                 key=m.key, addr=m.addr, val_len=m.val_len, option=0,
-                priority=m.priority, trace=m.trace,
+                priority=m.priority, trace=m.trace, tenant=m.tenant,
             )
             h = self._apply_pool.begin_stream(meta)
             self._c_push_reqs.inc()
@@ -1855,7 +2156,42 @@ class KVServer:
             priority=msg.meta.priority,
             trace=msg.meta.trace,
             codec=msg.meta.codec,
+            tenant=msg.meta.tenant,
         )
+        if self._qos_stamps and meta.pull and not meta.push:
+            # Hot-cache stamp (kv/hot_cache.py): captured at INTAKE —
+            # every push counted here fully applied before this point,
+            # so the snapshot the shards will take is guaranteed to
+            # include them; later pushes only make the value newer
+            # than the stamp claims (conservative, never stale).
+            with self._qos_mu:
+                meta.stamp = self._push_version
+        if meta.cmd == HOT_KEYS_CMD and meta.pull:
+            # Hot-key introspection (docs/qos.md): answer with the
+            # kv.hot_keys top-k — keys + observed counts — so workers
+            # can seed their pull caches.  Never touches the handler.
+            top = self._hot_keys.top(max(1, min(meta.val_len or 16,
+                                                128)))
+            self.response(meta, KVPairs(
+                keys=np.array([k for k, _ in top], dtype=np.uint64),
+                vals=np.array([n for _, n in top], dtype=np.float32),
+            ))
+            return
+        shed = False
+        if (self._admit_limit > 0 and self._apply_pool is not None
+                and meta.option != OPT_REPLICA
+                and meta.cmd == 0):
+            self._tenant_counter(meta.tenant, "requests").inc()
+            shed = self._admission_overloaded(meta.tenant)
+        if shed:
+            # Admission control (docs/qos.md): this tenant's bounded
+            # queue is full — shed BEFORE replication/apply so the
+            # request is atomically all-or-nothing, and fail the
+            # waiting worker fast with the retryable OPT_OVERLOAD.
+            self._c_shed.inc()
+            self._tenant_counter(meta.tenant, "shed").inc()
+            self.response_overload(meta)
+            return
         if meta.push:
             self._c_push_reqs.inc()
         if meta.pull:

@@ -118,6 +118,13 @@ OPT_REPLICA = 5
 # message always follows.
 OPT_XFER_PART = 6
 
+# meta.option marker on an (empty) response: the server SHED this
+# request under admission control (docs/qos.md — the tenant's bounded
+# queue was full).  Nothing was applied; the waiting worker's
+# ``wait()`` raises a retryable ``OverloadError`` (back off and retry)
+# instead of hanging, and completion callbacks are suppressed.
+OPT_OVERLOAD = 7
+
 
 @dataclass(frozen=True)
 class CodecInfo:
@@ -257,6 +264,18 @@ class Meta:
     # extension, packed BEFORE the chunk extension so EXT_CHUNK stays
     # the meta's trailing bytes (the native splitter's patch contract).
     codec: Optional[CodecInfo] = None
+    # Multi-tenant QoS (docs/qos.md): the named tenant this message's
+    # traffic is accounted to — weighted-fair scheduling in the send
+    # lanes / receive intake / apply shards, and per-tenant admission
+    # control.  Travels with ``stamp`` in the tagged EXT_QOS extension
+    # (packed only when either is nonzero, so default traffic's frames
+    # are byte-identical to pre-tenant builds).
+    tenant: int = 0
+    # Server push-version stamp (kv/hot_cache.py): piggybacked on
+    # responses so the worker-side hot-key cache can invalidate —
+    # bumped after each push fully applies, echoed at a value every
+    # concurrently-snapshotted pull is guaranteed to have observed.
+    stamp: int = 0
     src_dev_type: int = int(DeviceType.UNK)
     src_dev_id: int = -1
     dst_dev_type: int = int(DeviceType.UNK)

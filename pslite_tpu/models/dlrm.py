@@ -137,6 +137,74 @@ def make_train_step(cfg: DLRMConfig, engine, sparse_engine, lr: float = 0.1,
     return step
 
 
+def embedding_row(cfg: DLRMConfig, row: int):
+    """Deterministic embedding-row values (bit-exact serving checks)."""
+    import numpy as np
+
+    base = np.arange(cfg.emb_dim, dtype=np.float32)
+    return base * 1e-3 + np.float32(row) + 0.5
+
+
+def push_embedding_table(worker, cfg: DLRMConfig, tenant=None) -> None:
+    """Publish the full (deterministic) embedding table into the
+    message-path PS store — one key per row, ``emb_dim`` floats each.
+    The serving-path setup step (docs/qos.md): inference workers then
+    pull rows by key."""
+    import numpy as np
+
+    keys = np.arange(cfg.num_rows, dtype=np.uint64)
+    vals = np.concatenate(
+        [embedding_row(cfg, r) for r in range(cfg.num_rows)]
+    )
+    worker.wait(worker.push(keys, vals, tenant=tenant))
+
+
+def serving_keys(cfg: DLRMConfig, n: int, seed: int = 0):
+    """Zipf(1.5)-distributed row ids — the inference request stream
+    (same skew as ``toy_batch``; the head of this curve is what the
+    hot-key cache exists for)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    idx = rng.zipf(1.5, size=n).astype(np.int64)
+    return ((idx - 1) % cfg.num_rows).astype(np.uint64)
+
+
+def serve_embedding_storm(worker, cfg: DLRMConfig, n_pulls: int,
+                          seed: int = 0, tenant=None, priority: int = 0,
+                          check_every: int = 64):
+    """The DLRM serving path over the message-path PS: ``n_pulls``
+    single-row embedding pulls with Zipf-distributed keys, returning
+    per-pull wall latencies (seconds).  With ``PS_HOT_CACHE=1`` the
+    head of the Zipf curve stops paying the round trip (kv/hot_cache.py
+    — the pull answers locally when the cached row is stamp-fresh).
+
+    Every ``check_every``-th pull is verified bit-exact against
+    :func:`embedding_row` — a cache serving stale or corrupt rows fails
+    loudly, not silently."""
+    import time
+
+    import numpy as np
+
+    from ..utils import logging as log
+
+    keys = serving_keys(cfg, n_pulls, seed)
+    out = np.zeros(cfg.emb_dim, np.float32)
+    lats = []
+    for i, row in enumerate(keys):
+        kk = np.array([row], dtype=np.uint64)
+        t0 = time.perf_counter()
+        worker.wait(worker.pull(kk, out, priority=priority,
+                                tenant=tenant))
+        lats.append(time.perf_counter() - t0)
+        if check_every and i % check_every == 0:
+            log.check(
+                np.array_equal(out, embedding_row(cfg, int(row))),
+                f"serving pull of row {row} returned wrong values",
+            )
+    return lats
+
+
 def toy_batch(cfg: DLRMConfig, workers: int, batch: int, seed: int = 0):
     """Learnable toy CTR data: label correlates with one hot row's use."""
     import numpy as np

@@ -10,6 +10,14 @@ receive path.
 that is FIFO within a priority level, with the drain/stop handshake the
 lane scheduler needs (the owner supplies scheduler-wide stop/abort
 predicates at pop time so one decision governs every lane).
+
+Multi-tenant weighted fairness (docs/qos.md): both ``LaneQueue`` and
+``PriorityRecvQueue`` are built on per-tenant heaps (``_TenantHeaps``)
+so that, when ``PS_TENANTS`` names tenants with weights, same-band bulk
+traffic dequeues in weighted-fair byte shares across tenants while
+``priority > 0`` express traffic keeps strict global priority order.
+With no tenants configured every item is tenant 0 and the pop order is
+bit-identical to the old single-heap ``(-priority, seq)`` discipline.
 """
 
 from __future__ import annotations
@@ -23,6 +31,130 @@ from typing import (
 )
 
 T = TypeVar("T")
+
+# Items at this priority level (the shutdown sentinel / TERMINATE) pop
+# only when nothing else is queued anywhere — matches the old global
+# heap, where the lowest priority naturally drained last.
+DRAIN_LEVEL = -(1 << 30)
+
+
+class _TenantHeaps:
+    """Per-tenant ``(-priority, seq, cost, item)`` heaps with a
+    start-time-fair (virtual time) selector for the bulk band.
+
+    Pop discipline (docs/qos.md):
+
+    1. If the globally best head has ``priority > 0`` (express data and
+       control), pop it — strict ``(-priority, seq)`` across all
+       tenants, exactly the pre-tenant order.
+    2. Otherwise pop from the backlogged tenant with the smallest
+       virtual time; its clock advances by ``cost / weight``, so over a
+       contended window tenants dequeue bytes proportionally to their
+       weights.  Within a tenant the order stays ``(-priority, seq)``.
+    3. Drain-level items (shutdown sentinel, TERMINATE) pop only when
+       they are all that remains.
+
+    NOT thread-safe — owners hold their own lock around every call.
+    """
+
+    __slots__ = ("_heaps", "_weights", "_vtime", "_vfloor", "_n")
+
+    def __init__(self, weights: Optional[Dict[int, float]] = None):
+        self._heaps: Dict[int, List[tuple]] = {}
+        self._weights = dict(weights) if weights else {}
+        self._vtime: Dict[int, float] = {}
+        self._vfloor = 0.0
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def weight(self, tid: int) -> float:
+        return max(self._weights.get(tid, 1.0), 1e-9)
+
+    def push(self, tenant: int, priority: int, seq: int, cost: int,
+             item) -> None:
+        h = self._heaps.get(tenant)
+        if h is None:
+            h = self._heaps[tenant] = []
+        if not h:
+            # (Re)activation: an idle tenant must not bank credit — its
+            # clock catches up to the fair floor before competing.
+            self._vtime[tenant] = max(
+                self._vtime.get(tenant, 0.0), self._vfloor
+            )
+        heapq.heappush(h, (-priority, seq, max(int(cost), 1), item))
+        self._n += 1
+
+    def depth(self, tenant: int) -> int:
+        h = self._heaps.get(tenant)
+        return len(h) if h else 0
+
+    def _pop_from(self, tid: int) -> tuple:
+        entry = heapq.heappop(self._heaps[tid])
+        self._n -= 1
+        return entry
+
+    def _best_head(self) -> Tuple[Optional[int], Optional[tuple]]:
+        best_tid, best = None, None
+        for tid, h in self._heaps.items():
+            if h and (best is None or h[0][:2] < best[:2]):
+                best, best_tid = h[0], tid
+        return best_tid, best
+
+    def pop(self) -> Optional[tuple]:
+        """Remove and return the next ``(-priority, seq, cost, item)``
+        entry, or None when empty."""
+        best_tid, best = self._best_head()
+        if best is None:
+            return None
+        if -best[0] > 0:
+            return self._pop_from(best_tid)  # express band
+        cands = [tid for tid, h in self._heaps.items()
+                 if h and -h[0][0] > DRAIN_LEVEL]
+        if not cands:
+            return self._pop_from(best_tid)  # only drain-level left
+        if len(cands) == 1:
+            # Uncontended (the single-tenant / quiet-cluster fast
+            # path): no clock charge — fairness is a property of
+            # contended windows only, and solo drain must not bank
+            # debt against a tenant for work nobody competed for.
+            return self._pop_from(cands[0])
+        chosen = min(cands, key=lambda t: (self._vtime.get(t, 0.0), t))
+        entry = self._pop_from(chosen)
+        self._vfloor = self._vtime.get(chosen, 0.0)
+        self._vtime[chosen] = self._vfloor + entry[2] / self.weight(chosen)
+        return entry
+
+    def pop_at_or_before(self, max_seq: int) -> Optional[tuple]:
+        """Best entry with ``seq <= max_seq`` (the fence path — rare,
+        so the scan + re-heapify stays off hot pops)."""
+        best_tid, best = None, None
+        for tid, h in self._heaps.items():
+            for e in h:
+                if e[1] <= max_seq and (best is None or e[:2] < best[:2]):
+                    best, best_tid = e, tid
+        if best is None:
+            return None
+        h = self._heaps[best_tid]
+        h.remove(best)
+        heapq.heapify(h)
+        self._n -= 1
+        return best
+
+    def head(self) -> Optional[tuple]:
+        return self._best_head()[1]
+
+    def clear(self) -> int:
+        n = self._n
+        self._heaps.clear()
+        self._n = 0
+        return n
+
+    def sorted_entries(self) -> List[tuple]:
+        out = [e for h in self._heaps.values() for e in h]
+        out.sort()
+        return out
 
 
 class ThreadsafeQueue(Generic[T]):
@@ -97,14 +229,27 @@ class PriorityRecvQueue(Generic[T]):
     the level they learned at send time).  The shutdown sentinel and
     TERMINATE should map to a very low level so they drain last,
     preserving the FIFO contract that queued traffic is delivered
-    before the pump retires."""
+    before the pump retires.
 
-    def __init__(self, priority_fn: Callable[[T], int]):
+    Multi-tenant weighted fairness (docs/qos.md): ``tenant_fn`` /
+    ``cost_fn`` (or the explicit ``tenant=`` / ``cost=`` push
+    arguments) place bulk items (``priority <= 0``) into per-tenant
+    heaps dequeued in weighted-fair byte shares per ``weights``;
+    express items keep strict global priority order.  All optional —
+    unset, every item is tenant 0 and the behavior is the historical
+    single heap."""
+
+    def __init__(self, priority_fn: Callable[[T], int],
+                 tenant_fn: Optional[Callable[[T], int]] = None,
+                 cost_fn: Optional[Callable[[T], int]] = None,
+                 weights: Optional[Dict[int, float]] = None):
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._heap: List[Tuple[int, int, T]] = []
+        self._heaps = _TenantHeaps(weights)
         self._seq = 0
         self._priority_fn = priority_fn
+        self._tenant_fn = tenant_fn
+        self._cost_fn = cost_fn
         # Fence sequence numbers (push(..., fence=True)): while a fence
         # item is queued, nothing pushed AFTER it may overtake it —
         # pops are restricted to items at or before the earliest live
@@ -115,11 +260,16 @@ class PriorityRecvQueue(Generic[T]):
         self._fences: set = set()
 
     def push(self, item: T, priority: Optional[int] = None,
-             fence: bool = False) -> None:
+             fence: bool = False, tenant: Optional[int] = None,
+             cost: Optional[int] = None) -> None:
         if priority is None:
             priority = self._priority_fn(item)
+        if tenant is None:
+            tenant = self._tenant_fn(item) if self._tenant_fn else 0
+        if cost is None:
+            cost = self._cost_fn(item) if self._cost_fn else 1
         with self._cv:
-            heapq.heappush(self._heap, (-priority, self._seq, item))
+            self._heaps.push(tenant, priority, self._seq, cost, item)
             if fence:
                 self._fences.add(self._seq)
             self._seq += 1
@@ -127,46 +277,44 @@ class PriorityRecvQueue(Generic[T]):
 
     def _pop_locked(self) -> T:
         if self._fences:
-            fmin = min(self._fences)
-            if self._heap[0][1] > fmin:
-                # The heap top was pushed after the earliest fence:
-                # pop the best ELIGIBLE entry instead (highest
-                # priority, FIFO within a level, seq <= fence).  Rare
-                # path — only while a barrier op is queued — so the
-                # linear scan + re-heapify stays off the hot pops.
-                best = min(e for e in self._heap if e[1] <= fmin)
-                self._heap.remove(best)
-                heapq.heapify(self._heap)
-                self._fences.discard(best[1])
-                return best[2]
-            entry = heapq.heappop(self._heap)
+            # Pops are restricted to the best ELIGIBLE entry (highest
+            # priority, FIFO within a level, seq <= earliest fence) —
+            # the weighted-fair selector is bypassed for the rare
+            # barrier window, where strict order matters more.  The
+            # fence item itself always qualifies, so this cannot miss.
+            entry = self._heaps.pop_at_or_before(min(self._fences))
             self._fences.discard(entry[1])
-            return entry[2]
-        return heapq.heappop(self._heap)[2]
+            return entry[3]
+        return self._heaps.pop()[3]
+
+    def depth_by_tenant(self, tenant: int) -> int:
+        """Queued items for one tenant (admission-control probe)."""
+        with self._mu:
+            return self._heaps.depth(tenant)
 
     def wait_and_pop(self, timeout: Optional[float] = None) -> Optional[T]:
         with self._cv:
             if timeout is None:
-                while not self._heap:
+                while not len(self._heaps):
                     self._cv.wait()
             else:
                 deadline = time.monotonic() + timeout
-                while not self._heap:
+                while not len(self._heaps):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or not self._cv.wait(remaining):
-                        if not self._heap:
+                        if not len(self._heaps):
                             return None
             return self._pop_locked()
 
     def try_pop(self) -> Optional[T]:
         with self._mu:
-            if not self._heap:
+            if not len(self._heaps):
                 return None
             return self._pop_locked()
 
     def __len__(self) -> int:
         with self._mu:
-            return len(self._heap)
+            return len(self._heaps)
 
 
 class LaneQueue(Generic[T]):
@@ -177,11 +325,16 @@ class LaneQueue(Generic[T]):
     The consumer loop is ``pop`` → work → ``done``; ``inflight`` covers
     the window between the two so ``wait_idle`` cannot report a drained
     lane while its last item is still being dispatched.
+
+    ``weights`` (docs/qos.md) enables weighted-fair dequeue across the
+    tenants named by ``push(..., tenant=, cost=)``: bulk messages
+    (``priority <= 0``) share the lane's wire time in weighted byte
+    shares; ``priority > 0`` keeps strict global priority order.
     """
 
-    def __init__(self):
+    def __init__(self, weights: Optional[Dict[int, float]] = None):
         self.cv = threading.Condition()
-        self._heap: List[Tuple[int, int, T]] = []
+        self._heaps = _TenantHeaps(weights)
         self._seq = 0
         self._inflight = False
         # Cumulative dispatched bytes per priority level (the owner
@@ -192,14 +345,15 @@ class LaneQueue(Generic[T]):
         self._sent_bytes: Dict[int, int] = {}
 
     def push(self, priority: int, item: T,
-             unless: Optional[Callable[[], bool]] = None) -> bool:
+             unless: Optional[Callable[[], bool]] = None,
+             tenant: int = 0, cost: int = 1) -> bool:
         """Enqueue ``item``; returns False (nothing queued) when the
         ``unless`` predicate holds — checked under the lock, so a
         concurrent drain retiring the consumer cannot strand the item."""
         with self.cv:
             if unless is not None and unless():
                 return False
-            heapq.heappush(self._heap, (-priority, self._seq, item))
+            self._heaps.push(tenant, priority, self._seq, cost, item)
             self._seq += 1
             self.cv.notify()
             return True
@@ -212,14 +366,13 @@ class LaneQueue(Generic[T]):
         with self.cv:
             while True:
                 if aborting():
-                    dropped = len(self._heap)
-                    self._heap.clear()
+                    dropped = self._heaps.clear()
                     self.cv.notify_all()
                     return None, dropped
-                if self._heap:
-                    _, _, item = heapq.heappop(self._heap)
+                if len(self._heaps):
+                    entry = self._heaps.pop()
                     self._inflight = True
-                    return item, 0
+                    return entry[3], 0
                 if stopping():
                     return None, 0
                 self.cv.wait()
@@ -229,17 +382,17 @@ class LaneQueue(Generic[T]):
         when the lane went idle."""
         with self.cv:
             self._inflight = False
-            if not self._heap:
+            if not len(self._heaps):
                 self.cv.notify_all()
 
     def wait_idle(self, deadline: float) -> bool:
         """Block until the lane is empty AND nothing is in flight (or
         ``time.monotonic()`` passes ``deadline``); True when idle."""
         with self.cv:
-            while ((self._heap or self._inflight)
+            while ((len(self._heaps) or self._inflight)
                    and time.monotonic() < deadline):
                 self.cv.wait(timeout=0.1)
-            return not (self._heap or self._inflight)
+            return not (len(self._heaps) or self._inflight)
 
     def note_dispatch(self, priority: int, nbytes: int) -> None:
         """Record ``nbytes`` dispatched at ``priority`` (HOL ledger)."""
@@ -266,11 +419,11 @@ class LaneQueue(Generic[T]):
         fail a dead peer's parked messages fast instead of letting them
         sit until the drain deadline."""
         with self.cv:
-            items = [item for _, _, item in sorted(self._heap)]
-            self._heap.clear()
+            items = [e[3] for e in self._heaps.sorted_entries()]
+            self._heaps.clear()
             self.cv.notify_all()
             return items
 
     def __len__(self) -> int:
         with self.cv:
-            return len(self._heap)
+            return len(self._heaps)

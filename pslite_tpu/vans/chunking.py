@@ -84,6 +84,25 @@ def recv_priority(msg) -> int:
     return msg.meta.priority
 
 
+def recv_tenant(msg) -> int:
+    """Receive-queue tenant of a decoded message (docs/qos.md):
+    control and the shutdown sentinel are tenantless (they ride the
+    express/drain bands, never the weighted pool)."""
+    if msg is None or not msg.meta.control.empty():
+        return 0
+    return msg.meta.tenant
+
+
+def recv_cost(msg) -> int:
+    """Weighted-fair clock charge of a decoded message: its payload
+    bytes (chunk frames carry theirs in ``data``)."""
+    if msg is None or not msg.meta.control.empty():
+        return 1
+    if msg.data:
+        return max(1, sum(d.nbytes for d in msg.data))
+    return max(1, msg.meta.data_size)
+
+
 def _flat_u8(arr) -> np.ndarray:
     """A contiguous 1-D uint8 view of an array (copying only the rare
     strided input, like ``wire.pack_frame``)."""

@@ -22,7 +22,7 @@ from ..message import Message, Node
 from ..utils import logging as log
 from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
 from .. import wire
-from .chunking import RECV_DRAIN_LAST, recv_priority
+from .chunking import RECV_DRAIN_LAST, recv_cost, recv_priority, recv_tenant
 from .van import Van
 
 _registry_mu = threading.Lock()
@@ -46,8 +46,9 @@ class LoopbackVan(Van):
         # (docs/chunking.md), same PS_RECV_PRIORITY opt-out.
         self._prio_recv = bool(self.env.find_int("PS_RECV_PRIORITY", 1))
         self._queue = (
-            PriorityRecvQueue(lambda _b: 0) if self._prio_recv
-            else ThreadsafeQueue()
+            PriorityRecvQueue(lambda _b: 0,
+                              weights=self._tenant_weights)
+            if self._prio_recv else ThreadsafeQueue()
         )
         self._peers: Dict[int, Tuple[str, int]] = {}
         self._bound_key: Optional[Tuple[str, str, int]] = None
@@ -89,7 +90,12 @@ class LoopbackVan(Van):
         chunks = wire.pack_frame(msg)
         blob = b"".join(chunks)  # join accepts memoryviews: one copy
         if target._prio_recv:
-            target._queue.push(blob, priority=recv_priority(msg))
+            # The queue holds packed blobs: priority AND the tenant/
+            # cost (docs/qos.md) are computed sender-side while the
+            # Message is still in hand.
+            target._queue.push(blob, priority=recv_priority(msg),
+                               tenant=recv_tenant(msg),
+                               cost=recv_cost(msg))
         else:
             target._queue.push(blob)
         return len(blob)

@@ -25,7 +25,7 @@ from typing import List, Optional
 from ..message import Message, Node
 from ..utils import logging as log
 from ..utils.queues import PriorityRecvQueue, ThreadsafeQueue
-from .chunking import recv_priority
+from .chunking import recv_cost, recv_priority, recv_tenant
 from .tcp_van import TcpVan
 from .van import Van
 
@@ -79,7 +79,9 @@ class MultiVan(Van):
         # backlogs from one rail must not delay another rail's priority
         # frames) — same knob as the rails' own intake queues.
         self._queue = (
-            PriorityRecvQueue(recv_priority)
+            PriorityRecvQueue(recv_priority, tenant_fn=recv_tenant,
+                              cost_fn=recv_cost,
+                              weights=self._tenant_weights)
             if postoffice.env.find_int("PS_RECV_PRIORITY", 1)
             else ThreadsafeQueue()
         )

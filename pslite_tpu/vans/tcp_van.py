@@ -52,7 +52,9 @@ from .chunking import (
     NATIVE_XFER_COMPLETE,
     finalize_native_transfer,
     native_descriptor,
+    recv_cost,
     recv_priority,
+    recv_tenant,
 )
 from .van import PeerDeadError, Van
 
@@ -348,7 +350,13 @@ class TcpVan(Van):
                 else 0
             )
         else:
-            self._queue = PriorityRecvQueue(recv_priority)
+            # tenant/cost fns + lane weights (docs/qos.md): intake
+            # dequeues bulk frames weighted-fair across tenants too —
+            # the wire's fair shares survive the decode backlog.
+            self._queue = PriorityRecvQueue(
+                recv_priority, tenant_fn=recv_tenant, cost_fn=recv_cost,
+                weights=self._tenant_weights,
+            )
         self._send_socks: Dict[int, socket.socket] = {}
         self._send_addrs: Dict[int, Tuple[str, int]] = {}
         self._socks_mu = threading.Lock()  # guards the maps, not writes

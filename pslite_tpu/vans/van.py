@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from .. import environment
+from .. import tenants as tenants_mod
 from ..base import (
     ALL_GROUP,
     EMPTY_ID,
@@ -97,15 +98,26 @@ class _SendLane:
     """One per-destination send lane: the queue, the transmit lock that
     serializes every wire write to this peer (lane thread, inline
     control sends, and resender retransmits all take it), and the
-    lazily-spawned sender thread."""
+    lazily-spawned sender thread.  ``weights`` (docs/qos.md) makes the
+    lane dequeue bulk traffic in weighted-fair byte shares across
+    tenants."""
 
     __slots__ = ("key", "q", "tx_mu", "thread")
 
-    def __init__(self, key):
+    def __init__(self, key, weights=None):
         self.key = key
-        self.q: LaneQueue = LaneQueue()
+        self.q: LaneQueue = LaneQueue(weights)
         self.tx_mu = threading.Lock()
         self.thread: Optional[threading.Thread] = None
+
+
+def _msg_cost(msg: Message) -> int:
+    """Scheduling cost of one message (the weighted-fair clock charge):
+    its payload bytes — chunk frames carry theirs in ``data`` (their
+    canonical meta zeroes data_size)."""
+    if msg.data:
+        return max(1, sum(d.nbytes for d in msg.data))
+    return max(1, msg.meta.data_size)
 
 
 class Van:
@@ -196,6 +208,14 @@ class Van:
         # (PS_FORCE_REQ_ORDER) sees a consistent sequence.  Control
         # messages bypass the lanes and dispatch inline.
         self._send_async = self.env.find_int("PS_SEND_LANES", 1) != 0
+        # Multi-tenant QoS (docs/qos.md): the node's tenant table.
+        # Lane queues (and the transports' receive intake) dequeue bulk
+        # traffic weighted-fair across these tenants; with PS_TENANTS
+        # unset the table is trivial and scheduling is unchanged.
+        self.tenants = tenants_mod.table_for(self.env)
+        self._tenant_weights = (
+            self.tenants.weights_by_id() if self.tenants.enabled else None
+        )
         self._lanes: Dict[object, _SendLane] = {}
         self._lanes_mu = threading.Lock()
         self._lane_stop = False
@@ -456,7 +476,9 @@ class Van:
         with self._lanes_mu:
             lane = self._lanes.get(key)
             if lane is None:
-                lane = self._lanes[key] = _SendLane(key)
+                lane = self._lanes[key] = _SendLane(
+                    key, self._tenant_weights
+                )
             return lane
 
     def _ensure_lane_thread(self, lane: _SendLane) -> None:
@@ -498,11 +520,15 @@ class Van:
                 f"node {msg.meta.recver} was declared dead by the "
                 f"failure detector"
             )
-        if msg.meta.control.empty():
+        if msg.meta.control.empty() and not self.tenants.enabled:
             # Native data plane (docs/native_core.md): transports with
             # native sender lanes take the whole hot path — frame
             # encode, chunk split, priority drain — off the GIL; the
-            # Python lanes below are the portable fallback.
+            # Python lanes below are the portable fallback.  With
+            # PS_TENANTS configured the native lanes DECLINE: they
+            # schedule by priority only, and weighted-fair shares are
+            # the whole point of the tenant tier (docs/qos.md) — same
+            # decline pattern as the resender/chaos paths.
             nbytes = self._native_submit(msg)
             if nbytes is not None:
                 return nbytes
@@ -546,7 +572,8 @@ class Van:
             # message falls through to inline dispatch rather than
             # stranding in the queue.
             if lane.q.push(msg.meta.priority, (msg, False),
-                           unless=lambda: self._lane_stop):
+                           unless=lambda: self._lane_stop,
+                           tenant=msg.meta.tenant, cost=_msg_cost(msg)):
                 return 0  # bytes are accounted at dispatch
         return self._dispatch_send(msg)
 
@@ -696,7 +723,8 @@ class Van:
             msg._hol_mark = lane.q.bytes_below(msg.meta.priority)
             self._ensure_lane_thread(lane)
             if lane.q.push(msg.meta.priority, (msg, True),
-                           unless=lambda: self._lane_stop):
+                           unless=lambda: self._lane_stop,
+                           tenant=msg.meta.tenant, cost=_msg_cost(msg)):
                 return 0
         return self._transmit(msg)
 

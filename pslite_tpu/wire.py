@@ -58,6 +58,12 @@ CHUNK_MAX_SEGS = (255 - _EXT_CHUNK_FIXED.size) // _EXT_CHUNK_SEG.size
 # trailing bytes as the chunk extension, so EXT_CHUNK must stay last.
 EXT_CODEC = 3
 _EXT_CODEC_PAYLOAD = struct.Struct("<BBHQ")  # codec flags block raw_len
+# Multi-tenant QoS (docs/qos.md): tenant id + server push-version
+# stamp.  Packed (only when either is nonzero) BEFORE EXT_CODEC /
+# EXT_CHUNK, preserving the invariant that EXT_CHUNK stays the meta's
+# trailing bytes (the native splitter's patch contract).
+EXT_QOS = 4
+_EXT_QOS_PAYLOAD = struct.Struct("<HQ")  # tenant, stamp
 
 _META_FIXED = struct.Struct(
     "<B"  # version
@@ -191,6 +197,11 @@ def pack_meta(meta: Meta) -> bytes:
     if meta.trace:
         parts.append(_EXT_HDR.pack(EXT_TRACE, _EXT_TRACE_PAYLOAD.size))
         parts.append(_EXT_TRACE_PAYLOAD.pack(meta.trace % (1 << 64)))
+    if meta.tenant or meta.stamp:
+        parts.append(_EXT_HDR.pack(EXT_QOS, _EXT_QOS_PAYLOAD.size))
+        parts.append(_EXT_QOS_PAYLOAD.pack(
+            meta.tenant & 0xFFFF, meta.stamp % (1 << 64),
+        ))
     if meta.codec is not None:
         cd = meta.codec
         parts.append(_EXT_HDR.pack(EXT_CODEC, _EXT_CODEC_PAYLOAD.size))
@@ -256,6 +267,8 @@ def unpack_meta(buf: bytes) -> Meta:
     trace = 0
     chunk = None
     codec = None
+    tenant = 0
+    stamp = 0
     while off + _EXT_HDR.size <= len(view):
         tag, ext_len = _EXT_HDR.unpack_from(view, off)
         off += _EXT_HDR.size
@@ -263,6 +276,8 @@ def unpack_meta(buf: bytes) -> Meta:
             break  # truncated tail: ignore, extensions are optional
         if tag == EXT_TRACE and ext_len == _EXT_TRACE_PAYLOAD.size:
             (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(view, off)
+        elif tag == EXT_QOS and ext_len == _EXT_QOS_PAYLOAD.size:
+            tenant, stamp = _EXT_QOS_PAYLOAD.unpack_from(view, off)
         elif tag == EXT_CODEC and ext_len == _EXT_CODEC_PAYLOAD.size:
             c_id, c_flags, c_block, c_raw = _EXT_CODEC_PAYLOAD.unpack_from(
                 view, off
@@ -314,6 +329,8 @@ def unpack_meta(buf: bytes) -> Meta:
         trace=trace,
         chunk=chunk,
         codec=codec,
+        tenant=tenant,
+        stamp=stamp,
         src_dev_type=src_dt,
         src_dev_id=src_di,
         dst_dev_type=dst_dt,

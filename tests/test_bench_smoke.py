@@ -159,6 +159,12 @@ def test_bench_cli_contract(tmp_path):
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PS_BENCH_PARTIAL=str(tmp_path / "partial.json"),
+        # The multi_tenant section costs ~40s of real-process storms
+        # and has its own dedicated harness tests (admission probe,
+        # dlrm_serve, test_qos.py) — keep the CLI-contract smoke
+        # inside the tier-1 wall budget; the skip marker it records
+        # is exactly what bench_diff treats as absent.
+        PS_BENCH_SKIP="multi_tenant",
     )
     out = subprocess.run(
         [sys.executable, "bench.py"],
@@ -174,6 +180,7 @@ def test_bench_cli_contract(tmp_path):
     for field in ("metric", "value", "unit", "vs_baseline"):
         assert field in rec
     assert rec["value"] > 0
+    assert rec.get("multi_tenant_skipped") == "PS_BENCH_SKIP"
 
 
 def test_telemetry_overhead_guard():
@@ -293,6 +300,79 @@ def test_bench_diff_guard(tmp_path):
     del rec["quantized_goodput_ratio_int8"]
     new.write_text(json.dumps(rec))
     assert bench_diff.main([str(old), str(new)]) == 1
+
+
+def test_bench_diff_skipped_sections_not_regressions(tmp_path):
+    """A section that degraded with an explicit ``{"skipped": reason}``
+    (device down, toolchain absent) must read as ABSENT, not as a
+    vanished-metric regression — `make bench-check` on a device-down
+    round must still pass."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    old = tmp_path / "BENCH_r07.json"
+    new = tmp_path / "BENCH_r08.json"
+    old.write_text(json.dumps(_bench_record()))
+    rec = _bench_record()
+    # The native section skipped this round: its guarded metrics are
+    # gone but the skip marker names why.
+    del rec["native_goodput_ratio"]
+    rec["native_skipped"] = "native core unavailable"
+    new.write_text(json.dumps(rec))
+    assert bench_diff.main([str(old), str(new)]) == 0
+    # Without the marker the same vanishing still fails (r04/r05 mode).
+    rec2 = _bench_record()
+    del rec2["native_goodput_ratio"]
+    new.write_text(json.dumps(rec2))
+    assert bench_diff.main([str(old), str(new)]) == 1
+
+
+def test_bench_check_on_committed_records():
+    """`make bench-check` wiring (tier-1 smoke): bench_diff against the
+    repo's committed BENCH_r*.json pair must succeed — the trajectory
+    guard stays runnable on every checkout."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    pair = bench_diff.newest_two("/root/repo")
+    assert pair is not None, "committed BENCH_r*.json records missing"
+    assert bench_diff.main(list(pair)) == 0
+    # And the Makefile target that CI runs exists.
+    mk = open("/root/repo/Makefile").read()
+    assert "bench-check:" in mk and "bench_diff" in mk
+
+
+def test_multi_tenant_admission_probe():
+    """The multi_tenant section's admission half (docs/qos.md): the
+    loopback flood sheds with OPT_OVERLOAD fast-fails, nothing hangs,
+    store bit-exact at applied-count."""
+    from pslite_tpu.benchmark import admission_probe
+
+    r = admission_probe()
+    assert r["applied"] + r["shed"] == r["offered"]
+    assert r["shed"] > 0
+    assert r["store_exact"]
+
+
+@pytest.mark.slow
+def test_dlrm_serve_harness():
+    """The multi_tenant section's DLRM half: one subprocess leg of
+    ``--mode dlrm_serve`` with the hot cache on (real tcp cluster via
+    the local tracker) must produce the measurement line with a
+    nonzero hit rate and bit-exact spot checks.  Slow-marked: the
+    tier-1 wall budget is tight and the cache semantics are already
+    covered by the fast loopback tests in tests/test_qos.py — this
+    harness is exercised by the bench itself."""
+    from pslite_tpu.benchmark import _dlrm_run
+
+    r = _dlrm_run(150, cache=True)
+    assert r["samples"] == 150
+    assert r["hit_rate"] > 0.3
+    assert r["pull_p50_ms"] >= 0
 
 
 def test_send_lanes_fanout_harness():

@@ -54,12 +54,36 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "quantized_goodput_ratio_fp8_e4m3": "higher",
     "quantized_p99_ratio_int8": "lower",
     "quantized_p99_ratio_fp8_e4m3": "lower",
+    # multi_tenant (docs/qos.md) — isolation, cache, and hit rate.
+    "multi_tenant_p99_ratio": "lower",
+    "multi_tenant_dlrm_p50_ratio": "higher",
+    "multi_tenant_hit_rate": "higher",
     # kv_telemetry
     "kv_storm_msgs_per_s": "higher",
     # fault_recovery
     "fault_recovery_detect_s": "lower",
     "fault_recovery_failover_pull_s": "lower",
 }
+
+# Section key prefixes, used to map a guarded metric back to the
+# section that emits it.  A section that degraded on purpose emits
+# ``{"skipped": <reason>}`` — its fields then land as
+# ``<prefix>skipped`` in the record — and its guarded metrics are
+# treated as ABSENT (a device-down round must not read as a vanished-
+# metric regression) rather than failed.
+SECTION_PREFIXES = (
+    "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
+    "multi_tenant_", "kv_", "fault_recovery_", "van_",
+)
+
+
+def _section_skipped(rec: dict, key: str) -> bool:
+    """True when the section emitting guarded metric ``key`` recorded
+    an explicit skip in ``rec`` instead of running."""
+    for p in SECTION_PREFIXES:
+        if key.startswith(p) and f"{p}skipped" in rec:
+            return True
+    return False
 
 
 def _round_of(path: str) -> int:
@@ -116,7 +140,15 @@ def compare(old: dict, new: dict,
     # A guarded metric that VANISHED from the newer record is the
     # worst regression of all — a crashed/blind section (the r04/r05
     # failure mode this tool exists to catch) must not read as a pass.
+    # Exception: a section that recorded an EXPLICIT skip reason
+    # (``{"skipped": ...}`` — device down, toolchain absent) is noted
+    # but never fails the check; skipping loudly is the designed
+    # degrade, not a regression.
     for key in sorted(set(TRANSPORT_METRICS) & set(o) - set(n)):
+        if _section_skipped(new, key):
+            lines.append(f"  {key:<44} {o[key]:>12g} ->      skipped"
+                         f"  [section skipped]")
+            continue
         regressions.append(
             f"{key}: {o[key]:g} -> MISSING (section absent or failed "
             f"in the newer record)"

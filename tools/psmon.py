@@ -80,13 +80,17 @@ def _apply_row(m: dict, uptime: float) -> tuple:
 
 
 def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
-    """Human-readable per-node table + per-role rollups."""
+    """Human-readable per-node table + per-role and per-tenant
+    rollups (docs/qos.md)."""
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
-           f"{'cmpr':>6} {'sent':>7} {'recv':>7}")
+           f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
+    # Per-tenant request/shed totals across the cluster (the server-
+    # side ``tenant.<name>.requests`` / ``.shed`` counters).
+    tenants: Dict[str, Dict[str, int]] = {}
     hot_lines: List[str] = []
     for node_id in sorted(snap):
         s = snap[node_id]
@@ -110,13 +114,28 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         craw = _c(m, "codec.raw_bytes")
         cwire = _c(m, "codec.wire_bytes")
         cmpr = f"{craw / cwire:>6.1f}" if cwire > 0 else f"{'-':>6}"
+        # Hot-key cache hit rate (kv/hot_cache.py): worker-side; "-"
+        # when the node never consulted a cache (PS_HOT_CACHE off).
+        hits = _c(m, "kv.hot_cache.hits")
+        misses = _c(m, "kv.hot_cache.misses")
+        cache = (f"{100.0 * hits / (hits + misses):>5.1f}%"
+                 if hits + misses > 0 else f"{'-':>6}")
         role = s.get("role", "?")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{cmpr} {sent:>7} {recv:>7}"
+            f"{cmpr} {cache} {sent:>7} {recv:>7}"
         )
+        for cname, cval in m.get("counters", {}).items():
+            # tenant.<name>.<kind> — names are identifier-like (the
+            # PS_TENANTS parser rejects dots), but rsplit keeps this
+            # robust to any counter shape regardless.
+            if cname.startswith("tenant.") and cname.count(".") >= 2:
+                tname, kind = cname[len("tenant."):].rsplit(".", 1)
+                t = tenants.setdefault(tname, {"requests": 0, "shed": 0})
+                if kind in t:
+                    t[kind] += int(cval)
         r = rollup.setdefault(role, {"nodes": 0, "sent": 0, "recv": 0,
                                      "apply": 0, "retx": 0, "fwd": 0})
         r["nodes"] += 1
@@ -139,6 +158,17 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
             f"apply={int(r['apply'])} retx={int(r['retx'])} "
             f"repl_fwd={int(r['fwd'])}"
         )
+    if tenants:
+        lines.append("")
+        lines.append("per-tenant rollup (docs/qos.md):")
+        for tname in sorted(tenants):
+            t = tenants[tname]
+            total = t["requests"]
+            shed_pct = 100.0 * t["shed"] / total if total else 0.0
+            lines.append(
+                f"  {tname:>9}: requests={total} shed={t['shed']} "
+                f"({shed_pct:.1f}%)"
+            )
     if hot_lines:
         lines.append("")
         lines.extend(hot_lines)
