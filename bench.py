@@ -642,6 +642,17 @@ def _transport_sections(quick: bool) -> list:
         mt = multi_tenant_bench(quick=quick)
         return {f"multi_tenant_{k}": v for k, v in mt.items()}
 
+    def sec_elastic_scale():
+        # Elastic membership (docs/elasticity.md): scale 2 -> 4 -> 2
+        # servers mid push-storm with no global restart — stores
+        # bit-exact, zero hung waits (wrong-epoch slices re-route),
+        # and the priority small-pull p99 bounded (<= 3x the
+        # uncontended window) through the migration.
+        from pslite_tpu.benchmark import elastic_scale_bench
+
+        es = elastic_scale_bench(quick=quick)
+        return {f"elastic_{k}": v for k, v in es.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -699,6 +710,7 @@ def _transport_sections(quick: bool) -> list:
         ("native_goodput", sec_native_goodput),
         ("quantized_push", sec_quantized_push),
         ("multi_tenant", sec_multi_tenant),
+        ("elastic_scale", sec_elastic_scale),
         ("kv_telemetry", sec_kv_telemetry),
         ("fault_recovery", sec_fault_recovery),
     ]
@@ -721,6 +733,7 @@ def _transport_sections(quick: bool) -> list:
             "quantized_push": "quantized_skipped",
             "kv_telemetry": "kv_skipped",
             "van_latency": "van_skipped",
+            "elastic_scale": "elastic_skipped",
         }
         secs = [
             (name, fn) if name not in skip

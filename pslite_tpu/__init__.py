@@ -29,6 +29,7 @@ from .message import Command, Control, Message, Meta, Node, Role
 from .postoffice import Postoffice
 from .ps import finalize, num_instances, postoffice, start_ps
 from .range import Range
+from .routing import RouteEntry, RoutingTable
 from .sarray import DeviceType, SArray
 
 __version__ = "0.2.0"
@@ -60,6 +61,8 @@ __all__ = [
     "Postoffice",
     "Range",
     "Role",
+    "RouteEntry",
+    "RoutingTable",
     "SArray",
     "SimpleApp",
     "StartPS",

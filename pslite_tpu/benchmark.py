@@ -856,6 +856,143 @@ def fault_recovery_times(quick: bool = True) -> dict:
     }
 
 
+def elastic_scale_bench(quick: bool = True) -> dict:
+    """End-to-end elasticity proof (docs/elasticity.md): scale an
+    elastic cluster 2 -> 4 -> 2 servers in the middle of a push storm,
+    with NO global restart, over real TCP sockets (in-process nodes —
+    the measurement is comparative within one harness, so the shared
+    GIL prices both windows identically).
+
+    Two measured windows over the same cluster:
+
+    - **base**: storm + priority small-pull sampling with membership
+      static (the uncontended reference tail).
+    - **migration**: the same storm while two servers join (live range
+      splits + migrations) and then decommission (merges back).
+
+    Acceptance: ``p99_ratio = migration p99 / base p99 <= 3``, the
+    final store BIT-EXACT vs the completed push count (every ``wait``
+    completed or raised — wrong-epoch slices re-route transparently),
+    and zero hung requests.
+    """
+    import threading
+
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+    from .message import Role
+    from .environment import Environment
+    from .postoffice import Postoffice
+
+    n_keys = 32
+    val_len = 2048 if quick else 8192
+    window_s = 1.5 if quick else 4.0
+    env = {
+        "PS_ELASTIC": "1",
+        "PS_REQUEST_TIMEOUT": "3.0",
+        "PS_REQUEST_RETRIES": "8",
+    }
+    nodes = _loopback_cluster(1, 2, "elastic-scale", env, van_type="tcp")
+    servers = []
+    workers = []
+    joiner_pos: list = []
+    joiner_srvs: list = []
+    try:
+        for po in nodes[1:3]:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=nodes[3])
+        workers.append(worker)
+        span = (1 << 64) // n_keys
+        keys = (np.arange(n_keys, dtype=np.uint64) * np.uint64(span)
+                + np.uint64(3))
+        vals = np.arange(n_keys * val_len, dtype=np.float32) % 97 + 1.0
+        hot_key = keys[:1]
+        hot_out = np.zeros(val_len, np.float32)
+        pushes = [0]
+        stop = [False]
+        errors: list = []
+
+        def storm():
+            while not stop[0]:
+                try:
+                    worker.wait(worker.push(keys, vals))
+                    pushes[0] += 1
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+                    return
+
+        def sample(lats, dur_s):
+            deadline = time.perf_counter() + dur_s
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                worker.wait(worker.pull(hot_key, hot_out, priority=1))
+                lats.append(time.perf_counter() - t0)
+                time.sleep(0.002)
+
+        worker.wait(worker.push(keys, vals))
+        pushes[0] += 1
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        base_lats: list = []
+        sample(base_lats, window_s)
+
+        def join_one():
+            po = Postoffice(Role.SERVER, env=Environment(dict(
+                nodes[3].env._overrides)))
+            po.start(0)
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            joiner_pos.append(po)
+            joiner_srvs.append(srv)
+
+        mig_lats: list = []
+        t_mig = time.perf_counter()
+        sampler = threading.Thread(
+            target=sample, args=(mig_lats, window_s * 2 + 2.0),
+            daemon=True)
+        sampler.start()
+        join_one()
+        join_one()
+        time.sleep(window_s / 2)
+        for srv in joiner_srvs:
+            srv.decommission(timeout_s=60)
+        sampler.join(timeout=window_s * 4 + 20)
+        mig_wall = time.perf_counter() - t_mig
+        stop[0] = True
+        t.join(timeout=30)
+        n = pushes[0]
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        exact = bool(np.array_equal(out, vals * n)) and not errors
+        _, base_p99 = _pctl_ms(base_lats)
+        _, mig_p99 = _pctl_ms(mig_lats)
+        rt = nodes[3].current_routing()
+        return {
+            "pushes": n,
+            "push_mb": round(vals.nbytes / 2**20, 2),
+            "store_bitexact": exact,
+            "errors": errors[:3],
+            "joins": 2,
+            "leaves": 2,
+            "final_epoch": rt.epoch if rt else None,
+            "final_active": list(rt.active) if rt else None,
+            "scale_2_4_2_wall_s": round(mig_wall, 2),
+            "base_p99_ms": base_p99,
+            "migration_p99_ms": mig_p99,
+            "p99_ratio": (round(mig_p99 / base_p99, 2)
+                          if base_p99 > 0 else None),
+            "wrong_owner_bounces": nodes[3].metrics.counter(
+                "kv.wrong_owner_bounces").value,
+        }
+    finally:
+        _teardown_cluster(nodes, workers, servers + joiner_srvs)
+        for po in joiner_pos:
+            try:
+                po.van.stop()
+            except Exception:
+                pass
+
+
 def _chunk_run(push_mb: int, n_pushes: int,
                chunk_bytes: str, extra_env: dict = None,
                mode: str = "chunk_hol") -> dict:

@@ -187,6 +187,14 @@ class Customer:
         with self._mu:
             return self._entry(timestamp)[1]
 
+    def num_expected(self, timestamp: int) -> int:
+        """Responses this timestamp was issued expecting (0 for pruned
+        = long-complete entries).  Under elastic routing the per-slice
+        fan-out varies per request, so completion checks must read the
+        count recorded at issue time, not a global server count."""
+        with self._mu:
+            return self._entry(timestamp)[0]
+
     def add_response(self, timestamp: int, num: int = 1) -> None:
         with self._cv:
             if timestamp in self._tracker:

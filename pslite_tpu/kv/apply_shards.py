@@ -61,6 +61,7 @@ class _Pending:
     __slots__ = (
         "meta", "kvs", "mu", "remaining", "parts", "error",
         "done", "response", "arrived", "barrier", "emitted", "tracked",
+        "seq",
     )
 
     def __init__(self, meta, kvs):
@@ -79,6 +80,9 @@ class _Pending:
         # Counted in the pool's per-tenant backlog (admission control,
         # docs/qos.md): set by submit(), released once in _finish.
         self.tracked = False
+        # Submission sequence number (quiesce support — elastic range
+        # migration snapshots after every EARLIER submit completed).
+        self.seq = 0
 
 
 class _CaptureResponder:
@@ -149,6 +153,12 @@ class ApplyShardPool:
         # KVServer sheds a tenant's new requests past its bound.
         self._backlog_mu = threading.Lock()
         self._tenant_backlog: Dict[int, int] = {}
+        # Quiesce bookkeeping (docs/elasticity.md): every tracked
+        # submission gets a monotone seq, removed in _finish; a range
+        # migration snapshots only after every submit at or before its
+        # token has completed.
+        self._submit_seq = 0
+        self._inflight_seqs: set = set()
         # Per-sender FIFO ticket gate: responses leave in arrival order.
         self._order_mu = threading.Lock()
         self._order: Dict[int, Deque[_Pending]] = {}
@@ -245,6 +255,28 @@ class ApplyShardPool:
         with self._backlog_mu:
             return self._tenant_backlog.get(tenant, 0)
 
+    def submit_token(self) -> int:
+        """Current submission sequence — pass to :meth:`quiesce` to
+        wait for everything submitted so far (and nothing later)."""
+        with self._backlog_mu:
+            return self._submit_seq
+
+    def quiesce(self, token: int, timeout_s: float = 30.0) -> bool:
+        """Block until every request submitted at or before ``token``
+        has completed (its response was selected for emission) —
+        later submissions never extend the wait, so a busy pool on
+        OTHER key ranges cannot stall an elastic range migration's
+        consistent-cut snapshot.  Returns False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._backlog_mu:
+                busy = any(s <= token for s in self._inflight_seqs)
+            if not busy:
+                return True
+            if time.monotonic() >= deadline or self._stopping:
+                return not busy
+            time.sleep(0.002)
+
     @property
     def sharded_requests(self) -> int:
         return self._c_sharded.value
@@ -297,6 +329,9 @@ class ApplyShardPool:
             self._tenant_backlog[tid] = (
                 self._tenant_backlog.get(tid, 0) + 1
             )
+            self._submit_seq += 1
+            pending.seq = self._submit_seq
+            self._inflight_seqs.add(pending.seq)
         with self._order_mu:
             self._order.setdefault(meta.sender,
                                    collections.deque()).append(pending)
@@ -660,6 +695,7 @@ class ApplyShardPool:
                     self._tenant_backlog[tid] = n
                 else:
                     self._tenant_backlog.pop(tid, None)
+                self._inflight_seqs.discard(pending.seq)
         with self._order_mu:
             pending.done = True
             dq = self._order.get(pending.meta.sender)

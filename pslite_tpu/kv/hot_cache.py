@@ -84,6 +84,22 @@ class HotKeyCache:
                 if cur:
                     self._c_invalidations.inc()
 
+    def invalidate_range(self, begin: int, end: int) -> int:
+        """Drop every cached entry whose key lies in ``[begin, end)``
+        (docs/elasticity.md): when a key range migrates to a new owner,
+        a cached fill's stamp was minted by the OLD owner — the new
+        owner's independent version counter can never invalidate it, so
+        a migrated key must not be served from the old stamp at all.
+        Returns the number of entries dropped."""
+        with self._mu:
+            doomed = [k for k in self._entries if begin <= k < end]
+            for k in doomed:
+                seg = self._entries.pop(k)[0]
+                self._bytes -= seg.nbytes
+            if doomed:
+                self._c_invalidations.inc(len(doomed))
+            return len(doomed)
+
     # -- seeding --------------------------------------------------------------
 
     def seed(self, keys) -> None:

@@ -43,6 +43,7 @@ from ..message import (
     OPT_OVERLOAD,
     OPT_REPLICA,
     OPT_SEND_FAILED,
+    OPT_WRONG_OWNER,
     OPT_XFER_PART,
     Role,
 )
@@ -60,6 +61,19 @@ from .hot_cache import HotKeyCache
 # the worker uses to seed its hot-key pull cache.  Distinct from the
 # replication plane's REPLICA_FETCH_CMD (0x5EED).
 HOT_KEYS_CMD = 0x407C
+
+# meta.head of an elastic range-migration transfer (docs/elasticity.md):
+# the OLD owner pushes a range's snapshotted state to the NEW owner
+# named by the routing table; meta.key is the range's begin, meta.addr
+# the routing epoch.  Server-to-server only — never sliced by workers.
+MIGRATE_CMD = 0x314D
+
+# meta.head of the LOCAL routing-cutover marker a server's routing hook
+# posts into its own customer queue: processing it on the request
+# thread serializes the ownership flip against every earlier queued
+# request (they apply under the old epoch; later ones park or bounce).
+# Never on the wire.
+ROUTING_LOCAL_CMD = 0x52E9
 
 
 class OverloadError(RuntimeError):
@@ -222,6 +236,11 @@ class _PendingSlice:
     # immediately — and ONLY it, so one bad destination cannot trigger
     # duplicate sends of the request's healthy slices.
     retry_now: bool = False
+    # The destination answered OPT_WRONG_OWNER (docs/elasticity.md):
+    # the sweeper re-SLICES this part under the current routing table
+    # before re-routing — a range split mid-flight can divide one
+    # slice across two new owners.
+    wrong_owner: bool = False
 
 
 @dataclass
@@ -239,6 +258,12 @@ class _PendingReq:
     deadline: float
     trace: int = 0
     attempt: int = 0
+    # Wrong-owner re-routes (docs/elasticity.md) are counted apart from
+    # ``attempt``: a bounce answers immediately, so a routing-table lag
+    # of a few ms could otherwise burn the whole retry budget without a
+    # single real failure.  Bounces are bounded separately (generous —
+    # each one is a LIVE server actively answering).
+    bounces: int = 0
     slices: List[_PendingSlice] = field(default_factory=list)
     val_dtype: object = None
     val_nbytes: int = 0
@@ -341,7 +366,14 @@ class KVWorker:
         # PS_REQUEST_RETRIES the request fails and wait(ts) raises
         # TimeoutError.  _down_servers mirrors the failure detector's
         # NODE_FAILURE broadcasts via the postoffice hook registry.
-        self._req_timeout = self.po.env.find_float("PS_REQUEST_TIMEOUT", 0.0)
+        # Elastic membership (docs/elasticity.md) re-routes stale-epoch
+        # slices through the sweeper, so deadlines default ON when the
+        # cluster is elastic (an explicit PS_REQUEST_TIMEOUT still
+        # wins, including an explicit 0).
+        self._req_timeout = self.po.env.find_float(
+            "PS_REQUEST_TIMEOUT",
+            10.0 if getattr(self.po, "elastic", False) else 0.0,
+        )
         self._req_retries = self.po.env.find_int("PS_REQUEST_RETRIES", 3)
         self._replication = self.po.env.find_int("PS_KV_REPLICATION", 1)
         self._down_servers: set = set()
@@ -363,6 +395,14 @@ class KVWorker:
         # ts -> (monotonic start, pull?, trace id, wall-aligned start us)
         self._req_track: Dict[int, Tuple[float, bool, int, float]] = {}
         self.po.register_node_failure_hook(self._on_node_event)
+        # Elastic routing (docs/elasticity.md): wrong-owner bounce
+        # accounting, throttled stale-table pulls, and the routing hook
+        # that invalidates migrated hot-cache entries.
+        self._c_wrong_owner = self.po.metrics.counter(
+            "kv.wrong_owner_bounces")
+        self._last_routing_pull = 0.0
+        self._routing_hook = self._on_routing
+        self.po.register_routing_hook(self._routing_hook)
 
     @property
     def engine(self):
@@ -469,6 +509,14 @@ class KVWorker:
         alloc = getattr(self.po.van, "alloc_pull_segment", None)
         if alloc is None:
             return None
+        if getattr(self.po, "elastic", False):
+            # Elastic membership migrates ranges live; the per-server
+            # byte offsets registered below would silently go stale on
+            # the first epoch change — decline, callers pull into
+            # ordinary arrays (docs/elasticity.md).
+            log.warning("alloc_pull_buffer: elastic membership "
+                        "(PS_ELASTIC) active; zero-copy pull disabled")
+            return None
         if self._slicer is not default_slicer:
             # The per-server offsets below assume the default key-range
             # partition; a custom slicer would misplace slices silently.
@@ -564,12 +612,13 @@ class KVWorker:
         cache's admission set with the union.  Returns the keys.  The
         message-path analog of reading psmon's "hot keys" column —
         one tiny pull per server, cmd=HOT_KEYS_CMD."""
-        ranges = self.po.get_server_key_ranges()
-        ts = self._customer.new_request(SERVER_GROUP)
+        entries = self._route_entries()
+        ts = self._customer.new_request(SERVER_GROUP,
+                                        num_responses=len(entries))
         with self._mu:
             self._raw_ts.add(ts)
         try:
-            for group_rank in range(len(ranges)):
+            for rng, owner in entries:
                 msg = Message()
                 m = msg.meta
                 m.app_id = self._customer.app_id
@@ -578,10 +627,10 @@ class KVWorker:
                 m.pull = True
                 m.head = HOT_KEYS_CMD
                 m.timestamp = ts
-                m.recver = self._route(group_rank)
+                m.recver = self._route(owner)
                 m.val_len = int(k)  # how many hot keys we want back
-                m.key = int(ranges[group_rank].begin)
-                msg.add_data(SArray(np.array([ranges[group_rank].begin],
+                m.key = int(rng.begin)
+                msg.add_data(SArray(np.array([rng.begin],
                                              dtype=np.uint64)))
                 msg.add_data(SArray(np.empty(0, np.float32)))
                 self.po.van.send(msg)
@@ -1022,6 +1071,7 @@ class KVWorker:
 
     def stop(self) -> None:
         self.po.unregister_node_failure_hook(self._on_node_event)
+        self.po.unregister_routing_hook(self._routing_hook)
         with self._sweep_cv:
             self._sweep_stop = True
             self._sweep_cv.notify_all()
@@ -1047,6 +1097,54 @@ class KVWorker:
         if down:
             self._wake_sweeper()
 
+    def _on_routing(self, table) -> None:
+        """Postoffice routing hook (docs/elasticity.md): a new epoch
+        landed.  Invalidate hot-cache entries of every MIGRATED range —
+        their fill stamps were minted by the old owner, which the new
+        owner's independent version counter can never supersede — and
+        wake the sweeper so wrong-owner slices re-route immediately."""
+        if self._hot_cache is not None:
+            for e in table.entries:
+                if e.prev not in (-1, e.owner):
+                    self._hot_cache.invalidate_range(e.begin, e.end)
+        self._wake_sweeper()
+
+    def _route_entries(self) -> List[Tuple[Range, int]]:
+        """The worker's current ``(key range, owner rank)`` slicing
+        plan: the routing table's entries under elastic membership
+        (owners are NOT the entry index once ranges migrate), else the
+        static uniform split where entry i is owned by rank i."""
+        rt = self.po.current_routing()
+        if rt is not None:
+            return [(Range(e.begin, e.end), e.owner) for e in rt.entries]
+        return [(rng, i)
+                for i, rng in enumerate(self.po.get_server_key_ranges())]
+
+    def _maybe_pull_routing(self, seen_epoch: int) -> None:
+        """A server bounced us with a routing epoch ahead of ours: pull
+        the current table from the scheduler (throttled — one pull in
+        flight per window, not one per bounced slice)."""
+        rt = self.po.current_routing()
+        if seen_epoch <= (rt.epoch if rt is not None else -1):
+            return
+        now = time.monotonic()
+        with self._mu:
+            if now - self._last_routing_pull < 0.2:
+                return
+            self._last_routing_pull = now
+        from ..base import SCHEDULER_ID
+        from ..message import Command, Control
+
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.control = Control(cmd=Command.ROUTING)
+        msg.meta.timestamp = self.po.van.next_timestamp()
+        try:
+            self.po.van.send(msg)
+        except Exception as exc:  # noqa: BLE001 - next bounce retries
+            log.warning(f"routing pull failed: {exc!r}")
+
     def _route(self, group_rank: int) -> int:
         """Destination id for a key-range slice: the owning rank, or —
         when it is down and replication is on — the first live member
@@ -1059,12 +1157,18 @@ class KVWorker:
         if base not in self._down_servers:
             return base
         for rank in chain_ranks(group_rank, self._replication,
-                                self.po.num_servers):
+                                self.po.num_servers,
+                                active=self.po.active_server_ranks):
             cand = server_rank_to_id(rank * gs + self.po.instance_idx)
             if cand not in self._down_servers:
                 self._c_failovers.inc()
                 return cand
         return base
+
+    # Wrong-owner re-routes allowed per request before it is abandoned
+    # (each bounce is a live server answering; the worker's table pull
+    # converges in a broadcast round trip — 50 is a deep safety net).
+    _MAX_WRONG_OWNER_BOUNCES = 50
 
     def _mark_timed_out(self, ts: int) -> None:
         """Record a timed-out/abandoned request (caller holds _mu):
@@ -1121,7 +1225,19 @@ class KVWorker:
                 ]
                 if not troubled:
                     continue
-                if req.attempt >= self._req_retries:
+                # A pass whose every troubled slice is a wrong-owner
+                # bounce charges the (generous) bounce budget, not the
+                # retry budget: bounces answer immediately, so a few ms
+                # of routing-table lag would otherwise exhaust
+                # PS_REQUEST_RETRIES without one real failure.
+                bounce_only = not expired and all(
+                    s.wrong_owner for s in troubled
+                )
+                exhausted = (
+                    req.bounces >= self._MAX_WRONG_OWNER_BOUNCES
+                    if bounce_only else req.attempt >= self._req_retries
+                )
+                if exhausted:
                     self._pending.pop(ts)
                     self._mark_timed_out(ts)
                     # Release the abandoned request's pull state NOW:
@@ -1134,40 +1250,59 @@ class KVWorker:
                     self._zpull_ts.discard(ts)
                     failures.append((ts, len(unresp)))
                     continue
-                req.attempt += 1
-                # Exponential backoff: each attempt doubles the window.
-                req.deadline = now + self._req_timeout * (2 ** req.attempt)
+                if bounce_only:
+                    req.bounces += 1
+                    req.deadline = max(req.deadline,
+                                       now + self._req_timeout)
+                else:
+                    req.attempt += 1
+                    # Exponential backoff: each attempt doubles the
+                    # window.
+                    req.deadline = now + self._req_timeout * (
+                        2 ** req.attempt)
                 for s in troubled:
                     s.retry_now = False
                 self._c_retries.inc(len(troubled))
                 retries.append((req, troubled))
         for req, slices in retries:
             for sl in slices:
-                dest = self._route(sl.group_rank)
-                old = sl.sent_msg
-                if (old is not None and dest != sl.dest
-                        and self.po.van.resender is not None):
-                    # Stop retransmitting the original: its destination
-                    # is being abandoned, and a give-up there would
-                    # spuriously fail the now-failed-over request.
-                    self.po.van.resender.forget(old.meta.control.msg_sig)
-                log.vlog(1, f"retry ts={req.ts} slice rank="
-                            f"{sl.group_rank} -> node {dest} "
-                            f"(attempt {req.attempt})")
-                sl.dest = dest
-                msg = self._slice_msg(
-                    req.ts, req.push, req.pull, req.cmd, sl.part,
-                    sl.group_rank, dest, req.val_dtype, req.val_nbytes,
-                    req.codec, req.zpull, req.trace, enc=sl.enc,
-                    tenant=req.tenant,
-                )
-                try:
-                    self.po.van.send(msg)
-                    sl.sent_msg = msg
-                except Exception as exc:  # noqa: BLE001 - next sweep retries
-                    log.warning(
-                        f"retry send ts={req.ts} to {dest} failed: {exc!r}"
+                subs = [sl]
+                if sl.wrong_owner:
+                    # Stale-epoch bounce (docs/elasticity.md): re-slice
+                    # under the CURRENT routing table — a split that
+                    # landed mid-range divides this slice across two
+                    # new owners.
+                    sl.wrong_owner = False
+                    subs = self._resplit_slice(req, sl)
+                for sub in subs:
+                    dest = self._route(sub.group_rank)
+                    old = sub.sent_msg
+                    if (old is not None and dest != sub.dest
+                            and self.po.van.resender is not None):
+                        # Stop retransmitting the original: its
+                        # destination is being abandoned, and a give-up
+                        # there would spuriously fail the now-failed-
+                        # over request.
+                        self.po.van.resender.forget(
+                            old.meta.control.msg_sig)
+                    log.vlog(1, f"retry ts={req.ts} slice rank="
+                                f"{sub.group_rank} -> node {dest} "
+                                f"(attempt {req.attempt})")
+                    sub.dest = dest
+                    msg = self._slice_msg(
+                        req.ts, req.push, req.pull, req.cmd, sub.part,
+                        sub.group_rank, dest, req.val_dtype,
+                        req.val_nbytes, req.codec, req.zpull, req.trace,
+                        enc=sub.enc, tenant=req.tenant,
                     )
+                    try:
+                        self.po.van.send(msg)
+                        sub.sent_msg = msg
+                    except Exception as exc:  # noqa: BLE001 - next sweep
+                        log.warning(
+                            f"retry send ts={req.ts} to {dest} failed: "
+                            f"{exc!r}"
+                        )
         for ts, deficit in failures:
             log.warning(
                 f"request ts={ts} abandoned after {self._req_retries} "
@@ -1176,6 +1311,48 @@ class KVWorker:
             # Square the response ledger so wait(ts) unblocks (and then
             # raises TimeoutError via _timeout_ts).
             self._customer.add_response(ts, deficit)
+
+    def _resplit_slice(self, req: _PendingReq,
+                       sl: _PendingSlice) -> List[_PendingSlice]:
+        """Re-slice a wrong-owner slice's keys under the current
+        routing table (docs/elasticity.md).  Single-owner results
+        reuse the slice (retargeted); multi-owner splits REPLACE it in
+        the request's slice list and raise the expected-response bar by
+        the extra sub-slices.  Codec payloads re-encode per sub-slice
+        (fresh EF slots — the original fold stays with the abandoned
+        destination's slot; a migration-window fold is one step of
+        residual, not a correctness loss)."""
+        entries = self._route_entries()
+        ranges = [rng for rng, _owner in entries]
+        parts = self._slicer(sl.part, ranges)
+        live = [
+            (entries[i][1], p) for i, p in enumerate(parts)
+            if p is not None and not p.empty()
+        ]
+        if len(live) <= 1:
+            if live:
+                sl.group_rank = live[0][0]
+            return [sl]
+        subs = [
+            _PendingSlice(group_rank=owner, part=p, dest=-1)
+            for owner, p in live
+        ]
+        if req.codec is not None and req.push:
+            for sub in subs:
+                sub.enc = self._encode_part(req.codec, sub.group_rank,
+                                            sub.part)
+        with self._mu:
+            try:
+                idx = req.slices.index(sl)
+            except ValueError:
+                return [sl]  # already replaced/retired elsewhere
+            req.slices[idx:idx + 1] = subs
+        # Each sub-slice draws its own response; pre-charge the ledger
+        # so completion still needs every one of them.
+        self._customer.add_response(req.ts, -(len(subs) - 1))
+        log.vlog(1, f"re-sliced ts={req.ts} across "
+                    f"{[s.group_rank for s in subs]} (routing change)")
+        return subs
 
     # -- internals -----------------------------------------------------------
 
@@ -1270,18 +1447,27 @@ class KVWorker:
         trace: int = 0,
         tenant: int = 0,
     ) -> None:
-        ranges = self.po.get_server_key_ranges()
+        entries = self._route_entries()
+        ranges = [rng for rng, _owner in entries]
         sliced = self._slicer(kvs, ranges)
-        skipped = sum(1 for s in sliced if s is None or s.empty())
-        if skipped:
-            self._customer.add_response(ts, skipped)
-            if skipped == len(sliced):
-                self._finish(ts)  # also releases any _pull_dst entry
-                return
-        parts = [
-            (group_rank, part, self._route(group_rank))
-            for group_rank, part in enumerate(sliced)
+        live = [
+            (entries[i][1], part)
+            for i, part in enumerate(sliced)
             if part is not None and not part.empty()
+        ]
+        # Square the response ledger against what is actually sent:
+        # empty slices are pre-credited as before, and under elastic
+        # routing the entry count may DIFFER from the active server
+        # count the tracker recorded (a merged range's owner holds two
+        # entries — the negative credit raises the expected bar).
+        credit = self._customer.num_expected(ts) - len(live)
+        if credit:
+            self._customer.add_response(ts, credit)
+        if not live:
+            self._finish(ts)  # also releases any _pull_dst entry
+            return
+        parts = [
+            (owner, part, self._route(owner)) for owner, part in live
         ]
         # Encode ONCE, before any send can fail: a sweeper retry (or
         # replica failover) re-sends the identical compressed bytes —
@@ -1358,6 +1544,7 @@ class KVWorker:
         ts = msg.meta.timestamp
         discount = False
         retry_now = False
+        wrong_owner_epoch = None
         with self._mu:
             req = self._pending.get(ts)
             sl = None
@@ -1368,7 +1555,33 @@ class KVWorker:
                      if len(s.part.keys) and int(s.part.keys[0]) == key),
                     None,
                 )
-            if msg.meta.option == OPT_SEND_FAILED:
+            if msg.meta.option == OPT_WRONG_OWNER:
+                # The destination no longer owns the slice's key range
+                # (docs/elasticity.md): nothing was applied there.  With
+                # retry budget left, hand the slice to the sweeper —
+                # which re-SLICES it under the current routing table —
+                # and discount the bounce so the re-routed slices'
+                # real responses complete the count.
+                self._c_wrong_owner.inc()
+                wrong_owner_epoch = msg.meta.val_len
+                if (req is not None
+                        and req.bounces < self._MAX_WRONG_OWNER_BOUNCES):
+                    discount = retry_now = True
+                    if sl is not None:
+                        sl.retry_now = True
+                        sl.wrong_owner = True
+                    else:
+                        req.deadline = 0.0  # unmatched: expire them all
+                elif req is None and self._req_timeout > 0:
+                    # Stale bounce after the slice already completed
+                    # elsewhere (or was abandoned): never fail a
+                    # finished wait().
+                    pass
+                else:
+                    self._mark_timed_out(ts)
+                    if sl is not None:
+                        sl.responded = True
+            elif msg.meta.option == OPT_SEND_FAILED:
                 # The van abandoned the slice's delivery.  With retry
                 # budget left, hand it to the sweeper (and discount the
                 # synthesized response so the retry's real response
@@ -1397,6 +1610,11 @@ class KVWorker:
                     discount = True
                 else:
                     sl.responded = True
+        if wrong_owner_epoch is not None:
+            # The bouncing server runs a newer routing epoch than ours:
+            # pull the current table from the scheduler (throttled) so
+            # the re-route targets the right owner, not the same wall.
+            self._maybe_pull_routing(wrong_owner_epoch)
         if discount:
             # Pre-compensate the +1 the Customer adds after this handle.
             self._customer.add_response(ts, -1)
@@ -1461,7 +1679,11 @@ class KVWorker:
                                      kvs.keys, kvs.vals)
         # The Customer increments the response count *after* this handle, so
         # "last response" is expected-1 (reference: kv_app.h:686-710).
-        expected = self.po.num_servers
+        # Expected is the PER-REQUEST count the tracker recorded at
+        # issue time: under elastic routing the fan-out varies with the
+        # table (and with sweeper re-slices), so a global server count
+        # would mis-detect completion.
+        expected = self._customer.num_expected(ts)
         if self._customer.num_response(ts) + 1 >= expected:
             self._finish(ts)
 
@@ -1550,6 +1772,56 @@ class KVServer:
 
     def __init__(self, app_id: int, postoffice=None):
         self.po = postoffice or ps_mod.postoffice(Role.SERVER)
+        self._handle: Optional[Callable[[KVMeta, KVPairs, "KVServer"], None]] = None
+        self._apply_pool: Optional[ApplyShardPool] = None
+        # Elastic membership (docs/elasticity.md): ownership + parking
+        # state.  _owned is None until a routing table lands (static
+        # behavior — every request is ours); after that, requests whose
+        # keys fall outside it bounce with OPT_WRONG_OWNER, and
+        # requests for a PENDING range (gained, migration data not yet
+        # arrived) park until the handoff lands.  Initialized from the
+        # node's CURRENT table BEFORE the customer starts draining
+        # parked requests: a joiner that applied early-routed requests
+        # tableless would have them silently overwritten by the
+        # migration import.
+        self._elastic_mu = threading.Lock()
+        self._owned: Optional[List[Range]] = None
+        self._table = None  # the applied RoutingTable (gate reads it)
+        self._routing_epoch = -1
+        # range begin -> {"range", "frm", "epoch", "parked", "timer"}
+        self._pending_ranges: Dict[int, dict] = {}
+        # Migrations that arrived BEFORE their routing table (begin ->
+        # epoch): the table application skips parking those ranges.
+        self._arrived_migrations: Dict[int, int] = {}
+        self._migrate_timeout = self.po.env.find_float(
+            "PS_MIGRATE_TIMEOUT", 30.0)
+        self._c_wrong_owner = self.po.metrics.counter("kv.wrong_owner")
+        self._c_migrated_out = self.po.metrics.counter(
+            "kv.migrated_keys_out")
+        self._c_migrated_in = self.po.metrics.counter(
+            "kv.migrated_keys_in")
+        self._c_parked = self.po.metrics.counter("kv.parked_requests")
+        # Migration acks that came back ERROR-marked (the new owner's
+        # import raised): the old owner must NOT drop its copy.
+        self._migrate_nacks = BoundedKeySet(256)
+        # Outbound migrations are SERIALIZED through one worker thread
+        # (queue + in-flight flag): a second epoch landing mid-handoff
+        # must neither spawn a concurrent exporter nor let a leaver
+        # report REMOVE_DONE while an earlier epoch's ranges are still
+        # streaming out.
+        self._migrate_q: List[tuple] = []
+        self._migrating = False
+        self._routing_hook = None
+        if getattr(self.po, "elastic", False):
+            table = self.po.current_routing()
+            if table is not None:
+                self._apply_routing_update(table)
+            elif getattr(self.po, "elastic_join", False):
+                # Live joiner whose first ROUTING broadcast is still in
+                # flight: it owns NOTHING yet.  Bounce (never apply)
+                # early-routed requests — applying them tableless would
+                # let the migration import silently overwrite them.
+                self._owned = []
         # Executor mode is clamped to <= 1 here: the apply pool's
         # invariants (arrival-order shard affinity, per-sender response
         # order, serial/sharded bit-exactness) all assume ONE thread
@@ -1642,6 +1914,14 @@ class KVServer:
         self._codec_ef_enabled = codecs_mod.ef_enabled(self.po.env)
         self._c_codec_raw = self.po.metrics.counter("codec.raw_bytes")
         self._c_codec_wire = self.po.metrics.counter("codec.wire_bytes")
+        # Elastic routing updates flow through the customer queue (the
+        # cutover must serialize against earlier queued requests), so
+        # the hook registers only now that the customer exists; the
+        # registration replays the current table, which the epoch guard
+        # in _apply_routing_update discards as already applied.
+        if getattr(self.po, "elastic", False):
+            self._routing_hook = self._on_routing
+            self.po.register_routing_hook(self._routing_hook)
         rep = self.po.env.find_int("PS_KV_REPLICATION", 1)
         if rep >= 2 and self.po.num_servers >= 2:
             from .replication import Replicator
@@ -1682,6 +1962,7 @@ class KVServer:
                 handle, self._apply_shards, self
             )
         if (self._replicator is not None and self.po.is_recovery
+                and not getattr(self.po, "elastic_join", False)
                 and not self._restored):
             # Recovered server: restore this rank's key range from its
             # first replica BEFORE serving — the old path rejoined with
@@ -1944,6 +2225,399 @@ class KVServer:
         msg.meta.priority = max(msg.meta.priority, 1)
         self.po.van.send(msg)
 
+    # -- elastic membership (docs/elasticity.md) -----------------------------
+
+    _MAX_PARKED = 4096  # per pending range; overflow sheds retryably
+
+    def response_wrong_owner(self, req: KVMeta, epoch: int) -> None:
+        """Empty ``OPT_WRONG_OWNER``-marked response: this server does
+        not own the request's key range under its current routing
+        epoch.  Nothing was applied; ``val_len`` carries the epoch so
+        the stale worker can pull a fresher table, and its sweeper
+        re-slices + re-routes — never a hang, never a silent apply at
+        the wrong server."""
+        if req.option == OPT_REPLICA:
+            return
+        msg = self._response_msg(req)
+        msg.meta.option = OPT_WRONG_OWNER
+        msg.meta.addr = 0
+        msg.meta.val_len = max(int(epoch), 0)
+        # Bounces are re-route control signals: express band, like sheds.
+        msg.meta.priority = max(msg.meta.priority, 1)
+        self.po.van.send(msg)
+
+    def _on_routing(self, table) -> None:
+        """Postoffice routing hook (van receive pump): post the new
+        table through the request queue so the cutover runs on the
+        request-processing thread — every request queued BEFORE it
+        applies under the old epoch, everything after parks or
+        bounces.  That ordering (plus the apply-pool quiesce token
+        captured at cutover) is what makes the migration snapshot a
+        consistent cut."""
+        msg = Message()
+        msg.meta.request = True
+        msg.meta.app_id = self._customer.app_id
+        msg.meta.customer_id = self._customer.customer_id
+        msg.meta.head = ROUTING_LOCAL_CMD
+        msg._routing_table = table
+        self._customer.accept(msg)
+
+    def _apply_routing_update(self, table) -> None:
+        """Cutover to a new routing epoch (request thread only)."""
+        if table is None:
+            return
+        my = self.po.my_group_rank()
+        new_pending = []
+        with self._elastic_mu:
+            if table.epoch <= self._routing_epoch:
+                return
+            self._routing_epoch = table.epoch
+            self._table = table
+            self._owned = [Range(e.begin, e.end) for e in table.entries
+                           if e.owner == my]
+            losses = [e for e in table.entries
+                      if e.prev == my and e.owner != my]
+            for e in table.entries:
+                if e.owner != my or e.prev in (-1, my):
+                    continue
+                if self._arrived_migrations.pop(e.begin, None) is not None:
+                    continue  # the data beat the table here; already in
+                if e.begin in self._pending_ranges:
+                    continue
+                ent = {"range": Range(e.begin, e.end), "frm": e.prev,
+                       "epoch": table.epoch, "parked": [], "timer": None}
+                self._pending_ranges[e.begin] = ent
+                new_pending.append(ent)
+        for ent in new_pending:
+            t = threading.Timer(
+                self._migrate_timeout, self._pending_timeout,
+                args=(ent["range"].begin, ent["epoch"]),
+            )
+            t.daemon = True
+            ent["timer"] = t
+            t.start()
+        if losses:
+            if self._handle is None:
+                log.warning("routing update assigns migrations but no "
+                            "handle is set; ranges stay put")
+                return
+            # Quiesce token captured HERE (request thread): everything
+            # submitted to the apply pool so far is what the snapshot
+            # must wait for; requests after this point bounce at intake.
+            token = (self._apply_pool.submit_token()
+                     if self._apply_pool is not None else None)
+            with self._elastic_mu:
+                self._migrate_q.append((losses, table, token))
+                spawn = not self._migrating
+                if spawn:
+                    self._migrating = True
+            if spawn:
+                threading.Thread(
+                    target=self._migrate_out,
+                    name="kv-migrate-out", daemon=True,
+                ).start()
+        else:
+            with self._elastic_mu:
+                migrating = self._migrating
+            if my in table.leaving and not migrating:
+                # Decommission with nothing (left) to move: report done
+                # directly.  With a migration still in flight, the
+                # worker thread reports when it drains — a leaver must
+                # never be retired mid-handoff.
+                self._send_remove_done()
+
+    def _elastic_gate(self, msg: Message) -> bool:
+        """Ownership check at intake (request thread).  Returns True
+        when the message was consumed: parked at a pending range
+        (gained, migration data still in flight) or bounced with
+        OPT_WRONG_OWNER.  Plain KV requests only — migration,
+        replication, fetch, and introspection traffic passes."""
+        m = msg.meta
+        if (not m.request or m.simple_app or m.head != 0
+                or m.option in (OPT_REPLICA, OPT_XFER_PART)):
+            return False
+        if not msg.data:
+            return False
+        keys = msg.data[0].astype_view(np.uint64).numpy()
+        if len(keys) == 0:
+            return False
+        park_full = False
+        with self._elastic_mu:
+            epoch = self._routing_epoch
+            for ent in self._pending_ranges.values():
+                r = ent["range"]
+                lo = int(np.searchsorted(keys, r.begin))
+                hi = int(np.searchsorted(keys, r.end))
+                if hi > lo:  # any key in the pending range: park whole
+                    if len(ent["parked"]) >= self._MAX_PARKED:
+                        park_full = True
+                        break
+                    ent["parked"].append(msg)
+                    self._c_parked.inc()
+                    return True
+            if not park_full:
+                # EVERY key must fall in an acceptable range — a very
+                # stale worker's slice can span ranges that now
+                # interleave with another owner's; first/last checks
+                # would let the middle keys apply at the wrong server
+                # silently.  Acceptable = owned by me, OR owned by a
+                # DOWN rank whose replica chain includes me: the
+                # failover machinery (docs/fault_tolerance.md)
+                # deliberately re-routes a dead owner's slices here,
+                # and the routing table knows nothing about crashes —
+                # bouncing those would turn every failover into a
+                # bounce loop.
+                table = self._table
+                my = self.po.my_group_rank()
+                n_in = 0
+                for e in (table.entries if table is not None else ()):
+                    lo = int(np.searchsorted(keys, e.begin))
+                    hi = int(np.searchsorted(keys, e.end))
+                    if hi <= lo:
+                        continue
+                    if e.owner == my:
+                        n_in += hi - lo
+                    elif self._replicator is not None:
+                        from .replication import chain_ranks
+
+                        oid = server_rank_to_id(
+                            e.owner * self.po.group_size
+                            + self.po.instance_idx)
+                        if (self.po.van.is_peer_down(oid)
+                                and my in chain_ranks(
+                                    e.owner, self._replicator.k,
+                                    self.po.num_servers,
+                                    active=self.po.active_server_ranks)):
+                            n_in += hi - lo
+                if n_in == len(keys):
+                    return False
+        meta = KVMeta(
+            cmd=m.head, push=m.push, pull=m.pull, sender=m.sender,
+            timestamp=m.timestamp, customer_id=m.customer_id, key=m.key,
+            option=m.option, priority=m.priority, trace=m.trace,
+            tenant=m.tenant,
+        )
+        if park_full:
+            # Park buffer overflow: shed retryably (OPT_OVERLOAD)
+            # rather than queue unbounded memory behind a slow handoff.
+            self._c_shed.inc()
+            self.response_overload(meta)
+            return True
+        self._c_wrong_owner.inc()
+        self.response_wrong_owner(meta, epoch)
+        return True
+
+    def _import_migration(self, msg: Message) -> None:
+        """A range handoff landed (MIGRATE_CMD from the old owner):
+        import the snapshot, release the pending range, replay parked
+        requests in arrival order (request thread — no new arrivals
+        interleave), and ack the sender."""
+        from .replication import import_range as _import_range
+
+        m = msg.meta
+        if self._handle is None:
+            # Construction race: the app registered its customer but
+            # has not installed the handle yet.  Requeue — an error-
+            # marked response here would read as an ACK at the old
+            # owner, which would then DROP the only copy.
+            time.sleep(0.002)
+            self._customer.accept(msg)
+            return
+        keys = (msg.data[0].astype_view(np.uint64).numpy()
+                if len(msg.data) >= 1 else np.empty(0, np.uint64))
+        vals = (msg.data[1].numpy() if len(msg.data) >= 2
+                else np.empty(0, np.float32))
+        lens = (msg.data[2].astype_view(np.int32).numpy()
+                if len(msg.data) > 2 else None)
+        if len(keys):
+            _import_range(self._handle, keys, vals, lens)
+            self._c_migrated_in.inc(len(keys))
+        with self._elastic_mu:
+            ent = self._pending_ranges.pop(m.key, None)
+            if ent is None:
+                # Data raced ahead of the routing broadcast: remember
+                # the arrival so the table application skips parking.
+                self._arrived_migrations[m.key] = int(m.addr)
+                while len(self._arrived_migrations) > 64:
+                    self._arrived_migrations.pop(
+                        next(iter(self._arrived_migrations)))
+        if ent is not None and ent.get("timer") is not None:
+            ent["timer"].cancel()
+        log.vlog(1, f"imported {len(keys)} migrated keys at "
+                    f"{m.key} (epoch {m.addr})")
+        meta = KVMeta(
+            cmd=m.head, push=True, pull=False, sender=m.sender,
+            timestamp=m.timestamp, customer_id=m.customer_id,
+            key=m.key, addr=m.addr,
+        )
+        # NOT chain-forwarded: a migration import is SET semantics and
+        # cannot safely ride the replicas' ordered += apply path.  The
+        # old owner's chain still holds the range's pre-handoff state
+        # (only the old PRIMARY drops its copy), and the new owner's
+        # chain backfills through subsequent pushes — full backfill on
+        # chain recomputation is a ROADMAP follow-up.
+        self.response(meta)
+        if ent is not None:
+            for parked in ent["parked"]:
+                try:
+                    self._process_request(parked)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(f"parked request replay failed: {exc!r}")
+                    try:
+                        self._request_error(parked, exc)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def _migrate_out(self) -> None:
+        """Migration worker thread: drain queued migration batches in
+        epoch order — for each, wait for every apply submitted before
+        its cutover to finish (quiesce token), then stream each lost
+        range to its new owner.  A leaver reports REMOVE_DONE only
+        when the queue is DRY (never mid-handoff), judged against the
+        CURRENT table."""
+        while True:
+            with self._elastic_mu:
+                if not self._migrate_q:
+                    self._migrating = False
+                    table = self._table
+                    break
+                losses, table, token = self._migrate_q.pop(0)
+            if self._apply_pool is not None and token is not None:
+                if not self._apply_pool.quiesce(
+                        token, timeout_s=self._migrate_timeout):
+                    log.warning("migrate: apply pool did not quiesce "
+                                "in time; snapshotting anyway")
+            for e in losses:
+                try:
+                    self._migrate_range(e, table)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(f"migration of [{e.begin}, {e.end}) -> "
+                                f"rank {e.owner} failed: {exc!r}")
+        if (table is not None
+                and self.po.my_group_rank() in table.leaving):
+            self._send_remove_done()
+
+    def _migrate_range(self, e, table) -> None:
+        """Snapshot one lost range and push it to the new owner
+        (MIGRATE_CMD; large snapshots ride the chunked streaming
+        plane automatically).  The local copy is dropped only after
+        the new owner acks the import."""
+        from .replication import export_range as _export_range
+
+        keys, vals, lens = _export_range(self._handle, e.begin, e.end)
+        dest = server_rank_to_id(
+            e.owner * self.po.group_size + self.po.instance_idx)
+        ts = self._customer.new_request(dest)
+        msg = Message()
+        m = msg.meta
+        m.app_id = self._customer.app_id
+        m.customer_id = self._customer.customer_id
+        m.request = True
+        m.push = True
+        m.head = MIGRATE_CMD
+        m.timestamp = ts
+        m.recver = dest
+        m.key = int(e.begin)
+        m.addr = int(table.epoch)
+        m.val_len = vals.nbytes
+        msg.add_data(SArray(keys))
+        msg.add_data(SArray(vals))
+        msg.add_data(SArray(np.asarray(lens, dtype=np.int32)))
+        self.po.van.send(msg)
+        ok = self._customer.wait_request(
+            ts, timeout=self._migrate_timeout)
+        if not ok or ts in self._migrate_nacks:
+            self._migrate_nacks.discard(ts)
+            log.warning(f"migration of [{e.begin}, {e.end}) to rank "
+                        f"{e.owner} "
+                        f"{'failed at the importer' if ok else 'unacked'}"
+                        f"; keeping the local copy")
+            return
+        self._drop_keys(keys)
+        self._c_migrated_out.inc(len(keys))
+        log.vlog(1, f"migrated {len(keys)} keys of [{e.begin}, {e.end}) "
+                    f"-> rank {e.owner}")
+
+    def _drop_keys(self, keys) -> None:
+        handle = self._handle
+        if callable(getattr(handle, "drop_keys", None)):
+            handle.drop_keys(keys)
+            return
+        store = getattr(handle, "store", None)
+        if store is None:
+            return
+        for k in keys.tolist():
+            store.pop(int(k), None)
+
+    def _pending_timeout(self, begin: int, epoch: int) -> None:
+        """A gained range's migration data never arrived (source died
+        mid-handoff?): try the old owner's replica chain, then unpark —
+        parked waiters must complete or fail, never hang."""
+        with self._elastic_mu:
+            ent = self._pending_ranges.get(begin)
+            if ent is None or ent["epoch"] != epoch:
+                return
+            rng, frm = ent["range"], ent["frm"]
+        log.warning(f"migration of [{rng.begin}, {rng.end}) from rank "
+                    f"{frm} overdue; trying replica fallback")
+        if self._replicator is not None and self._handle is not None:
+            from .replication import chain_ranks
+
+            gs = self.po.group_size
+            to_id = lambda r: server_rank_to_id(  # noqa: E731
+                r * gs + self.po.instance_idx)
+            cands = [to_id(frm)] + [
+                to_id(r) for r in chain_ranks(
+                    frm, self._replicator.k, self.po.num_servers,
+                    active=self.po.active_server_ranks)
+            ]
+            try:
+                self._replicator._fetch_range(self._handle, rng, cands,
+                                              timeout_s=10.0)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"replica fallback for [{rng.begin}, "
+                            f"{rng.end}) failed: {exc!r}")
+        with self._elastic_mu:
+            ent = self._pending_ranges.pop(begin, None)
+        if ent is None:
+            return  # the real handoff landed while we were fetching
+        for parked in ent["parked"]:
+            # Re-inject through the intake queue: this is a timer
+            # thread, and request processing is single-threaded.
+            # Cross-timeout arrival order is best-effort — this is the
+            # degraded path of a handoff whose source died.
+            self._customer.accept(parked)
+
+    def _send_remove_done(self) -> None:
+        """Tell the scheduler this leaver finished migrating
+        (REMOVE_DONE_OPT on REMOVE_NODE): it may now retire the rank."""
+        import json as _json
+
+        from ..base import SCHEDULER_ID
+        from ..message import Command, Control
+
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.option = self.po.van.REMOVE_DONE_OPT
+        msg.meta.body = _json.dumps(
+            {"rank": self.po.my_group_rank()}).encode()
+        msg.meta.control = Control(cmd=Command.REMOVE_NODE)
+        msg.meta.timestamp = self.po.van.next_timestamp()
+        try:
+            self.po.van.send(msg)
+        except Exception as exc:  # noqa: BLE001
+            log.warning(f"REMOVE_DONE send failed: {exc!r}")
+
+    def decommission(self, timeout_s: float = 60.0) -> None:
+        """Gracefully leave the running cluster (docs/elasticity.md):
+        the scheduler reassigns this server's ranges, this server
+        migrates them live, and the rank is retired — no restart, no
+        dropped requests.  Afterwards, ``stop()`` this server and
+        ``finalize(do_barrier=False)`` its postoffice (a retired node
+        is no longer counted in barriers)."""
+        self.po.request_decommission(timeout_s)
+
     def _tenant_counter(self, tid: int, kind: str):
         """Lazily created per-tenant counters (psmon's tenant rollup):
         ``tenant.<name>.requests`` / ``tenant.<name>.shed``."""
@@ -1977,6 +2651,14 @@ class KVServer:
     def stop(self) -> None:
         self._customer.stop()
         self.po.unregister_node_failure_hook(self._on_stream_peer_event)
+        if self._routing_hook is not None:
+            self.po.unregister_routing_hook(self._routing_hook)
+        with self._elastic_mu:
+            pend = list(self._pending_ranges.values())
+            self._pending_ranges.clear()
+        for ent in pend:
+            if ent.get("timer") is not None:
+                ent["timer"].cancel()
         self._abort_streams()
         if self._apply_pool is not None:
             self._apply_pool.stop()
@@ -2032,6 +2714,12 @@ class KVServer:
         return (
             self._apply_pool is not None
             and self._replicator is None
+            # Elastic routing live: a stream opened before a cutover
+            # would have partially applied keys the final (bounced +
+            # re-routed) message then re-applies at the new owner —
+            # double-count.  Decline; the reassembled message takes the
+            # normal (ownership-checked) path (docs/elasticity.md).
+            and self._owned is None
             and (m.sender, m.key) not in self._recv_buffers
             # A partial straggling in after its sender was declared
             # dead must not re-open a stream the failure hook just
@@ -2113,7 +2801,13 @@ class KVServer:
         if not msg.meta.request:
             # With replication on, servers receive responses too (the
             # recovery restore's fetch).  Anything else is dropped: a
-            # response must never run the request handler.
+            # response must never run the request handler.  An ERROR-
+            # marked response to one of our own requests (a migration
+            # push whose import raised) is recorded so the migration
+            # thread keeps the local copy instead of dropping the only
+            # one.
+            if msg.meta.option == OPT_APPLY_ERROR:
+                self._migrate_nacks.add(msg.meta.timestamp)
             if self._replicator is not None:
                 self._replicator.absorb_response(msg)
             return
@@ -2125,12 +2819,26 @@ class KVServer:
         self._process_request(msg)
 
     def _process_request(self, msg: Message) -> None:
+        if msg.meta.head == ROUTING_LOCAL_CMD:
+            # Local cutover marker (docs/elasticity.md): the routing
+            # hook posts the new table through the request queue so the
+            # ownership flip serializes against every earlier request.
+            self._apply_routing_update(getattr(msg, "_routing_table",
+                                               None))
+            return
         if msg.meta.option == OPT_XFER_PART:
             # Partial delivery of a chunked streaming transfer: feed it
             # to the apply pool (or drop it — the final reassembled
             # message always follows).
             self._stream_part(msg)
             return
+        if (msg.meta.head == MIGRATE_CMD and msg.meta.push
+                and msg.meta.request
+                and msg.meta.option != OPT_REPLICA):
+            self._import_migration(msg)
+            return
+        if self._owned is not None and self._elastic_gate(msg):
+            return  # parked at a pending range, or bounced WRONG_OWNER
         xfer = getattr(msg, "_xfer_key", None)
         if xfer is not None:
             with self._streams_mu:
@@ -2177,6 +2885,12 @@ class KVServer:
                 vals=np.array([n for _, n in top], dtype=np.float32),
             ))
             return
+        if meta.option == OPT_REPLICA and self.tenants.enabled:
+            # Replica-side per-tenant accounting (docs/qos.md): a
+            # forward carries its origin tenant's EXT_QOS label, so the
+            # replica's rollups attribute the apply load to the TRUE
+            # tenant instead of lumping every forward on tenant 0.
+            self._tenant_counter(meta.tenant, "requests").inc()
         shed = False
         if (self._admit_limit > 0 and self._apply_pool is not None
                 and meta.option != OPT_REPLICA

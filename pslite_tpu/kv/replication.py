@@ -49,17 +49,75 @@ from ..utils.bounded import BoundedKeySet
 REPLICA_FETCH_CMD = 0x5EED
 
 
-def chain_ranks(group_rank: int, k: int, num_servers: int) -> List[int]:
+def chain_ranks(group_rank: int, k: int, num_servers: int,
+                active: Optional[List[int]] = None) -> List[int]:
     """The replica chain of a server rank: the next ``k-1`` group ranks
     in rank order, wrapping.  THE single source of the chain topology —
     servers use it to pick forward targets and workers to pick failover
-    destinations; two private copies would silently diverge."""
+    destinations; two private copies would silently diverge.
+
+    ``active`` (docs/elasticity.md) restricts the chain to the LIVE
+    ranks of an elastic cluster — chains recompute per routing epoch,
+    skipping departed ranks and including joiners; with ``active=None``
+    the static ``(rank + i) % num_servers`` order is unchanged."""
+    if active is not None:
+        order = sorted(set(active) | {group_rank})
+        idx = order.index(group_rank)
+        rot = order[idx + 1:] + order[:idx]
+        k = min(k, len(order))
+        return rot[: max(k - 1, 0)]
     k = min(k, max(num_servers, 1))
     return [
         (group_rank + i) % num_servers
         for i in range(1, k)
         if (group_rank + i) % num_servers != group_rank
     ]
+
+
+def export_range(handle, begin: int, end: int):
+    """Snapshot every stored key of ``handle`` in ``[begin, end)`` as
+    ``(keys, flat vals, per-key lens)`` — the currency of both the
+    replica state fetch and elastic range migration.  Prefers the
+    handle's own ``export_range`` hook; otherwise snapshots ``store``
+    with a short retry loop (apply-shard threads insert concurrently —
+    a bare iteration would raise ``dictionary changed size``)."""
+    if callable(getattr(handle, "export_range", None)):
+        return handle.export_range(begin, end)
+    store = getattr(handle, "store", None) or {}
+    items = None
+    for _ in range(100):
+        try:
+            items = list(store.items())
+            break
+        except RuntimeError:
+            continue
+    log.check(items is not None, "could not snapshot the store")
+    pairs = sorted((kk, arr) for kk, arr in items if begin <= kk < end)
+    keys = np.asarray([kk for kk, _ in pairs], dtype=np.uint64)
+    lens = np.asarray([arr.size for _, arr in pairs], dtype=np.int32)
+    vals = (
+        np.concatenate([arr.reshape(-1) for _, arr in pairs])
+        if pairs else np.empty(0, np.float32)
+    )
+    return keys, vals, lens
+
+
+def import_range(handle, keys, vals, lens) -> None:
+    """Load an exported range into ``handle`` (the inverse of
+    :func:`export_range`; prefers the handle's ``import_range``)."""
+    if callable(getattr(handle, "import_range", None)):
+        handle.import_range(keys, vals, lens)
+        return
+    store = getattr(handle, "store", None)
+    log.check(store is not None,
+              "state import needs a handle with .store or import_range()")
+    off = 0
+    for i, key in enumerate(keys):
+        n = int(lens[i]) if lens is not None else (
+            len(vals) // max(len(keys), 1)
+        )
+        store[int(key)] = vals[off:off + n].copy()
+        off += n
 
 
 class Replicator:
@@ -139,7 +197,8 @@ class Replicator:
         g, idx = my_rank // gs, my_rank % gs
         return [
             server_rank_to_id(r * gs + idx)
-            for r in chain_ranks(g, self.k, self.po.num_servers)
+            for r in chain_ranks(g, self.k, self.po.num_servers,
+                                 active=self.po.active_server_ranks)
         ]
 
     # -- origin dedup --------------------------------------------------------
@@ -223,6 +282,11 @@ class Replicator:
             # Forwards join the origin request's trace: the replica's
             # recv/apply spans land under the same trace id.
             m.trace = getattr(meta, "trace", 0)
+            # Carry the originating tenant's EXT_QOS label
+            # (docs/qos.md): replica-side per-tenant metrics, weighted
+            # apply scheduling, and admission backlogs must account the
+            # TRUE tenant, not lump every forward onto tenant 0.
+            m.tenant = getattr(meta, "tenant", 0)
             msg.add_data(SArray(kvs.keys))
             if wire is not None:
                 codes, scales, lens_arr, ci = wire
@@ -258,34 +322,7 @@ class Replicator:
         handle = server._handle
         from .kv_app import KVPairs
 
-        if callable(getattr(handle, "export_range", None)):
-            keys, vals, lens = handle.export_range(begin, end)
-        else:
-            store = getattr(handle, "store", None) or {}
-            # The apply pool's shard threads insert into the store
-            # concurrently; a bare iteration would raise "dictionary
-            # changed size during iteration" and turn the restore into
-            # a silent empty-range rejoin.  Snapshot with a short retry
-            # loop — an insert-heavy window loses the race only briefly.
-            items = None
-            for _ in range(100):
-                try:
-                    items = list(store.items())
-                    break
-                except RuntimeError:
-                    continue
-            log.check(items is not None,
-                      "could not snapshot the store for a replica fetch")
-            pairs = sorted(
-                (kk, arr) for kk, arr in items if begin <= kk < end
-            )
-            keys = np.asarray([kk for kk, _ in pairs], dtype=np.uint64)
-            lens = np.asarray([arr.size for _, arr in pairs],
-                              dtype=np.int32)
-            vals = (
-                np.concatenate([arr.reshape(-1) for _, arr in pairs])
-                if pairs else np.empty(0, np.float32)
-            )
+        keys, vals, lens = export_range(handle, begin, end)
         log.vlog(1, f"replica fetch [{begin}, {end}): {len(keys)} keys")
         server.response(meta, KVPairs(keys=keys, vals=vals, lens=lens))
 
@@ -315,26 +352,31 @@ class Replicator:
         my_rank = self.po.my_rank()
         g, idx = my_rank // gs, my_rank % gs
         num = self.po.num_servers
-        ranges = self.po.get_server_key_ranges()
+        active = self.po.active_server_ranks
+        ranks = active if active is not None else list(range(num))
         to_id = lambda r: server_rank_to_id(r * gs + idx)  # noqa: E731
+        chain = lambda r: chain_ranks(r, self.k, num,  # noqa: E731
+                                      active=active)
         total = 0
-        # My own range: fetch from my chain members.
-        total += self._fetch_range(
-            handle, ranges[g],
-            [to_id(r) for r in chain_ranks(g, self.k, num)], timeout_s,
-        )
+        # My own range(s) — several under elastic routing after a
+        # merge: fetch each from my chain members.
+        for rng in self.po.server_key_ranges_of(g):
+            total += self._fetch_range(
+                handle, rng, [to_id(r) for r in chain(g)], timeout_s,
+            )
         # Ranges I replicate for others: fetch from the primary first,
         # then its other chain members.
-        for r in range(num):
-            if r == g or g not in chain_ranks(r, self.k, num):
+        for r in ranks:
+            if r == g or g not in chain(r):
                 continue
-            total += self._fetch_range(
-                handle, ranges[r],
-                [to_id(r)] + [
-                    to_id(c) for c in chain_ranks(r, self.k, num) if c != g
-                ],
-                timeout_s,
-            )
+            for rng in self.po.server_key_ranges_of(r):
+                total += self._fetch_range(
+                    handle, rng,
+                    [to_id(r)] + [
+                        to_id(c) for c in chain(r) if c != g
+                    ],
+                    timeout_s,
+                )
         return total
 
     def _fetch_range(self, handle, rng, candidate_ids: List[int],
@@ -388,20 +430,7 @@ class Replicator:
         vals = resp.data[1].numpy()
         lens = (resp.data[2].astype_view(np.int32).numpy()
                 if len(resp.data) > 2 else None)
-        if callable(getattr(handle, "import_range", None)):
-            handle.import_range(keys, vals, lens)
-        else:
-            store = getattr(handle, "store", None)
-            log.check(store is not None,
-                      "replica restore needs a handle with .store or "
-                      "import_range()")
-            off = 0
-            for i, key in enumerate(keys):
-                n = int(lens[i]) if lens is not None else (
-                    len(vals) // max(len(keys), 1)
-                )
-                store[int(key)] = vals[off:off + n].copy()
-                off += n
+        import_range(handle, keys, vals, lens)
         log.vlog(1, f"restored {len(keys)} keys of "
                     f"[{rng.begin}, {rng.end}) from node {rid}")
         return len(keys)

@@ -48,6 +48,15 @@ class Command(enum.IntEnum):
     # a node for its metrics-registry snapshot; the reply carries it as
     # JSON in meta.body.  Rides the control plane like BARRIER.
     METRICS_PULL = 11
+    # Elastic membership (docs/elasticity.md): the scheduler's versioned
+    # routing-table broadcast (RoutingTable JSON in meta.body), and a
+    # node's table pull (request=True, stale-epoch self-heal).
+    ROUTING = 12
+    # Graceful decommission (docs/elasticity.md): a server asks the
+    # scheduler to leave the running cluster; the scheduler reassigns
+    # its key ranges (ROUTING epoch), the server migrates them, reports
+    # completion (REMOVE_DONE_OPT), and the scheduler retires it.
+    REMOVE_NODE = 13
 
 
 # Wire dtype codes (stable across hosts; independent of numpy internals).
@@ -124,6 +133,15 @@ OPT_XFER_PART = 6
 # ``wait()`` raises a retryable ``OverloadError`` (back off and retry)
 # instead of hanging, and completion callbacks are suppressed.
 OPT_OVERLOAD = 7
+
+# meta.option marker on an (empty) response: the receiving server does
+# NOT own the request's key range under its current routing epoch
+# (docs/elasticity.md — the worker raced a membership change with a
+# stale table).  Nothing was applied; ``meta.val_len`` carries the
+# server's epoch so the worker can pull a fresher table, and the
+# deadline sweeper re-slices + re-routes the slice — never a hang,
+# never a silent apply at the wrong server.
+OPT_WRONG_OWNER = 8
 
 
 @dataclass(frozen=True)

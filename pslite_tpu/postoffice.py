@@ -76,6 +76,28 @@ class Postoffice:
         self._exit_callback: Optional[Callable[[], None]] = None
         self._server_key_ranges: List[Range] = []
         self._server_key_ranges_mu = threading.Lock()
+        # Elastic membership (docs/elasticity.md): with PS_ELASTIC=1 the
+        # scheduler maintains a versioned RoutingTable (epoch-stamped
+        # key-range assignment) broadcast on every membership change;
+        # every node applies it here.  None = static routing (the
+        # uniform split below) — the default cluster is byte-identical
+        # to pre-elastic builds.
+        self.elastic = self.env.find_int("PS_ELASTIC", 0) != 0
+        # Set when the scheduler admitted this node as a live JOINER
+        # (ELASTIC_JOIN_OPT on the roster): it skips the startup
+        # barrier like a recovered node but must NOT run the replica
+        # restore — its state arrives through range migration instead.
+        self.elastic_join = False
+        self._routing = None  # Optional[routing.RoutingTable]
+        self._routing_mu = threading.Lock()
+        self._routing_hooks: List[Callable[[object], None]] = []
+        self._routing_hook_mu = threading.Lock()
+        # Live server group ranks (None = the static 0..num_servers-1).
+        # Rank holes are legal after an out-of-order decommission.
+        self._active_server_ranks: Optional[List[int]] = None
+        # Graceful decommission handshake (request_decommission):
+        # completed by the scheduler's REMOVE_NODE ack.
+        self._removed_event = threading.Event()
         self._node_ids: Dict[int, List[int]] = {}
         self._build_node_id_table()
 
@@ -123,6 +145,24 @@ class Postoffice:
         return self.num_servers * self.group_size
 
     @property
+    def active_server_ranks(self) -> Optional[List[int]]:
+        """Live server group ranks under elastic membership (None =
+        the static ``0..num_servers-1``)."""
+        return self._active_server_ranks
+
+    @property
+    def num_active_servers(self) -> int:
+        """Count of LIVE server groups — differs from ``num_servers``
+        only under elastic membership with rank holes."""
+        if self._active_server_ranks is not None:
+            return len(self._active_server_ranks)
+        return self.num_servers
+
+    @property
+    def num_active_server_instances(self) -> int:
+        return self.num_active_servers * self.group_size
+
+    @property
     def preferred_rank(self) -> int:
         """Preferred *instance* rank sent in ADD_NODE aux_id (DMLC_RANK)."""
         if self._preferred_group_rank == EMPTY_ID:
@@ -159,13 +199,24 @@ class Postoffice:
 
     def _build_node_id_table(self) -> None:
         """Group bitmask -> member instance ids (reference:
-        postoffice.cc:115-137)."""
+        postoffice.cc:115-137).  Under elastic membership the server
+        side follows the routing table's ACTIVE ranks (joiners appear,
+        departed ranks vanish — barriers, broadcasts, and the failure
+        detector's expectations all read this table)."""
         worker_ids = [
             worker_rank_to_id(i) for i in range(self.num_worker_instances)
         ]
-        server_ids = [
-            server_rank_to_id(i) for i in range(self.num_server_instances)
-        ]
+        if self._active_server_ranks is not None:
+            server_ids = [
+                server_rank_to_id(r * self.group_size + i)
+                for r in self._active_server_ranks
+                for i in range(self.group_size)
+            ]
+        else:
+            server_ids = [
+                server_rank_to_id(i)
+                for i in range(self.num_server_instances)
+            ]
         for group in range(1, 8):
             sched, srv, wrk = group_members(group)
             ids: List[int] = []
@@ -265,8 +316,13 @@ class Postoffice:
     # -- key ranges ----------------------------------------------------------
 
     def get_server_key_ranges(self) -> List[Range]:
-        """Uniform partition of key space over server groups (reference:
-        postoffice.cc:257-268)."""
+        """Key-range partition over server groups: the current routing
+        table's entries when elastic membership is live (one range per
+        ENTRY — entries outnumber servers after a merge), else the
+        static uniform split (reference: postoffice.cc:257-268)."""
+        rt = self.current_routing()
+        if rt is not None:
+            return [Range(e.begin, e.end) for e in rt.entries]
         with self._server_key_ranges_mu:
             if not self._server_key_ranges:
                 log.check(self.num_servers > 0, "no servers configured")
@@ -276,6 +332,124 @@ class Postoffice:
                     end = span * (i + 1) if i + 1 < self.num_servers else MAX_KEY
                     self._server_key_ranges.append(Range(begin, end))
             return self._server_key_ranges
+
+    def server_key_ranges_of(self, rank: int) -> List[Range]:
+        """Every key range a server group rank currently owns (one
+        under static routing; possibly several under elastic)."""
+        rt = self.current_routing()
+        if rt is not None:
+            return rt.ranges_of(rank)
+        ranges = self.get_server_key_ranges()
+        return [ranges[rank]] if 0 <= rank < len(ranges) else []
+
+    # -- elastic routing (docs/elasticity.md) --------------------------------
+
+    def current_routing(self):
+        """The routing table this node currently holds (None = static)."""
+        with self._routing_mu:
+            return self._routing
+
+    def routing_table(self):
+        """Like :meth:`current_routing`, but the elastic SCHEDULER
+        lazily builds the epoch-0 table (identical to the static
+        split) so membership changes always have a base to derive
+        from."""
+        with self._routing_mu:
+            if self._routing is None and self.elastic and self.is_scheduler:
+                from .routing import RoutingTable
+
+                self._routing = RoutingTable.initial(self.num_servers)
+            return self._routing
+
+    def apply_routing(self, table) -> bool:
+        """Adopt a (strictly newer) routing table: update membership-
+        derived state (server count, active ranks, node-id tables) and
+        run the routing hooks.  Returns False for stale epochs —
+        reordered broadcasts can never roll routing backwards."""
+        with self._routing_mu:
+            cur = self._routing
+            if cur is not None and table.epoch <= cur.epoch:
+                return False
+            self._routing = table
+        membership_changed = (
+            table.num_servers != self.num_servers
+            or self._active_server_ranks != list(table.active)
+        )
+        if membership_changed:
+            self.num_servers = table.num_servers
+            self._active_server_ranks = list(table.active)
+            self._build_node_id_table()
+        log.vlog(1, f"routing epoch {table.epoch}: active="
+                    f"{list(table.active)} leaving={list(table.leaving)} "
+                    f"entries={len(table.entries)}")
+        with self._routing_hook_mu:
+            hooks = list(self._routing_hooks)
+        for hook in hooks:
+            try:
+                hook(table)
+            except Exception as exc:  # noqa: BLE001 - isolate hooks
+                log.warning(f"routing hook failed: {exc!r}")
+        return True
+
+    def register_routing_hook(self, hook: Callable[[object], None]) -> None:
+        """``hook(table)`` runs on every adopted routing epoch (van
+        receive pump — keep it fast, never block on the van).  If a
+        table is already live it is replayed immediately so a late-
+        constructed app (a joiner's KVServer) sees the current epoch."""
+        with self._routing_hook_mu:
+            self._routing_hooks.append(hook)
+        table = self.current_routing()
+        if table is not None:
+            try:
+                hook(table)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"routing hook failed on replay: {exc!r}")
+
+    def unregister_routing_hook(self, hook) -> None:
+        with self._routing_hook_mu:
+            try:
+                self._routing_hooks.remove(hook)
+            except ValueError:
+                pass
+
+    def request_decommission(self, timeout_s: float = 60.0) -> None:
+        """Gracefully leave the running cluster (docs/elasticity.md):
+        ask the scheduler to reassign this server's key ranges, wait
+        for the migration + retirement handshake to complete.  After
+        this returns, finalize with ``do_barrier=False`` — a retired
+        node is no longer counted in any barrier."""
+        log.check(self.is_server, "only servers decommission")
+        log.check(self.elastic, "decommission requires PS_ELASTIC=1")
+        self._removed_event.clear()
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.body = json.dumps({"rank": self.my_group_rank()}).encode()
+        msg.meta.control = Control(cmd=Command.REMOVE_NODE)
+        msg.meta.timestamp = self.van.next_timestamp()
+        self.van.send(msg)
+        ok = self._removed_event.wait(timeout_s)
+        log.check(ok, f"decommission did not complete in {timeout_s}s")
+
+    def hot_key_hint(self) -> Dict[int, int]:
+        """Scheduler-side load hint for load-weighted range splits:
+        the union of ``kv.hot_keys`` top-k estimates from the most
+        recent METRICS_PULL replies (psmon keeps these warm); empty
+        when no snapshot was ever collected — splits then fall back to
+        the widest range."""
+        with self._metrics_cv:
+            replies = dict(self._metrics_replies)
+        hint: Dict[int, int] = {}
+        for snap in replies.values():
+            top = (snap.get("metrics", {}) or {}).get(
+                "topk", {}).get("kv.hot_keys") or []
+            for item in top:
+                try:
+                    k, n = int(item[0]), int(item[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                hint[k] = hint.get(k, 0) + n
+        return hint
 
     # -- customers -----------------------------------------------------------
 
@@ -379,7 +553,7 @@ class Postoffice:
     def telemetry_snapshot(self) -> dict:
         """This node's registry snapshot plus identity, the payload a
         METRICS_PULL reply carries (and what psmon renders per node)."""
-        return {
+        snap = {
             "node_id": self.van.my_node.id,
             "role": self.role_str(),
             "rank": (
@@ -389,6 +563,22 @@ class Postoffice:
             "wall_time": time.time(),
             "metrics": self.metrics.snapshot(),
         }
+        rt = self.current_routing()
+        if rt is not None:
+            # Elastic membership context (docs/elasticity.md): psmon's
+            # epoch column and per-node owned-range view come from here.
+            routing = {
+                "epoch": rt.epoch,
+                "active": list(rt.active),
+                "leaving": list(rt.leaving),
+            }
+            if self.is_server:
+                routing["owned"] = [
+                    [r.begin, r.end]
+                    for r in rt.ranges_of(self.my_group_rank())
+                ]
+            snap["routing"] = routing
+        return snap
 
     def absorb_metrics_reply(self, msg: Message) -> None:
         """Van hook: a node's METRICS_PULL response arrived."""

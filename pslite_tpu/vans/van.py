@@ -231,10 +231,16 @@ class Van:
         self._failure_thread: Optional[threading.Thread] = None
         self._announced_dead: Set[int] = set()  # scheduler: already broadcast
         # Chain replication (PS_KV_REPLICATION >= 2) needs server↔server
-        # connections, which the bootstrap otherwise never establishes.
+        # connections, which the bootstrap otherwise never establishes;
+        # elastic membership (PS_ELASTIC, docs/elasticity.md) needs them
+        # too — key-range migrations are server→server transfers.
         self._connect_server_peers = (
             self.env.find_int("PS_KV_REPLICATION", 1) >= 2
+            or self.env.find_int("PS_ELASTIC", 0) != 0
         )
+        # Decommissions mid-handshake at the scheduler: group rank ->
+        # leaver node id, resolved when its REMOVE_DONE arrives.
+        self._removals_pending: Dict[int, int] = {}
 
     # -- transport interface -------------------------------------------------
 
@@ -936,6 +942,204 @@ class Van:
         except Exception as exc:  # noqa: BLE001
             log.warning(f"METRICS_PULL reply failed: {exc!r}")
 
+    # -- elastic membership (docs/elasticity.md) -----------------------------
+
+    # meta.option on the ADD_NODE roster reply to a live JOINER: the
+    # node skips the startup barrier (is_recovery) but must NOT run the
+    # replica restore — its state arrives via range migration.
+    ELASTIC_JOIN_OPT = 0xE1A5
+    # meta.option on a REMOVE_NODE request: the leaver finished
+    # migrating its ranges; the scheduler may retire it.
+    REMOVE_DONE_OPT = 0xD02E
+
+    def broadcast_routing(self, table) -> None:
+        """Scheduler: adopt ``table`` and broadcast it to every live
+        worker and server (JSON body on a ROUTING control).  Applied
+        locally FIRST so the broadcast set reflects the new membership
+        (a joiner is included, a departed rank is not)."""
+        self.po.apply_routing(table)
+        body = table.to_json().encode()
+        for peer in self.po.get_node_ids(SERVER_GROUP + WORKER_GROUP):
+            msg = Message()
+            msg.meta.recver = peer
+            msg.meta.sender = self.my_node.id
+            msg.meta.request = False
+            msg.meta.body = body
+            msg.meta.control = Control(cmd=Command.ROUTING)
+            msg.meta.timestamp = self.next_timestamp()
+            try:
+                # _dispatch_send: runs on the receive pump; must not
+                # consume a parked _lane_error or die on one dead peer.
+                self._dispatch_send(msg)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"ROUTING broadcast to {peer} failed: {exc!r}")
+        # Membership may have SHRUNK: a barrier whose senders were
+        # complete-minus-the-departed would otherwise wait forever (no
+        # further request re-evaluates it).
+        for group, instance in list(self._barrier_senders):
+            self._maybe_release_barrier(group, instance)
+
+    def _process_routing(self, msg: Message) -> None:
+        """ROUTING control: a request is a stale node pulling the
+        current table from the scheduler (WRONG_OWNER self-heal);
+        anything with a body is a table to adopt."""
+        if msg.meta.request and self.po.is_scheduler:
+            table = self.po.routing_table()
+            if table is None:
+                return
+            reply = Message()
+            reply.meta.recver = msg.meta.sender
+            reply.meta.sender = self.my_node.id
+            reply.meta.request = False
+            reply.meta.body = table.to_json().encode()
+            reply.meta.control = Control(cmd=Command.ROUTING)
+            reply.meta.timestamp = self.next_timestamp()
+            try:
+                self._dispatch_send(reply)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"ROUTING reply failed: {exc!r}")
+            return
+        if not msg.meta.body:
+            return
+        from ..routing import RoutingTable
+
+        try:
+            table = RoutingTable.from_json(msg.meta.body)
+        except Exception as exc:  # noqa: BLE001 - corrupt broadcast
+            log.warning(f"bad ROUTING body: {exc!r}")
+            return
+        self.po.apply_routing(table)
+
+    def _process_remove_node(self, msg: Message) -> None:
+        """Graceful decommission handshake.  Scheduler side: a plain
+        request STARTS a removal (reassign the leaver's ranges, epoch
+        broadcast); a REMOVE_DONE_OPT request FINISHES it (retire the
+        rank, final epoch, ack the leaver).  Leaver side: the ack
+        completes ``Postoffice.request_decommission``."""
+        if not msg.meta.request:
+            self.po._removed_event.set()
+            return
+        if not self.po.is_scheduler:
+            log.warning("REMOVE_NODE request at a non-scheduler; dropped")
+            return
+        try:
+            rank = int(json.loads(msg.meta.body.decode())["rank"])
+        except Exception:  # noqa: BLE001 - fall back to the sender id
+            rank = self.po.id_to_group_rank(msg.meta.sender)
+        table = self.po.routing_table()
+        if table is None:
+            log.warning("REMOVE_NODE without elastic routing; dropped")
+            return
+        if msg.meta.option == self.REMOVE_DONE_OPT:
+            self._finish_removal(rank)
+            return
+        if rank in self._removals_pending:
+            return  # duplicate request (resender / retry)
+        # Reject, never abort: a bad client request must not CHECK-kill
+        # the scheduler's receive pump.  Requires >= 1 survivor that is
+        # neither the leaver nor itself mid-decommission (the caller's
+        # request_decommission times out loudly on a rejection).
+        survivors = [r for r in table.active
+                     if r != rank and r not in table.leaving]
+        if rank not in table.active or not survivors:
+            log.warning(f"decommission of rank {rank} rejected: "
+                        f"active={list(table.active)} "
+                        f"leaving={list(table.leaving)}")
+            return
+        log.warning(f"decommission requested for server rank {rank}")
+        self._removals_pending[rank] = msg.meta.sender
+        try:
+            self.broadcast_routing(table.with_leave(rank))
+        except Exception as exc:  # noqa: BLE001 - reject, don't abort
+            self._removals_pending.pop(rank, None)
+            log.warning(f"decommission of rank {rank} failed: {exc!r}")
+
+    def _finish_removal(self, rank: int) -> None:
+        """The leaver migrated everything: retire it from membership
+        (registrations, heartbeats, node tables via the final epoch)
+        and ack it so its request_decommission returns.  Acts ONLY on
+        a pending removal: a duplicate REMOVE_DONE (resender
+        retransmit) arriving after retirement would otherwise strip a
+        joiner that has since REUSED the rank."""
+        leaver_id = self._removals_pending.pop(rank, None)
+        if leaver_id is None:
+            log.vlog(1, f"duplicate REMOVE_DONE for rank {rank}; "
+                        f"ignored")
+            return
+        table = self.po.routing_table()
+        log.warning(f"retiring decommissioned server rank {rank} "
+                    f"(node {leaver_id})")
+        self._registrations = [
+            n for n in self._registrations if n.id != leaver_id
+        ]
+        self._registered_addrs = {
+            a: i for a, i in self._registered_addrs.items()
+            if i != leaver_id
+        }
+        with self.po._heartbeat_mu:
+            self.po._heartbeats.pop(leaver_id, None)
+        self._announced_dead.discard(leaver_id)
+        try:
+            self.broadcast_routing(table.with_departed(rank))
+        except Exception as exc:  # noqa: BLE001 - never abort the pump
+            log.warning(f"retirement epoch for rank {rank} failed: "
+                        f"{exc!r}")
+            return  # no ack: the leaver's decommission times out loudly
+        ack = Message()
+        ack.meta.recver = leaver_id
+        ack.meta.sender = self.my_node.id
+        ack.meta.request = False
+        ack.meta.control = Control(cmd=Command.REMOVE_NODE)
+        ack.meta.timestamp = self.next_timestamp()
+        try:
+            self._dispatch_send(ack)
+        except Exception as exc:  # noqa: BLE001
+            log.warning(f"REMOVE_NODE ack to {leaver_id} failed: {exc!r}")
+
+    def _elastic_admit(self, node: Node, addr: str) -> None:
+        """Admit a brand-new server into a RUNNING cluster
+        (PS_ELASTIC=1): assign the smallest free rank, broadcast the
+        roster (recovery-style so peers reset sids and connect), then
+        bump the routing epoch with a load-weighted range split marked
+        for migration from the donor."""
+        log.check(self.po.group_size == 1,
+                  "elastic membership requires DMLC_GROUP_SIZE=1")
+        table = self.po.routing_table()
+        active = set(table.active) | set(self._removals_pending)
+        rank = next(r for r in itertools.count() if r not in active)
+        node.id = server_rank_to_id(rank)
+        node.is_recovery = True  # skip the startup barrier; peers reset sids
+        log.warning(f"elastic join: admitting {node.short_debug()} as "
+                    f"server rank {rank}")
+        self._reset_peer_sids(node.id)
+        self.clear_peer_down(node.id)
+        self.connect(node)
+        self._registered_addrs[addr] = node.id
+        self.po.update_heartbeat(node.id, time.time())
+        self._registrations = [
+            n for n in self._registrations if n.id != node.id
+        ] + [node]
+        roster = [copy.deepcopy(self.scheduler)] + [
+            copy.deepcopy(n) for n in self._registrations
+        ]
+        for peer in self._registrations:
+            reply = Message()
+            reply.meta.recver = peer.id
+            reply.meta.sender = self.my_node.id
+            reply.meta.timestamp = self.next_timestamp()
+            payload = (roster if peer.id == node.id
+                       else [copy.deepcopy(node)])
+            reply.meta.control = Control(cmd=Command.ADD_NODE, node=payload)
+            if peer.id == node.id:
+                reply.meta.option = self.ELASTIC_JOIN_OPT
+            try:
+                self._dispatch_send(reply)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"join broadcast to {peer.id} failed: {exc!r}")
+        self.broadcast_routing(
+            table.with_join(rank, hot=self.po.hot_key_hint())
+        )
+
     # -- receive loop --------------------------------------------------------
 
     def _receiving(self) -> None:
@@ -1006,6 +1210,10 @@ class Van:
                     self._process_node_failure(msg)
                 elif ctrl.cmd == Command.METRICS_PULL:
                     self._process_metrics_pull(msg)
+                elif ctrl.cmd == Command.ROUTING:
+                    self._process_routing(msg)
+                elif ctrl.cmd == Command.REMOVE_NODE:
+                    self._process_remove_node(msg)
                 elif ctrl.cmd == Command.ACK:
                     pass  # consumed by the resender when enabled
                 else:
@@ -1178,6 +1386,14 @@ class Van:
             f"workers and {self.po.num_server_instances} servers",
         )
         self.ready.set()
+        if self.po.elastic:
+            # Elastic bootstrap (docs/elasticity.md): broadcast epoch 0
+            # (identical to the static split) so every server holds A
+            # table from the start.  Ownership changes are then always
+            # bounced or parked by a table-holding server — a gaining
+            # server that processed requests TABLELESS would silently
+            # apply writes the migration import then overwrites.
+            self.broadcast_routing(self.po.routing_table())
 
     def _assign_ranks(self, nodes: List[Node]) -> None:
         """Assign node ids — reference: van.cc:112-265.
@@ -1243,6 +1459,20 @@ class Van:
                 if (d % 2 == 0) == (node.role == Role.SERVER)
             ]
             if not dead:
+                if (self.env.find_int("PS_ELASTIC", 0)
+                        and node.role == Role.SERVER
+                        and node.aux_id == EMPTY_ID):
+                    # A brand-new server joining the RUNNING cluster
+                    # (docs/elasticity.md) — not a recovery.  A late
+                    # registrant CARRYING a preferred rank (DMLC_RANK)
+                    # is a supervised RESTART of an existing rank that
+                    # beat the failure detector: admitting it as a
+                    # fresh joiner would orphan its old rank's ranges
+                    # forever — let the detector declare the old
+                    # incarnation dead and the recovery path reassign
+                    # the id (elastic joiners must NOT set DMLC_RANK).
+                    self._elastic_admit(node, addr)
+                    continue
                 log.warning(f"unexpected late ADD_NODE from {node.short_debug()}")
                 continue
             # With several simultaneous dead nodes of this role, honor the
@@ -1304,6 +1534,11 @@ class Van:
 
     def _process_roster(self, msg: Message) -> None:
         """Non-scheduler handling of the scheduler's ADD_NODE broadcast."""
+        if msg.meta.option == self.ELASTIC_JOIN_OPT:
+            # This node was admitted as a live elastic JOINER: barrier
+            # skip rides is_recovery below, but the replica-restore
+            # path must not run — state arrives via range migration.
+            self.po.elastic_join = True
         my_addr = self.my_node.addr_key()
         for node in msg.meta.control.node:
             if (
@@ -1387,10 +1622,12 @@ class Van:
         sched, srv, wrk = group_members(group)
         count = 1 if sched else 0
         if instance:
-            count += self.po.num_server_instances if srv else 0
+            # ACTIVE server count: under elastic membership, departed
+            # ranks must not be waited on and joiners must be.
+            count += self.po.num_active_server_instances if srv else 0
             count += self.po.num_worker_instances if wrk else 0
         else:
-            count += self.po.num_servers if srv else 0
+            count += self.po.num_active_servers if srv else 0
             count += self.po.num_workers if wrk else 0
         return count
 
@@ -1407,41 +1644,62 @@ class Van:
                             f"{msg.meta.sender}")
                 return
             senders.add(msg.meta.sender)
-            # Instance barriers count every instance; group barriers count
-            # distinct group members (reference: van.cc:351-426).  The
-            # dedup key must keep role parity: server id 8 and worker id 9
-            # both map to group rank 0, and collapsing them deadlocks any
-            # mixed-role group barrier.
-            if instance:
-                progress = len(senders)
-            else:
-                # (parity, group_rank) is unique per member: scheduler is
-                # the only id mapping to group rank -1.
-                progress = len({
-                    (s % 2, self.po.id_to_group_rank(s)) for s in senders
-                })
-            log.vlog(
-                1,
-                f"barrier(group={group}, instance={instance}): "
-                f"{progress}/{self._barrier_expected(group, instance)} "
-                f"senders={sorted(senders)}",
+            self._maybe_release_barrier(
+                group, instance,
+                app_id=msg.meta.app_id,
+                customer_id=msg.meta.customer_id,
             )
-            if progress >= self._barrier_expected(group, instance):
-                members = sorted(senders)
-                self._barrier_senders[key] = set()
-                for member in members:
-                    reply = Message()
-                    reply.meta.recver = member
-                    reply.meta.request = False
-                    reply.meta.app_id = msg.meta.app_id
-                    reply.meta.customer_id = msg.meta.customer_id
-                    reply.meta.control = Control(
-                        cmd=msg.meta.control.cmd, barrier_group=group
-                    )
-                    reply.meta.timestamp = self.next_timestamp()
-                    self.send(reply)
         else:
             self.po.manage(msg)
+
+    def _maybe_release_barrier(self, group: int, instance: bool,
+                               app_id: int = 0,
+                               customer_id: int = 0) -> None:
+        """Release a pending barrier when its sender set satisfies the
+        CURRENT expected count.  Called on every barrier request AND on
+        every membership change (docs/elasticity.md): a barrier whose
+        last arrival preceded a decommission's retirement epoch would
+        otherwise never be re-evaluated — the survivors would wait
+        forever on a node that no longer exists to ask."""
+        key = (group, instance)
+        senders = self._barrier_senders.get(key) or set()
+        if not senders:
+            return
+        # Instance barriers count every instance; group barriers count
+        # distinct group members (reference: van.cc:351-426).  The
+        # dedup key must keep role parity: server id 8 and worker id 9
+        # both map to group rank 0, and collapsing them deadlocks any
+        # mixed-role group barrier.
+        if instance:
+            progress = len(senders)
+        else:
+            # (parity, group_rank) is unique per member: scheduler is
+            # the only id mapping to group rank -1.
+            progress = len({
+                (s % 2, self.po.id_to_group_rank(s)) for s in senders
+            })
+        log.vlog(
+            1,
+            f"barrier(group={group}, instance={instance}): "
+            f"{progress}/{self._barrier_expected(group, instance)} "
+            f"senders={sorted(senders)}",
+        )
+        if progress >= self._barrier_expected(group, instance):
+            members = sorted(senders)
+            self._barrier_senders[key] = set()
+            cmd = (Command.INSTANCE_BARRIER if instance
+                   else Command.BARRIER)
+            for member in members:
+                reply = Message()
+                reply.meta.recver = member
+                reply.meta.request = False
+                reply.meta.app_id = app_id
+                reply.meta.customer_id = customer_id
+                reply.meta.control = Control(
+                    cmd=cmd, barrier_group=group
+                )
+                reply.meta.timestamp = self.next_timestamp()
+                self.send(reply)
 
     # -- heartbeat -----------------------------------------------------------
 
@@ -1510,4 +1768,27 @@ class Van:
             reply.meta.request = False
             reply.meta.control = Control(cmd=Command.HEARTBEAT)
             reply.meta.timestamp = self.next_timestamp()
+            if self.po.elastic:
+                # Piggyback the routing epoch (docs/elasticity.md):
+                # a node whose ROUTING broadcast was lost learns it is
+                # stale on its next beat and pulls the table — without
+                # this, a stale SERVER would bounce a migrated range's
+                # requests until the next membership change.
+                rt = self.po.routing_table()
+                if rt is not None:
+                    reply.meta.option = rt.epoch
             self.send(reply)
+        elif (not msg.meta.request and not self.po.is_scheduler
+              and self.po.elastic):
+            rt = self.po.current_routing()
+            if msg.meta.option > (rt.epoch if rt is not None else -1):
+                pull = Message()
+                pull.meta.recver = SCHEDULER_ID
+                pull.meta.sender = self.my_node.id
+                pull.meta.request = True
+                pull.meta.control = Control(cmd=Command.ROUTING)
+                pull.meta.timestamp = self.next_timestamp()
+                try:
+                    self._dispatch_send(pull)
+                except Exception as exc:  # noqa: BLE001 - next beat
+                    log.warning(f"routing pull failed: {exc!r}")

@@ -96,6 +96,19 @@ class LoopbackCluster:
     def all_nodes(self) -> List[Postoffice]:
         return [self.scheduler] + self.servers + self.workers
 
+    def join_server(self, env_extra: Optional[Dict[str, str]] = None):
+        """Boot ONE extra server against the RUNNING cluster (elastic
+        join, docs/elasticity.md): same base env, started immediately
+        (no barrier — the scheduler admits it via the late ADD_NODE
+        path).  Returns its Postoffice; the caller tracks/stops it."""
+        env_map = dict(self.base_env)
+        if env_extra:
+            env_map.update(env_extra)
+        po = Postoffice(Role.SERVER, env=Environment(env_map))
+        po.start(0)
+        self.servers.append(po)
+        return po
+
     def start(self, customer_id: int = 0, do_barrier: bool = True) -> None:
         errors = []
 

@@ -58,6 +58,11 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "multi_tenant_p99_ratio": "lower",
     "multi_tenant_dlrm_p50_ratio": "higher",
     "multi_tenant_hit_rate": "higher",
+    # elastic_scale (docs/elasticity.md) — the serving tail must stay
+    # bounded through a live 2->4->2 migration window, and the scale
+    # round trip itself must not regress.
+    "elastic_p99_ratio": "lower",
+    "elastic_scale_2_4_2_wall_s": "lower",
     # kv_telemetry
     "kv_storm_msgs_per_s": "higher",
     # fault_recovery
@@ -73,7 +78,7 @@ TRANSPORT_METRICS: Dict[str, str] = {
 # metric regression) rather than failed.
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
-    "multi_tenant_", "kv_", "fault_recovery_", "van_",
+    "multi_tenant_", "elastic_", "kv_", "fault_recovery_", "van_",
 )
 
 

@@ -82,12 +82,18 @@ def _apply_row(m: dict, uptime: float) -> tuple:
 def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     """Human-readable per-node table + per-role and per-tenant
     rollups (docs/qos.md)."""
+    # ``epoch`` (elastic membership) rides LAST: existing consumers
+    # parse earlier columns by index.
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
-           f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7}")
+           f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7} "
+           f"{'epoch':>5}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
+    # Elastic membership (docs/elasticity.md): per-node routing epoch
+    # and, for servers, the key ranges they own under it.
+    membership_lines: List[str] = []
     # Per-tenant request/shed totals across the cluster (the server-
     # side ``tenant.<name>.requests`` / ``.shed`` counters).
     tenants: Dict[str, Dict[str, int]] = {}
@@ -121,12 +127,30 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         cache = (f"{100.0 * hits / (hits + misses):>5.1f}%"
                  if hits + misses > 0 else f"{'-':>6}")
         role = s.get("role", "?")
+        routing = s.get("routing") or {}
+        epoch = (f"{routing['epoch']:>5}" if "epoch" in routing
+                 else f"{'-':>5}")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{cmpr} {cache} {sent:>7} {recv:>7}"
+            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch}"
         )
+        if routing:
+            owned = routing.get("owned")
+            if owned is not None:
+                pretty = (", ".join(f"[{b:#x}, {e:#x})" for b, e in owned)
+                          or "(none)")
+                membership_lines.append(
+                    f"  node {node_id} ({role}) epoch "
+                    f"{routing.get('epoch')}: owns {pretty}"
+                )
+            elif role == "scheduler":
+                membership_lines.append(
+                    f"  active ranks: {routing.get('active')}  leaving: "
+                    f"{routing.get('leaving')}  (epoch "
+                    f"{routing.get('epoch')})"
+                )
         for cname, cval in m.get("counters", {}).items():
             # tenant.<name>.<kind> — names are identifier-like (the
             # PS_TENANTS parser rejects dots), but rsplit keeps this
@@ -169,6 +193,10 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
                 f"  {tname:>9}: requests={total} shed={t['shed']} "
                 f"({shed_pct:.1f}%)"
             )
+    if membership_lines:
+        lines.append("")
+        lines.append("elastic membership (docs/elasticity.md):")
+        lines.extend(membership_lines)
     if hot_lines:
         lines.append("")
         lines.extend(hot_lines)
