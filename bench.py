@@ -642,6 +642,18 @@ def _transport_sections(quick: bool) -> list:
         mt = multi_tenant_bench(quick=quick)
         return {f"multi_tenant_{k}": v for k, v in mt.items()}
 
+    def sec_small_op_batching():
+        # Small-op aggregation plane (docs/batching.md): the ops/s
+        # regime — 4 KiB ops over a real 1w+1s tcp cluster, combiner
+        # on (EXT_BATCH multi-op frames + batched server apply) vs
+        # PS_BATCH_BYTES=0, interleaved rounds.  Acceptance: >= 4x
+        # msgs/s, low-load single-op p50 within 1.5x, stores
+        # bit-exact on both legs.
+        from pslite_tpu.benchmark import small_op_bench
+
+        so = small_op_bench(quick=quick)
+        return {f"small_op_batching_{k}": v for k, v in so.items()}
+
     def sec_elastic_scale():
         # Elastic membership (docs/elasticity.md): scale 2 -> 4 -> 2
         # servers mid push-storm with no global restart — stores
@@ -710,6 +722,7 @@ def _transport_sections(quick: bool) -> list:
         ("native_goodput", sec_native_goodput),
         ("quantized_push", sec_quantized_push),
         ("multi_tenant", sec_multi_tenant),
+        ("small_op_batching", sec_small_op_batching),
         ("elastic_scale", sec_elastic_scale),
         ("kv_telemetry", sec_kv_telemetry),
         ("fault_recovery", sec_fault_recovery),

@@ -15,6 +15,7 @@ from .base import (
     WORKER_GROUP,
 )
 from .kv import (
+    ElasticZeroCopyError,
     HotKeyCache,
     KVMeta,
     KVPairs,
@@ -47,6 +48,7 @@ __all__ = [
     "Control",
     "DeviceType",
     "Finalize",
+    "ElasticZeroCopyError",
     "HotKeyCache",
     "KVMeta",
     "KVPairs",

@@ -171,9 +171,9 @@ class Customer:
         raise KeyError(f"unknown timestamp {timestamp}")
 
     def wait_request(self, timestamp: int, timeout: Optional[float] = None) -> bool:
-        hooks = self._take_hooks(timestamp)
-        for hook in hooks:
-            hook()
+        if self._hooks:  # unlocked probe: hooks are an ICI-path feature
+            for hook in self._take_hooks(timestamp):
+                hook()
         with self._cv:
             done = lambda: (  # noqa: E731
                 self._entry(timestamp)[0] <= self._entry(timestamp)[1]
@@ -286,7 +286,11 @@ class Customer:
                         f"on_request_error hook failed: {hook_exc!r}"
                     )
         finally:
-            if not msg.meta.request:
+            # A batched response envelope (docs/batching.md) carries N
+            # sub-ops with N distinct timestamps — the app layer counts
+            # each sub-op itself; the envelope's own timestamp is just
+            # the first op's and must not be double-counted.
+            if not msg.meta.request and msg.meta.batch is None:
                 self.add_response(msg.meta.timestamp)
 
     def stop(self) -> None:

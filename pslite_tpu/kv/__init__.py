@@ -1,9 +1,11 @@
 from .hot_cache import HotKeyCache
-from .kv_app import (KVMeta, KVPairs, KVServer, KVServerDefaultHandle,
+from .kv_app import (ElasticZeroCopyError, KVMeta, KVPairs, KVServer,
+                     KVServerDefaultHandle,
                      KVServerOptimizerHandle, KVWorker, OverloadError)
 from .simple_app import SimpleApp, SimpleData
 
 __all__ = [
+    "ElasticZeroCopyError",
     "HotKeyCache",
     "KVMeta",
     "KVPairs",

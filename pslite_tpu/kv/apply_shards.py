@@ -61,7 +61,7 @@ class _Pending:
     __slots__ = (
         "meta", "kvs", "mu", "remaining", "parts", "error",
         "done", "response", "arrived", "barrier", "emitted", "tracked",
-        "seq",
+        "seq", "group", "op_idx", "backlog_n",
     )
 
     def __init__(self, meta, kvs):
@@ -83,6 +83,32 @@ class _Pending:
         # Submission sequence number (quiesce support — elastic range
         # migration snapshots after every EARLIER submit completed).
         self.seq = 0
+        # Batched-frame membership (docs/batching.md): a sub-op pending
+        # reports its per-op result into ``group.results[op_idx]``
+        # instead of entering the order gate itself; the group's GATE
+        # pending carries the whole frame's single ticket.  A gate
+        # pending's ``backlog_n`` is the number of admission-control
+        # slots it holds (one per sub-op; plain requests hold 1).
+        self.group: Optional["_BatchGroup"] = None
+        self.op_idx = 0
+        self.backlog_n = 1
+
+
+class _BatchGroup:
+    """Completion fan-in of one batched frame (docs/batching.md): the
+    gate pending (one order-gate ticket for the whole frame), the
+    per-op metas, and the per-op result slots.  ``remaining`` counts
+    sub-ops still applying; the last one to finish publishes the
+    frame's single batched response."""
+
+    __slots__ = ("gate", "metas", "results", "remaining", "mu")
+
+    def __init__(self, gate: "_Pending", metas, results):
+        self.gate = gate
+        self.metas = metas
+        self.results = results
+        self.remaining = 0
+        self.mu = threading.Lock()
 
 
 class _CaptureResponder:
@@ -388,6 +414,133 @@ class ApplyShardPool:
                     f"(shutting down?)"
                 )
 
+    # -- batched frames (docs/batching.md) ------------------------------------
+
+    def submit_batch(self, env_meta, metas, kvss, results) -> None:
+        """Fan one batched frame's sub-ops into the shards as a GROUP:
+        one order-gate ticket (the whole frame's response leaves in the
+        frame's arrival slot, like the serial view), one quiesce seq,
+        ``len(metas)`` admission-control slots, and per-op shard tasks
+        completing independently.  ``results[i]`` is pre-set for
+        sub-ops decided at intake (admission sheds, replication dedup
+        acks) and ``None`` for sub-ops that need apply; the last
+        finishing sub-op publishes ONE batched response via
+        ``server.response_batch``."""
+        if self._stopping:
+            # Shard threads are retiring: degrade to per-op inline
+            # apply with per-op responses (the worker accepts batched
+            # and unbatched responses interchangeably).  Sub-ops
+            # DECIDED at intake (admission sheds, dedup acks) still
+            # answer — an unanswered shed would hang its wait().
+            for meta, kvs, pre in zip(metas, kvss, results):
+                try:
+                    if pre is not None:
+                        if pre[0] == "overload":
+                            self._server.response_overload(meta)
+                        elif pre[0] == "error":
+                            self._server.response_error(meta)
+                        else:
+                            self._server.response(meta)
+                        continue
+                    if getattr(kvs, "enc", None) is not None:
+                        kvs.materialize()
+                    self.handle(meta, kvs, self._server)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(
+                        f"batched apply (inline, stopping) failed for "
+                        f"ts={meta.timestamp}: {exc!r}"
+                    )
+                    try:
+                        self._server.response_error(meta)
+                    except Exception:  # noqa: BLE001
+                        pass
+            return
+        gate = _Pending(env_meta, None)
+        gate.tracked = True
+        # Admission slots = sub-ops actually entering apply (sheds and
+        # intake-decided acks never occupied the pool, matching the
+        # unbatched path's accounting).
+        gate.backlog_n = sum(1 for r in results if r is None)
+        tid = getattr(env_meta, "tenant", 0)
+        with self._backlog_mu:
+            self._tenant_backlog[tid] = (
+                self._tenant_backlog.get(tid, 0) + gate.backlog_n
+            )
+            self._submit_seq += 1
+            gate.seq = self._submit_seq
+            self._inflight_seqs.add(gate.seq)
+        with self._order_mu:
+            self._order.setdefault(env_meta.sender,
+                                   collections.deque()).append(gate)
+        group = _BatchGroup(gate, metas, results)
+        dispatch = []
+        for i, (meta, kvs) in enumerate(zip(metas, kvss)):
+            if results[i] is not None:
+                continue
+            plan = self._split(kvs)
+            if plan is None:
+                # The combiner only merges fixed-k lens-free ops, so an
+                # unsplittable sub-op is malformed — fail it per-op
+                # without wedging its siblings.
+                results[i] = ("error",)
+                continue
+            p = _Pending(meta, kvs)
+            p.group = group
+            p.op_idx = i
+            tasks = []
+            for sid, positions in plan:
+                ngrp = self._task_groups(kvs, positions)
+                if ngrp <= 1:
+                    tasks.append((sid, positions))
+                else:
+                    for grp in np.array_split(positions, ngrp):
+                        if len(grp):
+                            tasks.append((sid, grp))
+            p.remaining = len(tasks)
+            group.remaining += 1
+            dispatch.append((p, kvs, tasks))
+        self._c_sharded.inc(max(1, len(dispatch)))
+        if group.remaining == 0:
+            # Every sub-op was decided at intake: the frame's response
+            # is ready now (still ordered behind earlier requests).
+            gate.response = ("batch", group)
+            self._finish(gate)
+            return
+        for p, kvs, tasks in dispatch:
+            n = len(kvs.keys)
+            for sid, grp in tasks:
+                task = (_ALL if len(tasks) == 1 and len(grp) == n
+                        else ("slice", grp))
+                self._queues[sid].push(
+                    (p, task), cost=self._task_cost(kvs, len(grp))
+                )
+
+    def _complete_batch_op(self, pending: "_Pending") -> None:
+        """A batched sub-op finished all its shard tasks: record its
+        per-op result; the LAST sub-op publishes the gate response."""
+        meta = pending.meta
+        if pending.error is not None:
+            result = ("error",)
+        elif meta.pull:
+            try:
+                result = ("res", self._assemble(pending))
+            except Exception as exc:  # noqa: BLE001
+                log.warning(
+                    f"batched pull assembly failed for "
+                    f"ts={meta.timestamp}: {exc!r}"
+                )
+                result = ("error",)
+        else:
+            result = ("ok", None)
+        group = pending.group
+        with group.mu:
+            group.results[pending.op_idx] = result
+            group.remaining -= 1
+            last = group.remaining == 0
+        if last:
+            group.gate.response = ("batch", group)
+            self._finish(group.gate)
+
     # -- streamed chunked pushes (docs/chunking.md) ---------------------------
 
     def begin_stream(self, meta) -> "_StreamHandle":
@@ -609,6 +762,11 @@ class ApplyShardPool:
     # -- completion -----------------------------------------------------------
 
     def _complete(self, pending: _Pending) -> None:
+        if pending.group is not None:
+            # Batched sub-op (docs/batching.md): results fan into the
+            # group; only the gate pending enters the order gate.
+            self._complete_batch_op(pending)
+            return
         meta = pending.meta
         if pending.error is not None:
             pending.response = ("error",)
@@ -690,7 +848,7 @@ class ApplyShardPool:
             pending.tracked = False
             tid = getattr(pending.meta, "tenant", 0)
             with self._backlog_mu:
-                n = self._tenant_backlog.get(tid, 0) - 1
+                n = self._tenant_backlog.get(tid, 0) - pending.backlog_n
                 if n > 0:
                     self._tenant_backlog[tid] = n
                 else:
@@ -741,6 +899,12 @@ class ApplyShardPool:
                 self._server.response(pending.meta)
             elif kind == "error":
                 self._server.response_error(pending.meta)
+            elif kind == "batch":
+                # One response frame for the whole batched request
+                # (docs/batching.md): per-op results + error codes.
+                group = pending.response[1]
+                self._server.response_batch(pending.meta, group.metas,
+                                            group.results)
             # "none": the handler deliberately did not respond.
         except Exception as exc:
             log.warning(f"apply-shard response emit failed: {exc!r}")

@@ -25,6 +25,13 @@ The cache is a byte-bounded LRU (``PS_HOT_CACHE_MB``); ``seed``
 restricts admission to a hot set (``KVWorker.seed_hot_cache`` fills it
 from the servers' ``kv.hot_keys`` top-k) — unseeded, every smallish
 pulled value is admitted and the LRU keeps whatever repeats.
+
+Batching interplay (docs/batching.md): the stamp contract is PER
+SUB-OP end to end — a batched request's pull sub-ops each capture
+their own intake stamp, the batched response's per-op table carries
+each sub-op's stamp, and the worker runs ``observe``/``fill`` per
+sub-op — so read-your-writes (and the fill-race skip below) survive
+the aggregation plane unchanged.
 """
 
 from __future__ import annotations

@@ -75,6 +75,16 @@ MIGRATE_CMD = 0x314D
 # Never on the wire.
 ROUTING_LOCAL_CMD = 0x52E9
 
+# Small-op aggregation plane (kv/batching.py, docs/batching.md) —
+# hoisted once so the per-frame/per-response hot paths don't pay a
+# sys.modules lookup per call (batching.py imports nothing from this
+# module, so there is no cycle).
+from ..message import BatchInfo as _BatchInfo  # noqa: E402
+from ..message import BatchOp as _BatchOp  # noqa: E402
+from .batching import BATCH_PROBE_CMD as _BATCH_PROBE_CMD  # noqa: E402
+from .batching import BATCH_WIRE_VERSION as _BATCH_WIRE_VERSION  # noqa: E402,E501
+from .batching import split_batch_message as _split_batch_message  # noqa: E402,E501
+
 
 class OverloadError(RuntimeError):
     """The server SHED this request under per-tenant admission control
@@ -83,6 +93,20 @@ class OverloadError(RuntimeError):
     below is a reasonable floor) and re-issue the request."""
 
     retry_after_s = 0.005
+
+
+class ElasticZeroCopyError(RuntimeError):
+    """Zero-copy registered pull buffers (``ZPush``/``ZPull`` into an
+    ``alloc_pull_buffer`` destination) are incompatible with elastic
+    membership (``PS_ELASTIC=1`` — docs/elasticity.md): the buffer's
+    per-server byte offsets are frozen at registration, and the first
+    live range migration would silently deliver slices at stale
+    offsets.  Raised LOUDLY at registration (PR 9 declined silently —
+    callers that ignored the warning pulled into ordinary arrays
+    without knowing why).  Workarounds: pull into ordinary arrays
+    (plain ``pull`` — correct under elastic routing, the transport
+    still reassembles per slice), or run the cluster without
+    ``PS_ELASTIC`` when registered-buffer delivery is required."""
 
 
 @dataclass
@@ -335,6 +359,50 @@ class KVWorker:
         self._raw_ts: set = set()
         self._raw_results: Dict[int, List[KVPairs]] = {}
         self._c_overloads = self.po.metrics.counter("kv.overloads")
+        # Small-op aggregation (kv/batching.py, docs/batching.md):
+        # PS_BATCH_BYTES > 0 turns on the per-(destination, tenant,
+        # priority, codec) combiner — concurrently-issued small ops to
+        # one destination coalesce into EXT_BATCH frames under the byte
+        # cap, closing at the next dispatcher pickup
+        # (PS_BATCH_WINDOW_US=0, the default) so an idle worker adds no
+        # timer latency.  0 (the conservative default) bypasses the
+        # plane entirely: every frame is byte-identical to a pre-batch
+        # build.  64 KiB is the recommended serving-storm setting
+        # (bench.py's small_op_batching section runs it).
+        self._batch_bytes = max(0, self.po.env.find_int("PS_BATCH_BYTES",
+                                                        0))
+        self._combiner = None
+        # Per-destination capability (docs/batching.md): None = probe
+        # in flight (ops pass through unbatched meanwhile), True/False
+        # = answered.  PS_BATCH_NEGOTIATE=0 asserts a homogeneous
+        # cluster and skips the probe round trip.
+        self._batch_caps: Dict[int, bool] = {}
+        self._batch_probe_ts: Dict[int, int] = {}
+        self._batch_probing: set = set()
+        self._batch_negotiate = bool(
+            self.po.env.find_int("PS_BATCH_NEGOTIATE", 1))
+        if self._batch_bytes > 0:
+            from .batching import OpCombiner
+
+            if getattr(self.po, "elastic", False):
+                # Declined under elastic membership (docs/batching.md):
+                # wrong-owner re-slicing is per sub-op machinery the
+                # batched request path does not carry.
+                log.warning("PS_BATCH_BYTES set but PS_ELASTIC is "
+                            "active; small-op batching disabled")
+                self._batch_bytes = 0
+            else:
+                self._combiner = OpCombiner(
+                    lambda m: self.po.van.send(m),
+                    self._batch_send_failed,
+                    max_bytes=self._batch_bytes,
+                    window_us=self.po.env.find_float(
+                        "PS_BATCH_WINDOW_US", 0.0),
+                    min_ops=self.po.env.find_int("PS_BATCH_MIN_OPS", 32),
+                    hold_max_us=self.po.env.find_float(
+                        "PS_BATCH_HOLD_US", 2000.0),
+                    on_sent=self._batch_sent,
+                )
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
         # compared on lookup).
@@ -378,6 +446,7 @@ class KVWorker:
         self._replication = self.po.env.find_int("PS_KV_REPLICATION", 1)
         self._down_servers: set = set()
         self._pending: Dict[int, _PendingReq] = {}
+        self._static_entries = None  # _route_entries cache (non-elastic)
         self._timeout_ts = BoundedKeySet(4096)
         self._sweep_thread: Optional[threading.Thread] = None
         self._sweep_cv = threading.Condition()
@@ -453,6 +522,8 @@ class KVWorker:
         if codec is not None:
             codecs_mod.get_codec(codec)
             return codec
+        if not self._bucket_codecs:
+            return None  # no registered buckets: skip the sig lookup
         if len(keys) == 0:
             return None
         sig = (len(keys), int(keys[0]), int(keys[-1]))
@@ -506,16 +577,23 @@ class KVWorker:
         then pull into ordinary arrays).  Contract: at most one
         outstanding pull per buffer (kv_app.h:210-217).
         """
-        alloc = getattr(self.po.van, "alloc_pull_segment", None)
-        if alloc is None:
-            return None
         if getattr(self.po, "elastic", False):
             # Elastic membership migrates ranges live; the per-server
             # byte offsets registered below would silently go stale on
-            # the first epoch change — decline, callers pull into
-            # ordinary arrays (docs/elasticity.md).
-            log.warning("alloc_pull_buffer: elastic membership "
-                        "(PS_ELASTIC) active; zero-copy pull disabled")
+            # the first epoch change.  Fail LOUDLY (the PR 9 silent
+            # decline left callers pulling into ordinary arrays without
+            # knowing why) — docs/elasticity.md documents the
+            # workarounds the error names.
+            raise ElasticZeroCopyError(
+                "alloc_pull_buffer (zero-copy ZPull buffers) is "
+                "incompatible with elastic membership: PS_ELASTIC=1 "
+                "migrates key ranges live, which would silently "
+                "invalidate the buffer's frozen per-server offsets. "
+                "Pull into ordinary arrays instead, or disable "
+                "PS_ELASTIC for this cluster."
+            )
+        alloc = getattr(self.po.van, "alloc_pull_segment", None)
+        if alloc is None:
             return None
         if self._slicer is not default_slicer:
             # The per-server offsets below assume the default key-range
@@ -835,6 +913,120 @@ class KVWorker:
             self._req_track[ts] = (time.monotonic(), pull, trace, t0_us)
         return trace
 
+    # -- small-op aggregation (kv/batching.py, docs/batching.md) -------------
+
+    @property
+    def combiner(self):
+        """The worker's op combiner (None unless PS_BATCH_BYTES > 0)."""
+        return self._combiner
+
+    def _batch_capable(self, dest: int) -> bool:
+        """Per-destination capability gate: old decoders must never
+        see an EXT_BATCH frame (docs/batching.md).  Until the probe
+        answers, ops pass through inline — never queued."""
+        if not self._batch_negotiate:
+            return True
+        # Unlocked fast path: caps only ever transition None -> bool,
+        # and dict reads are atomic under the GIL.
+        cap = self._batch_caps.get(dest)
+        if cap is not None:
+            return cap
+        self._probe_batch_cap(dest)
+        return False
+
+    def _probe_batch_cap(self, dest: int) -> None:
+        """One-shot capability probe: a tiny BATCH_PROBE_CMD pull the
+        server answers before its handler.  A peer that errors (an
+        older build routing the unknown cmd into its handler) is
+        recorded incapable; no answer leaves the destination unbatched
+        without ever blocking an op.  The probing reservation is taken
+        BEFORE the request is allocated, so a racing second caller
+        neither double-probes nor leaks a tracker entry."""
+        with self._mu:
+            if dest in self._batch_caps or dest in self._batch_probing:
+                return
+            self._batch_probing.add(dest)
+        ts = self._customer.new_request(dest)  # direct id: expect 1
+        with self._mu:
+            self._batch_probe_ts[ts] = dest
+        msg = Message()
+        m = msg.meta
+        m.app_id = self._customer.app_id
+        m.customer_id = self._customer.customer_id
+        m.request = True
+        m.pull = True
+        m.head = _BATCH_PROBE_CMD
+        m.timestamp = ts
+        m.recver = dest
+        m.val_len = 1
+        msg.add_data(SArray(np.zeros(1, np.uint64)))
+        msg.add_data(SArray(np.empty(0, np.float32)))
+        try:
+            self.po.van.send(msg)
+        except Exception as exc:  # noqa: BLE001 - re-probed later
+            log.warning(f"batch capability probe to {dest} failed: "
+                        f"{exc!r}")
+            with self._mu:
+                self._batch_probe_ts.pop(ts, None)
+                self._batch_probing.discard(dest)
+            # Square the ledger so the dead probe entry reads complete
+            # (prunable) instead of in-flight forever.
+            self._customer.add_response(ts, 1)
+
+    def _batch_sent(self, msgs, wire_msg: Message) -> None:
+        """Combiner sent hook: record the frame that actually left on
+        each member's pending slice — for a merged frame that is the
+        ENVELOPE message, whose resender signature is what a failover
+        must ``forget()`` (a None sent_msg would leave the resender
+        retransmitting toward the abandoned destination and eventually
+        failing a request that succeeded at its replica)."""
+        for m in msgs:
+            sl = getattr(m, "_batch_sl", None)
+            if sl is not None:
+                sl.sent_msg = wire_msg
+
+    def _batch_send_failed(self, msgs, exc: Exception) -> None:
+        """Combiner error hook: a flush's transport send raised off the
+        caller thread — fail each member op exactly as an inline send
+        failure would have (sweeper retry with deadlines on, fast
+        TimeoutError without)."""
+        for m in msgs:
+            self._slice_send_failed(
+                getattr(m, "_batch_ts", m.meta.timestamp),
+                getattr(m, "_batch_sl", None), exc,
+            )
+
+    def _slice_send_failed(self, ts: int, sl, exc: Exception) -> None:
+        """Shared failure path of one slice's send (inline sends and
+        combiner flushes)."""
+        if sl is not None:
+            # Deadlines on: mark THIS slice failed — the sweeper
+            # re-routes it (to a replica if the rank is down) right
+            # away, without touching healthy siblings.
+            log.warning(
+                f"send ts={ts} failed ({exc!r}); handing to the "
+                f"deadline sweeper"
+            )
+            with self._mu:
+                sl.retry_now = True
+            self._wake_sweeper()
+        else:
+            # No deadline machinery: fail the slice fast so wait(ts)
+            # raises TimeoutError instead of hanging — and release the
+            # doomed request's pull state (no response will ever
+            # arrive to trigger _finish).
+            log.warning(
+                f"send ts={ts} failed ({exc!r}); failing the request "
+                f"(PS_REQUEST_TIMEOUT off)"
+            )
+            with self._mu:
+                self._mark_timed_out(ts)
+                self._recv_kvs.pop(ts, None)
+                self._pull_dst.pop(ts, None)
+                self._callbacks.pop(ts, None)
+                self._zpull_ts.discard(ts)
+            self._customer.add_response(ts, 1)
+
     # -- public ops ----------------------------------------------------------
 
     def push(
@@ -1038,6 +1230,13 @@ class KVWorker:
 
     def wait(self, timestamp: int) -> None:
         self._customer.wait_request(timestamp)
+        if not (self._timeout_ts or self._error_ts or self._overload_ts):
+            # Unlocked emptiness probe (the overwhelmingly common
+            # healthy path): no failure mark exists anywhere, so none
+            # can name this timestamp.  Marks are only ever ADDED for
+            # in-flight requests — ours completed above — so a miss
+            # here cannot be a mark racing in later.
+            return
         with self._mu:
             timed_out = timestamp in self._timeout_ts
             self._timeout_ts.discard(timestamp)
@@ -1072,6 +1271,10 @@ class KVWorker:
     def stop(self) -> None:
         self.po.unregister_node_failure_hook(self._on_node_event)
         self.po.unregister_routing_hook(self._routing_hook)
+        if self._combiner is not None:
+            # Flush queued ops before the customer retires: a queued
+            # sub-op's wait() still expects its response.
+            self._combiner.stop()
         with self._sweep_cv:
             self._sweep_stop = True
             self._sweep_cv.notify_all()
@@ -1113,7 +1316,17 @@ class KVWorker:
         """The worker's current ``(key range, owner rank)`` slicing
         plan: the routing table's entries under elastic membership
         (owners are NOT the entry index once ranges migrate), else the
-        static uniform split where entry i is owned by rank i."""
+        static uniform split where entry i is owned by rank i — cached,
+        since a non-elastic cluster's split never changes and this runs
+        on every op's issue path."""
+        if not getattr(self.po, "elastic", False):
+            ents = self._static_entries
+            if ents is None:
+                ents = self._static_entries = [
+                    (rng, i)
+                    for i, rng in enumerate(self.po.get_server_key_ranges())
+                ]
+            return ents
         rt = self.po.current_routing()
         if rt is not None:
             return [(Range(e.begin, e.end), e.owner) for e in rt.entries]
@@ -1150,12 +1363,12 @@ class KVWorker:
         when it is down and replication is on — the first live member
         of its replica chain (the topology lives in ONE place:
         replication.chain_ranks, shared with the server's forwarder)."""
-        from .replication import chain_ranks
-
         gs = self.po.group_size
         base = server_rank_to_id(group_rank * gs + self.po.instance_idx)
         if base not in self._down_servers:
             return base
+        from .replication import chain_ranks
+
         for rank in chain_ranks(group_rank, self._replication,
                                 self.po.num_servers,
                                 active=self.po.active_server_ranks):
@@ -1449,7 +1662,14 @@ class KVWorker:
     ) -> None:
         entries = self._route_entries()
         ranges = [rng for rng, _owner in entries]
-        sliced = self._slicer(kvs, ranges)
+        if len(ranges) == 1 and self._slicer is default_slicer:
+            # Single-destination fast path (the 1-server serving shape,
+            # and the hot path of the small-op storm): the lone range
+            # spans the whole key space, so slicing is the identity —
+            # skip the searchsorted partition work per op.
+            sliced = [kvs]
+        else:
+            sliced = self._slicer(kvs, ranges)
         live = [
             (entries[i][1], part)
             for i, part in enumerate(sliced)
@@ -1504,44 +1724,110 @@ class KVWorker:
                                   dest, val_dtype, val_nbytes, codec,
                                   zpull, trace, enc=encs[idx],
                                   tenant=tenant)
+            if (self._combiner is not None
+                    and self._batch_capable(msg.meta.recver)):
+                # Small-op aggregation (docs/batching.md): EVERY slice
+                # toward a batch-capable destination rides the
+                # combiner's per-(dest, tenant, priority) FIFO — small
+                # compatible ops merge into EXT_BATCH frames, while
+                # unmergeable ops (zpull, lens, traced, oversized,
+                # codec-mismatched) flow through the same stream as
+                # single frames IN POSITION, so batching can never
+                # reorder a lane's ops.  Transport failures come back
+                # via _batch_send_failed; sweeper retries/failovers
+                # re-send per sub-op directly.
+                msg._batch_ts = ts
+                msg._batch_sl = sl
+                self._combiner.submit(msg)
+                continue
             try:
                 self.po.van.send(msg)
                 if sl is not None:
                     sl.sent_msg = msg
             except Exception as exc:  # noqa: BLE001 - PeerDeadError & co
-                if sl is not None:
-                    # Deadlines on: mark THIS slice failed — the sweeper
-                    # re-routes it (to a replica if the rank is down)
-                    # right away, without touching healthy siblings.
-                    log.warning(
-                        f"send ts={ts} to {dest} failed ({exc!r}); "
-                        f"handing to the deadline sweeper"
-                    )
-                    with self._mu:
-                        sl.retry_now = True
-                    self._wake_sweeper()
-                else:
-                    # No deadline machinery: fail the slice fast so
-                    # wait(ts) raises TimeoutError instead of hanging
-                    # on a destination the detector declared dead —
-                    # and release the doomed request's pull state (no
-                    # response will ever arrive to trigger _finish).
-                    log.warning(
-                        f"send ts={ts} to {dest} failed ({exc!r}); "
-                        f"failing the request (PS_REQUEST_TIMEOUT off)"
-                    )
-                    with self._mu:
-                        self._mark_timed_out(ts)
-                        self._recv_kvs.pop(ts, None)
-                        self._pull_dst.pop(ts, None)
-                        self._callbacks.pop(ts, None)
-                        self._zpull_ts.discard(ts)
-                    self._customer.add_response(ts, 1)
+                self._slice_send_failed(ts, sl, exc)
 
     def _process(self, msg: Message) -> None:
         if msg.meta.request:
             return  # workers only receive responses
+        if msg.meta.batch is not None:
+            # Batched response envelope (docs/batching.md): one frame,
+            # N sub-op results — account each sub-op, then count its
+            # response (the Customer skips its per-envelope count for
+            # batch frames).
+            info = msg.meta.batch
+            if not msg.data and all(
+                    op.option == 0 and not op.pull for op in info.ops):
+                # Fast path: an all-ack push-response frame (the
+                # storm's dominant return traffic) — per-op accounting
+                # without constructing per-op Message objects.
+                sender = msg.meta.sender
+                hc = self._hot_cache
+                for op in info.ops:
+                    ts = op.timestamp
+                    discount = False
+                    try:
+                        with self._mu:
+                            req = self._pending.get(ts)
+                            if req is not None:
+                                sl = next(
+                                    (s for s in req.slices
+                                     if len(s.part.keys)
+                                     and int(s.part.keys[0]) == op.key),
+                                    None)
+                                if sl is not None:
+                                    if sl.responded:
+                                        discount = True  # dup: 1st wins
+                                    else:
+                                        sl.responded = True
+                        if hc is not None and op.stamp:
+                            hc.observe(sender, op.stamp)
+                        if discount:
+                            continue
+                        if (self._customer.num_response(ts) + 1
+                                >= self._customer.num_expected(ts)):
+                            self._finish(ts)
+                    except Exception as exc:  # noqa: BLE001
+                        log.warning(f"batched sub-op ts={ts} response "
+                                    f"handling failed: {exc!r}")
+                    finally:
+                        # One sub-op's failure must not strand its
+                        # siblings' (or its own) wait() — the count is
+                        # unconditional, exactly like the Customer's
+                        # per-message finally on the unbatched path.
+                        if not discount:
+                            self._customer.add_response(ts)
+                return
+            for sub in _split_batch_message(msg):
+                try:
+                    self._process(sub)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning(
+                        f"batched sub-op ts={sub.meta.timestamp} "
+                        f"response handling failed: {exc!r}"
+                    )
+                finally:
+                    self._customer.add_response(sub.meta.timestamp)
+            return
         ts = msg.meta.timestamp
+        probe_dest = None
+        if self._batch_probe_ts:  # unlocked probe: empty ~always
+            with self._mu:
+                probe_dest = self._batch_probe_ts.pop(ts, None)
+        if probe_dest is not None:
+            # Capability probe answer (docs/batching.md): a clean
+            # response carrying at least BATCH_WIRE_VERSION marks the
+            # destination batch-capable; an error-marked one (an older
+            # build's handler rejecting the unknown cmd) marks it
+            # incapable — it only ever gets plain frames.
+            ok = False
+            if msg.meta.option == 0 and len(msg.data) >= 2:
+                vals = msg.data[1].numpy().reshape(-1)
+                ok = vals.size >= 1 and int(vals[0]) >= _BATCH_WIRE_VERSION
+            with self._mu:
+                self._batch_caps[probe_dest] = ok
+                self._batch_probing.discard(probe_dest)
+            return
         discount = False
         retry_now = False
         wrong_owner_epoch = None
@@ -2635,6 +2921,26 @@ class KVServer:
         the serial path — fail the remote waiter fast."""
         if msg.meta.simple_app or not msg.meta.request:
             return
+        if msg.meta.batch is not None:
+            # A batched frame failed at intake: fail EVERY sub-op's
+            # waiter (each holds its own timestamp), not just the
+            # envelope's first.
+            try:
+                subs = _split_batch_message(msg)
+                metas = [KVMeta(
+                    cmd=s.meta.head, push=s.meta.push, pull=s.meta.pull,
+                    sender=s.meta.sender, timestamp=s.meta.timestamp,
+                    customer_id=s.meta.customer_id, key=s.meta.key,
+                ) for s in subs]
+                env = KVMeta(sender=msg.meta.sender,
+                             customer_id=msg.meta.customer_id,
+                             priority=msg.meta.priority,
+                             tenant=msg.meta.tenant)
+                self.response_batch(env, metas, [("error",)] * len(metas))
+            except Exception as be:  # noqa: BLE001 - best effort
+                log.warning(f"batched request-error response failed: "
+                            f"{be!r}")
+            return
         self.response_error(KVMeta(
             cmd=msg.meta.head,
             push=msg.meta.push,
@@ -2728,14 +3034,17 @@ class KVServer:
             and not self.po.van.is_peer_down(m.sender)
         )
 
-    def _admission_overloaded(self, tenant: int) -> bool:
+    def _admission_overloaded(self, tenant: int, extra: int = 0) -> bool:
         """Per-tenant admission probe (docs/qos.md): in-flight apply
         backlog plus this tenant's OPEN STREAMS (a streaming chunked
         push occupies server capacity from its first partial, long
-        before its pending enters the pool's ledger)."""
+        before its pending enters the pool's ledger).  ``extra`` counts
+        slots already claimed but not yet submitted — a batched frame's
+        earlier sub-ops (docs/batching.md: admission sheds per sub-op,
+        so the probe must see the frame's own accepted ops)."""
         if self._admit_limit <= 0 or self._apply_pool is None:
             return False
-        n = self._apply_pool.tenant_backlog(tenant)
+        n = self._apply_pool.tenant_backlog(tenant) + extra
         if n < self._admit_limit:
             with self._streams_mu:
                 n += sum(
@@ -2832,6 +3141,12 @@ class KVServer:
             # message always follows).
             self._stream_part(msg)
             return
+        if msg.meta.batch is not None:
+            # Multi-op batched frame (docs/batching.md): decode once,
+            # fan the sub-ops into the apply pool as a group, answer
+            # with one batched response frame.
+            self._process_batch(msg)
+            return
         if (msg.meta.head == MIGRATE_CMD and msg.meta.push
                 and msg.meta.request
                 and msg.meta.option != OPT_REPLICA):
@@ -2874,6 +3189,17 @@ class KVServer:
             # than the stamp claims (conservative, never stale).
             with self._qos_mu:
                 meta.stamp = self._push_version
+        if meta.cmd == _BATCH_PROBE_CMD and meta.pull:
+            # Batch capability probe (docs/batching.md): answered
+            # BEFORE the handler, like HOT_KEYS_CMD — the vals carry
+            # this build's batch wire version.  Builds predating the
+            # aggregation plane route the unknown cmd into their
+            # handler and error, which the prober reads as "incapable".
+            self.response(meta, KVPairs(
+                keys=np.array([1], dtype=np.uint64),
+                vals=np.array([_BATCH_WIRE_VERSION], dtype=np.float32),
+            ))
+            return
         if meta.cmd == HOT_KEYS_CMD and meta.pull:
             # Hot-key introspection (docs/qos.md): answer with the
             # kv.hot_keys top-k — keys + observed counts — so workers
@@ -2911,6 +3237,11 @@ class KVServer:
         if meta.pull:
             self._c_pull_reqs.inc()
         kvs = KVPairs()
+        # NOTE: the per-op intake below (codec decode, hot-key
+        # accounting, admission, replication dedup/forward, stamps)
+        # has a batched twin in _process_batch — a change here almost
+        # certainly needs the same change there, or the two paths
+        # silently diverge.
         # Compressed wire payload of a codec push, kept as received so
         # replication can forward the COMPRESSED bytes down the chain
         # (each replica decodes once; re-sending decompressed would pay
@@ -3052,6 +3383,265 @@ class KVServer:
             self.po.tracer.span(meta.trace, "apply", now - dur * 1e6,
                                 dur * 1e6, args={"keys": len(kvs.keys),
                                                  "push": meta.push})
+
+    # -- batched frames (kv/batching.py, docs/batching.md) --------------------
+
+    def _process_batch(self, msg: Message) -> None:
+        """One EXT_BATCH frame: decode once, run per-op intake
+        (admission sheds PER SUB-OP, replication forwards/dedups per
+        sub-op, per-op hot-cache stamps), then fan the admitted ops
+        into the apply pool as a GROUP — shared shard dispatch, one
+        batched response frame through the per-sender order gate."""
+        env = msg.meta
+        subs = _split_batch_message(msg)
+        if not subs:
+            return
+        # Conservative fallbacks (decline matrix, docs/batching.md):
+        # elastic ownership gates and registered recv buffers are
+        # per-op machinery the group apply does not carry — re-slice
+        # and run each sub-op through the ordinary pipeline (per-op
+        # responses; the worker accepts both response shapes).
+        fallback = self._owned is not None
+        if not fallback and self._recv_buffers:
+            for sub in subs:
+                if sub.meta.push and len(sub.data) >= 1:
+                    k0 = sub.data[0].astype_view(np.uint64).numpy()
+                    if len(k0) and (env.sender,
+                                    int(k0[0])) in self._recv_buffers:
+                        fallback = True
+                        break
+        if fallback:
+            for sub in subs:
+                self._process_request(sub)
+            return
+        env_meta = KVMeta(
+            cmd=0, push=env.push, pull=env.pull, sender=env.sender,
+            timestamp=subs[0].meta.timestamp,
+            customer_id=env.customer_id, key=subs[0].meta.key,
+            priority=env.priority, tenant=env.tenant,
+        )
+        metas: List[KVMeta] = []
+        kvss: List[KVPairs] = []
+        results: List[Optional[tuple]] = []
+        admitted = 0
+        admission_on = (self._admit_limit > 0
+                        and self._apply_pool is not None)
+        # NOTE: this per-op intake is the batched twin of the one in
+        # _process_request (differing only in eager decode and the
+        # per-sub-op admission/result plumbing) — keep them in sync.
+        for sub in subs:
+            sm = sub.meta
+            meta = KVMeta(
+                cmd=0, push=sm.push, pull=sm.pull, sender=env.sender,
+                timestamp=sm.timestamp, customer_id=env.customer_id,
+                key=sm.key, val_len=sm.val_len, option=0,
+                priority=env.priority, codec=sm.codec, tenant=env.tenant,
+            )
+            kvs = KVPairs()
+            wire_payload = None
+            ci = sm.codec
+            if len(sub.data) >= 2:
+                kvs.keys = sub.data[0].astype_view(np.uint64).numpy()
+                if (ci is not None and ci.raw_len > 0 and meta.push
+                        and len(sub.data) >= 3):
+                    # Sub-op codec payloads decode EAGERLY: batched ops
+                    # are small by construction (PS_BATCH_BYTES), so
+                    # the lazy shard-side decode buys nothing here.
+                    codec = codecs_mod.by_wire_id(ci.codec)
+                    codecs_mod.check_block(ci)
+                    kvs.vals = codec.decode(
+                        sub.data[1].astype_view(np.uint8).numpy(),
+                        sub.data[2].astype_view(np.float32).numpy(),
+                        ci.raw_len // 4, flags=ci.flags,
+                    )
+                    wire_payload = (sub.data[1], sub.data[2], None, ci)
+                else:
+                    kvs.vals = sub.data[1].numpy()
+                    if len(sub.data) > 2:
+                        # A ragged (lens) sub-op — our combiner never
+                        # merges these, but a foreign encoder might:
+                        # parse the lens so the pool's split declines
+                        # it LOUDLY (per-op error) instead of applying
+                        # values at wrong per-key boundaries.
+                        kvs.lens = sub.data[2].astype_view(
+                            np.int32).numpy()
+            if self._qos_stamps and meta.pull and not meta.push:
+                # Per-sub-op intake stamp (kv/hot_cache.py): read-your-
+                # writes survives batching because every pull sub-op
+                # carries its own stamp in the response table.
+                with self._qos_mu:
+                    meta.stamp = self._push_version
+            if len(kvs.keys):
+                if len(kvs.keys) <= 64:
+                    for k in kvs.keys.tolist():
+                        self._hot_keys.add(int(k))
+                else:
+                    self._hot_keys.add(int(kvs.keys[0]), len(kvs.keys))
+            result = None
+            if admission_on:
+                self._tenant_counter(meta.tenant, "requests").inc()
+                if self._admission_overloaded(meta.tenant,
+                                              extra=admitted):
+                    # Admission sheds SUB-OPS individually, never the
+                    # whole frame (docs/qos.md): this op fast-fails
+                    # with a per-op OPT_OVERLOAD code while its
+                    # siblings apply.
+                    self._c_shed.inc()
+                    self._tenant_counter(meta.tenant, "shed").inc()
+                    result = ("overload",)
+            if result is None:
+                if meta.push:
+                    self._c_push_reqs.inc()
+                if meta.pull:
+                    self._c_pull_reqs.inc()
+                if (self._replicator is not None and meta.push
+                        and len(kvs.keys)):
+                    if not self._replicator.should_apply(meta):
+                        # Duplicate origin (failover retry vs forwarded
+                        # copy): apply nothing, still ack / serve pull.
+                        if meta.pull:
+                            meta.push = False
+                            kvs.vals = np.empty(0, kvs.vals.dtype)
+                        else:
+                            result = ("ok", None)
+                    else:
+                        # Per-sub-op chain forward, on this (single)
+                        # processing thread in op order — replicas see
+                        # the exact arrival order, and each forward
+                        # carries its op's own origin (ts, key) for
+                        # exactly-once dedup.
+                        self._replicator.forward(meta, kvs,
+                                                 wire=wire_payload)
+                if result is None:
+                    admitted += 1
+            metas.append(meta)
+            kvss.append(kvs)
+            results.append(result)
+        log.check(self._handle is not None, "KVServer handle not set")
+        if self._apply_pool is not None:
+            self._apply_pool.submit_batch(env_meta, metas, kvss, results)
+            return
+        # Serial path (PS_APPLY_SHARDS=0 / handler without
+        # apply_shard): apply each admitted op inline, capture its
+        # response, emit ONE batched frame — the per-frame saving is
+        # the point even without shard concurrency.
+        for i, (meta, kvs) in enumerate(zip(metas, kvss)):
+            if results[i] is not None:
+                continue
+            cap = _OpCapture(self)
+            t0 = time.monotonic()
+            try:
+                self._handle(meta, kvs, cap)
+                results[i] = cap.result
+            except Exception as exc:  # noqa: BLE001 - per-op fast-fail
+                log.warning(
+                    f"batched apply failed for ts={meta.timestamp} "
+                    f"from {meta.sender}: {exc!r}"
+                )
+                results[i] = ("error",)
+            self._h_serial_apply.observe(time.monotonic() - t0)
+        self.response_batch(env_meta, metas, results)
+
+    def response_batch(self, env: KVMeta, metas, results) -> None:
+        """ONE response frame for a batched request (docs/batching.md):
+        per-op result segments concatenated in op order, per-op
+        error/overload codes and hot-cache stamps riding the EXT_BATCH
+        table.  Push sub-ops bump the push version here — the moment
+        their results leave — exactly like per-op responses; pull
+        sub-ops carry the stamp captured at frame intake."""
+        if env.option == OPT_REPLICA:
+            return
+        msg = Message()
+        m = msg.meta
+        m.app_id = self._customer.app_id
+        m.customer_id = env.customer_id
+        m.request = False
+        m.head = 0  # batched ops are plain-cmd by construction
+        m.timestamp = metas[0].timestamp
+        m.recver = env.sender
+        m.key = metas[0].key
+        m.priority = env.priority
+        m.tenant = getattr(env, "tenant", 0)
+        ops = []
+        for meta, result in zip(metas, results):
+            kind = result[0] if result is not None else "ok"
+            option = 0
+            codec_info = None
+            nseg = 0
+            if kind == "overload":
+                option = OPT_OVERLOAD  # nothing applied: no stamp bump
+            elif kind == "error":
+                # A failed push may have applied partially: bump the
+                # version anyway — conservative invalidation is
+                # correct, a skipped one is not (kv/hot_cache.py).
+                self._qos_push_done(meta)
+                option = OPT_APPLY_ERROR
+            else:
+                self._qos_push_done(meta)
+                res = result[1] if kind == "res" else None
+                if meta.pull and res is not None and not res.empty():
+                    ci = getattr(meta, "codec", None)
+                    enc = None
+                    if (ci is not None and ci.raw_len == 0
+                            and isinstance(res.vals, np.ndarray)
+                            and res.vals.dtype == np.float32
+                            and res.vals.size > 0):
+                        # Per-sub-op pull compression: the op asked for
+                        # a codec via its table entry; the per-op
+                        # CodecInfo rides back in the response table.
+                        enc = self._encode_response(ci, meta, res)
+                    if enc is not None:
+                        codes, scales, codec_info = enc
+                        msg.add_data(SArray(res.keys))
+                        msg.add_data(SArray(codes))
+                        msg.add_data(SArray(scales))
+                        nseg = 3
+                    else:
+                        msg.add_data(SArray(res.keys))
+                        msg.add_data(SArray(res.vals))
+                        nseg = 2
+                    if res.lens is not None:
+                        # Ragged pull result (a custom handler's lens
+                        # response on the serial path): the lens
+                        # segment travels per-op, exactly like the
+                        # unbatched response() — dropping it would hand
+                        # the worker un-segmentable values.
+                        msg.add_data(
+                            SArray(np.asarray(res.lens, dtype=np.int32))
+                        )
+                        nseg += 1
+            m.push = m.push or meta.push
+            m.pull = m.pull or meta.pull
+            ops.append(_BatchOp(
+                push=meta.push, pull=meta.pull,
+                timestamp=meta.timestamp, key=meta.key,
+                val_len=meta.val_len, option=option,
+                stamp=getattr(meta, "stamp", 0), nseg=nseg,
+                codec=codec_info,
+            ))
+        m.batch = _BatchInfo(ops=tuple(ops))
+        self.po.van.send(msg)
+
+
+class _OpCapture:
+    """Server proxy for serial-path batched sub-ops: captures the
+    handler's ``response`` into ``result`` (so the frame emits ONE
+    batched response) and forwards everything else to the server."""
+
+    __slots__ = ("_server", "result")
+
+    def __init__(self, server: "KVServer"):
+        self._server = server
+        self.result = ("ok", None)
+
+    def response(self, req, res=None) -> None:
+        self.result = ("res", res) if res is not None else ("ok", None)
+
+    def response_error(self, req) -> None:
+        self.result = ("error",)
+
+    def __getattr__(self, name):
+        return getattr(self._server, name)
 
 
 def _push_segs(meta: KVMeta, all_keys: np.ndarray, vals: np.ndarray,

@@ -166,6 +166,42 @@ class CodecInfo:
 
 
 @dataclass(frozen=True)
+class BatchOp:
+    """One sub-op of a multi-op batched frame (docs/batching.md).
+
+    A batched frame's data section is the concatenation of its sub-ops'
+    data segments in op order; ``nseg`` says how many segments this op
+    consumed, so the decoder re-slices without byte arithmetic (the
+    frame header's per-segment length table already delimits each
+    segment).  Every sub-op keeps its OWN timestamp (completion
+    accounting), key (slice identity), option (per-op error/overload
+    codes on responses), hot-cache ``stamp``, and codec identity —
+    batching changes how ops travel, never what they mean."""
+
+    push: bool = False
+    pull: bool = False
+    timestamp: int = 0
+    key: int = 0
+    val_len: int = 0
+    option: int = 0    # per-op response code (OPT_APPLY_ERROR/OVERLOAD)
+    stamp: int = 0     # per-op hot-cache push-version (kv/hot_cache.py)
+    nseg: int = 0      # data segments this op owns in the frame
+    codec: Optional["CodecInfo"] = None
+
+
+@dataclass(frozen=True)
+class BatchInfo:
+    """Multi-op aggregation extension (docs/batching.md): this frame
+    carries ``len(ops)`` independent small KV ops to one destination.
+    Rides the tagged ``EXT_BATCH`` meta extension (wire.py) with the
+    per-op table serialized ahead of ``meta.body``; packed BEFORE
+    EXT_CODEC/EXT_CHUNK so EXT_CHUNK stays the meta's trailing bytes
+    (the native splitter's patch contract)."""
+
+    ops: tuple = ()  # tuple[BatchOp]
+
+
+@dataclass(frozen=True)
 class ChunkInfo:
     """Chunked-transfer wire extension (docs/chunking.md): one large
     data message travels as ``total`` chunk messages, each carrying a
@@ -276,6 +312,13 @@ class Meta:
     # message as ONE chunk of a larger transfer.  Travels as a tagged
     # wire extension like ``trace`` — old decoders skip it by length.
     chunk: Optional[ChunkInfo] = None
+    # Small-op aggregation (docs/batching.md): non-None marks this
+    # frame as a MULTI-OP batch — N independent KV ops to one
+    # destination, each with its own timestamp/key/option/stamp/codec
+    # in the per-op table.  Tagged EXT_BATCH extension; only ever sent
+    # to peers whose batch capability was negotiated (old decoders
+    # never see these frames).
+    batch: Optional[BatchInfo] = None
     # Wire compression (docs/compression.md): non-None marks the vals
     # payload as codec-encoded (or, on a pull request with raw_len=0,
     # asks the server to encode its response).  Tagged EXT_CODEC

@@ -23,6 +23,12 @@ Scheduling contract (shared by every tenant-aware queue):
 
 With ``PS_TENANTS`` unset every message is tenant 0 and the weighted
 pool degenerates to the old single-heap order bit-for-bit.
+
+Batching interplay (docs/batching.md): the small-op combiner never
+merges ops across tenants (the tenant is part of its group key), so a
+multi-op ``EXT_BATCH`` frame's envelope tenant prices every sub-op
+correctly in the weighted-fair queues — and per-tenant ADMISSION
+through a batched frame sheds sub-ops individually (docs/qos.md).
 """
 
 from __future__ import annotations

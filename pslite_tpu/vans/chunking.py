@@ -95,7 +95,10 @@ def recv_tenant(msg) -> int:
 
 def recv_cost(msg) -> int:
     """Weighted-fair clock charge of a decoded message: its payload
-    bytes (chunk frames carry theirs in ``data``)."""
+    bytes (chunk frames carry theirs in ``data``).  Batch frames
+    (docs/batching.md) charge their WHOLE multi-op payload — the
+    combiner never merges across tenants or priorities, so the frame's
+    envelope fields price every sub-op correctly."""
     if msg is None or not msg.meta.control.empty():
         return 1
     if msg.data:
@@ -299,11 +302,14 @@ class _Xfer:
         self.t_last = time.monotonic()
         self.t0_us = 0.0
         # Streaming eligibility (module docstring): plain fixed-k push
-        # request with exactly keys+vals segments.
+        # request with exactly keys+vals segments.  Multi-op batch
+        # frames (docs/batching.md) never stream-apply — their data
+        # section interleaves several ops' segments, so only the fully
+        # reassembled frame can be re-sliced per op.
         m = meta
         self.streamable = bool(
             m.push and m.request and not m.pull and not m.simple_app
-            and m.option == 0 and m.codec is None
+            and m.option == 0 and m.codec is None and m.batch is None
             and len(ck.seg_lens) == 2
             and ck.seg_types[0] == _UINT64_CODE
             and ck.seg_lens[0] > 0 and ck.seg_lens[0] % 8 == 0

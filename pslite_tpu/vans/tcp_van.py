@@ -747,19 +747,27 @@ class TcpVan(Van):
                     calls += 1
                     sock.sendall(v)
                 return total
-            calls += 1
-            sent = sock.sendmsg(views)
-            if sent < total:
-                # Partial vector write (socket buffer full): drop the
-                # whole chunks already on the wire, then sendall the
-                # straddling chunk's tail and everything after it.
-                for v in views:
-                    if sent >= v.nbytes:
-                        sent -= v.nbytes
-                        continue
-                    calls += 1
-                    sock.sendall(v[sent:] if sent else v)
-                    sent = 0
+            # UIO_MAXIOV bound: the kernel rejects sendmsg with more
+            # than 1024 iovecs (EMSGSIZE) — a deep multi-op batch
+            # frame (docs/batching.md) can carry hundreds of segments,
+            # so slice the vector; ordinary frames take one call.
+            for lo in range(0, len(views), 1000):
+                part = views[lo:lo + 1000]
+                ptotal = sum(v.nbytes for v in part)
+                calls += 1
+                sent = sock.sendmsg(part)
+                if sent < ptotal:
+                    # Partial vector write (socket buffer full): drop
+                    # the whole chunks already on the wire, then
+                    # sendall the straddling chunk's tail and
+                    # everything after it.
+                    for v in part:
+                        if sent >= v.nbytes:
+                            sent -= v.nbytes
+                            continue
+                        calls += 1
+                        sock.sendall(v[sent:] if sent else v)
+                        sent = 0
             return total
         finally:
             if calls:

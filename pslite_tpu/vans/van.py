@@ -178,6 +178,13 @@ class Van:
         )
         self._c_chunks_sent = self._node_metrics.counter("van.chunks_sent")
         self._c_chunks_recv = self._node_metrics.counter("van.chunks_recv")
+        # Small-op aggregation (docs/batching.md): multi-op EXT_BATCH
+        # frames this node sent and the sub-ops they carried — psmon's
+        # ops/frame column divides the two.  On the node registry (no
+        # legacy read surface) so PS_TELEMETRY=0 no-ops them.
+        self._c_batched_frames = self._node_metrics.counter(
+            "van.batched_frames")
+        self._c_batch_ops = self._node_metrics.counter("van.batch_ops")
         self._h_hol_wait = self._node_metrics.histogram("van.hol_wait_s")
         self._node_metrics.gauge("van.xfers_inflight",
                                  fn=self._owner_xfer_depth)
@@ -526,6 +533,12 @@ class Van:
                 f"node {msg.meta.recver} was declared dead by the "
                 f"failure detector"
             )
+        if msg.meta.batch is not None and msg.meta.control.empty():
+            # Aggregation accounting (docs/batching.md): counted once
+            # per frame at submission, whichever plane (native lane,
+            # Python lane, chunk split) carries it.
+            self._c_batched_frames.inc()
+            self._c_batch_ops.inc(len(msg.meta.batch.ops))
         if msg.meta.control.empty() and not self.tenants.enabled:
             # Native data plane (docs/native_core.md): transports with
             # native sender lanes take the whole hot path — frame
@@ -810,26 +823,36 @@ class Van:
             f"delivery to node {m.recver} failed ({exc}); failing "
             f"local request ts={m.timestamp}"
         )
-        fail = Message()
-        f = fail.meta
-        f.app_id = m.app_id
-        f.customer_id = m.customer_id
-        f.timestamp = m.timestamp
-        f.sender = m.recver
-        f.recver = self.my_node.id
-        f.request = False
-        f.push = m.push
-        f.pull = m.pull
-        f.simple_app = m.simple_app
-        f.key = m.key
-        f.option = OPT_SEND_FAILED
-        try:
-            self._process_data_msg(fail)
-        except Exception as deliver_exc:  # noqa: BLE001
-            log.warning(
-                f"could not fail local request ts={m.timestamp}: "
-                f"{deliver_exc!r}"
-            )
+        # A multi-op batch frame (docs/batching.md) carries N waiters,
+        # each with its OWN timestamp: synthesize one OPT_SEND_FAILED
+        # per sub-op — failing only the envelope's (first) timestamp
+        # would strand every sibling's wait() forever.
+        if m.batch is not None:
+            subs = [(op.timestamp, op.key, op.push, op.pull)
+                    for op in m.batch.ops]
+        else:
+            subs = [(m.timestamp, m.key, m.push, m.pull)]
+        for ts, key, push, pull in subs:
+            fail = Message()
+            f = fail.meta
+            f.app_id = m.app_id
+            f.customer_id = m.customer_id
+            f.timestamp = ts
+            f.sender = m.recver
+            f.recver = self.my_node.id
+            f.request = False
+            f.push = push
+            f.pull = pull
+            f.simple_app = m.simple_app
+            f.key = key
+            f.option = OPT_SEND_FAILED
+            try:
+                self._process_data_msg(fail)
+            except Exception as deliver_exc:  # noqa: BLE001
+                log.warning(
+                    f"could not fail local request ts={ts}: "
+                    f"{deliver_exc!r}"
+                )
 
     def _failure_detector_loop(self, scan_s: float, timeout_s: float) -> None:
         """Scheduler-side active scan: poll the heartbeat registry and

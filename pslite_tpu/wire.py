@@ -20,6 +20,8 @@ from typing import List, Tuple
 import numpy as np
 
 from .message import (
+    BatchInfo,
+    BatchOp,
     ChunkInfo,
     CodecInfo,
     Command,
@@ -64,6 +66,66 @@ _EXT_CODEC_PAYLOAD = struct.Struct("<BBHQ")  # codec flags block raw_len
 # trailing bytes (the native splitter's patch contract).
 EXT_QOS = 4
 _EXT_QOS_PAYLOAD = struct.Struct("<HQ")  # tenant, stamp
+# Small-op aggregation (docs/batching.md): this frame carries N
+# independent KV ops.  The u8 ext length cannot hold a per-op table,
+# so the payload is just (n_ops, table_len) and the table itself is
+# serialized AHEAD of ``meta.body`` (stripped again at unpack — body
+# round-trips unchanged).  Packed before EXT_CODEC/EXT_CHUNK so
+# EXT_CHUNK stays the trailing bytes (the native splitter's contract).
+# Capability-gated: senders only emit EXT_BATCH toward peers that
+# answered the batch probe, so old decoders never see these frames.
+EXT_BATCH = 5
+_EXT_BATCH_PAYLOAD = struct.Struct("<HI")  # n_ops, table_len
+_BATCH_OP_FIXED = struct.Struct("<BBiQqqQ")
+# flags, nseg, timestamp, key, val_len, option, stamp
+_BATCH_F_PUSH, _BATCH_F_PULL, _BATCH_F_CODEC = 1, 2, 4
+BATCH_MAX_OPS = 0xFFFF  # u16 op count
+
+
+def _pack_batch_table(info: BatchInfo) -> bytes:
+    parts = []
+    for op in info.ops:
+        flags = (
+            (_BATCH_F_PUSH if op.push else 0)
+            | (_BATCH_F_PULL if op.pull else 0)
+            | (_BATCH_F_CODEC if op.codec is not None else 0)
+        )
+        parts.append(_BATCH_OP_FIXED.pack(
+            flags, op.nseg & 0xFF, op.timestamp, op.key % (1 << 64),
+            op.val_len, op.option, op.stamp % (1 << 64),
+        ))
+        if op.codec is not None:
+            cd = op.codec
+            parts.append(_EXT_CODEC_PAYLOAD.pack(
+                cd.codec & 0xFF, cd.flags & 0xFF, cd.block & 0xFFFF,
+                cd.raw_len % (1 << 64),
+            ))
+    return b"".join(parts)
+
+
+def _unpack_batch_table(table: memoryview, n_ops: int) -> BatchInfo:
+    ops = []
+    off = 0
+    for _ in range(n_ops):
+        flags, nseg, ts, key, val_len, option, stamp = (
+            _BATCH_OP_FIXED.unpack_from(table, off)
+        )
+        off += _BATCH_OP_FIXED.size
+        codec = None
+        if flags & _BATCH_F_CODEC:
+            c_id, c_flags, c_block, c_raw = _EXT_CODEC_PAYLOAD.unpack_from(
+                table, off
+            )
+            off += _EXT_CODEC_PAYLOAD.size
+            codec = CodecInfo(codec=c_id, raw_len=c_raw, block=c_block,
+                              flags=c_flags)
+        ops.append(BatchOp(
+            push=bool(flags & _BATCH_F_PUSH),
+            pull=bool(flags & _BATCH_F_PULL),
+            timestamp=ts, key=key, val_len=val_len, option=option,
+            stamp=stamp, nseg=nseg, codec=codec,
+        ))
+    return BatchInfo(ops=tuple(ops))
 
 _META_FIXED = struct.Struct(
     "<B"  # version
@@ -162,6 +224,15 @@ def pack_meta(meta: Meta) -> bytes:
         | (_F_SHM if meta.shm_data else 0)
     )
     ctrl = meta.control
+    # Small-op aggregation (docs/batching.md): the per-op table rides
+    # ahead of the caller's body bytes; EXT_BATCH records (n_ops,
+    # table_len) so the decoder strips it back out — meta.body itself
+    # round-trips unchanged.
+    body = bytes(meta.body)
+    batch_table = b""
+    if meta.batch is not None:
+        batch_table = _pack_batch_table(meta.batch)
+        body = batch_table + body
     fixed = _META_FIXED.pack(
         WIRE_VERSION,
         meta.head,
@@ -187,11 +258,11 @@ def pack_meta(meta: Meta) -> bytes:
         ctrl.msg_sig % (1 << 64),
         len(ctrl.node),
         len(meta.data_type),
-        len(meta.body),
+        len(body),
     )
     parts = [fixed]
     parts.append(bytes(bytearray(min(c, 255) for c in meta.data_type)))
-    parts.append(bytes(meta.body))
+    parts.append(body)
     for n in ctrl.node:
         parts.append(_pack_node(n))
     if meta.trace:
@@ -201,6 +272,11 @@ def pack_meta(meta: Meta) -> bytes:
         parts.append(_EXT_HDR.pack(EXT_QOS, _EXT_QOS_PAYLOAD.size))
         parts.append(_EXT_QOS_PAYLOAD.pack(
             meta.tenant & 0xFFFF, meta.stamp % (1 << 64),
+        ))
+    if meta.batch is not None:
+        parts.append(_EXT_HDR.pack(EXT_BATCH, _EXT_BATCH_PAYLOAD.size))
+        parts.append(_EXT_BATCH_PAYLOAD.pack(
+            len(meta.batch.ops) & 0xFFFF, len(batch_table),
         ))
     if meta.codec is not None:
         cd = meta.codec
@@ -267,6 +343,7 @@ def unpack_meta(buf: bytes) -> Meta:
     trace = 0
     chunk = None
     codec = None
+    batch = None
     tenant = 0
     stamp = 0
     while off + _EXT_HDR.size <= len(view):
@@ -278,6 +355,15 @@ def unpack_meta(buf: bytes) -> Meta:
             (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(view, off)
         elif tag == EXT_QOS and ext_len == _EXT_QOS_PAYLOAD.size:
             tenant, stamp = _EXT_QOS_PAYLOAD.unpack_from(view, off)
+        elif tag == EXT_BATCH and ext_len == _EXT_BATCH_PAYLOAD.size:
+            n_ops, table_len = _EXT_BATCH_PAYLOAD.unpack_from(view, off)
+            # The per-op table rode ahead of the caller's body bytes
+            # (see pack_meta): strip it back out so body round-trips.
+            if table_len <= len(body):
+                batch = _unpack_batch_table(
+                    memoryview(body)[:table_len], n_ops
+                )
+                body = body[table_len:]
         elif tag == EXT_CODEC and ext_len == _EXT_CODEC_PAYLOAD.size:
             c_id, c_flags, c_block, c_raw = _EXT_CODEC_PAYLOAD.unpack_from(
                 view, off
@@ -329,6 +415,7 @@ def unpack_meta(buf: bytes) -> Meta:
         trace=trace,
         chunk=chunk,
         codec=codec,
+        batch=batch,
         tenant=tenant,
         stamp=stamp,
         src_dev_type=src_dt,

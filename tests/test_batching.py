@@ -1,0 +1,758 @@
+"""Small-op aggregation plane, end to end (docs/batching.md).
+
+The wire format is covered in test_wire.py; this file proves the
+TIER: the worker-side combiner (grouping, parity, caps, failure
+routing), the capability negotiation, the server's batched group
+apply (per-op results, per-op admission sheds, per-op errors), the
+hot-cache read-your-writes contract through batched frames, and the
+decline matrix (elastic, zpull, traced ops, custom cmds).
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tests")
+from helpers import LoopbackCluster  # noqa: E402
+
+from pslite_tpu.kv import batching  # noqa: E402
+from pslite_tpu.kv.batching import (  # noqa: E402
+    OpCombiner,
+    batchable,
+    build_batch_message,
+    op_wire_cost,
+    split_batch_message,
+)
+from pslite_tpu.kv.kv_app import (  # noqa: E402
+    ElasticZeroCopyError,
+    KVMeta,
+    KVPairs,
+    KVServer,
+    KVServerDefaultHandle,
+    KVServerOptimizerHandle,
+    KVWorker,
+    OverloadError,
+)
+from pslite_tpu.message import Message  # noqa: E402
+from pslite_tpu.sarray import SArray  # noqa: E402
+
+
+def _op_msg(ts, key, vals, recver=8, tenant=0, priority=0, pull=False):
+    msg = Message()
+    m = msg.meta
+    m.request = True
+    m.head = 0
+    m.push = not pull
+    m.pull = pull
+    m.timestamp = ts
+    m.key = key
+    m.recver = recver
+    m.tenant = tenant
+    m.priority = priority
+    msg.add_data(SArray(np.array([key], np.uint64)))
+    msg.add_data(SArray(np.asarray(vals, np.float32)))
+    m.val_len = msg.data[1].nbytes
+    return msg
+
+
+# -- combiner units ----------------------------------------------------------
+
+
+def test_combiner_groups_never_cross_tenant_priority_codec():
+    """The group key is the LANE identity (destination, tenant,
+    priority) — batching never crosses those.  Codec-mismatched ops
+    SHARE the group's FIFO (order never relaxes within a lane) but
+    never MERGE: a flush emits them as separate consecutive frames."""
+    from pslite_tpu.message import CodecInfo
+
+    base = _op_msg(1, 1, np.ones(4))
+    other_dest = _op_msg(2, 2, np.ones(4), recver=10)
+    other_tenant = _op_msg(3, 3, np.ones(4), tenant=1)
+    other_prio = _op_msg(4, 4, np.ones(4), priority=1)
+    keys = {OpCombiner.group_key(m)
+            for m in (base, other_dest, other_tenant, other_prio)}
+    assert len(keys) == 4
+    # Same lane => same group, even with a codec (order preservation).
+    coded = _op_msg(5, 5, np.ones(4))
+    coded.meta.codec = CodecInfo(codec=1, raw_len=16, block=128)
+    assert OpCombiner.group_key(base) == OpCombiner.group_key(coded)
+    # ... but codec-mismatched ops never merge: raw, raw, coded, raw
+    # flushes as [batch(2), coded single, raw single] — in order.
+    sent = []
+    c = OpCombiner(lambda m: sent.append(m) or 0, lambda msgs, exc: None,
+                   max_bytes=1 << 20)
+    items = [(_op_msg(1, 1, np.ones(4)), 40, True),
+             (_op_msg(2, 2, np.ones(4)), 40, True),
+             (coded, 40, True),
+             (_op_msg(6, 6, np.ones(4)), 40, True)]
+    c._flush(items)
+    shapes = [len(m.meta.batch.ops) if m.meta.batch else 1 for m in sent]
+    assert shapes == [2, 1, 1]
+    got = [op.timestamp for m in sent
+           for op in (m.meta.batch.ops if m.meta.batch else [m.meta])]
+    assert got == [1, 2, 5, 6]  # submission order, never relaxed
+
+
+def test_combiner_single_op_passthrough_and_merge():
+    """A lone op is sent as its ORIGINAL message (low-load parity); a
+    concurrent burst merges into one EXT_BATCH frame in submission
+    order."""
+    sent = []
+    done = threading.Event()
+
+    def send(m):
+        sent.append(m)
+        if len(sent) >= 2:
+            done.set()
+        return 0
+
+    c = OpCombiner(send, lambda msgs, exc: None, max_bytes=1 << 20)
+    lone = _op_msg(1, 1, np.ones(4))
+    c.submit(lone)
+    for _ in range(100):
+        if sent:
+            break
+        time.sleep(0.01)
+    assert sent and sent[0] is lone and sent[0].meta.batch is None
+    # Burst: queue while the dispatcher is parked on a fresh group
+    # (first_enq pinned in the past so the adaptive hold closes at the
+    # very next pickup).
+    with c._cv:  # hold the lock so the burst lands as one group
+        key = OpCombiner.group_key(lone)
+        for i in range(2, 6):
+            c._groups.setdefault(key, []).append(
+                (_op_msg(i, i, np.ones(4)), 32, True))
+        c._first_enq[key] = 0.0
+        c._cv.notify_all()
+    for _ in range(200):
+        if len(sent) >= 2:
+            break
+        time.sleep(0.01)
+    env = sent[1]
+    assert env.meta.batch is not None
+    assert [op.timestamp for op in env.meta.batch.ops] == [2, 3, 4, 5]
+    c.stop()
+
+
+def test_combiner_flush_splits_at_op_cap():
+    """A backpressured group larger than the per-frame op cap emits as
+    consecutive capped frames, order preserved."""
+    sent = []
+    c = OpCombiner(lambda m: sent.append(m) or 0, lambda msgs, exc: None,
+                   max_bytes=1 << 30, max_ops=4)
+    batch = [(_op_msg(i, i, np.ones(2)), 8, True) for i in range(10)]
+    c._flush(batch)
+    assert [len(m.meta.batch.ops) if m.meta.batch else 1
+            for m in sent] == [4, 4, 2]
+    got = [op.timestamp for m in sent
+           for op in (m.meta.batch.ops if m.meta.batch else [m.meta])]
+    assert got == list(range(10))
+
+
+def test_combiner_error_hook_routes_failures():
+    """A transport failure during a flush reaches on_error with the
+    member messages (the worker fails each sub-op's slice from it)."""
+    failed = []
+
+    def send(m):
+        raise ConnectionError("down")
+
+    c = OpCombiner(send, lambda msgs, exc: failed.append((msgs, exc)),
+                   max_bytes=1 << 20)
+    c._flush([(_op_msg(1, 1, np.ones(2)), 8, True),
+              (_op_msg(2, 2, np.ones(2)), 8, True)])
+    assert len(failed) == 1 and len(failed[0][0]) == 2
+    assert isinstance(failed[0][1], ConnectionError)
+
+
+def test_build_split_roundtrip_preserves_ops():
+    msgs = [_op_msg(i, i * 10, np.full(4, float(i))) for i in range(1, 5)]
+    env = build_batch_message(msgs)
+    assert env.meta.push and not env.meta.pull
+    assert len(env.data) == 8
+    subs = split_batch_message(env)
+    assert len(subs) == 4
+    for i, s in enumerate(subs, start=1):
+        assert s.meta.timestamp == i and s.meta.key == i * 10
+        np.testing.assert_array_equal(
+            s.data[1].numpy(), np.full(4, np.float32(i)))
+
+
+def test_batchable_declines():
+    """Structural decline rows: traced ops, custom cmds, zpull-marked,
+    chunk frames, and >3-segment (lens'd) payloads pass through."""
+    from pslite_tpu.message import OPT_ZPULL, ChunkInfo
+
+    ok = _op_msg(1, 1, np.ones(4))
+    assert batchable(ok)
+    traced = _op_msg(1, 1, np.ones(4))
+    traced.meta.trace = 99
+    assert not batchable(traced)
+    cmd = _op_msg(1, 1, np.ones(4))
+    cmd.meta.head = 0x77
+    assert not batchable(cmd)
+    zp = _op_msg(1, 1, np.ones(4))
+    zp.meta.option = OPT_ZPULL
+    assert not batchable(zp)
+    ck = _op_msg(1, 1, np.ones(4))
+    ck.meta.chunk = ChunkInfo(xfer=1, index=0, total=2)
+    assert not batchable(ck)
+    # A raw ragged push is keys+vals+LENS = 3 segments: excluded (the
+    # batched intake is a fixed-k contract) — while a codec push's 3
+    # segments (keys+codes+scales) stay eligible.
+    from pslite_tpu.message import CodecInfo
+
+    lens = _op_msg(1, 1, np.ones(4))
+    lens.add_data(SArray(np.ones(1, np.int32)))
+    assert len(lens.data) == 3 and not batchable(lens)
+    coded = _op_msg(1, 1, np.ones(4))
+    coded.meta.codec = CodecInfo(codec=1, raw_len=16, block=128)
+    coded.add_data(SArray(np.ones(1, np.float32)))  # scales
+    assert len(coded.data) == 3 and batchable(coded)
+    coded.add_data(SArray(np.ones(1, np.int32)))  # codec + lens: out
+    assert not batchable(coded)
+    assert op_wire_cost(ok) == ok.data[0].nbytes + ok.data[1].nbytes
+
+
+# -- end to end --------------------------------------------------------------
+
+
+def _storm_cluster(env_extra=None, num_servers=1, handle=None):
+    cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
+                         env_extra={"PS_BATCH_BYTES": "65536",
+                                    **(env_extra or {})})
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(handle() if handle else
+                             KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    return cl, servers, w
+
+
+def _teardown(cl, servers, w):
+    w.stop()
+    for s in servers:
+        s.stop()
+    cl.finalize()
+
+
+def test_batched_push_storm_bit_exact_and_batches_formed():
+    """Concurrent small pushes coalesce into EXT_BATCH frames; the
+    accumulated store is bit-exact vs the arithmetic sum; the van's
+    batch counters advance (the psmon ops/frame source)."""
+    cl, servers, w = _storm_cluster(num_servers=2)
+    try:
+        span = (1 << 64) // 2
+        keys = np.sort(np.array([3, 77, span + 5, span + 900], np.uint64))
+        rng = np.random.default_rng(7)
+        total = np.zeros(4 * 64, np.float32)
+        tss = []
+        for _ in range(150):
+            vals = rng.normal(size=4 * 64).astype(np.float32)
+            total += vals
+            tss.append(w.push(keys, vals.copy()))
+        for ts in tss:
+            w.wait(ts)
+        out = np.zeros_like(total)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_allclose(out, total, rtol=1e-4)
+        assert w.combiner is not None
+        assert w.combiner.flushed_frames > 0
+        van = cl.workers[0].van
+        assert van._c_batched_frames.value == w.combiner.flushed_frames
+        assert van._c_batch_ops.value == w.combiner.flushed_ops
+        assert van._c_batch_ops.value > van._c_batched_frames.value
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_batched_pulls_return_correct_per_op_data():
+    """Concurrent small pulls coalesce; the ONE batched response frame
+    carries each op's own keys+vals and every destination buffer lands
+    bit-exact."""
+    cl, servers, w = _storm_cluster()
+    try:
+        nkeys = 24
+        all_keys = np.arange(nkeys, dtype=np.uint64)
+        vals = np.arange(nkeys * 16, dtype=np.float32)
+        w.wait(w.push(all_keys, vals))
+        outs = [np.zeros(16, np.float32) for _ in range(nkeys)]
+        tss = [w.pull(np.array([k], np.uint64), outs[k])
+               for k in range(nkeys)]
+        for ts in tss:
+            w.wait(ts)
+        for k in range(nkeys):
+            np.testing.assert_array_equal(
+                outs[k], vals[k * 16:(k + 1) * 16])
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_mixed_push_pull_batches_and_order():
+    """Pushes and pulls of the same keys share a group (same dest/
+    tenant/priority); each pull observes every push WAITED before it
+    was issued (per-dest frame order == submission order)."""
+    cl, servers, w = _storm_cluster()
+    try:
+        keys = np.array([5], np.uint64)
+        acc = np.zeros(32, np.float32)
+        for i in range(20):
+            vals = np.full(32, float(i + 1), np.float32)
+            acc += vals
+            w.wait(w.push(keys, vals))
+        out = np.zeros(32, np.float32)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_array_equal(out, acc)
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_parity_batching_off_sends_no_batch_frames():
+    """PS_BATCH_BYTES=0 (the default): no combiner, no EXT_BATCH frame
+    ever leaves — byte-identical to a pre-batching build."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_BATCH_BYTES": "0"})
+    cl.start()
+    servers = []
+    try:
+        s = KVServer(0, postoffice=cl.servers[0])
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        assert w.combiner is None
+        keys = np.array([1, 2], np.uint64)
+        tss = [w.push(keys, np.ones(2 * 8, np.float32))
+               for _ in range(20)]
+        for ts in tss:
+            w.wait(ts)
+        van = cl.workers[0].van
+        assert van._c_batched_frames.value == 0
+        assert van._c_batch_ops.value == 0
+        # ... and no capability probe traffic either: with batching
+        # off the negotiation machinery must stay silent.
+        assert not w._batch_probe_ts and not w._batch_caps
+        w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cl.finalize()
+
+
+def test_capability_negotiation_and_incapable_peer():
+    """The first eligible op probes the destination (BATCH_PROBE_CMD);
+    a capable server answers and batching engages.  A destination
+    recorded INCAPABLE never receives an EXT_BATCH frame — old
+    decoders must never see a frame they cannot parse."""
+    cl, servers, w = _storm_cluster()
+    try:
+        keys = np.array([1], np.uint64)
+        vals = np.ones(8, np.float32)
+        w.wait(w.push(keys, vals))
+        dest = None
+        for _ in range(200):
+            with w._mu:
+                caps = dict(w._batch_caps)
+            if caps:
+                dest = next(iter(caps))
+                break
+            time.sleep(0.01)
+        assert dest is not None and caps[dest] is True
+        # Storm: batches now form.
+        tss = [w.push(keys, vals) for _ in range(60)]
+        for ts in tss:
+            w.wait(ts)
+        assert w.combiner.flushed_frames > 0
+        # Flip the destination to incapable: every further op passes
+        # through unbatched.
+        before = w.combiner.flushed_frames
+        with w._mu:
+            w._batch_caps[dest] = False
+        tss = [w.push(keys, vals) for _ in range(60)]
+        for ts in tss:
+            w.wait(ts)
+        assert w.combiner.flushed_frames == before
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_negotiate_off_asserts_capable():
+    """PS_BATCH_NEGOTIATE=0: the operator asserts a homogeneous
+    cluster — no probe round trip, batching engages immediately."""
+    cl, servers, w = _storm_cluster(
+        env_extra={"PS_BATCH_NEGOTIATE": "0"})
+    try:
+        keys = np.array([1], np.uint64)
+        tss = [w.push(keys, np.ones(8, np.float32)) for _ in range(60)]
+        for ts in tss:
+            w.wait(ts)
+        assert not w._batch_probe_ts  # no probes ever sent
+        assert w.combiner.flushed_frames > 0
+        out = np.zeros(8, np.float32)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_array_equal(out, np.full(8, 60.0, np.float32))
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_admission_sheds_sub_ops_individually():
+    """Per-tenant admission through a batched frame sheds SUB-OPS, not
+    the whole frame (docs/qos.md note): some waits raise the retryable
+    OverloadError, the rest apply, and the store ends bit-exact at
+    applied-count."""
+    cl, servers, w = _storm_cluster(env_extra={
+        "PS_TENANTS": "serve:8,train:1",
+        "PS_TENANT_QUEUE_LIMIT": "4",
+        "PS_BATCH_NEGOTIATE": "0",
+    })
+    try:
+        keys = np.arange(8, dtype=np.uint64)
+        vals = np.ones(8 * 1024, np.float32)
+        tss = [w.push(keys, vals, tenant="train") for _ in range(64)]
+        applied = shed = 0
+        for ts in tss:
+            try:
+                w.wait(ts)
+                applied += 1
+            except OverloadError:
+                shed += 1
+        assert applied + shed == 64 and applied > 0
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out, tenant="train"))
+        assert np.all(out == np.float32(applied)), (applied, out[:2])
+        # Batches really formed (sheds rode per-op OPT_OVERLOAD codes
+        # inside batched responses, not whole-frame rejects).
+        assert w.combiner.flushed_frames > 0
+    finally:
+        _teardown(cl, servers, w)
+
+
+class _PoisonKeyHandle(KVServerDefaultHandle):
+    """Raises while applying key 13 — the per-op error-code path."""
+
+    def apply_shard(self, meta, keys, segs):
+        if meta.push and 13 in keys.tolist():
+            raise RuntimeError("poison key")
+        return super().apply_shard(meta, keys, segs)
+
+
+def test_per_op_error_codes_fail_only_the_poisoned_op():
+    """A sub-op whose apply raises fails ITS wait() fast
+    (OPT_APPLY_ERROR in the per-op table); sibling sub-ops in the same
+    frame complete normally."""
+    cl, servers, w = _storm_cluster(handle=_PoisonKeyHandle,
+                                    env_extra={"PS_BATCH_NEGOTIATE": "0"})
+    try:
+        good = [w.push(np.array([k], np.uint64), np.ones(64, np.float32))
+                for k in (1, 2, 3)]
+        bad = w.push(np.array([13], np.uint64), np.ones(64, np.float32))
+        good += [w.push(np.array([k], np.uint64), np.ones(64, np.float32))
+                 for k in (4, 5)]
+        for ts in good:
+            w.wait(ts)  # siblings unaffected
+        with pytest.raises(RuntimeError, match="failed server-side"):
+            w.wait(bad)
+        out = np.zeros(64, np.float32)
+        w.wait(w.pull(np.array([4], np.uint64), out))
+        np.testing.assert_array_equal(out, np.ones(64, np.float32))
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_serial_path_batches_without_apply_pool():
+    """PS_APPLY_SHARDS=0 (no shard pool): batched frames still decode
+    once and answer with ONE response frame via the serial inline
+    loop — the per-frame saving without shard concurrency."""
+    cl, servers, w = _storm_cluster(env_extra={
+        "PS_APPLY_SHARDS": "0", "PS_BATCH_NEGOTIATE": "0"})
+    try:
+        keys = np.array([2, 9], np.uint64)
+        tss = [w.push(keys, np.ones(2 * 16, np.float32))
+               for _ in range(40)]
+        for ts in tss:
+            w.wait(ts)
+        out = np.zeros(2 * 16, np.float32)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_array_equal(out, np.full(2 * 16, 40.0,
+                                                   np.float32))
+        assert w.combiner.flushed_frames > 0
+        # The server answered batched frames with batched responses.
+        srv_van = cl.servers[0].van
+        assert srv_van._c_batched_frames.value > 0
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_optimizer_handle_order_preserved_through_batching():
+    """KVServerOptimizerHandle is ORDER-SENSITIVE (momentum): a
+    batched storm must apply per-key in submission order — compare
+    against an unbatched run of the identical sequence."""
+
+    def run(batch_bytes):
+        cl = LoopbackCluster(num_workers=1, num_servers=1,
+                             env_extra={"PS_BATCH_BYTES": batch_bytes,
+                                        "PS_BATCH_NEGOTIATE": "0"})
+        cl.start()
+        servers = []
+        try:
+            s = KVServer(0, postoffice=cl.servers[0])
+            s.set_request_handle(KVServerOptimizerHandle(
+                kind="sgd_momentum", lr=0.1))
+            servers.append(s)
+            w = KVWorker(0, 0, postoffice=cl.workers[0])
+            keys = np.array([3], np.uint64)
+            rng = np.random.default_rng(11)
+            tss = [w.push(keys, rng.normal(size=32).astype(np.float32))
+                   for _ in range(50)]
+            for ts in tss:
+                w.wait(ts)
+            out = np.zeros(32, np.float32)
+            w.wait(w.pull(keys, out))
+            w.stop()
+            return out
+        finally:
+            for s in servers:
+                s.stop()
+            cl.finalize()
+
+    batched = run("65536")
+    unbatched = run("0")
+    np.testing.assert_array_equal(batched, unbatched)
+
+
+def test_unmergeable_ops_never_overtake_queued_siblings():
+    """An op that cannot MERGE (here: a custom-cmd push, which
+    ``batchable`` declines) still rides the combiner's per-lane FIFO
+    in position — it must never overtake queued mergeable siblings to
+    the SAME key.  Proven with the order-sensitive momentum optimizer:
+    a concurrent sequence interleaving plain and custom-cmd pushes of
+    one key must end bit-identical to the unbatched run."""
+
+    def run(batch_bytes):
+        cl = LoopbackCluster(num_workers=1, num_servers=1,
+                             env_extra={"PS_BATCH_BYTES": batch_bytes,
+                                        "PS_BATCH_NEGOTIATE": "0"})
+        cl.start()
+        servers = []
+        try:
+            s = KVServer(0, postoffice=cl.servers[0])
+            s.set_request_handle(KVServerOptimizerHandle(
+                kind="sgd_momentum", lr=0.1))
+            servers.append(s)
+            w = KVWorker(0, 0, postoffice=cl.workers[0])
+            keys = np.array([3], np.uint64)
+            rng = np.random.default_rng(23)
+            tss = []
+            for i in range(40):
+                vals = rng.normal(size=32).astype(np.float32)
+                # Every 5th op carries a custom cmd: structurally
+                # unmergeable, so it MUST flow through the lane FIFO
+                # as a single frame in position — under the old
+                # bypass it overtook the queued batch and momentum
+                # diverged.
+                tss.append(w.push(keys, vals, cmd=5 if i % 5 == 4
+                                  else 0))
+            for ts in tss:
+                w.wait(ts)
+            out = np.zeros(32, np.float32)
+            w.wait(w.pull(keys, out))
+            w.stop()
+            return out
+        finally:
+            for s in servers:
+                s.stop()
+            cl.finalize()
+
+    np.testing.assert_array_equal(run("65536"), run("0"))
+
+
+# -- hot cache x batching (satellite) ----------------------------------------
+
+
+def test_hot_cache_read_your_writes_through_batching():
+    """Satellite (ISSUE 10): per-sub-op stamps keep the hot-cache
+    contract through batched frames — a batched PUSH's response
+    invalidates older fills (read-your-writes), a batched PULL's
+    response fills with its intake stamp, and a racing stale fill
+    parks invalid."""
+    cl, servers, w = _storm_cluster(env_extra={
+        "PS_HOT_CACHE": "1", "PS_BATCH_NEGOTIATE": "0"})
+    try:
+        nkeys = 8
+        outs = [np.zeros(16, np.float32) for _ in range(nkeys)]
+        one_keys = [np.array([k], np.uint64) for k in range(nkeys)]
+        vals = np.arange(nkeys * 16, dtype=np.float32)
+        w.wait(w.push(np.arange(nkeys, dtype=np.uint64), vals))
+        # Batched pulls fill the cache (per-op stamps from the table).
+        tss = [w.pull(one_keys[k], outs[k]) for k in range(nkeys)]
+        for ts in tss:
+            w.wait(ts)
+        assert len(w.hot_cache) > 0
+        hits0 = w.po.metrics.counter("kv.hot_cache.hits").value
+        # Repeat pulls serve locally.
+        for k in range(nkeys):
+            w.wait(w.pull(one_keys[k], outs[k]))
+        assert w.po.metrics.counter("kv.hot_cache.hits").value > hits0
+        # Batched pushes of the same keys: the response's per-op stamps
+        # must invalidate the cached fills — the next pulls observe the
+        # NEW values (read-your-writes survives batching).
+        tss = [w.push(one_keys[k], np.full(16, 100.0 + k, np.float32))
+               for k in range(nkeys)]
+        for ts in tss:
+            w.wait(ts)
+        for k in range(nkeys):
+            w.wait(w.pull(one_keys[k], outs[k]))
+            np.testing.assert_array_equal(
+                outs[k],
+                vals[k * 16:(k + 1) * 16] + np.float32(100.0 + k),
+            )
+        # Fill-race skip: a fill whose stamp predates a known push
+        # parks invalid (HotKeyCache.fill's stamp check) — simulate
+        # the race directly against the cache.
+        cache = w.hot_cache
+        stale_stamp = 1
+        cache.observe(next(iter(cl.servers)).van.my_node.id
+                      if hasattr(next(iter(cl.servers)), "van") else 8,
+                      1 << 60)
+        n_before = len(cache)
+        cache.fill(8, stale_stamp, np.array([999], np.uint64),
+                   np.ones(4, np.float32))
+        assert len(cache) == n_before  # born-invalid fill skipped
+    finally:
+        _teardown(cl, servers, w)
+
+
+# -- decline matrix ----------------------------------------------------------
+
+
+def test_elastic_declines_batching_and_zpull_raises():
+    """PS_ELASTIC=1: the combiner declines (warned, unbatched sends)
+    and — the ISSUE 10 satellite fix — ZPush/ZPull registered buffers
+    now raise the documented ElasticZeroCopyError instead of the PR 9
+    silent decline."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_ELASTIC": "1",
+                                    "PS_BATCH_BYTES": "65536",
+                                    "PS_HEARTBEAT_INTERVAL": "0"})
+    cl.start()
+    servers = []
+    try:
+        s = KVServer(0, postoffice=cl.servers[0])
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        assert w.combiner is None  # declined loudly at construction
+        with pytest.raises(ElasticZeroCopyError):
+            w.alloc_pull_buffer(np.array([1, 2], np.uint64), 8)
+        w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cl.finalize()
+
+
+class _RaggedHandle:
+    """Serial-path handler answering pulls with RAGGED (lens) results
+    — per-key value lengths differ, so the response must carry the
+    lens segment through the batched response table too."""
+
+    def __call__(self, meta, kvs, server):
+        if meta.pull:
+            k = int(kvs.keys[0])
+            vals = np.full(k + 1, float(k), np.float32)  # len = key+1
+            server.response(meta, KVPairs(
+                keys=kvs.keys, vals=vals,
+                lens=np.array([k + 1], np.int32),
+            ))
+        else:
+            server.response(meta)
+
+
+def test_ragged_pull_responses_carry_lens_through_batching():
+    """A batched pull whose (serial-path) result is ragged gets its
+    per-op LENS segment back — dropping it would hand the worker
+    un-segmentable values (review regression)."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1,
+                         env_extra={"PS_BATCH_BYTES": "65536",
+                                    "PS_BATCH_NEGOTIATE": "0",
+                                    "PS_APPLY_SHARDS": "0"})
+    cl.start()
+    servers = []
+    try:
+        s = KVServer(0, postoffice=cl.servers[0])
+        s.set_request_handle(_RaggedHandle())
+        servers.append(s)
+        w = KVWorker(0, 0, postoffice=cl.workers[0])
+        outs = {k: np.zeros(k + 1, np.float32) for k in (1, 2, 3, 4)}
+        lens_out = {k: np.zeros(1, np.int32) for k in outs}
+        tss = [w.pull(np.array([k], np.uint64), outs[k],
+                      lens=lens_out[k]) for k in outs]
+        for ts in tss:
+            w.wait(ts)
+        for k in outs:
+            np.testing.assert_array_equal(
+                outs[k], np.full(k + 1, float(k), np.float32))
+            assert lens_out[k][0] == k + 1
+        w.stop()
+    finally:
+        for s in servers:
+            s.stop()
+        cl.finalize()
+
+
+def test_abandoned_batch_frame_fails_every_sub_op():
+    """The van's give-up path (dead peer / resender exhausted) is
+    batch-aware: one abandoned EXT_BATCH frame synthesizes an
+    OPT_SEND_FAILED per SUB-OP, so every member's wait() raises
+    instead of only the envelope's first timestamp."""
+    cl, servers, w = _storm_cluster(env_extra={"PS_BATCH_NEGOTIATE": "0"})
+    try:
+        dest = cl.servers[0].van.my_node.id
+        subs = []
+        tss = []
+        for k in (1, 2, 3):
+            ts = w._customer.new_request(dest)
+            tss.append(ts)
+            sub = _op_msg(ts, k, np.ones(8), recver=dest)
+            sub.meta.app_id = w._customer.app_id
+            sub.meta.customer_id = w._customer.customer_id
+            subs.append(sub)
+        env = build_batch_message(subs)
+        cl.workers[0].van._delivery_failed(env, RuntimeError("gone"))
+        for ts in tss:
+            with pytest.raises(TimeoutError):
+                w.wait(ts)
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_replication_batched_storm_bit_exact_replica():
+    """Batching x replication: batched pushes chain-forward PER
+    SUB-OP in arrival order — the replica's store ends bit-exact with
+    the primary's."""
+    cl, servers, w = _storm_cluster(
+        num_servers=2,
+        env_extra={"PS_KV_REPLICATION": "2", "PS_BATCH_NEGOTIATE": "0"})
+    try:
+        keys = np.array([3], np.uint64)  # rank 0's range only
+        rng = np.random.default_rng(5)
+        tss = [w.push(keys, rng.normal(size=256).astype(np.float32))
+               for _ in range(60)]
+        for ts in tss:
+            w.wait(ts)
+        assert w.combiner.flushed_frames > 0
+        primary = servers[0]._handle.store[3]
+        replica = None
+        for _ in range(200):
+            replica = servers[1]._handle.store.get(3)
+            if replica is not None and np.array_equal(primary, replica):
+                break
+            time.sleep(0.02)
+        np.testing.assert_array_equal(primary, replica)
+    finally:
+        _teardown(cl, servers, w)

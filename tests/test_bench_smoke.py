@@ -159,12 +159,14 @@ def test_bench_cli_contract(tmp_path):
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PS_BENCH_PARTIAL=str(tmp_path / "partial.json"),
-        # The multi_tenant section costs ~40s of real-process storms
-        # and has its own dedicated harness tests (admission probe,
-        # dlrm_serve, test_qos.py) — keep the CLI-contract smoke
-        # inside the tier-1 wall budget; the skip marker it records
-        # is exactly what bench_diff treats as absent.
-        PS_BENCH_SKIP="multi_tenant",
+        # The multi_tenant and small_op_batching sections cost ~40-60s
+        # of real-process storms each and have their own dedicated
+        # harness tests (admission probe, dlrm_serve, test_qos.py,
+        # test_batching.py + the small_op harness smoke below) — keep
+        # the CLI-contract smoke inside the tier-1 wall budget; the
+        # skip markers they record are exactly what bench_diff treats
+        # as absent.
+        PS_BENCH_SKIP="multi_tenant,small_op_batching",
     )
     out = subprocess.run(
         [sys.executable, "bench.py"],
@@ -181,6 +183,7 @@ def test_bench_cli_contract(tmp_path):
         assert field in rec
     assert rec["value"] > 0
     assert rec.get("multi_tenant_skipped") == "PS_BENCH_SKIP"
+    assert rec.get("small_op_batching_skipped") == "PS_BENCH_SKIP"
 
 
 def test_telemetry_overhead_guard():
@@ -248,12 +251,85 @@ def _bench_record(**over):
         "chunk_chunked_push_gbps": 10.0,
         "native_goodput_ratio": 2.0,
         "quantized_goodput_ratio_int8": 2.5,
+        "small_op_batching_msgs_ratio": 4.2,
         "kv_storm_msgs_per_s": 1000.0,
         "fault_recovery_detect_s": 1.0,
         "some_untracked_wall_s": 5.0,
     }
     rec.update(over)
     return rec
+
+
+@pytest.mark.slow
+def test_small_op_storm_harness():
+    """The small_op_batching section's harness: one short subprocess
+    leg of ``--mode small_op_storm`` with the combiner on (real tcp
+    cluster via the local tracker) must produce the measurement line
+    with batches actually formed and the order-sensitive store check
+    passing.  Slow-marked like the dlrm harness: the plane's semantics
+    are covered by the fast loopback tests in tests/test_batching.py —
+    the ratio itself is the bench's job."""
+    from pslite_tpu.benchmark import _small_op_run
+
+    r = _small_op_run(1.0, batch=True)
+    assert r["ops"] > 0 and r["msgs_per_s"] > 0
+    assert r["ops_per_frame"] > 1.0  # multi-op frames really formed
+    assert r["store_exact"]
+    assert r["p99_ms"] >= r["p50_ms"] >= 0
+
+
+def test_bench_diff_gates_small_op_ratio(tmp_path):
+    """The small_op_batching guard: a collapsing msgs ratio (or a
+    ballooning low-load p50 ratio) fails the check; the section's
+    PS_BENCH_SKIP marker reads as absent, never a vanished metric."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    old = tmp_path / "BENCH_r07.json"
+    new = tmp_path / "BENCH_r08.json"
+    old.write_text(json.dumps(_bench_record()))
+    new.write_text(json.dumps(_bench_record(
+        small_op_batching_msgs_ratio=2.0,  # -52%: regression
+    )))
+    assert bench_diff.main([str(old), str(new)]) == 1
+    rec = _bench_record()
+    del rec["small_op_batching_msgs_ratio"]
+    rec["small_op_batching_skipped"] = "PS_BENCH_SKIP"
+    new.write_text(json.dumps(rec))
+    assert bench_diff.main([str(old), str(new)]) == 0
+
+
+def test_bench_diff_history(tmp_path):
+    """``bench_diff --history`` (ISSUE 10 satellite): the full
+    BENCH_r*.json trajectory renders one sparkline row per guarded
+    metric with min/max/last, flags a newest-record blind spot, and
+    shows per-round status so a blind stretch (the r04/r05 mode) is
+    visible at a glance."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    for rnd, ratio in ((1, 4.0), (2, 4.4), (3, 4.2)):
+        (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(
+            json.dumps(_bench_record(small_op_batching_msgs_ratio=ratio)))
+    lines = bench_diff.history(str(tmp_path))
+    text = "\n".join(lines)
+    assert "r01..r03" in text
+    row = next(l for l in lines
+               if l.strip().startswith("small_op_batching_msgs_ratio"))
+    assert "4" in row and "4.4" in row  # min/max/last columns
+    assert any(ch in row for ch in bench_diff._SPARK)
+    # A blind newest round: the metric row flags it, and the round
+    # status line shows zero guarded fields.
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"error": "tunnel down", "sections_done": []}))
+    text2 = "\n".join(bench_diff.history(str(tmp_path)))
+    assert "BLIND" in text2
+    # CLI flag: exits 0 and prints the table.
+    assert bench_diff.main(["--history", "--dir", str(tmp_path)]) == 0
 
 
 def test_bench_diff_guard(tmp_path):

@@ -24,9 +24,14 @@ from pslite_tpu.ops import codecs  # noqa: E402
 
 
 def _cluster_run(env_extra=None, codec="int8", pushes=3, seed=11,
-                 num_servers=2, val_len=4096, pulls=True):
+                 num_servers=2, val_len=4096, pulls=True,
+                 concurrent=False):
     """Deterministic compressed push/pull storm; returns (final pulled
-    vals, per-node van byte counters snapshot)."""
+    vals, per-node van byte counters snapshot).  ``concurrent=True``
+    issues every push before the first wait — the shape that engages
+    the small-op combiner (docs/batching.md) when PS_BATCH_BYTES is
+    set; per-destination frame order still equals issue order, so the
+    end state must stay bit-identical either way."""
     cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
                          env_extra=env_extra or {})
     cl.start()
@@ -46,11 +51,18 @@ def _cluster_run(env_extra=None, codec="int8", pushes=3, seed=11,
         ))
         rng = np.random.default_rng(seed)
         w.register_bucket(keys, codec=codec)
+        tss = []
         for _ in range(pushes):
             vals = rng.normal(size=len(keys) * val_len).astype(
                 np.float32
             )
-            w.wait(w.push(keys, vals))
+            ts = w.push(keys, vals)
+            if concurrent:
+                tss.append(ts)
+            else:
+                w.wait(ts)
+        for ts in tss:
+            w.wait(ts)
         out = np.zeros(len(keys) * val_len, np.float32)
         if pulls:
             w.wait(w.pull(keys, out, codec="raw"))
@@ -185,6 +197,32 @@ def test_matrix_bit_identical_end_state():
                 out, _ = _cluster_run(env_extra=env, codec="int8",
                                       pushes=2, val_len=2048)
                 results[(chunk, repl, nat)] = out
+    ref = results[("0", "1", "0")]
+    for key, out in results.items():
+        np.testing.assert_array_equal(ref, out, err_msg=str(key))
+
+
+def test_matrix_batching_replication_codec_bit_identical():
+    """Satellite (ISSUE 10): batching x replication x codec rows added
+    to the existing PS_CHUNK_BYTES x PS_KV_REPLICATION x PS_NATIVE
+    grid — CONCURRENTLY-issued compressed pushes end bit-identical
+    with the small-op combiner on vs off (docs/batching.md: encode-
+    once before the combiner + per-destination frame order == issue
+    order + per-sub-op replication forwards in op order)."""
+    results = {}
+    for batch in ("0", "65536"):
+        for repl in ("1", "2"):
+            for nat in ("0", "1"):
+                env = {
+                    "PS_BATCH_BYTES": batch,
+                    "PS_BATCH_NEGOTIATE": "0",
+                    "PS_KV_REPLICATION": repl,
+                    "PS_NATIVE": nat,
+                }
+                out, _ = _cluster_run(env_extra=env, codec="int8",
+                                      pushes=4, val_len=512,
+                                      concurrent=True)
+                results[(batch, repl, nat)] = out
     ref = results[("0", "1", "0")]
     for key, out in results.items():
         np.testing.assert_array_equal(ref, out, err_msg=str(key))

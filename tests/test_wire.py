@@ -101,6 +101,139 @@ def test_codec_extension_roundtrip():
     assert out2.codec == meta.codec and out2.chunk is None
 
 
+def test_batch_extension_roundtrip():
+    """EXT_BATCH (docs/batching.md): the per-op table (flags, ts, key,
+    val_len, option, stamp, nseg, per-op codec) round-trips, the
+    caller's ``meta.body`` is untouched by the piggybacked table, and
+    the extension composes with trace/qos/codec/chunk with EXT_CHUNK
+    still trailing."""
+    from pslite_tpu.message import BatchInfo, BatchOp, ChunkInfo, CodecInfo
+
+    meta = _sample_meta()
+    meta.control = Control()
+    meta.trace = 0x77
+    meta.tenant = 3
+    meta.stamp = 12
+    meta.batch = BatchInfo(ops=(
+        BatchOp(push=True, timestamp=5, key=100, val_len=4096, nseg=2),
+        BatchOp(pull=True, timestamp=6, key=200, val_len=64, nseg=3,
+                option=7, stamp=99,
+                codec=CodecInfo(codec=2, raw_len=0, block=128)),
+        BatchOp(push=True, pull=True, timestamp=7, key=300, val_len=8,
+                nseg=2),
+    ))
+    meta.chunk = ChunkInfo(xfer=5, index=0, total=2, offset=0,
+                           seg_lens=(16, 32), seg_types=(8, 10))
+    out = wire.unpack_meta(wire.pack_meta(meta))
+    assert out.batch == meta.batch
+    assert out.body == meta.body  # table stripped back out
+    assert out.chunk == meta.chunk and out.trace == meta.trace
+    assert out.tenant == 3 and out.stamp == 12
+    # Absent batch: no EXT_BATCH byte pattern obligations — just a
+    # clean roundtrip with batch None (the PS_BATCH_BYTES=0 parity leg).
+    meta.batch = None
+    out2 = wire.unpack_meta(wire.pack_meta(meta))
+    assert out2.batch is None and out2.body == meta.body
+
+
+def test_ext_registry_audit():
+    """Satellite (ISSUE 10): the wire-extension registry — every EXT_*
+    tag in wire.py is unique, and the canonical packing order holds at
+    every pack site with EXT_CHUNK STRICTLY LAST (the native splitter
+    patches the meta's trailing bytes as the chunk extension; until
+    now that contract was enforced only by comments)."""
+    import struct
+
+    from pslite_tpu.message import BatchInfo, BatchOp, ChunkInfo, CodecInfo
+
+    # 1. Tag uniqueness, by reflection over the module's EXT_* names.
+    tags = {name: getattr(wire, name) for name in dir(wire)
+            if name.startswith("EXT_")}
+    assert len(tags) >= 5  # trace, chunk, codec, qos, batch
+    assert len(set(tags.values())) == len(tags), (
+        f"duplicate EXT tag values: {tags}"
+    )
+
+    def ext_sequence(buf: bytes, meta: Meta) -> list:
+        """Walk the packed meta's extension tail; returns tag order."""
+        # Skip fixed + dtypes + body + nodes exactly like unpack_meta.
+        fields = wire._META_FIXED.unpack_from(buf, 0)
+        num_nodes, num_dtypes, body_len = fields[-3], fields[-2], fields[-1]
+        off = wire._META_FIXED.size + num_dtypes + body_len
+        view = memoryview(buf)
+        for _ in range(num_nodes):
+            _node, off = wire._unpack_node(view, off)
+        seq = []
+        while off + 2 <= len(buf):
+            tag, ln = struct.unpack_from("<BB", buf, off)
+            seq.append((tag, off, ln))
+            off += 2 + ln
+        assert off == len(buf), "extension walk did not land on the end"
+        return seq
+
+    # 2. Order at the PRIMARY pack site (wire.pack_meta) with EVERY
+    #    extension present at once.
+    meta = _sample_meta()
+    meta.control = Control()
+    meta.trace = 1
+    meta.tenant = 2
+    meta.stamp = 3
+    meta.batch = BatchInfo(ops=(
+        BatchOp(push=True, timestamp=1, key=1, val_len=4, nseg=2),
+        BatchOp(push=True, timestamp=2, key=2, val_len=4, nseg=2),
+    ))
+    meta.codec = CodecInfo(codec=1, raw_len=64, block=128)
+    meta.chunk = ChunkInfo(xfer=1, index=0, total=2, offset=0,
+                           seg_lens=(8, 16), seg_types=(8, 10))
+    buf = wire.pack_meta(meta)
+    seq = ext_sequence(buf, meta)
+    order = [t for t, _off, _ln in seq]
+    assert order == [wire.EXT_TRACE, wire.EXT_QOS, wire.EXT_BATCH,
+                     wire.EXT_CODEC, wire.EXT_CHUNK], order
+    # EXT_CHUNK strictly last: its payload is the buffer's tail.
+    tag, off, ln = seq[-1]
+    assert tag == wire.EXT_CHUNK and off + 2 + ln == len(buf)
+    assert ln == wire.chunk_ext_payload_size(2)
+    # ... and for every SUBSET of extensions that includes chunk.
+    for drop in ("trace", "tenant_stamp", "batch", "codec"):
+        m2 = wire.unpack_meta(buf)  # fresh fully-loaded meta
+        if drop == "trace":
+            m2.trace = 0
+        elif drop == "tenant_stamp":
+            m2.tenant = m2.stamp = 0
+        elif drop == "batch":
+            m2.batch = None
+        else:
+            m2.codec = None
+        b2 = wire.pack_meta(m2)
+        s2 = ext_sequence(b2, m2)
+        assert s2[-1][0] == wire.EXT_CHUNK, f"chunk not last without {drop}"
+        assert s2[-1][1] + 2 + s2[-1][2] == len(b2)
+
+    # 3. The SECONDARY pack sites build chunk metas through pack_meta
+    #    too — chunking.split_message and the native descriptor both
+    #    rely on the trailing-bytes contract; assert it on their actual
+    #    output.
+    import itertools
+
+    from pslite_tpu.sarray import SArray
+    from pslite_tpu.vans import chunking
+
+    msg = Message(meta=Meta(app_id=1, request=True, push=True, head=0))
+    msg.meta.trace = 9
+    msg.add_data(SArray(np.arange(64, dtype=np.uint64)))
+    msg.add_data(SArray(np.ones(4096, np.float32)))
+    chunks = chunking.split_message(msg, 1024, xfer_id=7)
+    assert chunks is not None
+    for c in chunks:
+        cb = wire.pack_meta(c.meta)
+        cs = ext_sequence(cb, c.meta)
+        assert cs[-1][0] == wire.EXT_CHUNK
+        assert cs[-1][1] + 2 + cs[-1][2] == len(cb)
+    nd = chunking.native_descriptor(msg, 1024, itertools.count(1))
+    assert nd.ext_off == len(nd.meta_buf) - wire.chunk_ext_payload_size(2)
+
+
 def test_frame_roundtrip():
     msg = Message(meta=Meta(app_id=3, timestamp=5, request=True, push=True))
     keys = np.array([1, 2, 3], dtype=np.uint64)

@@ -58,6 +58,11 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "multi_tenant_p99_ratio": "lower",
     "multi_tenant_dlrm_p50_ratio": "higher",
     "multi_tenant_hit_rate": "higher",
+    # small_op_batching (docs/batching.md) — the ops/s multiple of the
+    # aggregation plane, and the low-load latency it must not cost.
+    "small_op_batching_msgs_ratio": "higher",
+    "small_op_batching_batched_msgs_per_s": "higher",
+    "small_op_batching_low_load_p50_ratio": "lower",
     # elastic_scale (docs/elasticity.md) — the serving tail must stay
     # bounded through a live 2->4->2 migration window, and the scale
     # round trip itself must not regress.
@@ -78,7 +83,8 @@ TRANSPORT_METRICS: Dict[str, str] = {
 # metric regression) rather than failed.
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
-    "multi_tenant_", "elastic_", "kv_", "fault_recovery_", "van_",
+    "multi_tenant_", "small_op_batching_", "elastic_", "kv_",
+    "fault_recovery_", "van_",
 )
 
 
@@ -167,6 +173,105 @@ def compare(old: dict, new: dict,
     return lines, regressions
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(series: List[Optional[float]]) -> str:
+    """Unicode mini-chart of one metric's round-by-round values;
+    rounds where the metric was absent/skipped render as '·'."""
+    vals = [v for v in series if v is not None]
+    if not vals:
+        return "·" * len(series)
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in series:
+        if v is None:
+            out.append("·")
+        elif span <= 0:
+            out.append(_SPARK[3])
+        else:
+            out.append(_SPARK[min(7, int((v - lo) / span * 7.999))])
+    return "".join(out)
+
+
+def history(directory: str) -> List[str]:
+    """Render the FULL ``BENCH_r*.json`` trajectory of every guarded
+    transport metric as a min/max/last sparkline table — the
+    at-a-glance view that makes a blind stretch (the r04/r05 tunnel
+    outage produced two rounds of silently missing device numbers)
+    visible immediately instead of only when the newest two records
+    happen to straddle it."""
+    recs = sorted(
+        (p for p in glob.glob(os.path.join(directory, "BENCH_r*.json"))
+         if _round_of(p) >= 0),
+        key=_round_of,
+    )
+    if not recs:
+        return [f"bench_diff --history: no BENCH_r*.json in {directory}"]
+    rounds = [_round_of(p) for p in recs]
+    objs = []
+    for p in recs:
+        try:
+            rec = json.load(open(p))
+        except Exception:  # noqa: BLE001 - a corrupt record renders absent
+            rec = {}
+        # The driver wraps bench.py's emitted JSON under "parsed"
+        # (alongside the raw cmd/rc/tail provenance) — unwrap so the
+        # committed records render their metric fields.
+        if isinstance(rec.get("parsed"), dict) and not any(
+                k in rec for k in TRANSPORT_METRICS):
+            rec = rec["parsed"]
+        objs.append(rec)
+    lines = [
+        f"bench_diff history: rounds r{rounds[0]:02d}..r{rounds[-1]:02d} "
+        f"({len(recs)} records, {len(TRANSPORT_METRICS)} guarded metrics)",
+    ]
+    # Per-round status first: a blind round (error field, zero sections,
+    # or no transport fields at all) must be visible even when no
+    # guarded metric ever rendered a sparkline cell for it.
+    for rnd, rec in zip(rounds, objs):
+        sha = str(rec.get("git_sha", ""))[:9] or "-"
+        n_metrics = sum(1 for k in TRANSPORT_METRICS if k in rec)
+        done = rec.get("sections_done")
+        failed = rec.get("sections_failed")
+        status = []
+        if rec.get("error"):
+            status.append(f"ERROR: {str(rec['error'])[:60]}")
+        if done is not None:
+            status.append(f"{len(done)} sections done"
+                          + (f", {len(failed)} failed" if failed else ""))
+        if n_metrics == 0:
+            status.append("BLIND (no guarded transport fields)")
+        lines.append(f"  r{rnd:02d}  sha={sha:<9} "
+                     f"guarded={n_metrics:>2}  " + "; ".join(status))
+    lines.append("")
+    lines.append(
+        f"  {'metric':<44} {'trend':<{max(5, len(recs))}} "
+        f"{'min':>10} {'max':>10} {'last':>10}  dir"
+    )
+    for key in sorted(TRANSPORT_METRICS):
+        series: List[Optional[float]] = []
+        for rec in objs:
+            v = rec.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                v = None
+            series.append(None if v is None else float(v))
+        vals = [v for v in series if v is not None]
+        if not vals:
+            continue  # metric never emitted (older than its section)
+        spark = _sparkline(series)
+        blind = series[-1] is None
+        lines.append(
+            f"  {key:<44} {spark:<{max(5, len(recs))}} "
+            f"{min(vals):>10g} {max(vals):>10g} "
+            f"{(series[-1] if series[-1] is not None else float('nan')):>10g}"
+            f"  {TRANSPORT_METRICS[key]}"
+            + ("   << BLIND (absent in newest record)" if blind else "")
+        )
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -179,7 +284,14 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.25,
                     help="adverse fractional change that fails the "
                          "check (default 0.25)")
+    ap.add_argument("--history", action="store_true",
+                    help="render every BENCH_r*.json round per guarded "
+                         "metric (min/max/last sparkline table) instead "
+                         "of diffing the newest two")
     args = ap.parse_args(argv)
+    if args.history:
+        print("\n".join(history(args.dir)))
+        return 0
     if args.files:
         if len(args.files) != 2:
             ap.error("pass exactly two files (OLD NEW) or none")

@@ -82,13 +82,14 @@ def _apply_row(m: dict, uptime: float) -> tuple:
 def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     """Human-readable per-node table + per-role and per-tenant
     rollups (docs/qos.md)."""
-    # ``epoch`` (elastic membership) rides LAST: existing consumers
-    # parse earlier columns by index.
+    # ``epoch`` (elastic membership) and ``ops/F`` (small-op batching)
+    # ride LAST, in landing order: existing consumers parse earlier
+    # columns by index.
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
            f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7} "
-           f"{'epoch':>5}")
+           f"{'epoch':>5} {'ops/F':>6}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
     # Elastic membership (docs/elasticity.md): per-node routing epoch
@@ -130,11 +131,18 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         routing = s.get("routing") or {}
         epoch = (f"{routing['epoch']:>5}" if "epoch" in routing
                  else f"{'-':>5}")
+        # Small-op aggregation depth this node SENT at (docs/
+        # batching.md): sub-ops per multi-op frame.  "-" when the node
+        # never emitted an EXT_BATCH frame (combiner off, or nothing
+        # coalesced).
+        bframes = _c(m, "van.batched_frames")
+        bops = _c(m, "van.batch_ops")
+        opsf = (f"{bops / bframes:>6.1f}" if bframes > 0 else f"{'-':>6}")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch}"
+            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf}"
         )
         if routing:
             owned = routing.get("owned")
