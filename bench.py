@@ -654,6 +654,19 @@ def _transport_sections(quick: bool) -> list:
         so = small_op_bench(quick=quick)
         return {f"small_op_batching_{k}": v for k, v in so.items()}
 
+    def sec_serving_fanin():
+        # Serving fan-in (docs/batching.md): multi-get + server-side
+        # response aggregation — the DLRM Zipf fan-out storm (64
+        # single-row lookups/request, 2 tcp servers, hot cache COLD),
+        # aggregated (one EXT_BATCH frame per server each way) vs
+        # PS_BATCH_BYTES=0, interleaved rounds.  Acceptance: >= 3x
+        # requests/s, response frames/request ~= contacted servers,
+        # low-load single-pull p50 within 1.5x, bit-exact both legs.
+        from pslite_tpu.benchmark import serving_fanin_bench
+
+        sf = serving_fanin_bench(quick=quick)
+        return {f"serving_fanin_{k}": v for k, v in sf.items()}
+
     def sec_elastic_scale():
         # Elastic membership (docs/elasticity.md): scale 2 -> 4 -> 2
         # servers mid push-storm with no global restart — stores
@@ -723,6 +736,7 @@ def _transport_sections(quick: bool) -> list:
         ("quantized_push", sec_quantized_push),
         ("multi_tenant", sec_multi_tenant),
         ("small_op_batching", sec_small_op_batching),
+        ("serving_fanin", sec_serving_fanin),
         ("elastic_scale", sec_elastic_scale),
         ("kv_telemetry", sec_kv_telemetry),
         ("fault_recovery", sec_fault_recovery),

@@ -29,7 +29,7 @@ import numpy as np
 
 MODES = ("push_then_pull", "push_pull", "push_only", "pull_only",
          "chunk_hol", "lane_goodput", "quantized_push", "multi_tenant",
-         "dlrm_serve", "small_op_storm")
+         "dlrm_serve", "small_op_storm", "serving_fanin")
 
 
 def _recv_buffer_mode() -> bool:
@@ -435,6 +435,116 @@ def run_small_op_storm(worker, args) -> None:
           f"store_exact={exact}", flush=True)
 
 
+def run_serving_fanin(worker, args) -> None:
+    """``--mode serving_fanin`` (docs/batching.md): the DLRM serving
+    FAN-OUT regime — each request is ``PS_SF_FANOUT`` independent
+    single-row embedding lookups (Zipf rows, table SPREAD across every
+    server), issued via ``KVWorker.multi_get`` with the hot-key cache
+    COLD.  The two bench legs run this identical mode with
+    ``PS_BATCH_BYTES=262144`` vs ``0``: aggregated, a request costs
+    ~one EXT_BATCH frame per contacted server each way; unaggregated
+    it costs one frame per LOOKUP each way.  Requests/s is the
+    headline; frames/request (from the van's recv counter) proves the
+    ~1-RTT fan-in; every 32nd request is verified bit-exact; a LOW-
+    LOAD sequential single-pull loop guards the unbatched-latency
+    contract."""
+    from .models.dlrm import (DLRMConfig, embedding_row,
+                              push_embedding_table, serve_fanout_storm,
+                              spread_row_keys)
+
+    secs = float(os.environ.get("PS_SF_SECONDS", "3"))
+    fanout = int(os.environ.get("PS_SF_FANOUT", "64"))
+    cfg = DLRMConfig(
+        num_rows=int(os.environ.get("PS_SF_ROWS", "2048")),
+        emb_dim=int(os.environ.get("PS_SF_DIM", "16")),
+    )
+    depth = int(os.environ.get("PS_SF_DEPTH", "8"))
+    servers = worker.po.num_servers
+    push_embedding_table(worker, cfg, spread=True)
+    # Warm the path (connections, capability probes, frame pools).
+    serve_fanout_storm(worker, cfg, 16, fanout=fanout, seed=1)
+    van_recv = worker.po.metrics.counter("van.recv_messages")
+    recv0 = van_recv.value
+    # Depth-bounded request pipeline (a serving worker handles DEPTH
+    # concurrent requests, like small_op_storm's op pipeline): each
+    # outstanding request owns its row set and destination buffers;
+    # the oldest is waited (and every 32nd verified bit-exact against
+    # embedding_row) before its slot recycles.
+    from collections import deque
+
+    from .models.dlrm import serving_keys
+
+    row_keys = spread_row_keys(cfg)
+    outs_pool = [
+        [np.zeros(cfg.emb_dim, np.float32) for _ in range(fanout)]
+        for _ in range(depth)
+    ]
+    # Bounded row pool, reused modulo: sized well past one request's
+    # correlation horizon but independent of how many requests the
+    # window issues (an eager per-request pool both ballooned memory
+    # at large fan-outs and crashed on exhaustion).
+    pool_reqs = 4096
+    all_rows = serving_keys(cfg, pool_reqs * fanout, seed=7)
+    lats = []
+    pending: deque = deque()
+    free = list(range(depth))
+    n_req = 0
+
+    def _retire(check: bool) -> None:
+        t_iss, handle, rows, slot = pending.popleft()
+        handle.wait()
+        lats.append(time.perf_counter() - t_iss)
+        if check:
+            outs = outs_pool[slot]
+            for j, r in enumerate(rows):
+                if not np.array_equal(outs[j],
+                                      embedding_row(cfg, int(r))):
+                    raise RuntimeError(
+                        f"fan-out pull of row {r} returned wrong values"
+                    )
+        free.append(slot)
+
+    t0 = time.perf_counter()
+    t_end = t0 + secs
+    while time.perf_counter() < t_end:
+        base = (n_req % pool_reqs) * fanout
+        rows = all_rows[base:base + fanout]
+        slot = free.pop()
+        key_lists = [row_keys[int(r):int(r) + 1] for r in rows]
+        t1 = time.perf_counter()
+        handle = worker.multi_get(key_lists, outs=outs_pool[slot])
+        pending.append((t1, handle, rows, slot))
+        n_req += 1
+        if len(pending) >= depth:
+            _retire(check=n_req % 32 == 0)
+    while pending:
+        _retire(check=False)
+    wall = time.perf_counter() - t0
+    frames_per_req = (van_recv.value - recv0) / max(n_req, 1)
+    p50, p99 = _pctl_ms(lats)
+    # Low-load single-pull guard: sequential pull+wait of Zipf rows —
+    # a lone op must dispatch at the next combiner pickup with no
+    # timer latency (the PS_BATCH_WINDOW_US=0 contract).
+    row_keys = spread_row_keys(cfg)
+    out = np.zeros(cfg.emb_dim, np.float32)
+    low = []
+    t_end = time.perf_counter() + min(1.0, secs / 2)
+    row = 0
+    while time.perf_counter() < t_end:
+        row = (row + 17) % cfg.num_rows
+        t1 = time.perf_counter()
+        worker.wait(worker.pull(row_keys[row:row + 1], out))
+        low.append(time.perf_counter() - t1)
+    low_p50, _ = _pctl_ms(low)
+    exact = bool(np.array_equal(out, embedding_row(cfg, row)))
+    print(f"SERVING_FANIN reqs={n_req} secs={wall:.3f} "
+          f"reqs_per_s={n_req / max(wall, 1e-9):.1f} "
+          f"fanout={fanout} servers={servers} "
+          f"p50_ms={p50:.3f} p99_ms={p99:.3f} "
+          f"frames_per_req={frames_per_req:.2f} "
+          f"low_p50_ms={low_p50:.4f} store_exact={exact}", flush=True)
+
+
 def run_worker(args) -> None:
     from . import postoffice
     from .kv.kv_app import KVWorker
@@ -459,6 +569,9 @@ def run_worker(args) -> None:
         return
     if args.mode == "small_op_storm":
         run_small_op_storm(worker, args)
+        return
+    if args.mode == "serving_fanin":
+        run_serving_fanin(worker, args)
         return
     ranges = po.get_server_key_ranges()
     keys_per_server = args.num_keys
@@ -1652,6 +1765,114 @@ def small_op_bench(quick: bool = True) -> dict:
     }
 
 
+def _serving_fanin_run(secs: float, batch: bool,
+                       servers: int = 2) -> dict:
+    """One leg of the serving_fanin bench: a REAL 1w+Ns tcp cluster
+    (one process per node) running ``--mode serving_fanin``.  The
+    aggregated leg runs the op combiner + response combiner tuned for
+    the 64-lookup fan-out; the baseline leg is ``PS_BATCH_BYTES=0`` —
+    one frame per lookup each way, the pre-fan-in build."""
+    import re
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "pslite_tpu.tracker.local",
+        "-n", "1", "-s", str(servers), "--van", "tcp", "--",
+        sys.executable, "-m", "pslite_tpu.benchmark",
+        "--mode", "serving_fanin", "--repeat", "1",
+    ]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PS_SF_SECONDS=str(secs),
+        PS_HOT_CACHE="0",  # the acceptance runs the cache COLD
+    )
+    if batch:
+        env.update(PS_BATCH_BYTES=str(256 << 10))
+    else:
+        env["PS_BATCH_BYTES"] = "0"
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       env=env)
+    m = re.search(
+        r"SERVING_FANIN reqs=(\d+) secs=([0-9.]+) "
+        r"reqs_per_s=([0-9.]+) fanout=(\d+) servers=(\d+) "
+        r"p50_ms=([0-9.]+) p99_ms=([0-9.]+) "
+        r"frames_per_req=([0-9.]+) low_p50_ms=([0-9.]+) "
+        r"store_exact=(True|False)", r.stdout)
+    if m is None:
+        raise RuntimeError(
+            f"serving_fanin leg produced no result (rc={r.returncode}): "
+            f"{r.stdout[-600:]}\n{r.stderr[-600:]}"
+        )
+    return {
+        "reqs": int(m.group(1)),
+        "reqs_per_s": float(m.group(3)),
+        "fanout": int(m.group(4)),
+        "servers": int(m.group(5)),
+        "p50_ms": float(m.group(6)),
+        "p99_ms": float(m.group(7)),
+        "frames_per_req": float(m.group(8)),
+        "low_p50_ms": float(m.group(9)),
+        "store_exact": m.group(10) == "True",
+    }
+
+
+def serving_fanin_bench(quick: bool = True) -> dict:
+    """Serving fan-in (docs/batching.md, ISSUE 11) over real tcp
+    processes — multi-get + server-side response aggregation.
+
+    Headline: the DLRM Zipf fan-out storm (64 single-row lookups per
+    request, table spread across 2 servers, hot-key cache COLD) moves
+    >= 3x more requests/s with the aggregation planes on
+    (``PS_BATCH_BYTES=262144`` -> one EXT_BATCH frame per server each
+    way via ``multi_get`` + the batched group response) than with
+    ``PS_BATCH_BYTES=0``, while response frames per request land near
+    the contacted-server count (~1 RTT fan-in, vs ~fanout frames
+    unaggregated), the LOW-LOAD sequential single-pull p50 stays
+    within 1.5x of unaggregated, and every spot-checked request is
+    bit-exact on both legs.  Legs run in INTERLEAVED rounds, medians
+    reported (host drift lands symmetrically)."""
+    secs = 3.0 if quick else 6.0
+    rounds = 2 if quick else 3
+    legs = {"agg": [], "plain": []}
+    for _ in range(rounds):
+        legs["agg"].append(_serving_fanin_run(secs, batch=True))
+        legs["plain"].append(_serving_fanin_run(secs, batch=False))
+    med = statistics.median
+    a_rate = med(r["reqs_per_s"] for r in legs["agg"])
+    p_rate = med(r["reqs_per_s"] for r in legs["plain"])
+    a_low = med(r["low_p50_ms"] for r in legs["agg"])
+    p_low = med(r["low_p50_ms"] for r in legs["plain"])
+    return {
+        "seconds": secs,
+        "rounds": rounds,
+        "fanout": legs["agg"][0]["fanout"],
+        "servers": legs["agg"][0]["servers"],
+        "agg_reqs_per_s": round(a_rate, 1),
+        "plain_reqs_per_s": round(p_rate, 1),
+        # Headline: the requests/s multiple (acceptance: >= 3.0).
+        "req_ratio": (round(a_rate / p_rate, 2) if p_rate > 0 else None),
+        "req_p50_agg_ms": round(
+            med(r["p50_ms"] for r in legs["agg"]), 3),
+        "req_p50_plain_ms": round(
+            med(r["p50_ms"] for r in legs["plain"]), 3),
+        # ~1 RTT fan-in: response frames/request near the contacted-
+        # server count (acceptance: lower is better; the plain leg
+        # sits near the fan-out).
+        "frames_per_req": round(
+            med(r["frames_per_req"] for r in legs["agg"]), 2),
+        "plain_frames_per_req": round(
+            med(r["frames_per_req"] for r in legs["plain"]), 2),
+        # Low-load single-pull latency guard (acceptance: <= 1.5).
+        "low_load_p50_ratio": (round(a_low / p_low, 2)
+                               if p_low > 0 else None),
+        "store_exact": all(r["store_exact"]
+                           for leg in legs.values() for r in leg),
+    }
+
+
 def register_push_buffers(server, args) -> None:
     """ENABLE_RECV_BUFFER server side (test_benchmark.cc:268-320):
     pre-pin the receive buffer each worker's push slice lands in.  A
@@ -1739,7 +1960,7 @@ def main(argv=None) -> int:
     if role in ("server", "joint"):
         server = KVServer(0)
         if args.mode in ("chunk_hol", "lane_goodput", "quantized_push",
-                         "multi_tenant", "dlrm_serve"):
+                         "multi_tenant", "dlrm_serve", "serving_fanin"):
             # Shard-capable handle: the apply pool (and the streaming
             # apply of chunked pushes) is part of what these modes price.
             from .kv.kv_app import KVServerDefaultHandle

@@ -194,7 +194,13 @@ class ApplyShardPool:
         # (KVServer._encode_response), and doing that under _order_mu
         # would block every shard thread's completion behind one bulk
         # encode.  The deque + single drainer keep the send order
-        # exactly the selection order.
+        # exactly the selection order.  Downstream of _emit, the
+        # server's _send_response may hold a finished small pull
+        # result briefly on its (sender, tenant, priority) response-
+        # combiner lane (docs/batching.md, serving fan-in): separate-
+        # frame pulls that completed back-to-back past this gate then
+        # leave as ONE EXT_BATCH response frame, still in selection
+        # order within the lane.
         self._emit_mu = threading.Lock()
         self._emit_q: Deque[_Pending] = collections.deque()
         # Observability (docs/observability.md): registry-backed

@@ -40,6 +40,19 @@ Capability: EXT_BATCH frames are only sent to peers that answered the
 ``BATCH_PROBE_CMD`` capability probe (``PS_BATCH_NEGOTIATE=0`` skips
 the probe and asserts a homogeneous cluster), so decoders that predate
 the extension never see a frame they cannot parse.
+
+Response direction (docs/batching.md, "Response aggregation"): the
+same :class:`OpCombiner` runs on the SERVER with ``response=True``
+(``PS_RESP_BATCH_BYTES``), coalescing independent small pull results
+and push acks headed back to one ``(sender, tenant, priority)`` lane —
+whether the requests arrived batched or as separate frames within an
+aggregation window — into one ``response_batch``-shaped EXT_BATCH
+frame.  Per-op result codes and hot-cache stamps ride the per-op
+table (:func:`build_batch_message` carries ``option``/``stamp``
+through), and the server only ever aggregates toward senders that
+proved themselves batch-aware (a capability probe or an EXT_BATCH
+request received from them), so un-upgraded workers never see an
+aggregated response.
 """
 
 from __future__ import annotations
@@ -73,34 +86,44 @@ BATCH_WIRE_VERSION = 1
 MAX_OPS_PER_FRAME = min(256, BATCH_MAX_OPS)
 
 
-def batchable(msg: Message) -> bool:
+def batchable(msg: Message, response: bool = False) -> bool:
     """Structural MERGE eligibility of one already-sliced op message
     (the caller checks capability/config separately): a plain request
     with a default head, no zero-copy placement, no trace id, and a
     fixed-k segment layout — ``keys+vals`` raw (2 segments) or
     ``keys+codes+scales`` codec (3 segments).  Ragged ``lens``
     payloads carry an extra segment either way and are declined: the
-    batched server intake and response tables are fixed-k contracts."""
+    batched server intake and response tables are fixed-k contracts.
+
+    ``response=True`` evaluates the RESPONSE-direction twin (the
+    server's response combiner, docs/batching.md): same shape rules,
+    but the message must be a response, and empty-data frames (push
+    acks, empty pull results — the unbatched ``response()`` sends no
+    segments for those either) are mergeable with ``nseg=0``."""
     m = msg.meta
     return (
         m.control.empty()
-        and m.request
+        and m.request != response
         and m.head == 0
         and m.option == 0
         and m.trace == 0
         and not m.shm_data
         and m.chunk is None
         and m.batch is None
-        and 1 <= len(msg.data) <= (2 if m.codec is None else 3)
+        and (0 if response else 1)
+        <= len(msg.data) <= (2 if m.codec is None else 3)
     )
 
 
-def op_wire_cost(msg: Message) -> int:
+def op_wire_cost(msg: Message, response: bool = False) -> int:
     """Bytes one op contributes to a batch frame plus the response
-    bytes it will pull back — the quantity ``PS_BATCH_BYTES`` caps."""
+    bytes it will pull back — the quantity ``PS_BATCH_BYTES`` caps.
+    Response-direction frames carry the result bytes themselves, so
+    only the actual segment bytes count (``val_len`` echoes the
+    request's byte budget and would double-charge)."""
     sent = sum(d.nbytes for d in msg.data)
     m = msg.meta
-    if m.pull and not m.push:
+    if not response and m.pull and not m.push:
         return sent + max(0, m.val_len)  # val_len = response nbytes
     return sent
 
@@ -116,7 +139,7 @@ def build_batch_message(msgs: List[Message]) -> Message:
     m = env.meta
     m.app_id = head.app_id
     m.customer_id = head.customer_id
-    m.request = True
+    m.request = head.request  # False on the response-direction twin
     m.head = 0  # only plain-cmd ops are batchable
     m.recver = head.recver
     m.priority = head.priority
@@ -138,10 +161,13 @@ def build_batch_message(msgs: List[Message]) -> Message:
         data.extend(sub.data)
         dtypes.extend(sm.data_type)
         size += sm.data_size
+        # option/stamp carry through: always 0 on the request
+        # direction (batchable() filters), per-op result codes and
+        # hot-cache stamps on the response direction.
         ops.append(BatchOp(
             push=sm.push, pull=sm.pull, timestamp=sm.timestamp,
-            key=sm.key, val_len=sm.val_len, option=0, stamp=0,
-            nseg=len(sub.data), codec=sm.codec,
+            key=sm.key, val_len=sm.val_len, option=sm.option,
+            stamp=sm.stamp, nseg=len(sub.data), codec=sm.codec,
         ))
     m.data_size = size
     m.batch = BatchInfo(ops=tuple(ops))
@@ -192,9 +218,15 @@ class OpCombiner:
                  max_ops: int = MAX_OPS_PER_FRAME,
                  min_ops: int = 32, hold_max_us: float = 2000.0,
                  on_sent: Optional[Callable[[List[Message], Message],
-                                            None]] = None):
+                                            None]] = None,
+                 response: bool = False):
         self._send = send
         self._on_error = on_error
+        # Response-direction mode (the server's response combiner,
+        # docs/batching.md): eligibility and cost use the response
+        # rules; everything else — lanes, order, adaptive hold — is
+        # direction-agnostic.
+        self._response = bool(response)
         # on_sent(members, wire_msg): the frame that actually left —
         # the worker records it per member slice so failover can
         # resender.forget() the right (possibly merged) message.
@@ -211,6 +243,10 @@ class OpCombiner:
         self._groups: Dict[Tuple, List[Tuple[Message, int]]] = {}
         self._bytes: Dict[Tuple, int] = {}
         self._first_enq: Dict[Tuple, float] = {}
+        # Groups a submit_many() marked flush-ready: a whole fan-out
+        # was queued atomically, so the dispatcher emits it NOW as one
+        # run (one frame per lane up to the caps) — no adaptive hold.
+        self._ready: set = set()
         # Adaptive hold (window 0 mode): a group that flushed within
         # _HOT_S is mid-storm — hold its next frame open _HOLD_S so the
         # producer's back-to-back ops coalesce.  A group idle longer
@@ -241,9 +277,14 @@ class OpCombiner:
     def _merge_sig(msg: Message):
         """Frame-compatibility signature: codec-mismatched sub-ops
         never merge (docs/batching.md) — but they DO share the group's
-        FIFO, emitting as separate consecutive frames."""
-        ci = msg.meta.codec
-        return None if ci is None else (ci.codec, ci.raw_len == 0)
+        FIFO, emitting as separate consecutive frames.  app/customer
+        ride the ENVELOPE (not the per-op table), so two customers'
+        ops — possible on the response direction, where one server
+        answers every app on a node — must never share a frame."""
+        m = msg.meta
+        ci = m.codec
+        return (m.app_id, m.customer_id,
+                None if ci is None else (ci.codec, ci.raw_len == 0))
 
     def submit(self, msg: Message) -> None:
         """Queue one sliced op for the dispatcher (the SINGLE flusher —
@@ -252,23 +293,15 @@ class OpCombiner:
         byte/op cap dispatches at the very next pickup; a producer that
         outruns the dispatcher far past the cap blocks briefly
         (bounded memory, natural backpressure)."""
-        key = self.group_key(msg)
-        cost = op_wire_cost(msg)
-        mergeable = batchable(msg) and cost <= self.max_bytes
         flush_now = None
         with self._cv:
             if self._stop:
-                flush_now = [(msg, cost, mergeable)]  # late: send inline
+                flush_now = [(msg, 0, False)]  # late: send inline
             else:
-                grp = self._groups.setdefault(key, [])
-                if not grp:
-                    import time as _time
+                import time as _time
 
-                    self._first_enq[key] = _time.monotonic()
-                grp.append((msg, cost, mergeable))
-                self.submitted_ops += 1
-                nbytes = self._bytes.get(key, 0) + cost
-                self._bytes[key] = nbytes
+                key, grp, nbytes = self._enqueue_locked(
+                    msg, _time.monotonic())
                 self._ensure_thread_locked()
                 # Wake the dispatcher only when it matters — first op
                 # of the group (it may be idle-waiting) or cap reached
@@ -284,6 +317,72 @@ class OpCombiner:
                     self._cv.wait(0.05)
         if flush_now is not None:
             self._flush(flush_now)
+
+    def submit_many(self, msgs: List[Message]) -> None:
+        """Queue a whole fan-out ATOMICALLY (``KVWorker.multi_get``):
+        every op lands in its lane's group under one lock acquisition,
+        and each touched group is marked flush-READY — the dispatcher
+        emits it at the very next pickup as one contiguous run (one
+        EXT_BATCH frame per lane up to the byte/op caps), skipping the
+        adaptive hold.  A serving fan-out thus costs ~one frame per
+        contacted destination with no timer latency, instead of
+        trickling out while the hold waits for depth."""
+        if not msgs:
+            return
+        late: List[Message] = []
+        with self._cv:
+            if self._stop:
+                late = list(msgs)
+            else:
+                import time as _time
+
+                now = _time.monotonic()
+                touched = set()
+                for msg in msgs:
+                    key, _grp, _nbytes = self._enqueue_locked(msg, now)
+                    self._ready.add(key)
+                    touched.add(key)
+                self._ensure_thread_locked()
+                self._cv.notify_all()
+                # Same bounded-memory backpressure as submit(): a
+                # producer outrunning the dispatcher blocks until its
+                # touched lanes drain rather than balloon the queue.
+                while (not self._stop
+                       and any(self._bytes.get(k, 0) >= 4 * self.max_bytes
+                               for k in touched)):
+                    self._cv.wait(0.05)
+        for msg in late:
+            self._flush([(msg, 0, False)])
+
+    def _enqueue_locked(self, msg: Message, now: float):
+        """One op's enqueue bookkeeping (``_cv`` held) — the SINGLE
+        implementation behind ``submit`` and ``submit_many``, so the
+        two entry points cannot drift.  Returns ``(key, group,
+        group_bytes)``."""
+        key = self.group_key(msg)
+        cost = op_wire_cost(msg, response=self._response)
+        mergeable = (batchable(msg, response=self._response)
+                     and cost <= self.max_bytes)
+        grp = self._groups.setdefault(key, [])
+        if not grp:
+            self._first_enq[key] = now
+        grp.append((msg, cost, mergeable))
+        self.submitted_ops += 1
+        nbytes = self._bytes.get(key, 0) + cost
+        self._bytes[key] = nbytes
+        if not mergeable and self._response:
+            # Response lanes: an unmergeable frame (above all a
+            # response_batch envelope — the serving fan-in's dominant
+            # return traffic) can never profit from the adaptive hold;
+            # holding it would add up to hold_max_us of pure latency
+            # per serving request.  Mark the lane flush-ready: the
+            # dispatcher emits the whole group (in position, earlier
+            # mergeable runs still merge) at the next pickup.  Request
+            # lanes keep the hold — there, flushing early would cut
+            # the accumulation window of mergeable siblings queued
+            # behind sparse unmergeables (traced/oversized ops).
+            self._ready.add(key)
+        return key, grp, nbytes
 
     def flush_all(self) -> None:
         """Synchronously drain every queued group (stop path)."""
@@ -318,6 +417,7 @@ class OpCombiner:
         grp = self._groups.pop(key, None)
         self._bytes.pop(key, None)
         self._first_enq.pop(key, None)
+        self._ready.discard(key)
         return grp
 
     # Adaptive-hold parameters (window 0 mode — "close at next
@@ -340,7 +440,8 @@ class OpCombiner:
         Returns ``(key, None)`` or ``(None, nap_s)`` with the shortest
         sleep until some group becomes due."""
         for key, grp in self._groups.items():
-            if (self._bytes.get(key, 0) >= self.max_bytes
+            if (key in self._ready
+                    or self._bytes.get(key, 0) >= self.max_bytes
                     or len(grp) >= self._max_ops):
                 return key, None
         nap = None

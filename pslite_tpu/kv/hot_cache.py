@@ -198,6 +198,56 @@ class HotKeyCache:
             self._c_hits.inc()
             return True
 
+    def serve_mask(self, keys: np.ndarray,
+                   out: np.ndarray) -> Optional[np.ndarray]:
+        """Partial serve (``KVWorker.multi_get`` fast path): copy every
+        LIVE (stamp-fresh, TTL-fresh) entry's values into its key's row
+        of ``out`` and return the boolean hit mask — the caller fetches
+        only the misses.  Returns ``None`` (nothing touched) when the
+        buffer shape cannot be row-partitioned (``out.size`` not
+        divisible by ``len(keys)``); a live entry whose size disagrees
+        with the row size counts a miss.  Validity rules are exactly
+        :meth:`serve`'s
+        — a superseded or aged entry counts a miss and is dropped — so
+        read-your-writes semantics are identical whether a key is
+        served through the all-or-nothing or the partial path.  Hits
+        and misses are counted PER KEY (``serve`` counts per call)."""
+        n = len(keys)
+        if n == 0:
+            return None
+        flat = out.reshape(-1)
+        if flat.size % n:
+            return None
+        k = flat.size // n
+        mask = np.zeros(n, dtype=bool)
+        now = time.monotonic()
+        with self._mu:
+            for i, key in enumerate(keys):
+                key = int(key)
+                e = self._entries.get(key)
+                if e is None:
+                    self._c_misses.inc()
+                    continue
+                seg, server, stamp, t_fill = e
+                if (stamp < self._latest.get(server, 0)
+                        or (self.ttl_s > 0
+                            and now - t_fill > self.ttl_s)):
+                    self._entries.pop(key, None)
+                    self._bytes -= seg.nbytes
+                    self._c_misses.inc()
+                    continue
+                if seg.size != k:
+                    # Cached under a different per-key length (another
+                    # pull shape): not servable into this row — a miss,
+                    # but still a valid entry for its own shape.
+                    self._c_misses.inc()
+                    continue
+                flat[i * k:(i + 1) * k] = seg
+                self._entries.move_to_end(key)  # LRU touch
+                mask[i] = True
+                self._c_hits.inc()
+        return mask
+
     # -- introspection --------------------------------------------------------
 
     def __len__(self) -> int:

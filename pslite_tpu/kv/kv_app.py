@@ -296,6 +296,50 @@ class _PendingReq:
     tenant: int = 0
 
 
+class MultiGetHandle:
+    """Completion handle of one :meth:`KVWorker.multi_get` fan-out.
+
+    One handle covers the whole serving request: ``wait()`` joins every
+    sub-get (cache-served ones are already complete), collects per-sub
+    failures into ``errors`` (index -> exception), and re-raises the
+    FIRST failure only after every sibling finished — a shed or
+    timed-out sub-get never strands or aborts the others (the per-sub
+    fail-only-the-affected-keys contract, docs/batching.md)."""
+
+    __slots__ = ("_worker", "timestamps", "outs", "errors", "cached")
+
+    def __init__(self, worker: "KVWorker", n: int):
+        self._worker = worker
+        # Per-sub-get request timestamp; None = answered entirely from
+        # the hot-key cache (no message left the worker).
+        self.timestamps: List[Optional[int]] = [None] * n
+        self.outs: List[Optional[np.ndarray]] = [None] * n
+        self.errors: Dict[int, Exception] = {}
+        self.cached = 0  # sub-gets served fully from the hot cache
+
+    def __len__(self) -> int:
+        return len(self.timestamps)
+
+    def wait(self) -> List[Optional[np.ndarray]]:
+        """Join every in-flight sub-get; returns the destination
+        buffers.  Raises the first recorded per-sub error (Overload /
+        Timeout / server-side apply error) AFTER all siblings
+        completed; ``errors`` holds every failure by sub-get index."""
+        first: Optional[Exception] = None
+        for i, ts in enumerate(self.timestamps):
+            if ts is None:
+                continue
+            try:
+                self._worker.wait(ts)
+            except Exception as exc:  # noqa: BLE001 - re-raised below
+                self.errors[i] = exc
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first
+        return self.outs
+
+
 class KVWorker:
     """Client of the KV store (kv_app.h:134-300)."""
 
@@ -1094,6 +1138,7 @@ class KVWorker:
         compress: Optional[str] = None,
         codec: Optional[str] = None,
         tenant=None,
+        _batch_sink: Optional[List[Message]] = None,
     ) -> int:
         """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792).
 
@@ -1172,7 +1217,8 @@ class KVWorker:
         self._send(ts, push=False, pull=True, cmd=cmd, kvs=kvs,
                    val_dtype=vals.dtype, val_nbytes=vals.nbytes,
                    zpull=zpull, codec=codec, trace=trace,
-                   tenant=self._resolve_tenant(tenant))
+                   tenant=self._resolve_tenant(tenant),
+                   batch_sink=_batch_sink)
         return ts
 
     def push_pull(
@@ -1227,6 +1273,162 @@ class KVWorker:
                    codec=codec, trace=trace,
                    tenant=self._resolve_tenant(tenant))
         return ts
+
+    def multi_get(
+        self,
+        key_lists,
+        outs: Optional[List[np.ndarray]] = None,
+        val_len: Optional[int] = None,
+        dtype=np.float32,
+        cmd: int = 0,
+        priority: int = 0,
+        compress: Optional[str] = None,
+        codec: Optional[str] = None,
+        tenant=None,
+        callbacks: Optional[List[Callable[[], None]]] = None,
+        callback: Optional[Callable[[], None]] = None,
+    ) -> MultiGetHandle:
+        """Serving fan-in (docs/batching.md): pull N independent key
+        sets — a DLRM-style request's whole embedding fan-out — as ONE
+        logical operation that completes in ~1 round trip per
+        contacted server.
+
+        Each ``key_lists[i]`` is a sorted unique key array (typically
+        a single embedding row); its values land in ``outs[i]`` (or a
+        freshly allocated ``len(keys) * val_len`` array of ``dtype``).
+        Every sub-get is sliced across servers like :meth:`pull`, and
+        with the op combiner on (``PS_BATCH_BYTES``) the WHOLE
+        fan-out's per-server slices are handed to the combiner
+        atomically (``submit_many``), so each contacted server
+        receives ONE ``EXT_BATCH`` frame and — through the server's
+        batched group apply — answers with ONE ``response_batch``
+        frame: N lookups cost one frame build, one lane handoff, and
+        one syscall each way instead of N.
+
+        Hot-key cache (``PS_HOT_CACHE=1``): sub-gets whose every key
+        is live-cached are answered locally (no message at all);
+        PARTIAL hits serve the cached rows in place and fetch only the
+        misses, with the same stamp/TTL validity as :meth:`pull` —
+        read-your-writes survives, and fill-race fills born invalid
+        are still skipped (kv/hot_cache.py).
+
+        Completion: returns ONE :class:`MultiGetHandle`; per-sub-get
+        ``callbacks[i]`` fire as each sub-get completes (suppressed on
+        that sub-get's failure, like :meth:`pull`'s), and the
+        aggregate ``callback`` fires once after the LAST sub-get
+        completed successfully.  A per-sub failure (``OPT_OVERLOAD``
+        shed, timeout, apply error) fails only that sub-get:
+        ``handle.wait()`` finishes the siblings first, then re-raises.
+
+        ``codec=`` applies to every list; ``codec=None`` resolves each
+        list's own registered bucket codec (:meth:`register_bucket`).
+        """
+        n = len(key_lists)
+        log.check(outs is not None or val_len is not None,
+                  "multi_get needs outs= or val_len=")
+        if outs is not None:
+            log.check(len(outs) == n, "multi_get: len(outs) != len(key_lists)")
+        if callbacks is not None:
+            log.check(len(callbacks) == n,
+                      "multi_get: len(callbacks) != len(key_lists)")
+        handle = MultiGetHandle(self, n)
+        sink: Optional[List[Message]] = (
+            [] if self._combiner is not None else None
+        )
+        agg_mu = threading.Lock()
+        agg_left = [n]
+
+        def _complete(i: int) -> None:
+            if callbacks is not None and callbacks[i] is not None:
+                callbacks[i]()
+            if callback is not None:
+                with agg_mu:
+                    agg_left[0] -= 1
+                    fire = agg_left[0] == 0
+                if fire:
+                    callback()
+
+        # Skip per-sub completion closures entirely when the caller
+        # registered none — the storm path then pays no callback-dict
+        # traffic per sub-op.
+        want_cb = callbacks is not None or callback is not None
+        hc = self._hot_cache
+        try:
+            self._multi_get_issue(key_lists, outs, val_len, dtype, cmd,
+                                  priority, compress, codec, tenant,
+                                  handle, sink, want_cb, hc, _complete)
+        finally:
+            if sink:
+                # The whole fan-out enters the combiner in one atomic
+                # batch: one EXT_BATCH frame per contacted destination
+                # at the very next dispatcher pickup — no adaptive-
+                # hold latency, no partial frames.  In a finally so an
+                # exception partway through the issue loop can never
+                # strand already-queued sub-gets' slices locally
+                # (their waits would hang with deadlines off).
+                self._combiner.submit_many(sink)
+        return handle
+
+    def _multi_get_issue(self, key_lists, outs, val_len, dtype, cmd,
+                         priority, compress, codec, tenant, handle,
+                         sink, want_cb, hc, _complete) -> None:
+        for i, keys in enumerate(key_lists):
+            keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+            out = (outs[i] if outs is not None
+                   else np.empty(len(keys) * val_len, dtype))
+            handle.outs[i] = out
+            codec_i = self._resolve_codec(keys, codec, compress)
+            mask = None
+            if (hc is not None and cmd == 0 and codec_i is None
+                    and len(keys) and isinstance(out, np.ndarray)):
+                mask = hc.serve_mask(keys, out)
+            if mask is not None and mask.all():
+                # Every key live-cached: no message leaves the worker.
+                handle.cached += 1
+                self._c_pulls.inc()
+                self._h_pull_lat.observe(0.0)
+                _complete(i)
+                continue
+            if mask is not None and mask.any():
+                # Partial hit: fetch ONLY the misses into a staging
+                # buffer and scatter them into the served rows'
+                # siblings on completion (fixed-k row layout —
+                # serve_mask proved divisibility).
+                miss = np.flatnonzero(~mask)
+                k = out.reshape(-1).size // len(keys)
+                tmp = np.empty(len(miss) * k, out.dtype)
+
+                def _scatter(i=i, out=out, tmp=tmp, miss=miss, k=k):
+                    flat = out.reshape(-1)
+                    for j, pos in enumerate(miss):
+                        flat[pos * k:(pos + 1) * k] = tmp[j * k:(j + 1) * k]
+                    _complete(i)
+
+                handle.timestamps[i] = self.pull(
+                    keys[miss], tmp, cmd=cmd, priority=priority,
+                    tenant=tenant, callback=_scatter,
+                    _batch_sink=sink,
+                )
+                continue
+            handle.timestamps[i] = self.pull(
+                keys, out, cmd=cmd, priority=priority, codec=codec_i,
+                tenant=tenant,
+                callback=(lambda i=i: _complete(i)) if want_cb else None,
+                _batch_sink=sink,
+            )
+
+    def pull_multi(
+        self,
+        key_lists,
+        outs: Optional[List[np.ndarray]] = None,
+        **kw,
+    ) -> MultiGetHandle:
+        """Vectorized pull over registered buckets: each key list
+        resolves its own bucket default codec (:meth:`register_bucket`)
+        and the whole fan-out rides :meth:`multi_get`'s one-frame-per-
+        server path.  The reference-style spelling for callers that
+        think in buckets rather than serving requests."""
+        return self.multi_get(key_lists, outs=outs, **kw)
 
     def wait(self, timestamp: int) -> None:
         self._customer.wait_request(timestamp)
@@ -1659,6 +1861,7 @@ class KVWorker:
         zpull: Optional[dict] = None,
         trace: int = 0,
         tenant: int = 0,
+        batch_sink: Optional[List[Message]] = None,
     ) -> None:
         entries = self._route_entries()
         ranges = [rng for rng, _owner in entries]
@@ -1738,7 +1941,15 @@ class KVWorker:
                 # re-send per sub-op directly.
                 msg._batch_ts = ts
                 msg._batch_sl = sl
-                self._combiner.submit(msg)
+                if batch_sink is not None:
+                    # multi_get fan-out (docs/batching.md): the caller
+                    # collects every slice of the whole fan-out and
+                    # hands them to the combiner ATOMICALLY
+                    # (submit_many), so each contacted destination gets
+                    # ONE EXT_BATCH frame instead of a trickle.
+                    batch_sink.append(msg)
+                else:
+                    self._combiner.submit(msg)
                 continue
             try:
                 self.po.van.send(msg)
@@ -2192,6 +2403,40 @@ class KVServer:
             or self.po.env.find_int("PS_HOT_CACHE", 0)
             or self.po.env.find_int("PS_QOS_STAMPS", 0)
         )
+        # Serving fan-in: the response-direction aggregation plane
+        # (docs/batching.md, "Response aggregation").  Independent
+        # small pull results / push acks headed back to one (sender,
+        # tenant, priority) lane — whether their requests arrived
+        # batched or as separate frames within the aggregation window
+        # — coalesce into ONE EXT_BATCH response frame.  Only senders
+        # that PROVED batch awareness (a capability probe or an
+        # EXT_BATCH frame received from them) are ever aggregated
+        # toward: un-upgraded workers keep seeing plain frames.
+        # PS_RESP_BATCH_BYTES caps a response frame's payload and
+        # defaults to PS_BATCH_BYTES, so one knob turns on both
+        # directions; 0 disables the plane (every response frame is
+        # byte-identical to a pre-fan-in build).
+        self._batch_senders: set = set()
+        self._resp_combiner = None
+        resp_bytes = max(0, self.po.env.find_int(
+            "PS_RESP_BATCH_BYTES",
+            max(0, self.po.env.find_int("PS_BATCH_BYTES", 0)),
+        ))
+        if resp_bytes > 0:
+            from .batching import OpCombiner
+
+            self._resp_combiner = OpCombiner(
+                lambda m: self.po.van.send(m),
+                self._resp_send_failed,
+                max_bytes=resp_bytes,
+                window_us=self.po.env.find_float(
+                    "PS_RESP_BATCH_WINDOW_US", 0.0),
+                min_ops=self.po.env.find_int("PS_RESP_BATCH_MIN_OPS",
+                                             32),
+                hold_max_us=self.po.env.find_float(
+                    "PS_RESP_BATCH_HOLD_US", 2000.0),
+                response=True,
+            )
         # Quantized transport tier (docs/compression.md): the server is
         # the ENCODER of codec pull responses — its per-(key, worker)
         # error-feedback residuals live on the handle (ef_bank, created
@@ -2364,6 +2609,34 @@ class KVServer:
                                          "ts": req.timestamp})
         return msg
 
+    def _resp_send_failed(self, msgs, exc: Exception) -> None:
+        """Response-combiner error hook: a flush's transport send
+        raised off-thread.  Nothing to repair server-side — the
+        waiting workers' deadline sweepers / timeouts own retry — but
+        it must be LOUD, not swallowed."""
+        log.warning(
+            f"response flush of {len(msgs)} frame(s) failed: {exc!r}"
+        )
+
+    def _send_response(self, msg: Message) -> None:
+        """Emit one response frame, riding the response combiner's
+        per-(sender, tenant, priority) lane when the plane is on and
+        the sender negotiated batch capability (docs/batching.md) —
+        mergeable small results coalesce into one EXT_BATCH frame,
+        unmergeable ones travel as singles IN POSITION so per-lane
+        response order never relaxes.  Everything else (un-upgraded
+        senders, custom cmds, control-adjacent answers) sends
+        directly, byte-identical to a pre-fan-in build."""
+        m = msg.meta
+        if (self._resp_combiner is not None
+                and m.head == 0
+                and m.control.empty()
+                and not m.shm_data
+                and m.recver in self._batch_senders):
+            self._resp_combiner.submit(msg)
+            return
+        self.po.van.send(msg)
+
     def _qos_push_done(self, req) -> None:
         """One-shot push-version bump (kv/hot_cache.py): called as an
         applied push's response leaves (and on aborted streams, which
@@ -2418,13 +2691,13 @@ class KVServer:
                         msg.add_data(
                             SArray(np.asarray(res.lens, dtype=np.int32))
                         )
-                    self.po.van.send(msg)
+                    self._send_response(msg)
                     return
             msg.add_data(SArray(res.keys))
             msg.add_data(SArray(res.vals))
             if res.lens is not None:
                 msg.add_data(SArray(np.asarray(res.lens, dtype=np.int32)))
-        self.po.van.send(msg)
+        self._send_response(msg)
 
     def _encode_response(self, ci, req: KVMeta, res: KVPairs):
         """Encode a pull-response slice with the request's codec,
@@ -2492,7 +2765,10 @@ class KVServer:
         msg.meta.option = OPT_APPLY_ERROR
         msg.meta.addr = 0
         msg.meta.val_len = 0
-        self.po.van.send(msg)
+        # Error responses never MERGE (option != 0 declines) but still
+        # ride the sender's response lane in position, so a failed
+        # op's answer cannot overtake its siblings'.
+        self._send_response(msg)
 
     def response_overload(self, req: KVMeta) -> None:
         """Empty ``OPT_OVERLOAD``-marked response (docs/qos.md): this
@@ -2969,6 +3245,10 @@ class KVServer:
         if self._apply_pool is not None:
             self._apply_pool.stop()
             self._apply_pool = None
+        if self._resp_combiner is not None:
+            # After the pool: its stop-path emits stranded responses
+            # through _send_response, which must still find the lane.
+            self._resp_combiner.stop()
         if self._replicator is not None:
             self.po.unregister_node_failure_hook(self._on_self_rehab)
             self._replicator.close()
@@ -3003,6 +3283,10 @@ class KVServer:
         close (no further chunks) — reclaim them without responding."""
         if not down:
             return
+        # A dead sender's batch capability dies with it: its id may be
+        # reused by a recovered (possibly un-upgraded) process, which
+        # must re-prove itself before seeing aggregated responses.
+        self._batch_senders.discard(node_id)
         with self._streams_mu:
             stale = [k for k in self._streams if k[0] == node_id]
             handles = [self._streams.pop(k) for k in stale]
@@ -3052,6 +3336,146 @@ class KVServer:
                     if getattr(h.pending.meta, "tenant", 0) == tenant
                 )
         return n >= self._admit_limit
+
+    # -- shared per-op intake (docs/batching.md) ------------------------------
+    #
+    # ONE implementation of the per-op intake steps — pull stamps,
+    # hot-key accounting, payload decode, admission, replication
+    # dedup/forward — used by BOTH _process_request and its batched
+    # twin _process_batch, so the two paths cannot silently drift.
+
+    def _intake_pull_stamp(self, meta: KVMeta) -> None:
+        """Hot-cache stamp (kv/hot_cache.py): captured at INTAKE —
+        every push counted before this point fully applied, so the
+        snapshot the shards will take is guaranteed to include them;
+        later pushes only make the value newer than the stamp claims
+        (conservative, never stale).  Per sub-op on batched frames, so
+        read-your-writes survives aggregation in both directions."""
+        if self._qos_stamps and meta.pull and not meta.push:
+            with self._qos_mu:
+                meta.stamp = self._push_version
+
+    def _intake_hot_keys(self, keys: np.ndarray) -> None:
+        """Hot-key accounting: exact per-key counts for small key
+        sets; big bulk slices charge the slice's first key with the
+        whole weight (slice granularity — a per-key Python loop over
+        10k-key messages would tax the hot path)."""
+        if not len(keys):
+            return
+        if len(keys) <= 64:
+            for k in keys.tolist():
+                self._hot_keys.add(int(k))
+        else:
+            self._hot_keys.add(int(keys[0]), len(keys))
+
+    def _intake_decode(self, meta: KVMeta, data,
+                       lazy_ok: bool) -> Tuple[KVPairs, Optional[tuple]]:
+        """Parse one op's data segments into KVPairs, decoding codec
+        push payloads — LAZILY (shard-side, docs/compression.md) when
+        ``lazy_ok`` and the payload is fixed-k shard-decodable, else
+        eagerly.  Returns ``(kvs, wire_payload)``; ``wire_payload``
+        keeps a codec push's COMPRESSED bytes so replication forwards
+        re-send them without a decompress+recompress round trip."""
+        kvs = KVPairs()
+        wire_payload = None
+        ci = meta.codec
+        if len(data) < 2:
+            return kvs, None
+        kvs.keys = data[0].astype_view(np.uint64).numpy()
+        if (ci is not None and ci.raw_len > 0 and meta.push
+                and len(data) >= 3):
+            codec = codecs_mod.by_wire_id(ci.codec)
+            codecs_mod.check_block(ci)
+            lens_arr = (data[3].astype_view(np.int32).numpy()
+                        if len(data) > 3 else None)
+            codes_arr = data[1].astype_view(np.uint8).numpy()
+            scales_arr = data[2].astype_view(np.float32).numpy()
+            kvs.lens = lens_arr
+            wire_payload = (data[1], data[2], lens_arr, ci)
+            n_el = ci.raw_len // 4
+            # Shard-side decode: a fixed-k push headed for the apply
+            # pool defers its decode to the shard threads (each
+            # decodes exactly its own keys' segments, in parallel) —
+            # one whole-payload decode here would serialize the
+            # receive pump and head-of-line-block priority ops behind
+            # it.  Ragged / registered-buffer / serial-path / batched
+            # sub-op pushes decode eagerly (batched ops are small by
+            # construction, so the lazy path buys nothing there).
+            lazy = (
+                lazy_ok and lens_arr is None and not meta.pull
+                and self._apply_pool is not None
+                and getattr(codec, "_kind", -1) >= 0
+                and len(kvs.keys) > 0
+                and n_el % len(kvs.keys) == 0
+                and (meta.sender, int(kvs.keys[0]))
+                not in self._recv_buffers
+            )
+            if lazy:
+                kvs.enc = (codes_arr, scales_arr, ci)
+            else:
+                t0 = time.monotonic()
+                kvs.vals = codec.decode(
+                    codes_arr, scales_arr, n_el, lens=lens_arr,
+                    flags=ci.flags,
+                )
+                if meta.trace and self.po.tracer.active:
+                    dur = time.monotonic() - t0
+                    now = self.po.tracer.now_us()
+                    self.po.tracer.span(
+                        meta.trace, "codec_decode", now - dur * 1e6,
+                        dur * 1e6,
+                        args={"codec": codec.name,
+                              "raw_mb": round(ci.raw_len / 2**20, 1)},
+                    )
+        else:
+            kvs.vals = data[1].numpy()
+            if len(data) > 2:
+                kvs.lens = data[2].astype_view(np.int32).numpy()
+        return kvs, wire_payload
+
+    def _intake_admission(self, meta: KVMeta, extra: int = 0) -> bool:
+        """Per-tenant admission at intake (docs/qos.md): counts the
+        request against its tenant and returns True when it must be
+        SHED (the caller answers OPT_OVERLOAD / records the per-op
+        code).  ``extra`` counts a batched frame's own earlier
+        accepted sub-ops, so admission sheds PER SUB-OP."""
+        if not (self._admit_limit > 0 and self._apply_pool is not None
+                and meta.option != OPT_REPLICA and meta.cmd == 0):
+            return False
+        self._tenant_counter(meta.tenant, "requests").inc()
+        if self._admission_overloaded(meta.tenant, extra=extra):
+            self._c_shed.inc()
+            self._tenant_counter(meta.tenant, "shed").inc()
+            return True
+        return False
+
+    def _intake_replicate(self, meta: KVMeta, kvs: KVPairs,
+                          wire_payload, copy: bool = False) -> bool:
+        """Chain-replication intake of one push (docs/
+        fault_tolerance.md): dedup a duplicate origin (a worker's
+        failover retry racing the primary's forwarded copy, in either
+        order) and chain-forward accepted worker pushes IN ARRIVAL
+        ORDER on this (single) processing thread.  Returns True when
+        the op is a pure-push duplicate — apply nothing, just ack; a
+        dup WITH a pull half is mutated (push stripped) so the pull
+        still serves."""
+        if (self._replicator is None or not meta.push
+                or not len(kvs.keys)):
+            return False
+        if not self._replicator.should_apply(meta):
+            if meta.pull:
+                meta.push = False
+                kvs.vals = np.empty(0, kvs.vals.dtype)
+                return False
+            return True
+        if meta.option != OPT_REPLICA:
+            # Codec pushes forward their COMPRESSED wire bytes; a
+            # registered-buffer payload is snapshotted (copy=True) —
+            # the pump overwrites the shared buffer on the sender's
+            # next push while the replica lane may still serialize.
+            self._replicator.forward(meta, kvs, copy=copy,
+                                     wire=wire_payload)
+        return False
 
     def _stream_part(self, msg: Message) -> None:
         """One OPT_XFER_PART partial: feed the newly completed whole-key
@@ -3181,20 +3605,16 @@ class KVServer:
             codec=msg.meta.codec,
             tenant=msg.meta.tenant,
         )
-        if self._qos_stamps and meta.pull and not meta.push:
-            # Hot-cache stamp (kv/hot_cache.py): captured at INTAKE —
-            # every push counted here fully applied before this point,
-            # so the snapshot the shards will take is guaranteed to
-            # include them; later pushes only make the value newer
-            # than the stamp claims (conservative, never stale).
-            with self._qos_mu:
-                meta.stamp = self._push_version
+        self._intake_pull_stamp(meta)
         if meta.cmd == _BATCH_PROBE_CMD and meta.pull:
             # Batch capability probe (docs/batching.md): answered
             # BEFORE the handler, like HOT_KEYS_CMD — the vals carry
             # this build's batch wire version.  Builds predating the
             # aggregation plane route the unknown cmd into their
             # handler and error, which the prober reads as "incapable".
+            # Probing also PROVES the sender parses EXT_BATCH frames —
+            # it becomes eligible for aggregated responses.
+            self._batch_senders.add(meta.sender)
             self.response(meta, KVPairs(
                 keys=np.array([1], dtype=np.uint64),
                 vals=np.array([_BATCH_WIRE_VERSION], dtype=np.float32),
@@ -3217,99 +3637,24 @@ class KVServer:
             # replica's rollups attribute the apply load to the TRUE
             # tenant instead of lumping every forward on tenant 0.
             self._tenant_counter(meta.tenant, "requests").inc()
-        shed = False
-        if (self._admit_limit > 0 and self._apply_pool is not None
-                and meta.option != OPT_REPLICA
-                and meta.cmd == 0):
-            self._tenant_counter(meta.tenant, "requests").inc()
-            shed = self._admission_overloaded(meta.tenant)
-        if shed:
+        if self._intake_admission(meta):
             # Admission control (docs/qos.md): this tenant's bounded
             # queue is full — shed BEFORE replication/apply so the
             # request is atomically all-or-nothing, and fail the
             # waiting worker fast with the retryable OPT_OVERLOAD.
-            self._c_shed.inc()
-            self._tenant_counter(meta.tenant, "shed").inc()
             self.response_overload(meta)
             return
         if meta.push:
             self._c_push_reqs.inc()
         if meta.pull:
             self._c_pull_reqs.inc()
-        kvs = KVPairs()
-        # NOTE: the per-op intake below (codec decode, hot-key
-        # accounting, admission, replication dedup/forward, stamps)
-        # has a batched twin in _process_batch — a change here almost
-        # certainly needs the same change there, or the two paths
-        # silently diverge.
-        # Compressed wire payload of a codec push, kept as received so
-        # replication can forward the COMPRESSED bytes down the chain
-        # (each replica decodes once; re-sending decompressed would pay
-        # decompress+recompress and 4x wire on every hop).
-        wire_payload = None
-        ci = msg.meta.codec
-        if len(msg.data) >= 2:
-            kvs.keys = msg.data[0].astype_view(np.uint64).numpy()
-            if (ci is not None and ci.raw_len > 0 and meta.push
-                    and len(msg.data) >= 3):
-                codec = codecs_mod.by_wire_id(ci.codec)
-                codecs_mod.check_block(ci)
-                lens_arr = (msg.data[3].astype_view(np.int32).numpy()
-                            if len(msg.data) > 3 else None)
-                codes_arr = msg.data[1].astype_view(np.uint8).numpy()
-                scales_arr = msg.data[2].astype_view(np.float32).numpy()
-                kvs.lens = lens_arr
-                wire_payload = (msg.data[1], msg.data[2], lens_arr, ci)
-                n_el = ci.raw_len // 4
-                # Shard-side decode (docs/compression.md): a fixed-k
-                # push headed for the apply pool defers its decode to
-                # the shard threads (each decodes exactly its own
-                # keys' segments, in parallel) — one whole-payload
-                # decode here would serialize the receive pump and
-                # head-of-line-block priority ops behind it.  Ragged /
-                # registered-buffer / serial-path pushes decode
-                # eagerly.
-                lazy = (
-                    lens_arr is None and not meta.pull
-                    and self._apply_pool is not None
-                    and getattr(codec, "_kind", -1) >= 0
-                    and len(kvs.keys) > 0
-                    and n_el % len(kvs.keys) == 0
-                    and (meta.sender, int(kvs.keys[0]))
-                    not in self._recv_buffers
-                )
-                if lazy:
-                    kvs.enc = (codes_arr, scales_arr, ci)
-                else:
-                    t0 = time.monotonic()
-                    kvs.vals = codec.decode(
-                        codes_arr, scales_arr, n_el, lens=lens_arr,
-                        flags=ci.flags,
-                    )
-                    if meta.trace and self.po.tracer.active:
-                        dur = time.monotonic() - t0
-                        now = self.po.tracer.now_us()
-                        self.po.tracer.span(
-                            meta.trace, "codec_decode", now - dur * 1e6,
-                            dur * 1e6,
-                            args={"codec": codec.name,
-                                  "raw_mb": round(ci.raw_len / 2**20,
-                                                  1)},
-                        )
-            else:
-                kvs.vals = msg.data[1].numpy()
-                if len(msg.data) > 2:
-                    kvs.lens = msg.data[2].astype_view(np.int32).numpy()
-        if len(kvs.keys):
-            # Hot-key accounting: exact per-key counts for small key
-            # sets; big bulk slices charge the slice's first key with
-            # the whole weight (slice granularity — a per-key Python
-            # loop over 10k-key messages would tax the hot path).
-            if len(kvs.keys) <= 64:
-                for k in kvs.keys.tolist():
-                    self._hot_keys.add(int(k))
-            else:
-                self._hot_keys.add(int(kvs.keys[0]), len(kvs.keys))
+        # Per-op intake (the _intake_* helpers): ONE implementation
+        # shared with the batched twin _process_batch, so the two
+        # paths cannot drift.  lazy_ok=True: only this path may defer
+        # a codec push's decode to the shard threads.
+        kvs, wire_payload = self._intake_decode(meta, msg.data,
+                                                lazy_ok=True)
+        self._intake_hot_keys(kvs.keys)
         reg = None
         if meta.push and len(kvs.keys):
             reg = self._recv_buffers.get((meta.sender, int(kvs.keys[0])))
@@ -3338,30 +3683,12 @@ class KVServer:
                 # A recovered primary fetching its range's state.
                 self._replicator.handle_fetch(meta, kvs, self)
                 return
-            if meta.push and len(kvs.keys):
-                if not self._replicator.should_apply(meta):
-                    # Duplicate origin (a worker's failover retry racing
-                    # the primary's forwarded copy, in either order):
-                    # apply nothing; still serve the pull half and ack
-                    # the waiting worker.
-                    if meta.pull:
-                        meta.push = False
-                        kvs.vals = np.empty(0, kvs.vals.dtype)
-                    else:
-                        self.response(meta)
-                        return
-                elif meta.option != OPT_REPLICA:
-                    # Accepted worker push: chain-forward before the
-                    # apply dispatch, on this (single) processing thread
-                    # so replicas see the exact arrival order.  A
-                    # registered-buffer payload is snapshotted: the pump
-                    # overwrites the shared buffer on the sender's next
-                    # push while the replica lane may still serialize.
-                    # Codec pushes forward their COMPRESSED wire bytes
-                    # (wire=); the replica decodes once on arrival.
-                    self._replicator.forward(meta, kvs,
-                                             copy=reg is not None,
-                                             wire=wire_payload)
+        if self._intake_replicate(meta, kvs, wire_payload,
+                                  copy=reg is not None):
+            # Pure-push duplicate origin: apply nothing, still ack the
+            # waiting worker.
+            self.response(meta)
+            return
         if self._apply_pool is not None:
             # Sharded apply: returns immediately — the response is
             # emitted (in per-sender arrival order) by whichever shard
@@ -3393,6 +3720,10 @@ class KVServer:
         into the apply pool as a GROUP — shared shard dispatch, one
         batched response frame through the per-sender order gate."""
         env = msg.meta
+        # An EXT_BATCH frame from this sender proves its build parses
+        # batched frames (covers PS_BATCH_NEGOTIATE=0 clusters, where
+        # no probe is ever sent): aggregated responses may flow back.
+        self._batch_senders.add(env.sender)
         subs = _split_batch_message(msg)
         if not subs:
             return
@@ -3424,11 +3755,15 @@ class KVServer:
         kvss: List[KVPairs] = []
         results: List[Optional[tuple]] = []
         admitted = 0
-        admission_on = (self._admit_limit > 0
-                        and self._apply_pool is not None)
-        # NOTE: this per-op intake is the batched twin of the one in
-        # _process_request (differing only in eager decode and the
-        # per-sub-op admission/result plumbing) — keep them in sync.
+        # Per-op intake via the SHARED _intake_* helpers (one
+        # implementation with _process_request, so the twins cannot
+        # drift).  lazy_ok=False: batched sub-ops are small by
+        # construction (PS_BATCH_BYTES), so the lazy shard-side decode
+        # buys nothing here; a ragged (lens) sub-op — our combiner
+        # never merges these, but a foreign encoder might — still
+        # parses its lens so the pool's split declines it LOUDLY
+        # (per-op error) instead of applying values at wrong per-key
+        # boundaries.
         for sub in subs:
             sm = sub.meta
             meta = KVMeta(
@@ -3437,82 +3772,28 @@ class KVServer:
                 key=sm.key, val_len=sm.val_len, option=0,
                 priority=env.priority, codec=sm.codec, tenant=env.tenant,
             )
-            kvs = KVPairs()
-            wire_payload = None
-            ci = sm.codec
-            if len(sub.data) >= 2:
-                kvs.keys = sub.data[0].astype_view(np.uint64).numpy()
-                if (ci is not None and ci.raw_len > 0 and meta.push
-                        and len(sub.data) >= 3):
-                    # Sub-op codec payloads decode EAGERLY: batched ops
-                    # are small by construction (PS_BATCH_BYTES), so
-                    # the lazy shard-side decode buys nothing here.
-                    codec = codecs_mod.by_wire_id(ci.codec)
-                    codecs_mod.check_block(ci)
-                    kvs.vals = codec.decode(
-                        sub.data[1].astype_view(np.uint8).numpy(),
-                        sub.data[2].astype_view(np.float32).numpy(),
-                        ci.raw_len // 4, flags=ci.flags,
-                    )
-                    wire_payload = (sub.data[1], sub.data[2], None, ci)
-                else:
-                    kvs.vals = sub.data[1].numpy()
-                    if len(sub.data) > 2:
-                        # A ragged (lens) sub-op — our combiner never
-                        # merges these, but a foreign encoder might:
-                        # parse the lens so the pool's split declines
-                        # it LOUDLY (per-op error) instead of applying
-                        # values at wrong per-key boundaries.
-                        kvs.lens = sub.data[2].astype_view(
-                            np.int32).numpy()
-            if self._qos_stamps and meta.pull and not meta.push:
-                # Per-sub-op intake stamp (kv/hot_cache.py): read-your-
-                # writes survives batching because every pull sub-op
-                # carries its own stamp in the response table.
-                with self._qos_mu:
-                    meta.stamp = self._push_version
-            if len(kvs.keys):
-                if len(kvs.keys) <= 64:
-                    for k in kvs.keys.tolist():
-                        self._hot_keys.add(int(k))
-                else:
-                    self._hot_keys.add(int(kvs.keys[0]), len(kvs.keys))
+            kvs, wire_payload = self._intake_decode(meta, sub.data,
+                                                    lazy_ok=False)
+            self._intake_pull_stamp(meta)
+            self._intake_hot_keys(kvs.keys)
             result = None
-            if admission_on:
-                self._tenant_counter(meta.tenant, "requests").inc()
-                if self._admission_overloaded(meta.tenant,
-                                              extra=admitted):
-                    # Admission sheds SUB-OPS individually, never the
-                    # whole frame (docs/qos.md): this op fast-fails
-                    # with a per-op OPT_OVERLOAD code while its
-                    # siblings apply.
-                    self._c_shed.inc()
-                    self._tenant_counter(meta.tenant, "shed").inc()
-                    result = ("overload",)
+            if self._intake_admission(meta, extra=admitted):
+                # Admission sheds SUB-OPS individually, never the
+                # whole frame (docs/qos.md): this op fast-fails with a
+                # per-op OPT_OVERLOAD code while its siblings apply.
+                result = ("overload",)
             if result is None:
                 if meta.push:
                     self._c_push_reqs.inc()
                 if meta.pull:
                     self._c_pull_reqs.inc()
-                if (self._replicator is not None and meta.push
-                        and len(kvs.keys)):
-                    if not self._replicator.should_apply(meta):
-                        # Duplicate origin (failover retry vs forwarded
-                        # copy): apply nothing, still ack / serve pull.
-                        if meta.pull:
-                            meta.push = False
-                            kvs.vals = np.empty(0, kvs.vals.dtype)
-                        else:
-                            result = ("ok", None)
-                    else:
-                        # Per-sub-op chain forward, on this (single)
-                        # processing thread in op order — replicas see
-                        # the exact arrival order, and each forward
-                        # carries its op's own origin (ts, key) for
-                        # exactly-once dedup.
-                        self._replicator.forward(meta, kvs,
-                                                 wire=wire_payload)
-                if result is None:
+                # Per-sub-op chain forward/dedup, on this (single)
+                # processing thread in op order — replicas see the
+                # exact arrival order, and each forward carries its
+                # op's own origin (ts, key) for exactly-once dedup.
+                if self._intake_replicate(meta, kvs, wire_payload):
+                    result = ("ok", None)  # pure-push dup: ack only
+                else:
                     admitted += 1
             metas.append(meta)
             kvss.append(kvs)
@@ -3620,7 +3901,10 @@ class KVServer:
                 codec=codec_info,
             ))
         m.batch = _BatchInfo(ops=tuple(ops))
-        self.po.van.send(msg)
+        # Already one frame (batch is set, so it can never re-merge),
+        # but it rides the sender's response lane for ORDER with any
+        # interleaved single-frame responses to the same sender.
+        self._send_response(msg)
 
 
 class _OpCapture:

@@ -315,9 +315,13 @@ class Meta:
     # Small-op aggregation (docs/batching.md): non-None marks this
     # frame as a MULTI-OP batch — N independent KV ops to one
     # destination, each with its own timestamp/key/option/stamp/codec
-    # in the per-op table.  Tagged EXT_BATCH extension; only ever sent
-    # to peers whose batch capability was negotiated (old decoders
-    # never see these frames).
+    # in the per-op table.  Request direction (worker op combiner) and
+    # response direction (batched group responses + the server's
+    # response combiner) share the layout; on responses the per-op
+    # option/stamp carry result codes and hot-cache versions.  Tagged
+    # EXT_BATCH extension; only ever sent to peers whose batch
+    # capability was negotiated/proved (old decoders never see these
+    # frames).
     batch: Optional[BatchInfo] = None
     # Wire compression (docs/compression.md): non-None marks the vals
     # payload as codec-encoded (or, on a pull request with raw_len=0,

@@ -145,18 +145,71 @@ def embedding_row(cfg: DLRMConfig, row: int):
     return base * 1e-3 + np.float32(row) + 0.5
 
 
-def push_embedding_table(worker, cfg: DLRMConfig, tenant=None) -> None:
+def spread_row_keys(cfg: DLRMConfig):
+    """Row -> PS key mapping that SPREADS the table uniformly across
+    the u64 key space (and therefore across every server's key range).
+    Plain ``np.arange`` keys all land on server 0 of a multi-server
+    cluster — fine for single-server serving benches, useless for the
+    fan-in path, whose whole point is one request touching many
+    servers (docs/batching.md, serving fan-in)."""
+    import numpy as np
+
+    stride = (1 << 64) // cfg.num_rows
+    return (np.arange(cfg.num_rows, dtype=np.uint64)
+            * np.uint64(stride))
+
+
+def push_embedding_table(worker, cfg: DLRMConfig, tenant=None,
+                         spread: bool = False) -> None:
     """Publish the full (deterministic) embedding table into the
     message-path PS store — one key per row, ``emb_dim`` floats each.
     The serving-path setup step (docs/qos.md): inference workers then
-    pull rows by key."""
+    pull rows by key.  ``spread=True`` uses :func:`spread_row_keys`
+    so the table shards across every server of the cluster."""
     import numpy as np
 
-    keys = np.arange(cfg.num_rows, dtype=np.uint64)
+    keys = (spread_row_keys(cfg) if spread
+            else np.arange(cfg.num_rows, dtype=np.uint64))
     vals = np.concatenate(
         [embedding_row(cfg, r) for r in range(cfg.num_rows)]
     )
     worker.wait(worker.push(keys, vals, tenant=tenant))
+
+
+def serve_fanout_storm(worker, cfg: DLRMConfig, n_reqs: int,
+                       fanout: int = 64, seed: int = 0, tenant=None,
+                       check_every: int = 32):
+    """The DLRM serving FAN-OUT path (docs/batching.md): each request
+    is ``fanout`` independent single-row embedding lookups with
+    Zipf-distributed rows, issued through ``KVWorker.multi_get`` over
+    the SPREAD key layout (:func:`spread_row_keys`) so one request
+    touches every server.  Returns per-request wall latencies
+    (seconds).  Every ``check_every``-th request is verified bit-exact
+    against :func:`embedding_row`."""
+    import time
+
+    import numpy as np
+
+    from ..utils import logging as log
+
+    row_keys = spread_row_keys(cfg)
+    all_rows = serving_keys(cfg, n_reqs * fanout, seed)
+    outs = [np.zeros(cfg.emb_dim, np.float32) for _ in range(fanout)]
+    lats = []
+    for i in range(n_reqs):
+        rows = all_rows[i * fanout:(i + 1) * fanout]
+        key_lists = [row_keys[int(r):int(r) + 1] for r in rows]
+        t0 = time.perf_counter()
+        handle = worker.multi_get(key_lists, outs=outs, tenant=tenant)
+        handle.wait()
+        lats.append(time.perf_counter() - t0)
+        if check_every and i % check_every == 0:
+            for j, r in enumerate(rows):
+                log.check(
+                    np.array_equal(outs[j], embedding_row(cfg, int(r))),
+                    f"fan-out pull of row {r} returned wrong values",
+                )
+    return lats
 
 
 def serving_keys(cfg: DLRMConfig, n: int, seed: int = 0):

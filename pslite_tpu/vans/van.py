@@ -180,11 +180,19 @@ class Van:
         self._c_chunks_recv = self._node_metrics.counter("van.chunks_recv")
         # Small-op aggregation (docs/batching.md): multi-op EXT_BATCH
         # frames this node sent and the sub-ops they carried — psmon's
-        # ops/frame column divides the two.  On the node registry (no
-        # legacy read surface) so PS_TELEMETRY=0 no-ops them.
+        # ops/frame column divides the two.  Split by DIRECTION: the
+        # request counters are worker-origin (the op combiner), the
+        # resp counters server-origin (the response combiner + batched
+        # group responses) — psmon's "resp ops/F" column.  On the node
+        # registry (no legacy read surface) so PS_TELEMETRY=0 no-ops
+        # them.
         self._c_batched_frames = self._node_metrics.counter(
             "van.batched_frames")
         self._c_batch_ops = self._node_metrics.counter("van.batch_ops")
+        self._c_resp_batched_frames = self._node_metrics.counter(
+            "van.resp_batched_frames")
+        self._c_resp_batch_ops = self._node_metrics.counter(
+            "van.resp_batch_ops")
         self._h_hol_wait = self._node_metrics.histogram("van.hol_wait_s")
         self._node_metrics.gauge("van.xfers_inflight",
                                  fn=self._owner_xfer_depth)
@@ -536,9 +544,17 @@ class Van:
         if msg.meta.batch is not None and msg.meta.control.empty():
             # Aggregation accounting (docs/batching.md): counted once
             # per frame at submission, whichever plane (native lane,
-            # Python lane, chunk split) carries it.
-            self._c_batched_frames.inc()
-            self._c_batch_ops.inc(len(msg.meta.batch.ops))
+            # Python lane, chunk split) carries it.  Request-direction
+            # frames are the worker-side op combiner's; response-
+            # direction frames are server-origin (batched group
+            # responses and the response combiner) — psmon renders
+            # them as separate ops/F columns.
+            if msg.meta.request:
+                self._c_batched_frames.inc()
+                self._c_batch_ops.inc(len(msg.meta.batch.ops))
+            else:
+                self._c_resp_batched_frames.inc()
+                self._c_resp_batch_ops.inc(len(msg.meta.batch.ops))
         if msg.meta.control.empty() and not self.tenants.enabled:
             # Native data plane (docs/native_core.md): transports with
             # native sender lanes take the whole hot path — frame

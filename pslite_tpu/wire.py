@@ -72,8 +72,14 @@ _EXT_QOS_PAYLOAD = struct.Struct("<HQ")  # tenant, stamp
 # serialized AHEAD of ``meta.body`` (stripped again at unpack — body
 # round-trips unchanged).  Packed before EXT_CODEC/EXT_CHUNK so
 # EXT_CHUNK stays the trailing bytes (the native splitter's contract).
-# Capability-gated: senders only emit EXT_BATCH toward peers that
-# answered the batch probe, so old decoders never see these frames.
+# Used in BOTH directions with one layout: request frames (worker op
+# combiner; per-op option/stamp always 0) and response frames (batched
+# group responses + the server's response combiner; per-op option
+# carries OPT_APPLY_ERROR/OPT_OVERLOAD result codes, per-op stamp the
+# hot-cache push-version).  Capability-gated both ways: senders only
+# emit EXT_BATCH toward peers that answered the batch probe, and
+# servers only aggregate responses toward senders that probed (or sent
+# an EXT_BATCH frame) — old decoders never see these frames.
 EXT_BATCH = 5
 _EXT_BATCH_PAYLOAD = struct.Struct("<HI")  # n_ops, table_len
 _BATCH_OP_FIXED = struct.Struct("<BBiQqqQ")

@@ -481,9 +481,12 @@ def test_serial_path_batches_without_apply_pool():
         np.testing.assert_array_equal(out, np.full(2 * 16, 40.0,
                                                    np.float32))
         assert w.combiner.flushed_frames > 0
-        # The server answered batched frames with batched responses.
+        # The server answered batched frames with batched responses —
+        # counted on the RESPONSE-direction ledger (psmon "resp
+        # ops/F"), never mixed into the request-direction one.
         srv_van = cl.servers[0].van
-        assert srv_van._c_batched_frames.value > 0
+        assert srv_van._c_resp_batched_frames.value > 0
+        assert srv_van._c_batched_frames.value == 0
     finally:
         _teardown(cl, servers, w)
 

@@ -159,14 +159,15 @@ def test_bench_cli_contract(tmp_path):
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
         PS_BENCH_PARTIAL=str(tmp_path / "partial.json"),
-        # The multi_tenant and small_op_batching sections cost ~40-60s
-        # of real-process storms each and have their own dedicated
-        # harness tests (admission probe, dlrm_serve, test_qos.py,
-        # test_batching.py + the small_op harness smoke below) — keep
-        # the CLI-contract smoke inside the tier-1 wall budget; the
-        # skip markers they record are exactly what bench_diff treats
-        # as absent.
-        PS_BENCH_SKIP="multi_tenant,small_op_batching",
+        # The multi_tenant, small_op_batching, and serving_fanin
+        # sections cost ~40-60s of real-process storms each and have
+        # their own dedicated harness tests (admission probe,
+        # dlrm_serve, test_qos.py, test_batching.py,
+        # test_multi_get.py + the harness smokes below) — keep the
+        # CLI-contract smoke inside the tier-1 wall budget; the skip
+        # markers they record are exactly what bench_diff treats as
+        # absent.
+        PS_BENCH_SKIP="multi_tenant,small_op_batching,serving_fanin",
     )
     out = subprocess.run(
         [sys.executable, "bench.py"],
@@ -184,6 +185,7 @@ def test_bench_cli_contract(tmp_path):
     assert rec["value"] > 0
     assert rec.get("multi_tenant_skipped") == "PS_BENCH_SKIP"
     assert rec.get("small_op_batching_skipped") == "PS_BENCH_SKIP"
+    assert rec.get("serving_fanin_skipped") == "PS_BENCH_SKIP"
 
 
 def test_telemetry_overhead_guard():
@@ -276,6 +278,53 @@ def test_small_op_storm_harness():
     assert r["ops_per_frame"] > 1.0  # multi-op frames really formed
     assert r["store_exact"]
     assert r["p99_ms"] >= r["p50_ms"] >= 0
+
+
+@pytest.mark.slow
+def test_serving_fanin_harness():
+    """The serving_fanin section's harness: one short subprocess leg
+    of ``--mode serving_fanin`` with the aggregation planes on (real
+    1w+2s tcp cluster via the local tracker) must produce the
+    measurement line with the fan-in actually formed (response frames
+    per request far below the fan-out) and every spot-checked request
+    bit-exact.  Slow-marked like the small-op harness: the plane's
+    semantics are covered by the fast loopback tests in
+    tests/test_multi_get.py — the ratio itself is the bench's job."""
+    from pslite_tpu.benchmark import _serving_fanin_run
+
+    r = _serving_fanin_run(1.0, batch=True)
+    assert r["reqs"] > 0 and r["reqs_per_s"] > 0
+    assert r["servers"] == 2
+    # Fan-in really formed: ~1 frame per contacted server, nowhere
+    # near one frame per lookup.
+    assert r["frames_per_req"] < r["fanout"] / 4
+    assert r["store_exact"]
+    assert r["p99_ms"] >= r["p50_ms"] >= 0
+
+
+def test_bench_diff_gates_serving_fanin(tmp_path):
+    """The serving_fanin guard: a collapsing requests/s ratio (or
+    ballooning frames/request) fails the check; the PS_BENCH_SKIP
+    marker reads as absent, never a vanished metric."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import bench_diff
+
+    old = tmp_path / "BENCH_r07.json"
+    new = tmp_path / "BENCH_r08.json"
+    base = _bench_record(serving_fanin_req_ratio=4.0,
+                         serving_fanin_frames_per_req=1.6)
+    old.write_text(json.dumps(base))
+    new.write_text(json.dumps(_bench_record(
+        serving_fanin_req_ratio=4.0,
+        serving_fanin_frames_per_req=8.0,  # 5x more frames: regression
+    )))
+    assert bench_diff.main([str(old), str(new)]) == 1
+    rec = _bench_record()
+    rec["serving_fanin_skipped"] = "PS_BENCH_SKIP"
+    new.write_text(json.dumps(rec))
+    assert bench_diff.main([str(old), str(new)]) == 0
 
 
 def test_bench_diff_gates_small_op_ratio(tmp_path):

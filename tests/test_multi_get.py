@@ -1,0 +1,531 @@
+"""Serving fan-in (docs/batching.md): KVWorker.multi_get + the
+server-side response combiner.
+
+Covers the tentpole end to end over in-process loopback clusters —
+multi-get bit-exactness vs sequential pulls across the codec ×
+replication × PS_NATIVE × PS_BATCH_BYTES matrix, the one-frame-per-
+server fan-out (submit_many), the one-handle/per-key-callback
+completion contract, the hot-key cache partial-hit fast path with
+read-your-writes, per-sub-op OPT_OVERLOAD sheds failing only the
+affected keys, OPT_WRONG_OWNER bounces mid-multi-get re-slicing only
+the bounced part, response aggregation of SEPARATE request frames,
+the un-upgraded-sender capability gate, and psmon's resp ops/F
+column.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from helpers import LoopbackCluster  # noqa: E402
+
+from pslite_tpu.base import server_rank_to_id  # noqa: E402
+from pslite_tpu.kv.batching import (  # noqa: E402
+    OpCombiner,
+    batchable,
+    build_batch_message,
+    split_batch_message,
+)
+from pslite_tpu.kv.hot_cache import HotKeyCache  # noqa: E402
+from pslite_tpu.kv.kv_app import (  # noqa: E402
+    KVServer,
+    KVServerDefaultHandle,
+    KVWorker,
+    OverloadError,
+)
+from pslite_tpu.message import Message  # noqa: E402
+from pslite_tpu.routing import RouteEntry, RoutingTable  # noqa: E402
+from pslite_tpu.sarray import SArray  # noqa: E402
+
+
+def _cluster(env_extra=None, num_servers=2, handle=None):
+    cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
+                         env_extra={"PS_BATCH_BYTES": "65536",
+                                    **(env_extra or {})})
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(handle() if handle else
+                             KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    return cl, servers, w
+
+
+def _teardown(cl, servers, w):
+    w.stop()
+    for s in servers:
+        s.stop()
+    cl.finalize()
+
+
+def _spread_keys(n):
+    span = (1 << 64) // n
+    return np.arange(n, dtype=np.uint64) * np.uint64(span)
+
+
+# -- bit-exactness matrix ----------------------------------------------------
+
+
+@pytest.mark.parametrize("codec", [None, "int8"])
+@pytest.mark.parametrize("replication", [1, 2])
+@pytest.mark.parametrize("native", [0, 1])
+@pytest.mark.parametrize("batch_bytes", [0, 65536])
+def test_multi_get_matrix_bit_exact_vs_sequential(codec, replication,
+                                                  native, batch_bytes):
+    """multi_get returns byte-identical values to sequential pulls of
+    the same keys, across wire codec, chain replication, the native
+    plane toggle, and batching on/off."""
+    env = {
+        "PS_BATCH_BYTES": str(batch_bytes),
+        "PS_NATIVE": str(native),
+        "PS_KV_REPLICATION": str(replication),
+        # EF folds each encode's residual into the NEXT encode of the
+        # same slice (by design), so consecutive codec pulls are not
+        # byte-identical; the matrix compares pure codec round trips.
+        "PS_CODEC_EF": "0",
+    }
+    cl, servers, w = _cluster(env_extra=env)
+    try:
+        nk, vl = 32, 8
+        keys = _spread_keys(nk)
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=nk * vl).astype(np.float32)
+        w.wait(w.push(keys, vals))
+        key_lists = [keys[i:i + 1] for i in range(nk)]
+        kw = {"codec": codec} if codec else {}
+        handle = w.multi_get(key_lists, val_len=vl, **kw)
+        got = handle.wait()
+        # Reference: sequential pulls, identical codec config.
+        seq = np.zeros(vl, np.float32)
+        for i in range(nk):
+            w.wait(w.pull(keys[i:i + 1], seq, **kw))
+            np.testing.assert_array_equal(got[i], seq)
+        assert handle.errors == {}
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_multi_get_one_frame_per_server_and_batched_response():
+    """The fan-out's per-server slices enter the combiner atomically:
+    ONE EXT_BATCH frame per contacted server, answered by ONE batched
+    response frame per server (the ~1 RTT fan-in)."""
+    cl, servers, w = _cluster(num_servers=2)
+    try:
+        nk, vl = 64, 8
+        keys = _spread_keys(nk)
+        vals = np.arange(nk * vl, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+        # Warm capability so the fan-out below is fully batched.
+        warm = np.zeros(vl, np.float32)
+        w.wait(w.pull(keys[:1], warm))
+        wvan = cl.workers[0].van
+        f0, o0 = wvan._c_batched_frames.value, wvan._c_batch_ops.value
+        r0 = [po.van._c_resp_batched_frames.value for po in cl.servers]
+        handle = w.multi_get([keys[i:i + 1] for i in range(nk)],
+                             val_len=vl)
+        handle.wait()
+        assert wvan._c_batched_frames.value - f0 == 2  # one per server
+        assert wvan._c_batch_ops.value - o0 == nk
+        for i, po in enumerate(cl.servers):
+            assert po.van._c_resp_batched_frames.value - r0[i] == 1
+        for i in range(nk):
+            np.testing.assert_array_equal(
+                handle.outs[i], vals[i * vl:(i + 1) * vl])
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_multi_get_handle_and_callbacks():
+    """One wait handle; per-sub-get callbacks fire as each completes;
+    the aggregate callback fires once after the last success."""
+    cl, servers, w = _cluster(num_servers=1)
+    try:
+        nk, vl = 8, 4
+        keys = np.arange(nk, dtype=np.uint64)
+        w.wait(w.push(keys, np.ones(nk * vl, np.float32)))
+        fired = []
+        done = threading.Event()
+        cbs = [(lambda i=i: fired.append(i)) for i in range(nk)]
+        handle = w.multi_get([keys[i:i + 1] for i in range(nk)],
+                             val_len=vl, callbacks=cbs,
+                             callback=done.set)
+        handle.wait()
+        assert done.wait(5.0)
+        assert sorted(fired) == list(range(nk))
+        assert len(handle) == nk
+        # pull_multi is the bucket-flavored alias of the same path.
+        h2 = w.pull_multi([keys[:2]], val_len=vl)
+        h2.wait()
+        np.testing.assert_array_equal(h2.outs[0],
+                                      np.ones(2 * vl, np.float32))
+    finally:
+        _teardown(cl, servers, w)
+
+
+# -- hot-key cache partial hits ----------------------------------------------
+
+
+def test_multi_get_partial_cache_hit_fetches_only_misses():
+    """Cached keys serve locally; only the misses ride the wire; the
+    assembled buffer is bit-exact; fully-cached sub-gets send NO
+    message and read-your-writes still holds after a push."""
+    cl, servers, w = _cluster(num_servers=1,
+                              env_extra={"PS_HOT_CACHE": "1"})
+    try:
+        nk, vl = 8, 4
+        keys = np.arange(nk, dtype=np.uint64)
+        vals = np.arange(nk * vl, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+        # Warm the cache on the even keys only.
+        o = np.zeros(vl, np.float32)
+        for k in range(0, nk, 2):
+            w.wait(w.pull(keys[k:k + 1], o))
+        hits0 = w.po.metrics.counter("kv.hot_cache.hits").value
+        handle = w.multi_get([keys], val_len=vl)
+        handle.wait()
+        np.testing.assert_array_equal(handle.outs[0], vals)
+        assert w.po.metrics.counter(
+            "kv.hot_cache.hits").value - hits0 == nk // 2
+        # Fully-cached sub-gets: no timestamps, no wire traffic.
+        sent0 = cl.workers[0].van._c_sent_msgs.value
+        h2 = w.multi_get([keys[0:1], keys[2:3]], val_len=vl)
+        h2.wait()
+        assert h2.cached == 2 and h2.timestamps == [None, None]
+        assert cl.workers[0].van._c_sent_msgs.value == sent0
+        np.testing.assert_array_equal(h2.outs[0], vals[0:vl])
+        # Read-your-writes: a push invalidates the fill; the next
+        # multi_get must fetch fresh values, not the stale cache.
+        w.wait(w.push(keys[0:1], np.full(vl, 50.0, np.float32)))
+        h3 = w.multi_get([keys[0:1]], val_len=vl)
+        h3.wait()
+        assert h3.cached == 0
+        np.testing.assert_array_equal(h3.outs[0], vals[0:vl] + 50.0)
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_serve_mask_unit_validity_rules():
+    """serve_mask's validity is serve()'s: stale-stamp entries count
+    misses and drop (the fill-race guard), shape mismatches decline
+    wholesale, live rows copy in place."""
+    c = HotKeyCache(max_bytes=1 << 20, ttl_s=30.0)
+    keys = np.arange(4, dtype=np.uint64)
+    c.fill(8, 5, keys[:2], np.arange(8, dtype=np.float32))  # keys 0,1
+    out = np.zeros(16, np.float32)
+    mask = c.serve_mask(keys, out)
+    assert list(mask) == [True, True, False, False]
+    np.testing.assert_array_equal(out[:8],
+                                  np.arange(8, dtype=np.float32))
+    # A newer observed stamp invalidates the fills: all misses now.
+    c.observe(8, 9)
+    out2 = np.zeros(16, np.float32)
+    assert not c.serve_mask(keys, out2).any()
+    assert len(c) == 0  # dropped on probe, like serve()
+    # Fill-race: a fill older than the known stamp is skipped at fill
+    # time, so serve_mask can never resurrect it.
+    c.fill(8, 7, keys[:1], np.ones(4, np.float32))
+    assert len(c) == 0
+    # Non-partitionable buffer: declined wholesale, nothing touched.
+    c.fill(8, 11, keys[:1], np.ones(4, np.float32))
+    assert c.serve_mask(keys, np.zeros(7, np.float32)) is None
+
+
+# -- per-sub-op failure isolation --------------------------------------------
+
+
+def test_multi_get_overload_sheds_fail_only_affected_subs():
+    """Per-tenant admission through a multi-get fan-out sheds SUB-OPS:
+    the shed sub-gets' waits raise OverloadError and their callbacks
+    are suppressed; siblings complete bit-exact."""
+    cl, servers, w = _cluster(num_servers=1, env_extra={
+        "PS_TENANTS": "serve:8,train:1",
+        "PS_TENANT_QUEUE_LIMIT": "4",
+        "PS_BATCH_NEGOTIATE": "0",
+    })
+    try:
+        nk, vl = 64, 256
+        keys = np.arange(nk, dtype=np.uint64)
+        vals = np.ones(nk * vl, np.float32)
+        while True:
+            try:
+                w.wait(w.push(keys, vals, tenant="train"))
+                break
+            except OverloadError:
+                time.sleep(0.01)
+        fired = []
+        cbs = [(lambda i=i: fired.append(i)) for i in range(nk)]
+        handle = w.multi_get([keys[i:i + 1] for i in range(nk)],
+                             val_len=vl, tenant="train",
+                             callbacks=cbs)
+        with pytest.raises(OverloadError):
+            handle.wait()
+        shed = set(handle.errors)
+        assert shed  # the tiny limit must have shed something
+        assert all(isinstance(e, OverloadError)
+                   for e in handle.errors.values())
+        # Siblings completed bit-exact; their callbacks fired; the
+        # shed sub-gets' callbacks were suppressed.
+        for i in range(nk):
+            if i in shed:
+                assert i not in fired
+            else:
+                assert i in fired
+                np.testing.assert_array_equal(
+                    handle.outs[i], vals[i * vl:(i + 1) * vl])
+    finally:
+        _teardown(cl, servers, w)
+
+
+# -- elastic: wrong-owner bounce mid-multi-get --------------------------------
+
+
+def test_multi_get_wrong_owner_reslices_only_bounced_subs():
+    """A stale worker's multi-get spans both servers; a doctored newer
+    epoch flips rank 1's ranges to rank 0.  Only the bounced sub-gets
+    re-route (rank 0's answer directly); every wait completes and all
+    values land bit-exact."""
+    cl = LoopbackCluster(num_workers=1, num_servers=2, env_extra={
+        "PS_ELASTIC": "1",
+        "PS_REQUEST_TIMEOUT": "2.0",
+        "PS_REQUEST_RETRIES": "8",
+    })
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    try:
+        nk, vl = 8, 4
+        keys = _spread_keys(nk) + np.uint64(7)
+        vals = np.arange(nk * vl, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+        base = cl.scheduler.routing_table()
+        doctored = RoutingTable(
+            epoch=base.epoch + 1, num_servers=2, active=(0, 1),
+            entries=tuple(
+                RouteEntry(e.begin, e.end,
+                           0 if e.owner == 1 else e.owner)
+                for e in base.entries
+            ),
+        )
+        r0 = next(s for s in servers
+                  if s.po.van.my_node.id == server_rank_to_id(0))
+        r1 = next(s for s in servers
+                  if s.po.van.my_node.id == server_rank_to_id(1))
+        for k, v in list(r1._handle.store.items()):
+            r0._handle.store[k] = v.copy()
+        cl.scheduler.apply_routing(doctored)
+        for s in (r0, r1):
+            s.po.apply_routing(doctored)
+        # The worker still slices under the OLD epoch: rank-1 sub-gets
+        # bounce and re-route; rank-0 sub-gets answer directly.
+        bounced0 = w.po.metrics.counter("kv.wrong_owner_bounces").value
+        p0 = r0._c_pull_reqs.value
+        handle = w.multi_get([keys[i:i + 1] for i in range(nk)],
+                             val_len=vl)
+        handle.wait()
+        assert handle.errors == {}
+        for i in range(nk):
+            np.testing.assert_array_equal(
+                handle.outs[i], vals[i * vl:(i + 1) * vl])
+        bounced = (w.po.metrics.counter("kv.wrong_owner_bounces").value
+                   - bounced0)
+        assert bounced >= 1  # the rank-1 half re-routed ...
+        assert r1._c_wrong_owner.value >= 1
+        # ... and ONLY that half: rank 0 saw exactly one pull per
+        # sub-get (its own half directly + the re-routed half), never
+        # a duplicate from an unbounced sub-get re-slicing.
+        assert r0._c_pull_reqs.value - p0 == nk
+    finally:
+        for ww in [w]:
+            ww.stop()
+        for s in servers:
+            s.stop()
+        for po in cl.all_nodes():
+            try:
+                po.van.stop()
+            except Exception:  # noqa: BLE001 - already stopped
+                pass
+
+
+# -- response aggregation of separate frames ---------------------------------
+
+
+def test_separate_frames_aggregate_responses():
+    """Requests too large to merge on the request side (tiny
+    PS_BATCH_BYTES) still get their RESPONSES aggregated: the server's
+    response combiner coalesces acks of separate frames toward the
+    probed sender, the store stays bit-exact, and the response
+    counters land on the resp-direction ledger."""
+    cl, servers, w = _cluster(num_servers=1, env_extra={
+        "PS_BATCH_BYTES": "64",
+        "PS_RESP_BATCH_BYTES": "65536",
+    })
+    try:
+        keys = np.array([3], np.uint64)
+        w.wait(w.push(keys, np.ones(64, np.float32)))  # probe warms
+        tss = [w.push(keys, np.ones(64, np.float32)) for _ in range(80)]
+        for ts in tss:
+            w.wait(ts)
+        out = np.zeros(64, np.float32)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_array_equal(out, np.full(64, 81.0, np.float32))
+        wvan, svan = cl.workers[0].van, cl.servers[0].van
+        assert wvan._c_batched_frames.value == 0  # nothing merged out
+        assert svan._c_resp_batched_frames.value > 0
+        assert (svan._c_resp_batch_ops.value
+                > svan._c_resp_batched_frames.value)
+    finally:
+        _teardown(cl, servers, w)
+
+
+def test_unproved_sender_never_sees_aggregated_response():
+    """Capability gate: a worker that never probed and never sent an
+    EXT_BATCH frame (batching off) gets ONLY plain responses, even
+    with the server's response combiner explicitly on."""
+    cl = LoopbackCluster(num_workers=1, num_servers=1, env_extra={
+        "PS_BATCH_BYTES": "0",
+        "PS_RESP_BATCH_BYTES": "65536",
+    })
+    cl.start()
+    s = KVServer(0, postoffice=cl.servers[0])
+    s.set_request_handle(KVServerDefaultHandle())
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    try:
+        assert s._resp_combiner is not None  # plane on server-side
+        keys = np.array([1], np.uint64)
+        tss = [w.push(keys, np.ones(8, np.float32)) for _ in range(40)]
+        for ts in tss:
+            w.wait(ts)
+        out = np.zeros(8, np.float32)
+        w.wait(w.pull(keys, out))
+        np.testing.assert_array_equal(out, np.full(8, 40.0, np.float32))
+        assert cl.servers[0].van._c_resp_batched_frames.value == 0
+        assert not s._batch_senders
+    finally:
+        w.stop()
+        s.stop()
+        cl.finalize()
+
+
+def test_response_combiner_carries_option_and_stamp():
+    """Unit: response-direction build/split round-trips per-op result
+    codes and hot-cache stamps, and response-mode batchable accepts
+    empty-data acks while declining error-marked frames (they ride as
+    singles in position)."""
+
+    def _resp(ts, key, stamp=0, option=0, data=True):
+        msg = Message()
+        m = msg.meta
+        m.head = 0
+        m.request = False
+        m.push = not data
+        m.pull = data
+        m.timestamp = ts
+        m.key = key
+        m.recver = 9
+        m.stamp = stamp
+        m.option = option
+        if data:
+            msg.add_data(SArray(np.array([key], np.uint64)))
+            msg.add_data(SArray(np.ones(4, np.float32)))
+        return msg
+
+    a = _resp(1, 10, stamp=7)
+    b = _resp(2, 11, stamp=8)
+    ack = _resp(3, 12, data=False)
+    err = _resp(4, 13, option=3)
+    assert batchable(a, response=True)
+    assert batchable(ack, response=True)  # empty-data ack merges
+    assert not batchable(err, response=True)  # option != 0: single
+    assert not batchable(a)  # request-direction check still strict
+    env = build_batch_message([a, b, ack])
+    assert env.meta.request is False
+    subs = split_batch_message(env)
+    assert [s.meta.stamp for s in subs] == [7, 8, 0]
+    assert [s.meta.timestamp for s in subs] == [1, 2, 3]
+    assert len(subs[2].data) == 0
+    np.testing.assert_array_equal(subs[0].data[1].numpy(),
+                                  np.ones(4, np.float32))
+    # An OpCombiner in response mode emits [batch(3), err single] for
+    # the run above — order preserved, error as a single in position.
+    sent = []
+    c = OpCombiner(lambda m: sent.append(m) or 0,
+                   lambda msgs, exc: None, max_bytes=1 << 20,
+                   response=True)
+    c._flush([(a, 16, True), (b, 16, True), (ack, 0, True),
+              (err, 16, False)])
+    shapes = [len(m.meta.batch.ops) if m.meta.batch else 1 for m in sent]
+    assert shapes == [3, 1]
+    assert sent[1] is err
+
+
+def test_submit_many_flushes_whole_fanout_immediately():
+    """submit_many marks every touched lane flush-ready: the whole
+    fan-out leaves as one frame per lane at the next pickup, with no
+    adaptive hold."""
+    sent = []
+    done = threading.Event()
+
+    def send(m):
+        sent.append(m)
+        if len(sent) >= 2:
+            done.set()
+        return 0
+
+    c = OpCombiner(send, lambda msgs, exc: None, max_bytes=1 << 20,
+                   min_ops=1000, hold_max_us=2_000_000)
+    msgs = []
+    for dest in (8, 10):
+        for i in range(5):
+            msg = Message()
+            m = msg.meta
+            m.request = True
+            m.timestamp = dest * 100 + i
+            m.key = i
+            m.head = 0
+            m.push = True
+            m.recver = dest
+            msg.add_data(SArray(np.array([i], np.uint64)))
+            msg.add_data(SArray(np.ones(4, np.float32)))
+            msgs.append(msg)
+    c.submit_many(msgs)
+    assert done.wait(5.0)  # flushed despite min_ops=1000 / 2s hold
+    assert len(sent) == 2
+    assert sorted(len(m.meta.batch.ops) for m in sent) == [5, 5]
+    c.stop()
+
+
+def test_psmon_resp_ops_per_frame_column():
+    """psmon renders the response-direction aggregation column from
+    the server-origin van counters."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import psmon
+
+    snap = {
+        8: {"role": "server", "metrics": {
+            "uptime_s": 5.0,
+            "counters": {"van.resp_batched_frames": 4,
+                         "van.resp_batch_ops": 128},
+        }},
+        9: {"role": "worker", "metrics": {
+            "uptime_s": 5.0,
+            "counters": {"van.batched_frames": 2,
+                         "van.batch_ops": 64},
+        }},
+    }
+    table = psmon.format_table(snap)
+    assert "resp ops/F" in table
+    assert "32.0" in table  # 128 / 4 on the server row
+    assert "32.0" in [c.strip() for line in table.splitlines()
+                      for c in [line[-11:]] if "server" in line]

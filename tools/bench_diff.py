@@ -63,6 +63,14 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "small_op_batching_msgs_ratio": "higher",
     "small_op_batching_batched_msgs_per_s": "higher",
     "small_op_batching_low_load_p50_ratio": "lower",
+    # serving_fanin (docs/batching.md) — multi-get + response
+    # aggregation: the requests/s multiple of the fan-in plane, the
+    # ~1-RTT response-frames-per-request it must hold, and the
+    # low-load single-pull latency it must not cost.
+    "serving_fanin_req_ratio": "higher",
+    "serving_fanin_agg_reqs_per_s": "higher",
+    "serving_fanin_frames_per_req": "lower",
+    "serving_fanin_low_load_p50_ratio": "lower",
     # elastic_scale (docs/elasticity.md) — the serving tail must stay
     # bounded through a live 2->4->2 migration window, and the scale
     # round trip itself must not regress.
@@ -83,8 +91,8 @@ TRANSPORT_METRICS: Dict[str, str] = {
 # metric regression) rather than failed.
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
-    "multi_tenant_", "small_op_batching_", "elastic_", "kv_",
-    "fault_recovery_", "van_",
+    "multi_tenant_", "small_op_batching_", "serving_fanin_",
+    "elastic_", "kv_", "fault_recovery_", "van_",
 )
 
 

@@ -89,7 +89,7 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
            f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7} "
-           f"{'epoch':>5} {'ops/F':>6}")
+           f"{'epoch':>5} {'ops/F':>6} {'resp ops/F':>10}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
     # Elastic membership (docs/elasticity.md): per-node routing epoch
@@ -132,17 +132,24 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         epoch = (f"{routing['epoch']:>5}" if "epoch" in routing
                  else f"{'-':>5}")
         # Small-op aggregation depth this node SENT at (docs/
-        # batching.md): sub-ops per multi-op frame.  "-" when the node
-        # never emitted an EXT_BATCH frame (combiner off, or nothing
-        # coalesced).
+        # batching.md): sub-ops per multi-op frame, split by
+        # direction — request frames (worker op combiner) and
+        # response frames (server batched group responses + response
+        # combiner, the serving fan-in plane).  "-" when the node
+        # never emitted an EXT_BATCH frame in that direction
+        # (combiner off, nothing coalesced, or PS_TELEMETRY=0).
         bframes = _c(m, "van.batched_frames")
         bops = _c(m, "van.batch_ops")
         opsf = (f"{bops / bframes:>6.1f}" if bframes > 0 else f"{'-':>6}")
+        rframes = _c(m, "van.resp_batched_frames")
+        rops = _c(m, "van.resp_batch_ops")
+        ropsf = (f"{rops / rframes:>10.1f}" if rframes > 0
+                 else f"{'-':>10}")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf}"
+            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf} {ropsf}"
         )
         if routing:
             owned = routing.get("owned")
