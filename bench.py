@@ -588,13 +588,28 @@ def _transport_sections(quick: bool) -> list:
         # Registry snapshot embedded in the emitted record
         # (docs/observability.md): a live loopback KV storm's
         # counters + histogram quantiles land next to the throughput
-        # numbers so perf regressions come with their context.
+        # numbers so perf regressions come with their context.  Rates
+        # are WINDOWED (counter deltas over the measured interval,
+        # per-node "windowed_per_s" sub-dicts) — uptime averages fold
+        # bootstrap time into the denominator and go stale within
+        # minutes.  The kv_windowed_* roll-ups are context only:
+        # bench_diff ignores them (interval-dependent, host-noisy).
         from pslite_tpu.benchmark import kv_loopback_storm
 
         storm = kv_loopback_storm(msgs_per_worker=20 if quick else 60)
+        windowed = {}
+        for node, cond in storm["telemetry"].items():
+            for cname, rate in cond.get("windowed_per_s", {}).items():
+                if cname in ("kv.pushes", "kv.pulls",
+                             "apply.sharded_requests"):
+                    key = ("kv_windowed_"
+                           + cname.replace(".", "_") + "_per_s")
+                    windowed[key] = round(
+                        windowed.get(key, 0.0) + rate, 2)
         return {
             "kv_storm_msgs_per_s": storm["msgs_per_s"],
             "kv_storm_wall_s": storm["wall_s"],
+            **windowed,
             "telemetry": storm["telemetry"],
         }
 

@@ -876,6 +876,30 @@ def _teardown_cluster(nodes: list, workers: list, servers: list) -> None:
             pass
 
 
+# Counters whose WINDOWED rates ride the bench's kv_telemetry section
+# (deltas over the measured storm interval — docs/observability.md).
+_WINDOWED_COUNTERS = (
+    "van.sent_messages", "van.recv_messages", "kv.pushes", "kv.pulls",
+    "kv.server_push_requests", "kv.server_pull_requests",
+    "apply.sharded_requests", "apply.global_requests",
+    "qos.shed_requests", "resender.retransmits",
+)
+
+
+def _windowed_rates(pre: dict, post: dict, wall_s: float) -> dict:
+    """``{counter: delta/wall}`` for the curated counter set — only
+    counters the node actually has, negative deltas (registry reset)
+    dropped."""
+    out = {}
+    for name in _WINDOWED_COUNTERS:
+        if name not in post:
+            continue
+        delta = post[name] - pre.get(name, 0)
+        if delta >= 0:
+            out[name] = round(delta / max(wall_s, 1e-9), 2)
+    return out
+
+
 def _condense_snapshot(snap: dict) -> dict:
     """Registry snapshot condensed for a bench record: counters plus
     histogram quantiles (the raw buckets stay out of the JSON)."""
@@ -904,7 +928,10 @@ def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
 
     The returned ``wall_s`` clocks ONLY the storm (bootstrap excluded);
     ``telemetry`` is the per-node snapshot of every node after the
-    storm ({} when disabled).
+    storm ({} when disabled), each carrying a ``windowed_per_s``
+    sub-dict: counter DELTAS over the measured storm interval divided
+    by the wall — true windowed rates (docs/observability.md), not the
+    uptime averages that fold bootstrap time into every denominator.
     """
     from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
 
@@ -925,6 +952,15 @@ def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
         keys = np.arange(keys_per_msg, dtype=np.uint64) * span + 3
         vals = np.ones(keys_per_msg * val_len, np.float32)
         outs = [np.zeros_like(vals) for _ in workers]
+        # Pre-storm counter baseline: the windowed rates below are
+        # deltas over the MEASURED interval only (bootstrap excluded).
+        pre_counters = {}
+        if telemetry:
+            for po in nodes:
+                s = po.telemetry_snapshot()
+                pre_counters[f"{s['role']}{s['node_id']}"] = dict(
+                    s["metrics"].get("counters", {})
+                )
         t0 = time.perf_counter()
         for i in range(msgs_per_worker):
             tss = [w.push(keys, vals) for w in workers]
@@ -939,9 +975,14 @@ def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
         if telemetry:
             for po in nodes:
                 snap = po.telemetry_snapshot()
-                tel[f"{snap['role']}{snap['node_id']}"] = (
-                    _condense_snapshot(snap)
+                name = f"{snap['role']}{snap['node_id']}"
+                cond = _condense_snapshot(snap)
+                cond["windowed_per_s"] = _windowed_rates(
+                    pre_counters.get(name, {}),
+                    snap["metrics"].get("counters", {}),
+                    wall,
                 )
+                tel[name] = cond
         return {
             "wall_s": round(wall, 4),
             "msgs": total,

@@ -306,6 +306,25 @@ class ApplyShardPool:
             if not busy:
                 return True
             if time.monotonic() >= deadline or self._stopping:
+                if busy:
+                    # Flight recorder (docs/observability.md): a pool
+                    # that cannot drain within the quiesce deadline is
+                    # an apply stall — the smoking gun of a wedged
+                    # shard thread or a handler stuck in a lock.
+                    flight = getattr(
+                        getattr(self._server, "po", None), "flight", None
+                    )
+                    if flight is not None:
+                        with self._backlog_mu:
+                            pending = sum(
+                                1 for s in self._inflight_seqs
+                                if s <= token
+                            )
+                        flight.record(
+                            "apply_stall", severity="warn",
+                            pending=pending, timeout_s=timeout_s,
+                            stopping=self._stopping,
+                        )
                 return not busy
             time.sleep(0.002)
 

@@ -489,6 +489,11 @@ class KVWorker:
         self._req_retries = self.po.env.find_int("PS_REQUEST_RETRIES", 3)
         self._replication = self.po.env.find_int("PS_KV_REPLICATION", 1)
         self._down_servers: set = set()
+        # Dead ranks whose first failover re-route was already flight-
+        # recorded (one event per outage TRANSITION — _route runs per
+        # slice, and per-message recording would wrap the bounded ring
+        # with identical spam, evicting the context a postmortem needs).
+        self._failover_logged: set = set()
         self._pending: Dict[int, _PendingReq] = {}
         self._static_entries = None  # _route_entries cache (non-elastic)
         self._timeout_ts = BoundedKeySet(4096)
@@ -1499,6 +1504,9 @@ class KVWorker:
                 self._down_servers.add(node_id)
             else:
                 self._down_servers.discard(node_id)
+                # Re-arm the one-shot failover flight event: a fresh
+                # outage of the recovered rank is a NEW transition.
+                self._failover_logged.discard(node_id)
         if down:
             self._wake_sweeper()
 
@@ -1577,6 +1585,14 @@ class KVWorker:
             cand = server_rank_to_id(rank * gs + self.po.instance_idx)
             if cand not in self._down_servers:
                 self._c_failovers.inc()
+                if base not in self._failover_logged:
+                    # Flight recorder (docs/observability.md): ONE
+                    # event per outage transition naming the dead
+                    # primary and the replica absorbing its range
+                    # (re-armed when the rank recovers).
+                    self._failover_logged.add(base)
+                    self.po.flight.record("failover", severity="warn",
+                                          dead=base, replica=cand)
                 return cand
         return base
 
@@ -2386,6 +2402,9 @@ class KVServer:
         )
         self._c_shed = self.po.metrics.counter("qos.shed_requests")
         self._tenant_counters: Dict[int, tuple] = {}
+        # Per-tenant [last flight record monotonic, suppressed count]
+        # for coalesced overload_shed events (see _intake_admission).
+        self._shed_flight: Dict[int, list] = {}
         # Hot-key cache support (kv/hot_cache.py): the push-version
         # stamp.  Bumped AFTER a push fully applies (as its response
         # leaves); read at pull intake, so a pull response's stamp
@@ -2962,7 +2981,11 @@ class KVServer:
         if park_full:
             # Park buffer overflow: shed retryably (OPT_OVERLOAD)
             # rather than queue unbounded memory behind a slow handoff.
+            # Same coalescing as the admission path — a slow migration
+            # rejects at request rate.
             self._c_shed.inc()
+            self._record_shed_flight(m.tenant, m.sender, m.timestamp,
+                                     why="migration park buffer full")
             self.response_overload(meta)
             return True
         self._c_wrong_owner.inc()
@@ -3433,6 +3456,30 @@ class KVServer:
                 kvs.lens = data[2].astype_view(np.int32).numpy()
         return kvs, wire_payload
 
+    # Coalescing window for overload_shed flight events (seconds).
+    _SHED_FLIGHT_WINDOW_S = 0.5
+
+    def _record_shed_flight(self, tenant_id: int, sender: int, ts: int,
+                            **detail) -> None:
+        """Flight-record one shed, coalesced per tenant: sheds happen
+        at request rate under a storm, and per-event recording would
+        wrap the bounded ring with identical spam (evicting the
+        failover/epoch/stall context a postmortem needs).  At most one
+        event per tenant per window, carrying the suppressed count.
+        Runs on the single processing thread — no lock."""
+        ent = self._shed_flight.setdefault(tenant_id, [0.0, 0])
+        now = time.monotonic()
+        if now - ent[0] >= self._SHED_FLIGHT_WINDOW_S:
+            self.po.flight.record(
+                "overload_shed", severity="warn",
+                tenant=self.tenants.name(tenant_id),
+                sender=sender, ts=ts, coalesced=ent[1], **detail,
+            )
+            ent[0] = now
+            ent[1] = 0
+        else:
+            ent[1] += 1
+
     def _intake_admission(self, meta: KVMeta, extra: int = 0) -> bool:
         """Per-tenant admission at intake (docs/qos.md): counts the
         request against its tenant and returns True when it must be
@@ -3446,6 +3493,11 @@ class KVServer:
         if self._admission_overloaded(meta.tenant, extra=extra):
             self._c_shed.inc()
             self._tenant_counter(meta.tenant, "shed").inc()
+            # Flight recorder (docs/observability.md): sheds are the
+            # watchdog's primary overload signal; coalesced per tenant
+            # (see _record_shed_flight).
+            self._record_shed_flight(meta.tenant, meta.sender,
+                                     meta.timestamp)
             return True
         return False
 

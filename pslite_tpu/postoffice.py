@@ -34,6 +34,7 @@ from .base import (
 )
 from .message import Command, Control, Message, Node, Role
 from .range import Range
+from .telemetry.flight import FlightRecorder
 from .telemetry.metrics import Registry
 from .telemetry.tracing import Tracer
 from .utils import logging as log
@@ -102,17 +103,33 @@ class Postoffice:
         self._build_node_id_table()
 
         # Per-NODE telemetry (docs/observability.md): one metrics
-        # registry + one tracer per Postoffice — per-node even when many
-        # logical nodes share a test process.  Created BEFORE the van so
-        # transports can instrument from __init__.
+        # registry + one tracer + one fault flight recorder per
+        # Postoffice — per-node even when many logical nodes share a
+        # test process.  Created BEFORE the van so transports can
+        # instrument from __init__.
         self.metrics = Registry(
             enabled=self.env.find_bool("PS_TELEMETRY", True)
         )
-        self.tracer = Tracer(self.env, self.role_str())
-        # METRICS_PULL collection state (scheduler side).
+        self.tracer = Tracer(self.env, self.role_str(),
+                             metrics=self.metrics)
+        self.flight = FlightRecorder(self.env, self.role_str())
+        # METRICS_PULL collection state (scheduler side).  _collect_mu
+        # serializes whole pulls: the ClusterHistory sampler thread and
+        # psmon/--serve scrape threads may pull concurrently, and an
+        # unserialized second pull would bump the token mid-collection,
+        # discarding the first caller's in-flight replies as stale (a
+        # truncated snapshot reads as stale nodes → false node_stale
+        # watchdog events on a healthy cluster).
+        self._collect_mu = threading.Lock()
         self._metrics_cv = threading.Condition()
         self._metrics_token = 0
         self._metrics_replies: Dict[int, dict] = {}
+        self._metrics_last_seen: Dict[int, float] = {}
+        # Continuous telemetry plane (docs/observability.md): the
+        # scheduler's ClusterHistory sampler + SLO watchdog.  Lazily
+        # built by start_history(); started automatically by start()
+        # when PS_METRICS_INTERVAL > 0.
+        self.history = None  # Optional[telemetry.ClusterHistory]
 
         van_type = self.env.find("PS_VAN_TYPE") or self.env.find(
             "DMLC_ENABLE_RDMA"
@@ -193,6 +210,7 @@ class Postoffice:
 
     def on_id_assigned(self, node: Node) -> None:
         self.tracer.node_id = node.id
+        self.flight.node_id = node.id
         log.vlog(1, f"assigned id {node.id} (rank {id_to_rank(node.id)}) to me")
 
     # -- group membership ----------------------------------------------------
@@ -242,6 +260,11 @@ class Postoffice:
         # original cohort passed it long ago (reference: van.cc:292-332).
         if do_barrier and not self.van.my_node.is_recovery:
             self.barrier(customer_id, ALL_GROUP, instance=True)
+        # Continuous telemetry (docs/observability.md): the scheduler's
+        # background METRICS_PULL sampler, default off.
+        if (self.is_scheduler
+                and self.env.find_float("PS_METRICS_INTERVAL", 0.0) > 0):
+            self.start_history()
         log.vlog(1, f"{self.role_str()}[{self.instance_idx}] started")
 
     def finalize(self, customer_id: int = 0, do_barrier: bool = True) -> None:
@@ -379,9 +402,24 @@ class Postoffice:
             self.num_servers = table.num_servers
             self._active_server_ranks = list(table.active)
             self._build_node_id_table()
+            # Departed servers must not linger as perpetual STALE rows
+            # in psmon (metrics_last_seen feeds its last-seen ages).
+            live = set(table.active) | set(table.leaving)
+            with self._metrics_cv:
+                for nid in list(self._metrics_last_seen):
+                    if (is_server_id(nid)
+                            and id_to_rank(nid) // self.group_size
+                            not in live):
+                        del self._metrics_last_seen[nid]
         log.vlog(1, f"routing epoch {table.epoch}: active="
                     f"{list(table.active)} leaving={list(table.leaving)} "
                     f"entries={len(table.entries)}")
+        # Flight recorder (docs/observability.md): membership changes
+        # are the context every fault postmortem needs first.
+        self.flight.record(
+            "epoch_change", severity="info", epoch=table.epoch,
+            active=list(table.active), leaving=list(table.leaving),
+        )
         with self._routing_hook_mu:
             hooks = list(self._routing_hooks)
         for hook in hooks:
@@ -588,6 +626,7 @@ class Postoffice:
             log.warning(f"bad METRICS_PULL reply: {exc!r}")  # not wedge
             snap = {"node_id": msg.meta.sender, "error": repr(exc)}
         with self._metrics_cv:
+            self._metrics_last_seen[msg.meta.sender] = time.time()
             if msg.meta.timestamp != self._metrics_token:
                 return  # stale reply from an earlier (timed-out) pull
             self._metrics_replies[msg.meta.sender] = snap
@@ -601,42 +640,84 @@ class Postoffice:
         (psmon flags them); a down peer is skipped up front."""
         log.check(self.is_scheduler,
                   "collect_cluster_metrics runs on the scheduler")
-        peers = [
-            i for i in self.get_node_ids(WORKER_GROUP + SERVER_GROUP)
-            if not self.van.is_peer_down(i)
-        ]
+        with self._collect_mu:
+            peers = [
+                i for i in self.get_node_ids(WORKER_GROUP + SERVER_GROUP)
+                if not self.van.is_peer_down(i)
+            ]
+            with self._metrics_cv:
+                self._metrics_token += 1
+                token = self._metrics_token
+                self._metrics_replies = {}
+            reached = 0
+            for peer in peers:
+                msg = Message()
+                msg.meta.recver = peer
+                msg.meta.sender = self.van.my_node.id
+                msg.meta.request = True
+                msg.meta.timestamp = token
+                msg.meta.control = Control(cmd=Command.METRICS_PULL)
+                try:
+                    self.van.send(msg)
+                    reached += 1
+                except Exception as exc:  # noqa: BLE001 - a dead peer
+                    # must not fail the whole pull — and must not count
+                    # toward the expected replies either, or every pull
+                    # would stall the full timeout waiting on a peer
+                    # that was never asked.
+                    log.warning(f"METRICS_PULL to {peer} failed: {exc!r}")
+            deadline = time.monotonic() + timeout_s
+            with self._metrics_cv:
+                while len(self._metrics_replies) < reached:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._metrics_cv.wait(remaining)
+                replies = dict(self._metrics_replies)
+            out = {self.van.my_node.id: self.telemetry_snapshot()}
+            out.update(replies)
+            return out
+
+    def metrics_last_seen(self) -> Dict[int, float]:
+        """Scheduler-side: wall time of each node's most recent
+        METRICS_PULL reply — psmon renders nodes missing from the
+        newest pull with a last-seen age instead of dropping them."""
         with self._metrics_cv:
-            self._metrics_token += 1
-            token = self._metrics_token
-            self._metrics_replies = {}
-        reached = 0
-        for peer in peers:
-            msg = Message()
-            msg.meta.recver = peer
-            msg.meta.sender = self.van.my_node.id
-            msg.meta.request = True
-            msg.meta.timestamp = token
-            msg.meta.control = Control(cmd=Command.METRICS_PULL)
-            try:
-                self.van.send(msg)
-                reached += 1
-            except Exception as exc:  # noqa: BLE001 - a dead peer must
-                # not fail the whole pull — and must not count toward
-                # the expected replies either, or every pull would
-                # stall the full timeout waiting on a peer that was
-                # never asked.
-                log.warning(f"METRICS_PULL to {peer} failed: {exc!r}")
-        deadline = time.monotonic() + timeout_s
-        with self._metrics_cv:
-            while len(self._metrics_replies) < reached:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._metrics_cv.wait(remaining)
-            replies = dict(self._metrics_replies)
-        out = {self.van.my_node.id: self.telemetry_snapshot()}
-        out.update(replies)
-        return out
+            return dict(self._metrics_last_seen)
+
+    # -- continuous telemetry plane (docs/observability.md) ------------------
+
+    def start_history(self, interval_s: Optional[float] = None):
+        """Build (and start, when the interval is positive) the
+        scheduler's :class:`~.telemetry.ClusterHistory` sampler +
+        watchdog.  Idempotent; returns the history."""
+        log.check(self.is_scheduler, "ClusterHistory runs on the scheduler")
+        if self.history is None:
+            from .telemetry.timeseries import ClusterHistory
+
+            self.history = ClusterHistory(
+                po=self, env=self.env, interval_s=interval_s
+            )
+        if interval_s is not None and interval_s > 0:
+            self.history.interval_s = float(interval_s)
+        if self.history.interval_s > 0 and not self.history.running:
+            self.history.start()
+        return self.history
+
+    def stop_history(self) -> None:
+        h = self.history
+        if h is not None:
+            h.stop()
+
+    def health(self, min_severity: str = "warn",
+               since: Optional[float] = None) -> List:
+        """The watchdog's :class:`~.telemetry.HealthEvent` findings
+        (scheduler-side; empty on nodes without a history — per-node
+        fault context lives in ``po.flight`` instead)."""
+        h = self.history
+        if h is None:
+            return []
+        return h.watchdog.events(min_severity=min_severity, since=since)
 
     # -- node failure hooks --------------------------------------------------
 

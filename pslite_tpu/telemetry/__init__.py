@@ -8,16 +8,39 @@ request lifecycles are stitched across processes by
 the scheduler can snapshot every node's registry over the control plane
 (``Command.METRICS_PULL`` — see ``tools/psmon.py``).
 
+On top of the point-in-time plane sits the CONTINUOUS tier
+(docs/observability.md): :class:`~.timeseries.ClusterHistory` (a
+scheduler-side sampler deriving windowed rates/quantiles from snapshot
+deltas), the :class:`~.health.Watchdog` SLO rules it feeds
+(``Postoffice.health()``), and the per-node
+:class:`~.flight.FlightRecorder` fault ring dumped on abnormal
+shutdown.
+
 Env knobs (docs/observability.md):
 
 - ``PS_TELEMETRY`` (default 1): 0 swaps every instrument for a shared
   no-op singleton — near-zero cost, empty snapshots.
 - ``PS_TRACE_SAMPLE`` (default 0): probability in [0, 1] that a
   ``KVWorker.push/pull`` mints a trace id; 0 disables tracing.
-- ``PS_TRACE_DIR``: directory for per-node Chrome trace-event JSON
-  exports (default: current directory).
+- ``PS_TRACE_DIR``: directory for the per-node Chrome trace-event JSON
+  exports and flight-recorder dumps (default: system tempdir).
+- ``PS_METRICS_INTERVAL`` (default 0 = off): the scheduler's
+  background METRICS_PULL sampling period in seconds.
+- ``PS_METRICS_HISTORY`` (default 512): snapshots retained per node.
+- ``PS_SLO``: watchdog threshold overrides (``rule=warn:crit``).
+- ``PS_FLIGHT_EVENTS`` (default 1024): flight-recorder ring size.
 """
 
+from .flight import FlightRecorder, NULL_FLIGHT  # noqa: F401
+from .health import (  # noqa: F401
+    CRIT,
+    HealthEvent,
+    INFO,
+    Rule,
+    WARN,
+    Watchdog,
+    parse_slo,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -25,5 +48,8 @@ from .metrics import (  # noqa: F401
     NULL_REGISTRY,
     Registry,
     TopK,
+    bucket_quantile,
+    merge_bucket_lists,
 )
+from .timeseries import ClusterHistory, NodeSeries  # noqa: F401
 from .tracing import NULL_TRACER, Tracer  # noqa: F401

@@ -326,6 +326,58 @@ class Registry:
             inst.reset()
 
 
+def merge_bucket_lists(*bucket_lists) -> Dict[int, int]:
+    """Sum several snapshot ``buckets`` lists (``[[index, count], ...]``
+    — the raw log2 buckets every histogram snapshot carries) into one
+    ``{index: count}`` table.  The exact-merge primitive behind psmon's
+    combined push+pull quantile and the windowed quantiles of
+    ``timeseries.ClusterHistory`` (two histograms with the same ``lo``
+    share bucket geometry, so merging counts IS merging populations)."""
+    out: Dict[int, int] = {}
+    for buckets in bucket_lists:
+        for item in buckets or []:
+            try:
+                i, n = int(item[0]), int(item[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if n > 0:
+                out[i] = out.get(i, 0) + n
+    return out
+
+
+def bucket_quantile(counts: Dict[int, int], lo: float, q: float,
+                    clamp_lo: Optional[float] = None,
+                    clamp_hi: Optional[float] = None) -> float:
+    """Estimated q-quantile from a ``{bucket_index: count}`` table with
+    bucket geometry ``lo`` (the same log2 layout as :class:`Histogram`;
+    same geometric-midpoint estimate as :meth:`Histogram.quantile`).
+    Returns 0.0 for an empty table.  ``clamp_lo``/``clamp_hi`` bound
+    the estimate like the live histogram's observed min/max do."""
+    total = sum(n for n in counts.values() if n > 0)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    est = 0.0
+    # acc always reaches total >= target for q <= 1, so the break is
+    # guaranteed; est stays 0.0 (then clamps) for a degenerate q > 1.
+    for i in sorted(counts):
+        n = counts[i]
+        if n <= 0:
+            continue
+        acc += n
+        if acc >= target:
+            hi = lo * (2.0 ** i)
+            lo_b = hi / 2.0 if i else 0.0
+            est = (lo_b * hi) ** 0.5 if lo_b > 0 else hi / 2.0
+            break
+    if clamp_lo is not None:
+        est = max(est, clamp_lo)
+    if clamp_hi is not None:
+        est = min(est, clamp_hi)
+    return est
+
+
 NULL_REGISTRY = Registry(enabled=False)
 
 

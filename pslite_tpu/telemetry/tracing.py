@@ -35,7 +35,7 @@ class Tracer:
 
     MAX_EVENTS = 65536
 
-    def __init__(self, env, role: str):
+    def __init__(self, env, role: str, metrics=None):
         self.sample = env.find_float("PS_TRACE_SAMPLE", 0.0)
         self.active = self.sample > 0.0
         self.role = role
@@ -48,6 +48,17 @@ class Tracer:
         self._mu = threading.Lock()
         self._events: List[dict] = []
         self.dropped = 0
+        # Silent span loss made visible (docs/observability.md): every
+        # buffer-full drop also counts on the node registry, so the
+        # METRICS_PULL snapshot carries ``trace.dropped_events`` and
+        # psmon can warn that the exported trace is INCOMPLETE.  The
+        # legacy ``dropped`` attribute remains the local read view.
+        if metrics is not None:
+            self._c_dropped = metrics.counter("trace.dropped_events")
+        else:
+            from .metrics import NULL_REGISTRY
+
+            self._c_dropped = NULL_REGISTRY.counter("trace.dropped_events")
         # Cross-node clock alignment: durations come from monotonic_ns,
         # absolute timestamps re-base onto ONE wall anchor per tracer
         # (the Profiler's timebase — utils/profiling.MonotonicAnchor).
@@ -73,6 +84,7 @@ class Tracer:
         with self._mu:
             if len(self._events) >= self.MAX_EVENTS:
                 self.dropped += 1
+                self._c_dropped.inc()
                 return
             self._events.append(ev)
 

@@ -175,6 +175,16 @@ class Resender:
                 log.warning(
                     f"Failed to deliver ({why}): {msg.debug_string()}"
                 )
+                # Flight recorder (docs/observability.md): a give-up is
+                # the terminal fault of the reliability layer — the
+                # postmortem wants the peer, the retry count, and why.
+                flight = getattr(self._van, "flight", None)
+                if flight is not None:
+                    flight.record(
+                        "retransmit_giveup", severity="warn",
+                        peer=msg.meta.recver, retries=retries, why=why,
+                        ts=msg.meta.timestamp,
+                    )
                 # Fail the owning request (or park a van error) instead
                 # of the old silent delete, which left the waiting
                 # caller hanging forever on a message the resender had

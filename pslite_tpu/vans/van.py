@@ -157,6 +157,12 @@ class Van:
             node_metrics if node_metrics is not None else self.metrics
         )
         self.tracer = getattr(postoffice, "tracer", None) or NULL_TRACER
+        # Fault flight recorder (docs/observability.md): the bounded
+        # per-node ring of health-relevant events, dumped on abnormal
+        # stop.  Stub postoffices get the no-op recorder.
+        from ..telemetry.flight import NULL_FLIGHT
+
+        self.flight = getattr(postoffice, "flight", None) or NULL_FLIGHT
         self._c_sent_msgs = self.metrics.counter("van.sent_messages")
         self._c_sent_bytes = self.metrics.counter("van.sent_bytes")
         self._c_recv_msgs = self.metrics.counter("van.recv_messages")
@@ -196,6 +202,10 @@ class Van:
         self._h_hol_wait = self._node_metrics.histogram("van.hol_wait_s")
         self._node_metrics.gauge("van.xfers_inflight",
                                  fn=self._owner_xfer_depth)
+        # METRICS_PULL replies this node failed to send (the collector
+        # sees only absence; the counter names the failing side).
+        self._c_pull_reply_failures = self._node_metrics.counter(
+            "van.metrics_pull_failures")
         # Scheduler-side registration state.
         self._registrations: List[Node] = []
         self._registered_addrs: Dict[str, int] = {}  # addr -> assigned id
@@ -418,6 +428,16 @@ class Van:
             self._connected_nodes[addr] = node.id
 
     def stop(self) -> None:
+        # The scheduler's metrics sampler pulls through this van; stop
+        # it first so no METRICS_PULL broadcast races the teardown
+        # (every teardown path funnels through Van.stop, including the
+        # test harnesses that never call Postoffice.finalize).
+        stop_history = getattr(self.po, "stop_history", None)
+        if stop_history is not None:
+            try:
+                stop_history()
+            except Exception as exc:  # noqa: BLE001 - best-effort
+                log.warning(f"history stop failed: {exc!r}")
         self._drain_send_lanes()
         if self.resender is not None:
             # Flush unacked messages (e.g. barrier replies a lossy link
@@ -452,6 +472,22 @@ class Van:
             self.tracer.export_if_any()
         except Exception as exc:  # noqa: BLE001 - teardown best-effort
             log.warning(f"trace export failed: {exc!r}")
+        # Flight recorder (docs/observability.md): an ABNORMAL stop
+        # (CHECK failure, pump give-up, chaos crash, any CRIT event)
+        # dumps the fault ring for the postmortem; clean stops don't.
+        chaos_crashed = getattr(self, "chaos_crashed", None)
+        if chaos_crashed is not None and chaos_crashed.is_set():
+            self.flight.record("chaos_crash", severity="crit",
+                               phase=str(getattr(
+                                   self, "chaos", None
+                               ) and self.chaos.spec.get("crash_phase")))
+        try:
+            path = self.flight.dump_if_abnormal()
+            if path:
+                log.warning(f"abnormal stop: flight recorder dumped to "
+                            f"{path} ({self.flight.abnormal_reason})")
+        except Exception as exc:  # noqa: BLE001 - teardown best-effort
+            log.warning(f"flight dump failed: {exc!r}")
         self.ready.clear()
         self._init_stage = 0
 
@@ -839,6 +875,8 @@ class Van:
             f"delivery to node {m.recver} failed ({exc}); failing "
             f"local request ts={m.timestamp}"
         )
+        self.flight.record("send_failed", severity="warn", peer=m.recver,
+                           ts=m.timestamp, error=repr(exc)[:200])
         # A multi-op batch frame (docs/batching.md) carries N waiters,
         # each with its OWN timestamp: synthesize one OPT_SEND_FAILED
         # per sub-op — failing only the envelope's (first) timestamp
@@ -890,6 +928,9 @@ class Van:
                     f"failure detector: node {d} silent for more than "
                     f"{timeout_s}s — declaring dead"
                 )
+                self.flight.record("node_down", severity="warn", peer=d,
+                                   detector="heartbeat",
+                                   timeout_s=timeout_s)
                 self.mark_peer_down(d)
                 dead_nodes.append(Node(
                     role=Role.SERVER if is_server_id(d) else Role.WORKER,
@@ -935,6 +976,7 @@ class Van:
                     self.po.notify_node_failure(node.id, False)
                     continue
                 log.warning(f"peer {node.id} rehabilitated by the scheduler")
+                self.flight.record("node_up", severity="info", peer=node.id)
                 self.clear_peer_down(node.id)
                 self.po.notify_node_failure(node.id, False)
             return
@@ -947,6 +989,7 @@ class Van:
                             "scheduler; continuing to serve")
                 continue
             log.warning(f"peer {node.id} declared dead by the scheduler")
+            self.flight.record("node_down", severity="warn", peer=node.id)
             self.mark_peer_down(node.id)
             self.po.notify_node_failure(node.id, True)
 
@@ -979,6 +1022,7 @@ class Van:
             # transport error.
             self._dispatch_send(reply)
         except Exception as exc:  # noqa: BLE001
+            self._c_pull_reply_failures.inc()
             log.warning(f"METRICS_PULL reply failed: {exc!r}")
 
     # -- elastic membership (docs/elasticity.md) -----------------------------
@@ -1200,12 +1244,19 @@ class Van:
                 log.warning(
                     f"recv_msg failed (budget {error_budget:.0f}): {exc!r}"
                 )
+                self.flight.record("van_error", severity="warn",
+                                   error=repr(exc)[:200],
+                                   budget=round(error_budget, 1))
                 if error_budget >= 100.0:
                     # fatal_log, not a (nonexistent) log.error: the old
                     # attribute error would have killed the pump with an
                     # AttributeError instead of this message.
                     log.fatal_log("receive pump giving up after repeated "
                                   "decode failures")
+                    self.flight.record("van_error", severity="crit",
+                                       error="receive pump gave up after "
+                                             "repeated decode failures")
+                    self.flight.dump()
                     break
                 continue
             if msg is None:
@@ -1272,6 +1323,15 @@ class Van:
                     f"{msg.debug_string()}); node going dark "
                     f"(pump + heartbeat terminating)"
                 )
+                # The crash postmortem: record + dump the flight ring
+                # NOW — with PS_CHECK_FATAL the process is about to
+                # _exit and no Van.stop() will ever run.
+                self.flight.record("check_failure", severity="crit",
+                                   error=str(exc)[:200])
+                try:
+                    self.flight.dump()
+                except Exception:  # noqa: BLE001 - dying anyway
+                    pass
                 self._stop_event.set()
                 if self.env.find_bool("PS_CHECK_FATAL", True):
                     sys.stderr.flush()

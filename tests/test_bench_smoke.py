@@ -190,17 +190,21 @@ def test_bench_cli_contract(tmp_path):
 
 def test_telemetry_overhead_guard():
     """The telemetry layer must never silently become the bottleneck:
-    the kv loopback storm with PS_TELEMETRY on stays within 10% of
-    telemetry-off on the stub bench (min-of-3 per leg to damp scheduler
-    noise, plus a small absolute epsilon for sub-second walls)."""
+    the kv loopback storm with PS_TELEMETRY on — INCLUDING the
+    continuous METRICS_PULL sampler at a 1 s interval
+    (docs/observability.md) — stays within 10% of telemetry-off on the
+    stub bench (min-of-3 per leg to damp scheduler noise, plus a small
+    absolute epsilon for sub-second walls)."""
     from pslite_tpu.benchmark import kv_loopback_storm
 
     def best(telemetry: bool) -> float:
         walls = []
+        extra = {"PS_METRICS_INTERVAL": "1"} if telemetry else None
         for _ in range(3):
             r = kv_loopback_storm(
                 n_workers=2, n_servers=2, msgs_per_worker=40,
                 keys_per_msg=8, val_len=512, telemetry=telemetry,
+                env_extra=extra,
             )
             walls.append(r["wall_s"])
         return min(walls)

@@ -122,10 +122,19 @@ def newest_two(directory: str) -> Optional[Tuple[str, str]]:
     return recs[-2], recs[-1]
 
 
+# Top-level fields that are context-only by construction and never
+# comparable across rounds: the kv_telemetry section's windowed-rate
+# roll-ups depend on the measured interval and host load, so diffing
+# them only produces noise lines (docs/observability.md).
+IGNORED_PREFIXES = ("kv_windowed_",)
+
+
 def _numeric_items(rec: dict) -> Dict[str, float]:
     out = {}
     for k, v in rec.items():
         if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if any(k.startswith(p) for p in IGNORED_PREFIXES):
             continue
         out[k] = float(v)
     return out

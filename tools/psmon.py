@@ -6,17 +6,30 @@ metrics registry and renders one table row per node (request-latency
 quantiles, lane depth, apply-shard throughput, retransmits, replication
 forwards/lag) plus per-role rollups and each server's hottest keys.
 
+On top of the one-shot table sit the CONTINUOUS modes, backed by the
+scheduler's :class:`~pslite_tpu.telemetry.ClusterHistory` sampler:
+
+- ``--watch``: a live refreshing table with **windowed** rates (counter
+  deltas over the sampling window, not uptime averages), sparkline
+  trend columns, and a health-event footer from the SLO watchdog.
+- ``--serve PORT``: an OpenMetrics/Prometheus text endpoint over
+  ``http.server`` — counters, gauges, and the log2 histogram buckets
+  mapped to cumulative ``le`` buckets, so any standard scraper attaches
+  to any cluster.
+
 Library use (in-process clusters, tests, notebooks)::
 
     from tools import psmon
     snap = psmon.collect(scheduler_postoffice)   # {node_id: snapshot}
     print(psmon.format_table(snap))              # or psmon.to_json(snap)
+    hist = scheduler_postoffice.start_history(interval_s=1.0)
+    print(psmon.format_watch(hist))              # windowed rates + health
+    print(psmon.to_prometheus(snap))             # exposition text
 
-CLI: ``python tools/psmon.py [--json]`` boots a live demo
-LoopbackCluster (2 workers, 2 servers, scheduler), drives a short
-push/pull storm, pulls the cluster snapshot through the scheduler, and
-prints it — the end-to-end proof of the pull plane without needing an
-external deployment to attach to.
+CLI: ``python tools/psmon.py [--json|--watch|--serve PORT]`` boots a
+live demo LoopbackCluster (2 workers, 2 servers, scheduler), drives a
+short push/pull storm, and renders through the chosen mode — the
+end-to-end proof of the pull plane without an external deployment.
 """
 
 from __future__ import annotations
@@ -24,8 +37,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional
 
 # Script use from anywhere: put the repo root ahead of tools/.
 sys.path.insert(
@@ -36,18 +51,27 @@ sys.path.insert(
 def collect(scheduler_po, timeout_s: float = 5.0) -> Dict[int, dict]:
     """Cluster snapshot via the scheduler's METRICS_PULL broadcast:
     ``{node_id: telemetry_snapshot}`` (nodes that failed to answer
-    within the timeout are absent)."""
+    within the timeout are absent — pair with
+    :func:`stale_ages` / ``format_table(..., stale=...)`` to render
+    them as last-seen ages instead of silently dropping the row)."""
     return scheduler_po.collect_cluster_metrics(timeout_s=timeout_s)
+
+
+def stale_ages(scheduler_po, snap: Dict[int, dict]) -> Dict[int, float]:
+    """``{node_id: seconds since last METRICS_PULL reply}`` for every
+    node the scheduler has EVER heard from that is missing from
+    ``snap`` (it was asked and did not answer in time)."""
+    now = time.time()
+    return {
+        nid: round(now - t, 3)
+        for nid, t in scheduler_po.metrics_last_seen().items()
+        if nid not in snap
+    }
 
 
 def to_json(snap: Dict[int, dict]) -> str:
     return json.dumps({str(k): v for k, v in sorted(snap.items())},
                       indent=2, sort_keys=True)
-
-
-def _hist_q(m: dict, name: str, q: str) -> float:
-    h = m.get("histograms", {}).get(name)
-    return h.get(q, 0.0) if h else 0.0
 
 
 def _c(m: dict, name: str) -> int:
@@ -59,14 +83,34 @@ def _g(m: dict, name: str) -> float:
 
 
 def _req_quantiles(m: dict) -> tuple:
-    """Merged push/pull request-latency (p50, p99) in ms — worker side."""
+    """Merged push/pull request-latency (p50, p99) in ms — worker side.
+
+    TRUE merged quantiles: both histogram snapshots carry their raw
+    log2 ``buckets``, so the two populations merge exactly (same
+    bucket geometry) instead of the old "busier path wins"
+    approximation that hid a slow-but-quieter path entirely."""
+    from pslite_tpu.telemetry.metrics import (bucket_quantile,
+                                              merge_bucket_lists)
+
     hp = m.get("histograms", {}).get("kv.push_latency_s") or {}
     hl = m.get("histograms", {}).get("kv.pull_latency_s") or {}
-    # Weighted pick: report the busier path's quantiles (a true merged
-    # quantile would need the raw buckets of both; close enough for a
-    # monitor row — the JSON dump has both histograms in full).
-    busy = hp if hp.get("count", 0) >= hl.get("count", 0) else hl
-    return busy.get("p50", 0.0) * 1e3, busy.get("p99", 0.0) * 1e3
+    lo_p, lo_l = hp.get("lo", 1e-6), hl.get("lo", 1e-6)
+    if hp and hl and abs(lo_p - lo_l) > 1e-18:
+        # Different bucket geometry cannot merge exactly — fall back
+        # to the busier path (never happens for the stock histograms).
+        busy = hp if hp.get("count", 0) >= hl.get("count", 0) else hl
+        return busy.get("p50", 0.0) * 1e3, busy.get("p99", 0.0) * 1e3
+    merged = merge_bucket_lists(hp.get("buckets"), hl.get("buckets"))
+    if not merged:
+        return 0.0, 0.0
+    mins = [h["min"] for h in (hp, hl) if h.get("count", 0) > 0]
+    maxs = [h["max"] for h in (hp, hl) if h.get("count", 0) > 0]
+    clamp_lo = min(mins) if mins else None
+    clamp_hi = max(maxs) if maxs else None
+    return (
+        bucket_quantile(merged, lo_p, 0.5, clamp_lo, clamp_hi) * 1e3,
+        bucket_quantile(merged, lo_p, 0.99, clamp_lo, clamp_hi) * 1e3,
+    )
 
 
 def _apply_row(m: dict, uptime: float) -> tuple:
@@ -79,9 +123,14 @@ def _apply_row(m: dict, uptime: float) -> tuple:
     return n, rate, depth
 
 
-def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
+def format_table(snap: Dict[int, dict], top_keys: int = 3,
+                 stale: Optional[Dict[int, float]] = None,
+                 health: Optional[list] = None) -> str:
     """Human-readable per-node table + per-role and per-tenant
-    rollups (docs/qos.md)."""
+    rollups (docs/qos.md).  ``stale`` ({node_id: last-seen age s})
+    renders nodes that missed the pull as aged rows instead of
+    dropping them; ``health`` (HealthEvent list) appends the
+    watchdog footer."""
     # ``epoch`` (elastic membership) and ``ops/F`` (small-op batching)
     # ride LAST, in landing order: existing consumers parse earlier
     # columns by index.
@@ -99,6 +148,7 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     # side ``tenant.<name>.requests`` / ``.shed`` counters).
     tenants: Dict[str, Dict[str, int]] = {}
     hot_lines: List[str] = []
+    warn_lines: List[str] = []
     for node_id in sorted(snap):
         s = snap[node_id]
         m = s.get("metrics", {})
@@ -151,6 +201,17 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
             f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf} {ropsf}"
         )
+        # Silent span loss made loud (docs/observability.md): a
+        # nonzero trace.dropped_events means this node's exported
+        # Chrome trace is INCOMPLETE — say so instead of letting a
+        # truncated trace masquerade as a quiet one.
+        dropped = _c(m, "trace.dropped_events")
+        if dropped > 0:
+            warn_lines.append(
+                f"  WARNING node {node_id} ({role}): tracer dropped "
+                f"{dropped} span(s) — its trace export is incomplete "
+                f"(raise Tracer.MAX_EVENTS or lower PS_TRACE_SAMPLE)"
+            )
         if routing:
             owned = routing.get("owned")
             if owned is not None:
@@ -187,6 +248,18 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
         if top:
             pretty = ", ".join(f"{k}:{n}" for k, n in top[:top_keys])
             hot_lines.append(f"  node {node_id} ({role}) hot keys: {pretty}")
+    # Nodes that were asked but never answered: a STALE row with the
+    # last-seen age — an absent node is a finding, not a blank.
+    for node_id in sorted(stale or {}):
+        if node_id in snap:
+            continue
+        lines.append(
+            f"{node_id:>5} {'STALE':>9}  no METRICS_PULL reply — last "
+            f"seen {stale[node_id]:.1f}s ago"
+        )
+    if warn_lines:
+        lines.append("")
+        lines.extend(warn_lines)
     lines.append("")
     lines.append("per-role rollup:")
     for role in sorted(rollup):
@@ -215,21 +288,281 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3) -> str:
     if hot_lines:
         lines.append("")
         lines.extend(hot_lines)
+    if health:
+        lines.append("")
+        lines.append("health events (SLO watchdog, docs/observability.md):")
+        lines.extend(_health_lines(health))
     return "\n".join(lines)
 
 
-def _demo(as_json: bool) -> int:
-    """Boot a live 2w+2s LoopbackCluster, run a short storm, snapshot
-    through the scheduler, print.  The standalone proof of the pull
-    plane (library callers attach to their own scheduler instead)."""
+# -- live watch (windowed rates + sparklines + health footer) ----------------
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(series: List[Optional[float]], width: int = 10) -> str:
+    """Unicode mini-chart of one per-sample rate series (None → '·')."""
+    series = list(series)[-width:]
+    if len(series) < width:
+        series = [None] * (width - len(series)) + series
+    vals = [v for v in series if v is not None]
+    if not vals:
+        return "·" * width
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in series:
+        if v is None:
+            out.append("·")
+        elif span <= 0:
+            out.append(_SPARK[3])
+        else:
+            out.append(_SPARK[min(7, int((v - lo) / span * 7.999))])
+    return "".join(out)
+
+
+def _health_lines(events, limit: int = 8) -> List[str]:
+    out = []
+    for ev in list(events)[-limit:]:
+        who = f"node {ev.node_id} ({ev.role})"
+        if ev.tenant:
+            who += f" tenant {ev.tenant}"
+        out.append(
+            f"  [{ev.severity.upper():>4}] "
+            f"{time.strftime('%H:%M:%S', time.localtime(ev.wall))} "
+            f"{ev.rule}: {who} — {ev.message}"
+        )
+    return out or ["  (none)"]
+
+
+def format_watch(history, top_keys: int = 3) -> str:
+    """One ``--watch`` frame from the scheduler's ClusterHistory:
+    per-node WINDOWED rates (counter deltas over the sampling window —
+    meaningful an hour into a run, unlike uptime averages), sparkline
+    trends, stale-node ages, and the watchdog footer."""
+    window = history.default_window_s
+    hdr = (f"{'node':>5} {'role':>9} {'req_p50ms':>9} {'req_p99ms':>9} "
+           f"{'in/s':>8} {'out/s':>8} {'apply/s':>8} {'shed/s':>7} "
+           f"{'retx/s':>7} {'lane_q':>6} {'repl_lag':>8} "
+           f"{'trend(out/s)':>12}")
+    lines = [
+        f"psmon --watch  interval={history.interval_s:g}s "
+        f"window={window:.1f}s samples={history.samples}",
+        hdr, "-" * len(hdr),
+    ]
+    stale = history.stale_ages()
+    for node_id in history.node_ids():
+        role = history.role_of(node_id)
+        m = history.latest(node_id) or {}
+        p50 = history.window_quantile(
+            node_id, ["kv.push_latency_s", "kv.pull_latency_s"], 0.5)
+        p99 = history.window_quantile(
+            node_id, ["kv.push_latency_s", "kv.pull_latency_s"], 0.99)
+        rate = lambda c: history.rate(node_id, c)  # noqa: E731
+
+        def fmt_r(v, w=8):
+            return f"{v:>{w}.1f}" if v is not None else f"{'-':>{w}}"
+
+        def fmt_ms(v, w=9):
+            return f"{v * 1e3:>{w}.3f}" if v is not None else f"{'-':>{w}}"
+
+        apply_rate = None
+        a_sh = rate("apply.sharded_requests")
+        a_gl = rate("apply.global_requests")
+        if a_sh is not None or a_gl is not None:
+            apply_rate = (a_sh or 0.0) + (a_gl or 0.0)
+        row = (
+            f"{node_id:>5} {role:>9} {fmt_ms(p50)} {fmt_ms(p99)} "
+            f"{fmt_r(rate('van.recv_messages'))} "
+            f"{fmt_r(rate('van.sent_messages'))} "
+            f"{fmt_r(apply_rate)} "
+            f"{fmt_r(rate('qos.shed_requests'), 7)} "
+            f"{fmt_r(rate('resender.retransmits'), 7)} "
+            f"{_g(m, 'van.lane_depth'):>6.0f} "
+            f"{_g(m, 'replication.lag'):>8.0f} "
+            f"{_sparkline(history.trend(node_id, 'van.sent_messages')):>12}"
+        )
+        if node_id in stale:
+            row += f"  STALE {stale[node_id]:.1f}s"
+        lines.append(row)
+        dropped = _c(m, "trace.dropped_events")
+        if dropped > 0:
+            lines.append(f"      ^ WARNING: tracer dropped {dropped} "
+                         f"span(s) — trace export incomplete")
+    changes = history.membership_log()
+    if changes:
+        lines.append("")
+        lines.append("membership/epoch changes:")
+        for ch in changes[-5:]:
+            when = time.strftime("%H:%M:%S", time.localtime(ch["wall"]))
+            if ch["change"] == "epoch":
+                lines.append(f"  {when} epoch {ch['epoch']}: active="
+                             f"{ch.get('active')} leaving="
+                             f"{ch.get('leaving')}")
+            else:
+                lines.append(f"  {when} {ch['change']}: node "
+                             f"{ch.get('node_id')} ({ch.get('role')})")
+    lines.append("")
+    lines.append("health (SLO watchdog):")
+    lines.extend(_health_lines(history.watchdog.events(min_severity="info")))
+    return "\n".join(lines)
+
+
+# -- OpenMetrics / Prometheus exposition -------------------------------------
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_TENANT_RE = re.compile(r"^tenant\.(?P<tenant>.+)\.(?P<kind>[^.]+)$")
+
+
+def _prom_name(name: str) -> str:
+    return "pslite_" + _NAME_RE.sub("_", name)
+
+
+def _prom_float(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v))
+
+
+def to_prometheus(snap: Dict[int, dict]) -> str:
+    """Render a cluster snapshot as Prometheus text exposition
+    (version 0.0.4 — what ``--serve`` answers scrapes with).
+
+    - counters → ``pslite_<name>_total`` (per-tenant counters become
+      one family with a ``tenant`` label),
+    - gauges → ``pslite_<name>``,
+    - histograms → cumulative ``_bucket{le=...}`` series derived from
+      the raw log2 buckets (upper bound ``lo * 2^i``; monotone le and
+      monotone cumulative counts by construction), plus ``_sum`` and
+      ``_count``.
+
+    Every sample carries ``node``/``role`` labels, so one scrape of
+    the scheduler covers the whole cluster."""
+    counters: Dict[str, list] = {}
+    gauges: Dict[str, list] = {}
+    hists: Dict[str, list] = {}
+    for node_id in sorted(snap):
+        s = snap[node_id]
+        m = s.get("metrics", {})
+        base = {"node": str(node_id), "role": s.get("role", "?")}
+        for name, v in sorted(m.get("counters", {}).items()):
+            labels = dict(base)
+            tm = _TENANT_RE.match(name)
+            if tm:
+                labels["tenant"] = tm.group("tenant")
+                fam = _prom_name(f"tenant.{tm.group('kind')}") + "_total"
+            else:
+                fam = _prom_name(name) + "_total"
+            counters.setdefault(fam, []).append((labels, v))
+        for name, v in sorted(m.get("gauges", {}).items()):
+            gauges.setdefault(_prom_name(name), []).append((base, v))
+        for name, h in sorted(m.get("histograms", {}).items()):
+            hists.setdefault(_prom_name(name), []).append((base, h))
+        up = m.get("uptime_s")
+        if up is not None:
+            gauges.setdefault("pslite_uptime_seconds", []).append(
+                (base, up))
+    out: List[str] = []
+
+    def _esc(v) -> str:
+        # Exposition-format label escaping (\\, \", \n) — label values
+        # here are identifier-like, but a hostile tenant name must not
+        # corrupt the whole scrape.
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def _labels(d: dict) -> str:
+        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(d.items()))
+        return "{" + inner + "}" if inner else ""
+
+    for fam in sorted(counters):
+        out.append(f"# TYPE {fam} counter")
+        for labels, v in counters[fam]:
+            out.append(f"{fam}{_labels(labels)} {int(v)}")
+    for fam in sorted(gauges):
+        out.append(f"# TYPE {fam} gauge")
+        for labels, v in gauges[fam]:
+            out.append(f"{fam}{_labels(labels)} {_prom_float(v)}")
+    for fam in sorted(hists):
+        out.append(f"# TYPE {fam} histogram")
+        for labels, h in hists[fam]:
+            lo = h.get("lo", 1e-6)
+            acc = 0
+            for i, n in sorted(
+                    (int(i), int(n)) for i, n in h.get("buckets") or []):
+                acc += n
+                le = _prom_float(lo * (2.0 ** i))
+                lb = _labels({**labels, "le": le})
+                out.append(f"{fam}_bucket{lb} {acc}")
+            lb = _labels({**labels, "le": "+Inf"})
+            out.append(f"{fam}_bucket{lb} {int(h.get('count', acc))}")
+            out.append(f"{fam}_sum{_labels(labels)} "
+                       f"{_prom_float(h.get('sum', 0.0))}")
+            out.append(f"{fam}_count{_labels(labels)} "
+                       f"{int(h.get('count', acc))}")
+    return "\n".join(out) + "\n"
+
+
+def serve(collect_fn, port: int, host: str = "127.0.0.1"):
+    """Start a daemonized ``http.server`` answering ``GET /metrics``
+    (and ``/``) with :func:`to_prometheus` over ``collect_fn()``'s
+    snapshot.  Returns the live ``ThreadingHTTPServer`` — call
+    ``.shutdown()`` to stop; the bound port is ``.server_address[1]``
+    (pass ``port=0`` to let the OS pick, e.g. in tests)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?")[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                body = to_prometheus(collect_fn()).encode()
+            except Exception as exc:  # noqa: BLE001 - a failed pull
+                self.send_error(500, explain=repr(exc))  # not a crash
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", PROM_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet scraper chatter
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="psmon-serve", daemon=True)
+    t.start()
+    return httpd
+
+
+# -- CLI demo ----------------------------------------------------------------
+
+
+def _demo(args) -> int:
+    """Boot a live 2w+2s LoopbackCluster, run a short storm, and render
+    through the chosen mode.  The standalone proof of the pull plane
+    (library callers attach to their own scheduler instead)."""
     import numpy as np
 
     from pslite_tpu.benchmark import _loopback_cluster, _teardown_cluster
     from pslite_tpu.kv.kv_app import (KVServer, KVServerDefaultHandle,
                                       KVWorker)
 
+    env = {}
+    if args.watch:
+        # --serve does NOT start the sampler: scrapes pull on demand
+        # through collect(), and a background sampler would only burn
+        # a cluster-wide METRICS_PULL per interval alongside them.
+        env["PS_METRICS_INTERVAL"] = str(args.interval)
     nodes = _loopback_cluster(num_workers=2, num_servers=2,
-                              ns="psmon-demo")
+                              ns="psmon-demo", env_extra=env)
     scheduler, server_pos, worker_pos = nodes[0], nodes[1:3], nodes[3:]
     servers = []
     workers = []
@@ -246,8 +579,36 @@ def _demo(as_json: bool) -> int:
             for w in workers:
                 w.wait(w.push(keys, vals))
         workers[0].wait(workers[0].pull(keys, out))
-        snap = collect(scheduler)
-        print(to_json(snap) if as_json else format_table(snap))
+        if args.serve is not None:
+            httpd = serve(lambda: collect(scheduler), args.serve)
+            port = httpd.server_address[1]
+            print(f"psmon: serving Prometheus text on "
+                  f"http://127.0.0.1:{port}/metrics (Ctrl-C to stop)")
+            try:
+                while True:
+                    for w in workers:  # keep the cluster lively
+                        w.wait(w.push(keys, vals))
+                    time.sleep(max(args.interval, 0.5))
+            except KeyboardInterrupt:
+                pass
+            finally:
+                httpd.shutdown()
+        elif args.watch:
+            history = scheduler.start_history(interval_s=args.interval)
+            try:
+                for _ in range(args.rounds):
+                    for w in workers:
+                        w.wait(w.push(keys, vals))
+                    time.sleep(args.interval)
+                    sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                    print(format_watch(history))
+            except KeyboardInterrupt:
+                pass
+        else:
+            snap = collect(scheduler)
+            stale = stale_ages(scheduler, snap)
+            print(to_json(snap) if args.json
+                  else format_table(snap, stale=stale))
     finally:
         _teardown_cluster(nodes, workers, servers)
     return 0
@@ -257,8 +618,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--json", action="store_true",
                     help="dump the raw snapshot as JSON")
+    ap.add_argument("--watch", action="store_true",
+                    help="live refreshing table with windowed rates, "
+                         "sparklines, and the health-event footer")
+    ap.add_argument("--serve", type=int, metavar="PORT", default=None,
+                    help="serve OpenMetrics/Prometheus text exposition "
+                         "on PORT (0 = OS-assigned)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="sampling interval for --watch/--serve (s)")
+    ap.add_argument("--rounds", type=int, default=10,
+                    help="--watch refresh count before exiting")
     args = ap.parse_args(argv)
-    return _demo(args.json)
+    return _demo(args)
 
 
 if __name__ == "__main__":
