@@ -613,6 +613,30 @@ def _transport_sections(quick: bool) -> list:
             "telemetry": storm["telemetry"],
         }
 
+    def sec_kv_tracing():
+        # Tail-based request tracing (docs/observability.md): the same
+        # loopback storm with PS_TRACE_TAIL on, followed by a live
+        # TRACE_PULL assembly round — the record carries the kept/
+        # assembled counts and the slow set's per-stage shares, so a
+        # perf regression comes with its own "where did the tail
+        # live" attribution.  Context only: bench_diff notes but never
+        # gates kv_tracing_* fields (host-load-shaped, like the
+        # windowed rates).
+        from pslite_tpu.benchmark import kv_tracing_storm
+
+        r = kv_tracing_storm(msgs_per_worker=15 if quick else 40)
+        return {
+            "kv_tracing_msgs_per_s": r["msgs_per_s"],
+            "kv_tracing_assembled": r["assembled"],
+            "kv_tracing_collected": r["collected"],
+            "kv_tracing_wall_p50_us": r["trace_wall_p50_us"],
+            "kv_tracing_wall_max_us": r["trace_wall_max_us"],
+            "kv_tracing": {
+                "top_stage": r["top_stage"],
+                "stage_shares": r["stage_shares"],
+            },
+        }
+
     def sec_chunk_streaming():
         # Chunked streaming transfers (docs/chunking.md): 64 MiB
         # push goodput chunked vs monolithic, and the headline —
@@ -754,6 +778,7 @@ def _transport_sections(quick: bool) -> list:
         ("serving_fanin", sec_serving_fanin),
         ("elastic_scale", sec_elastic_scale),
         ("kv_telemetry", sec_kv_telemetry),
+        ("kv_tracing", sec_kv_tracing),
         ("fault_recovery", sec_fault_recovery),
     ]
     if not quick:
@@ -774,6 +799,7 @@ def _transport_sections(quick: bool) -> list:
             "native_goodput": "native_skipped",
             "quantized_push": "quantized_skipped",
             "kv_telemetry": "kv_skipped",
+            "kv_tracing": "kv_tracing_skipped",
             "van_latency": "van_skipped",
             "elastic_scale": "elastic_skipped",
         }

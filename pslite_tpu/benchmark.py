@@ -993,6 +993,67 @@ def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
         _teardown_cluster(nodes, workers, servers)
 
 
+def kv_tracing_storm(n_workers: int = 2, n_servers: int = 2,
+                     msgs_per_worker: int = 40, keys_per_msg: int = 8,
+                     val_len: int = 512,
+                     tail_spec: str = "slow:p95,errors,floor:0.05",
+                     env_extra: Optional[dict] = None) -> dict:
+    """The kv loopback storm with TAIL TRACING on, followed by a live
+    ``TRACE_PULL`` assembly round (docs/observability.md): the
+    condensed result — kept/assembled counts, walls, per-stage shares
+    and the slow set's dominant stage — is what bench.py's
+    ``kv_tracing`` section embeds next to the throughput numbers.
+    Context only: stage shares are host-load-shaped, so
+    ``tools/bench_diff.py`` notes but never gates them (like the
+    windowed rates)."""
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+
+    env = {"PS_TRACE_TAIL": tail_spec}
+    if env_extra:
+        env.update(env_extra)
+    nodes = _loopback_cluster(n_workers, n_servers, "kv-trace", env)
+    servers = []
+    workers = []
+    try:
+        for po in nodes[1:1 + n_servers]:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        workers = [KVWorker(0, 0, postoffice=po)
+                   for po in nodes[1 + n_servers:]]
+        span = (1 << 64) // max(keys_per_msg, 1)
+        keys = np.arange(keys_per_msg, dtype=np.uint64) * span + 3
+        vals = np.ones(keys_per_msg * val_len, np.float32)
+        outs = [np.zeros_like(vals) for _ in workers]
+        t0 = time.perf_counter()
+        for i in range(msgs_per_worker):
+            tss = [w.push(keys, vals) for w in workers]
+            for w, ts in zip(workers, tss):
+                w.wait(ts)
+            if i % 10 == 9:
+                for w, out in zip(workers, outs):
+                    w.wait(w.pull(keys, out))
+        wall = time.perf_counter() - t0
+        coll = nodes[0].collect_cluster_traces(timeout_s=10.0)
+        agg = coll.aggregate()
+        total = n_workers * msgs_per_worker
+        return {
+            "wall_s": round(wall, 4),
+            "msgs_per_s": round(total / max(wall, 1e-9), 1),
+            "assembled": agg["count"],
+            "collected": len(coll),
+            "top_stage": agg["top_stage"],
+            "trace_wall_p50_us": agg["wall_p50_us"],
+            "trace_wall_max_us": agg["wall_max_us"],
+            "stage_shares": {
+                name: info["share"]
+                for name, info in (agg.get("slow") or {}).items()
+            },
+        }
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+
+
 def fault_recovery_times(quick: bool = True) -> dict:
     """End-to-end recovery latency of the fault-tolerance tier
     (docs/fault_tolerance.md), over an in-process loopback cluster —

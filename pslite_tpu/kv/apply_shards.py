@@ -375,6 +375,10 @@ class ApplyShardPool:
         if wait:
             pending.emitted = threading.Event()
         pending.tracked = True
+        if getattr(meta, "trace", 0) and self._tracer.active:
+            # Shard-queue wait attribution: the apply span reports
+            # submission→apply-start as wait_us (docs/observability.md).
+            meta._submit_us = self._tracer.now_us()
         tid = getattr(meta, "tenant", 0)
         with self._backlog_mu:
             self._tenant_backlog[tid] = (
@@ -512,6 +516,8 @@ class ApplyShardPool:
             p = _Pending(meta, kvs)
             p.group = group
             p.op_idx = i
+            if getattr(meta, "trace", 0) and self._tracer.active:
+                meta._submit_us = self._tracer.now_us()
             tasks = []
             for sid, positions in plan:
                 ngrp = self._task_groups(kvs, positions)
@@ -743,9 +749,13 @@ class ApplyShardPool:
         trace = getattr(meta, "trace", 0)
         if trace and self._tracer.active:
             now = self._tracer.now_us()
+            args = {"keys": len(keys), "push": meta.push}
+            sub_us = getattr(meta, "_submit_us", None)
+            if sub_us is not None:
+                # Shard-queue dwell, submission → this apply's start.
+                args["wait_us"] = round(now - dur * 1e6 - sub_us, 1)
             self._tracer.span(
-                trace, "apply", now - dur * 1e6, dur * 1e6,
-                args={"keys": len(keys), "push": meta.push},
+                trace, "apply", now - dur * 1e6, dur * 1e6, args=args,
             )
         if not meta.pull:
             return None

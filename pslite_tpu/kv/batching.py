@@ -30,11 +30,15 @@ and re-send PER SUB-OP through the ordinary unbatched path.
 
 Declines (documented in docs/batching.md): codec-mismatched ops never
 merge (the codec is part of the group key); batching never crosses
-tenant or priority; zero-copy (OPT_ZPULL) ops, traced ops, ragged
-``lens`` payloads, custom ``cmd`` heads, and elastic-membership
-clusters pass through unbatched; chunking applies ABOVE the batch
-plane untouched (a batch frame larger than ``PS_CHUNK_BYTES`` splits
-like any other data message — EXT_BATCH is packed before EXT_CHUNK).
+tenant or priority; zero-copy (OPT_ZPULL) ops, ragged ``lens``
+payloads, custom ``cmd`` heads, and elastic-membership clusters pass
+through unbatched; chunking applies ABOVE the batch plane untouched
+(a batch frame larger than ``PS_CHUNK_BYTES`` splits like any other
+data message — EXT_BATCH is packed before EXT_CHUNK).  TRACED ops
+MERGE like any other (their ids ride the per-op table and are echoed
+on batched responses) — forcing them out of the batch plane would
+make the tracer perturb exactly the path it is meant to explain
+(docs/observability.md).
 
 Capability: EXT_BATCH frames are only sent to peers that answered the
 ``BATCH_PROBE_CMD`` capability probe (``PS_BATCH_NEGOTIATE=0`` skips
@@ -74,8 +78,11 @@ from ..wire import BATCH_MAX_OPS
 BATCH_PROBE_CMD = 0x6BA7
 
 # Protocol generation answered by the probe; bump when the per-op
-# table layout changes incompatibly.
-BATCH_WIRE_VERSION = 1
+# table layout changes incompatibly.  v2: optional per-op trace id
+# (flag-gated u64 after the codec block — wire._BATCH_F_TRACE); a v1
+# decoder would misparse a traced table, so v1 peers read as incapable
+# and keep receiving plain frames.
+BATCH_WIRE_VERSION = 2
 
 # Hard cap on ops per frame.  The u16 wire field is the formal
 # ceiling; the binding bound is the kernel's UIO_MAXIOV (1024 iovecs
@@ -106,7 +113,6 @@ def batchable(msg: Message, response: bool = False) -> bool:
         and m.request != response
         and m.head == 0
         and m.option == 0
-        and m.trace == 0
         and not m.shm_data
         and m.chunk is None
         and m.batch is None
@@ -163,11 +169,14 @@ def build_batch_message(msgs: List[Message]) -> Message:
         size += sm.data_size
         # option/stamp carry through: always 0 on the request
         # direction (batchable() filters), per-op result codes and
-        # hot-cache stamps on the response direction.
+        # hot-cache stamps on the response direction.  The trace id
+        # moves into the table — the ENVELOPE stays untraced, so span
+        # recording stays per-op, never per-frame.
         ops.append(BatchOp(
             push=sm.push, pull=sm.pull, timestamp=sm.timestamp,
             key=sm.key, val_len=sm.val_len, option=sm.option,
             stamp=sm.stamp, nseg=len(sub.data), codec=sm.codec,
+            trace=sm.trace,
         ))
     m.data_size = size
     m.batch = BatchInfo(ops=tuple(ops))
@@ -196,6 +205,7 @@ def split_batch_message(msg: Message) -> List[Message]:
         mm.option = op.option
         mm.stamp = op.stamp
         mm.codec = op.codec
+        mm.trace = op.trace
         mm.data_type = []
         mm.data_size = 0
         for seg in msg.data[di:di + op.nseg]:
@@ -219,9 +229,17 @@ class OpCombiner:
                  min_ops: int = 32, hold_max_us: float = 2000.0,
                  on_sent: Optional[Callable[[List[Message], Message],
                                             None]] = None,
-                 response: bool = False):
+                 response: bool = False, tracer=None):
         self._send = send
         self._on_error = on_error
+        # Traced ops record their combiner dwell as a ``combine_wait``
+        # span (the batch-plane analog of the van's lane_wait) — the
+        # worker-queue checkpoint critical_path.py attributes from.
+        if tracer is None:
+            from ..telemetry.tracing import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self._tracer = tracer
         # Response-direction mode (the server's response combiner,
         # docs/batching.md): eligibility and cost use the response
         # rules; everything else — lanes, order, adaptive hold — is
@@ -363,6 +381,8 @@ class OpCombiner:
         cost = op_wire_cost(msg, response=self._response)
         mergeable = (batchable(msg, response=self._response)
                      and cost <= self.max_bytes)
+        if msg.meta.trace and self._tracer.active:
+            msg._comb_enq = now  # combine_wait stamp, read at flush
         grp = self._groups.setdefault(key, [])
         if not grp:
             self._first_enq[key] = now
@@ -508,6 +528,20 @@ class OpCombiner:
                     run.append(nmsg)
                     run_bytes += ncost
                     i += 1
+            if self._tracer.active:
+                import time as _time
+
+                now_m = _time.monotonic()
+                for rm in run:
+                    enq = getattr(rm, "_comb_enq", None)
+                    if enq is None or not rm.meta.trace:
+                        continue
+                    wait_us = max(0.0, (now_m - enq) * 1e6)
+                    self._tracer.span(
+                        rm.meta.trace, "combine_wait",
+                        self._tracer.now_us() - wait_us, wait_us,
+                        args={"dst": rm.meta.recver, "run": len(run)},
+                    )
             try:
                 if len(run) == 1:
                     # Parity: a lone op travels as its ORIGINAL

@@ -446,6 +446,7 @@ class KVWorker:
                     hold_max_us=self.po.env.find_float(
                         "PS_BATCH_HOLD_US", 2000.0),
                     on_sent=self._batch_sent,
+                    tracer=self.po.tracer,
                 )
         # Dense buckets / sparse tables routed through the collective engine
         # (ICI van): (nkeys, first, last) -> bucket name (full key arrays
@@ -510,8 +511,21 @@ class KVWorker:
         self._c_timeouts = self.po.metrics.counter("kv.timeouts")
         self._c_failovers = self.po.metrics.counter("kv.failovers")
         self._c_retries = self.po.metrics.counter("kv.retries")
-        # ts -> (monotonic start, pull?, trace id, wall-aligned start us)
-        self._req_track: Dict[int, Tuple[float, bool, int, float]] = {}
+        # ts -> (monotonic start, pull?, trace id, wall-aligned start
+        # us, parent trace id — multi_get fan-outs link their sub-gets)
+        self._req_track: Dict[int, Tuple[float, bool, int, float,
+                                         int]] = {}
+        # ts -> failure-class outcome ("error"/"shed"/"timeout"/
+        # "retry"/"wrong_owner"/"send_failed"), set on the failure
+        # paths and consumed by the tail-keep decision at completion
+        # (docs/observability.md) — an errored request's trace is
+        # always interesting.
+        self._req_outcome: Dict[int, str] = {}
+        # Tail-based tracing: the rolling slow threshold falls back to
+        # these local histograms when no TRACE_PULL hint is fresh.
+        if getattr(self.po.tracer, "tail", None) is not None:
+            self.po.tracer.set_tail_source("push", self._h_push_lat)
+            self.po.tracer.set_tail_source("pull", self._h_pull_lat)
         self.po.register_node_failure_hook(self._on_node_event)
         # Elastic routing (docs/elasticity.md): wrong-owner bounce
         # accounting, throttled stale-table pulls, and the routing hook
@@ -951,16 +965,54 @@ class KVWorker:
 
     # -- telemetry -----------------------------------------------------------
 
-    def _track_request(self, ts: int, pull: bool) -> int:
+    def _track_request(self, ts: int, pull: bool, parent: int = 0) -> int:
         """Start request-latency tracking for a message-path timestamp
-        and mint a trace id when sampled (PS_TRACE_SAMPLE); returns the
-        trace id (0 = untraced)."""
+        and mint a trace id — EVERY request under tail capture
+        (PS_TRACE_TAIL; the keep decision moves to completion), else
+        head-sampled (PS_TRACE_SAMPLE).  Returns the trace id (0 =
+        untraced); ``parent`` links a multi_get sub-get to its
+        fan-out's parent id."""
         (self._c_pulls if pull else self._c_pushes).inc()
-        trace = self.po.tracer.maybe_trace()
+        trace = self.po.tracer.begin_request()
         t0_us = self.po.tracer.now_us() if trace else 0.0
         with self._mu:
-            self._req_track[ts] = (time.monotonic(), pull, trace, t0_us)
+            self._req_track[ts] = (time.monotonic(), pull, trace, t0_us,
+                                   parent)
         return trace
+
+    def _finish_trace(self, ts: int, trace: int, pull: bool, dur: float,
+                      t0_us: float, parent: int,
+                      outcome: Optional[str],
+                      observed: bool = True) -> None:
+        """The tail-keep decision point (docs/observability.md): at
+        completion the worker keeps this request's trace only if it is
+        interesting — a failure outcome, slower than the rolling
+        per-path quantile, or the uniform floor.  Kept traces get
+        their ``request`` root span (what makes them assemble at the
+        collector) and attach as an exemplar to the latency histogram
+        bucket they landed in."""
+        tracer = self.po.tracer
+        path = "pull" if pull else "push"
+        reason = tracer.tail_keep(dur, path, outcome)
+        if reason is None:
+            return
+        args = {"ts": ts, "pull": pull, "keep": reason}
+        if outcome:
+            args["outcome"] = outcome
+        if parent:
+            args["parent"] = f"{parent:x}"
+        tracer.span(trace, "request", t0_us, dur * 1e6, args=args)
+        tracer.instant(trace, "complete", args={"ts": ts})
+        if observed:
+            # Exemplars link HISTOGRAM buckets to traces, so only a
+            # duration the histogram actually observed may attach —
+            # a timed-out request (observed=False: _finish never runs,
+            # its latency never lands in the histogram) would park an
+            # exemplar on a zero-count bucket that never renders,
+            # evicting the live slow-trace links a timeout storm
+            # needs most.  The timeout's trace itself is still kept.
+            (self._h_pull_lat if pull else self._h_push_lat
+             ).attach_exemplar(dur, trace)
 
     # -- small-op aggregation (kv/batching.py, docs/batching.md) -------------
 
@@ -1007,7 +1059,10 @@ class KVWorker:
         m.head = _BATCH_PROBE_CMD
         m.timestamp = ts
         m.recver = dest
-        m.val_len = 1
+        # The probe declares THIS sender's batch wire version too
+        # (val_len — older servers ignore it): the server must never
+        # send a v2 per-op table (traced responses) to a v1 decoder.
+        m.val_len = _BATCH_WIRE_VERSION
         msg.add_data(SArray(np.zeros(1, np.uint64)))
         msg.add_data(SArray(np.empty(0, np.float32)))
         try:
@@ -1144,6 +1199,7 @@ class KVWorker:
         codec: Optional[str] = None,
         tenant=None,
         _batch_sink: Optional[List[Message]] = None,
+        _trace_parent: int = 0,
     ) -> int:
         """Zero-copy pull into ``vals`` (kv_app.h:241-247, 727-792).
 
@@ -1207,7 +1263,7 @@ class KVWorker:
                 callback()
             return ts
         ts = self._customer.new_request(SERVER_GROUP)
-        trace = self._track_request(ts, pull=True)
+        trace = self._track_request(ts, pull=True, parent=_trace_parent)
         zpull = (
             self._zpull_lookup(keys, vals)
             if lens is None and codec is None else None
@@ -1358,10 +1414,19 @@ class KVWorker:
         # traffic per sub-op.
         want_cb = callbacks is not None or callback is not None
         hc = self._hot_cache
+        # Fan-in trace linkage (docs/observability.md): one PARENT id
+        # spans the whole multi_get; every sub-get mints its own trace
+        # as usual and records the parent on its root span, so an
+        # assembled serving request reads as one tree across servers.
+        tracer = self.po.tracer
+        parent = tracer.begin_request() if tracer.active else 0
+        if parent:
+            tracer.instant(parent, "multi_get", args={"subs": n})
         try:
             self._multi_get_issue(key_lists, outs, val_len, dtype, cmd,
                                   priority, compress, codec, tenant,
-                                  handle, sink, want_cb, hc, _complete)
+                                  handle, sink, want_cb, hc, _complete,
+                                  parent)
         finally:
             if sink:
                 # The whole fan-out enters the combiner in one atomic
@@ -1376,7 +1441,8 @@ class KVWorker:
 
     def _multi_get_issue(self, key_lists, outs, val_len, dtype, cmd,
                          priority, compress, codec, tenant, handle,
-                         sink, want_cb, hc, _complete) -> None:
+                         sink, want_cb, hc, _complete,
+                         parent: int = 0) -> None:
         for i, keys in enumerate(key_lists):
             keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
             out = (outs[i] if outs is not None
@@ -1412,14 +1478,14 @@ class KVWorker:
                 handle.timestamps[i] = self.pull(
                     keys[miss], tmp, cmd=cmd, priority=priority,
                     tenant=tenant, callback=_scatter,
-                    _batch_sink=sink,
+                    _batch_sink=sink, _trace_parent=parent,
                 )
                 continue
             handle.timestamps[i] = self.pull(
                 keys, out, cmd=cmd, priority=priority, codec=codec_i,
                 tenant=tenant,
                 callback=(lambda i=i: _complete(i)) if want_cb else None,
-                _batch_sink=sink,
+                _batch_sink=sink, _trace_parent=parent,
             )
 
     def pull_multi(
@@ -1568,7 +1634,7 @@ class KVWorker:
         except Exception as exc:  # noqa: BLE001 - next bounce retries
             log.warning(f"routing pull failed: {exc!r}")
 
-    def _route(self, group_rank: int) -> int:
+    def _route(self, group_rank: int, trace: int = 0) -> int:
         """Destination id for a key-range slice: the owning rank, or —
         when it is down and replication is on — the first live member
         of its replica chain (the topology lives in ONE place:
@@ -1589,10 +1655,14 @@ class KVWorker:
                     # Flight recorder (docs/observability.md): ONE
                     # event per outage transition naming the dead
                     # primary and the replica absorbing its range
-                    # (re-armed when the rank recovers).
+                    # (re-armed when the rank recovers); the active
+                    # trace id, when one is in scope, lets pstrace
+                    # print the event inline with the trace.
                     self._failover_logged.add(base)
+                    detail = {"trace": f"{trace:x}"} if trace else {}
                     self.po.flight.record("failover", severity="warn",
-                                          dead=base, replica=cand)
+                                          dead=base, replica=cand,
+                                          **detail)
                 return cand
         return base
 
@@ -1603,10 +1673,21 @@ class KVWorker:
 
     def _mark_timed_out(self, ts: int) -> None:
         """Record a timed-out/abandoned request (caller holds _mu):
-        wait(ts) raises TimeoutError; completion callbacks suppress."""
+        wait(ts) raises TimeoutError; completion callbacks suppress.
+        No _finish will ever run, so the tail-keep decision happens
+        HERE — a timeout is exactly the kind of trace the tail plane
+        exists to keep."""
         self._timeout_ts.add(ts)
         self._c_timeouts.inc()
-        self._req_track.pop(ts, None)  # no _finish will ever run
+        track = self._req_track.pop(ts, None)
+        outcome = self._req_outcome.pop(ts, None) or "timeout"
+        if track is not None:
+            t0, was_pull, trace, t0_us, parent = track
+            if trace:
+                self._finish_trace(ts, trace, was_pull,
+                                   time.monotonic() - t0, t0_us, parent,
+                                   outcome if outcome != "retry"
+                                   else "timeout", observed=False)
 
     def _ensure_sweeper(self) -> None:
         if self._sweep_thread is not None and self._sweep_thread.is_alive():
@@ -1691,6 +1772,10 @@ class KVWorker:
                     # window.
                     req.deadline = now + self._req_timeout * (
                         2 ** req.attempt)
+                    # Retried requests are tail-keep material even
+                    # when the retry eventually succeeds — the saved
+                    # trace shows WHY the first attempt was lost.
+                    self._req_outcome.setdefault(ts, "retry")
                 for s in troubled:
                     s.retry_now = False
                 self._c_retries.inc(len(troubled))
@@ -1706,7 +1791,7 @@ class KVWorker:
                     sl.wrong_owner = False
                     subs = self._resplit_slice(req, sl)
                 for sub in subs:
-                    dest = self._route(sub.group_rank)
+                    dest = self._route(sub.group_rank, req.trace)
                     old = sub.sent_msg
                     if (old is not None and dest != sub.dest
                             and self.po.van.resender is not None):
@@ -1906,7 +1991,8 @@ class KVWorker:
             self._finish(ts)  # also releases any _pull_dst entry
             return
         parts = [
-            (owner, part, self._route(owner)) for owner, part in live
+            (owner, part, self._route(owner, trace))
+            for owner, part in live
         ]
         # Encode ONCE, before any send can fail: a sweeper retry (or
         # replica failover) re-sends the identical compressed bytes —
@@ -1990,9 +2076,19 @@ class KVWorker:
                 # without constructing per-op Message objects.
                 sender = msg.meta.sender
                 hc = self._hot_cache
+                tracer = self.po.tracer
+                tr_active = tracer.active
                 for op in info.ops:
                     ts = op.timestamp
                     discount = False
+                    if tr_active and op.trace:
+                        # The batch ENVELOPE carries no trace id; the
+                        # per-op response-arrival instant is what
+                        # bounds the response_wire stage for merged
+                        # traffic (telemetry/critical_path.py).
+                        tracer.instant(op.trace, "recv",
+                                       args={"from": sender,
+                                             "request": False})
                     try:
                         with self._mu:
                             req = self._pending.get(ts)
@@ -2025,7 +2121,12 @@ class KVWorker:
                         if not discount:
                             self._customer.add_response(ts)
                 return
+            tracer = self.po.tracer
             for sub in _split_batch_message(msg):
+                if tracer.active and sub.meta.trace:
+                    tracer.instant(sub.meta.trace, "recv",
+                                   args={"from": msg.meta.sender,
+                                         "request": False})
                 try:
                     self._process(sub)
                 except Exception as exc:  # noqa: BLE001
@@ -2077,6 +2178,8 @@ class KVWorker:
                 # real responses complete the count.
                 self._c_wrong_owner.inc()
                 wrong_owner_epoch = msg.meta.val_len
+                if ts in self._req_track:
+                    self._req_outcome[ts] = "wrong_owner"
                 if (req is not None
                         and req.bounces < self._MAX_WRONG_OWNER_BOUNCES):
                     discount = retry_now = True
@@ -2099,6 +2202,8 @@ class KVWorker:
                 # budget left, hand it to the sweeper (and discount the
                 # synthesized response so the retry's real response
                 # completes the count); otherwise the request fails.
+                if ts in self._req_track:
+                    self._req_outcome[ts] = "send_failed"
                 if req is not None and req.attempt < self._req_retries:
                     discount = retry_now = True
                     if sl is not None:
@@ -2137,6 +2242,8 @@ class KVWorker:
         if msg.meta.option == OPT_APPLY_ERROR:
             with self._mu:
                 self._error_ts.add(ts)
+                if ts in self._req_track:
+                    self._req_outcome[ts] = "error"
         elif msg.meta.option == OPT_OVERLOAD:
             # The server shed this slice under admission control
             # (docs/qos.md): the request completes FAST — wait(ts)
@@ -2144,6 +2251,8 @@ class KVWorker:
             self._c_overloads.inc()
             with self._mu:
                 self._overload_ts.add(ts)
+                if ts in self._req_track:
+                    self._req_outcome[ts] = "shed"
         if self._hot_cache is not None and msg.meta.stamp:
             # Push-driven invalidation (kv/hot_cache.py): every stamped
             # response advances the newest-known version of its server,
@@ -2216,14 +2325,14 @@ class KVWorker:
                 self._raw_results[ts] = chunks
                 chunks = []
         if track is not None:
-            t0, was_pull, trace, t0_us = track
+            t0, was_pull, trace, t0_us, parent = track
             dur = time.monotonic() - t0
             (self._h_pull_lat if was_pull else self._h_push_lat).observe(dur)
+            with self._mu:
+                outcome = self._req_outcome.pop(ts, None)
             if trace:
-                tracer = self.po.tracer
-                tracer.span(trace, "request", t0_us, dur * 1e6,
-                            args={"ts": ts, "pull": was_pull})
-                tracer.instant(trace, "complete", args={"ts": ts})
+                self._finish_trace(ts, trace, was_pull, dur, t0_us,
+                                   parent, outcome)
         if zpull and chunks and dst is not None and all(
             np.shares_memory(c.vals, dst[1]) for c in chunks
         ):
@@ -2436,6 +2545,13 @@ class KVServer:
         # directions; 0 disables the plane (every response frame is
         # byte-identical to a pre-fan-in build).
         self._batch_senders: set = set()
+        # Senders PROVEN to decode the v2 per-op table (trace ids):
+        # their probe declared version >= 2, or an EXT_BATCH frame
+        # they sent carried a per-op trace.  Traced responses only
+        # ever MERGE toward these — a v1 decoder mid-rolling-upgrade
+        # would misparse the trace flag and walk the table at wrong
+        # offsets (traced responses to everyone else go as singles).
+        self._batch_senders_v2: set = set()
         self._resp_combiner = None
         resp_bytes = max(0, self.po.env.find_int(
             "PS_RESP_BATCH_BYTES",
@@ -2455,6 +2571,7 @@ class KVServer:
                 hold_max_us=self.po.env.find_float(
                     "PS_RESP_BATCH_HOLD_US", 2000.0),
                 response=True,
+                tracer=self.po.tracer,
             )
         # Quantized transport tier (docs/compression.md): the server is
         # the ENCODER of codec pull responses — its per-(key, worker)
@@ -2651,7 +2768,9 @@ class KVServer:
                 and m.head == 0
                 and m.control.empty()
                 and not m.shm_data
-                and m.recver in self._batch_senders):
+                and m.recver in self._batch_senders
+                and (m.trace == 0
+                     or m.recver in self._batch_senders_v2)):
             self._resp_combiner.submit(msg)
             return
         self.po.van.send(msg)
@@ -2985,6 +3104,7 @@ class KVServer:
             # rejects at request rate.
             self._c_shed.inc()
             self._record_shed_flight(m.tenant, m.sender, m.timestamp,
+                                     trace=m.trace,
                                      why="migration park buffer full")
             self.response_overload(meta)
             return True
@@ -3230,6 +3350,7 @@ class KVServer:
                     cmd=s.meta.head, push=s.meta.push, pull=s.meta.pull,
                     sender=s.meta.sender, timestamp=s.meta.timestamp,
                     customer_id=s.meta.customer_id, key=s.meta.key,
+                    trace=s.meta.trace,
                 ) for s in subs]
                 env = KVMeta(sender=msg.meta.sender,
                              customer_id=msg.meta.customer_id,
@@ -3310,6 +3431,7 @@ class KVServer:
         # reused by a recovered (possibly un-upgraded) process, which
         # must re-prove itself before seeing aggregated responses.
         self._batch_senders.discard(node_id)
+        self._batch_senders_v2.discard(node_id)
         with self._streams_mu:
             stale = [k for k in self._streams if k[0] == node_id]
             handles = [self._streams.pop(k) for k in stale]
@@ -3460,7 +3582,7 @@ class KVServer:
     _SHED_FLIGHT_WINDOW_S = 0.5
 
     def _record_shed_flight(self, tenant_id: int, sender: int, ts: int,
-                            **detail) -> None:
+                            trace: int = 0, **detail) -> None:
         """Flight-record one shed, coalesced per tenant: sheds happen
         at request rate under a storm, and per-event recording would
         wrap the bounded ring with identical spam (evicting the
@@ -3470,6 +3592,10 @@ class KVServer:
         ent = self._shed_flight.setdefault(tenant_id, [0.0, 0])
         now = time.monotonic()
         if now - ent[0] >= self._SHED_FLIGHT_WINDOW_S:
+            if trace:
+                # Active trace id in scope: pstrace --slowest prints
+                # the shed inline with the trace it coalesced under.
+                detail["trace"] = f"{trace:x}"
             self.po.flight.record(
                 "overload_shed", severity="warn",
                 tenant=self.tenants.name(tenant_id),
@@ -3497,7 +3623,8 @@ class KVServer:
             # watchdog's primary overload signal; coalesced per tenant
             # (see _record_shed_flight).
             self._record_shed_flight(meta.tenant, meta.sender,
-                                     meta.timestamp)
+                                     meta.timestamp,
+                                     trace=getattr(meta, "trace", 0))
             return True
         return False
 
@@ -3657,6 +3784,16 @@ class KVServer:
             codec=msg.meta.codec,
             tenant=msg.meta.tenant,
         )
+        if meta.trace and self.po.tracer.active:
+            recv_us = getattr(msg, "_recv_us", None)
+            if recv_us is not None:
+                # Server intake queue (docs/observability.md): wire
+                # arrival (van receive stamp) → this request thread —
+                # the customer-queue wait the critical path attributes
+                # as server_queue.
+                self.po.tracer.span(meta.trace, "server_queue", recv_us,
+                                    args={"ts": meta.timestamp,
+                                          "push": meta.push})
         self._intake_pull_stamp(meta)
         if meta.cmd == _BATCH_PROBE_CMD and meta.pull:
             # Batch capability probe (docs/batching.md): answered
@@ -3665,8 +3802,12 @@ class KVServer:
             # aggregation plane route the unknown cmd into their
             # handler and error, which the prober reads as "incapable".
             # Probing also PROVES the sender parses EXT_BATCH frames —
-            # it becomes eligible for aggregated responses.
+            # it becomes eligible for aggregated responses.  val_len
+            # carries the SENDER's wire version (0/1 from older
+            # builds): only >= 2 decoders may receive per-op traces.
             self._batch_senders.add(meta.sender)
+            if meta.val_len >= 2:
+                self._batch_senders_v2.add(meta.sender)
             self.response(meta, KVPairs(
                 keys=np.array([1], dtype=np.uint64),
                 vals=np.array([_BATCH_WIRE_VERSION], dtype=np.float32),
@@ -3775,7 +3916,11 @@ class KVServer:
         # An EXT_BATCH frame from this sender proves its build parses
         # batched frames (covers PS_BATCH_NEGOTIATE=0 clusters, where
         # no probe is ever sent): aggregated responses may flow back.
+        # A frame CARRYING per-op traces further proves the v2 table —
+        # traced responses may then merge toward it too.
         self._batch_senders.add(env.sender)
+        if any(op.trace for op in env.batch.ops):
+            self._batch_senders_v2.add(env.sender)
         subs = _split_batch_message(msg)
         if not subs:
             return
@@ -3816,6 +3961,8 @@ class KVServer:
         # parses its lens so the pool's split declines it LOUDLY
         # (per-op error) instead of applying values at wrong per-key
         # boundaries.
+        recv_us = getattr(msg, "_recv_us", None)
+        tracer = self.po.tracer
         for sub in subs:
             sm = sub.meta
             meta = KVMeta(
@@ -3823,7 +3970,14 @@ class KVServer:
                 timestamp=sm.timestamp, customer_id=env.customer_id,
                 key=sm.key, val_len=sm.val_len, option=0,
                 priority=env.priority, codec=sm.codec, tenant=env.tenant,
+                trace=sm.trace,
             )
+            if sm.trace and tracer.active and recv_us is not None:
+                # Per-sub-op intake-queue span off the ENVELOPE's wire
+                # arrival stamp (the frame arrived once; each traced
+                # member attributes the same wait).
+                tracer.span(sm.trace, "server_queue", recv_us,
+                            args={"ts": sm.timestamp, "push": sm.push})
             kvs, wire_payload = self._intake_decode(meta, sub.data,
                                                     lazy_ok=False)
             self._intake_pull_stamp(meta)
@@ -3896,6 +4050,8 @@ class KVServer:
         m.priority = env.priority
         m.tenant = getattr(env, "tenant", 0)
         ops = []
+        tracer = self.po.tracer
+        tr_active = tracer.active
         for meta, result in zip(metas, results):
             kind = result[0] if result is not None else "ok"
             option = 0
@@ -3945,12 +4101,21 @@ class KVServer:
                         nseg += 1
             m.push = m.push or meta.push
             m.pull = m.pull or meta.pull
+            op_trace = getattr(meta, "trace", 0)
+            if op_trace and tr_active:
+                # Per-op response-gate exit: the batched analog of
+                # _response_msg's respond instant, echoed with the
+                # op's id in the response table so the worker's spans
+                # stay per-op.
+                tracer.instant(op_trace, "respond",
+                               args={"to": env.sender,
+                                     "ts": meta.timestamp})
             ops.append(_BatchOp(
                 push=meta.push, pull=meta.pull,
                 timestamp=meta.timestamp, key=meta.key,
                 val_len=meta.val_len, option=option,
                 stamp=getattr(meta, "stamp", 0), nseg=nseg,
-                codec=codec_info,
+                codec=codec_info, trace=op_trace,
             ))
         m.batch = _BatchInfo(ops=tuple(ops))
         # Already one frame (batch is set, so it can never re-merge),

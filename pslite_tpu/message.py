@@ -57,6 +57,12 @@ class Command(enum.IntEnum):
     # its key ranges (ROUTING epoch), the server migrates them, reports
     # completion (REMOVE_DONE_OPT), and the scheduler retires it.
     REMOVE_NODE = 13
+    # Tail-trace pull (docs/observability.md): the scheduler drains a
+    # node's bounded span ring (the reply carries it as JSON in
+    # meta.body, plus trace-correlated flight events); the request body
+    # piggybacks windowed-quantile threshold hints for the node's
+    # tail-keep policy.  Same broadcast+gather shape as METRICS_PULL.
+    TRACE_PULL = 14
 
 
 # Wire dtype codes (stable across hosts; independent of numpy internals).
@@ -187,6 +193,12 @@ class BatchOp:
     stamp: int = 0     # per-op hot-cache push-version (kv/hot_cache.py)
     nseg: int = 0      # data segments this op owns in the frame
     codec: Optional["CodecInfo"] = None
+    # Per-op trace id (telemetry/tracing.py): traced ops MERGE like any
+    # other — the id rides the table (packed only when nonzero, so
+    # untraced frames are byte-identical to pre-trace builds) and is
+    # echoed on the batched response, killing the old observer effect
+    # where sampled ops were forced out of the batch plane.
+    trace: int = 0
 
 
 @dataclass(frozen=True)

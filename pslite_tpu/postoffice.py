@@ -125,6 +125,15 @@ class Postoffice:
         self._metrics_token = 0
         self._metrics_replies: Dict[int, dict] = {}
         self._metrics_last_seen: Dict[int, float] = {}
+        # TRACE_PULL collection state (docs/observability.md): same
+        # broadcast+gather shape as METRICS_PULL, serialized under the
+        # SAME collect lock (a trace pull racing a metrics pull is
+        # fine — they use separate tokens/reply maps — but two trace
+        # pulls must not interleave).  The collector itself (span
+        # assembly, TTL retirement) is lazily built scheduler-side.
+        self._trace_token = 0
+        self._trace_replies: Dict[int, dict] = {}
+        self._trace_collector = None  # telemetry.TraceCollector
         # Continuous telemetry plane (docs/observability.md): the
         # scheduler's ClusterHistory sampler + SLO watchdog.  Lazily
         # built by start_history(); started automatically by start()
@@ -684,6 +693,112 @@ class Postoffice:
         newest pull with a last-seen age instead of dropping them."""
         with self._metrics_cv:
             return dict(self._metrics_last_seen)
+
+    # -- tail-trace pull plane (TRACE_PULL — docs/observability.md) ----------
+
+    def trace_collector(self):
+        """The scheduler's cross-node trace assembler (lazily built;
+        ``telemetry.TraceCollector``)."""
+        if self._trace_collector is None:
+            from .telemetry.trace_store import TraceCollector
+
+            self._trace_collector = TraceCollector(
+                ttl_s=self.env.find_float("PS_TRACE_TTL", 30.0),
+                max_traces=self.env.find_int("PS_TRACE_KEEP", 4096),
+            )
+        return self._trace_collector
+
+    def absorb_trace_reply(self, msg: Message) -> None:
+        """Van hook: a node's TRACE_PULL reply arrived."""
+        try:
+            rep = json.loads(msg.meta.body.decode())
+        except Exception as exc:  # noqa: BLE001 - one corrupt reply
+            log.warning(f"bad TRACE_PULL reply: {exc!r}")  # can't wedge
+            rep = {"node_id": msg.meta.sender, "error": repr(exc)}
+        with self._metrics_cv:
+            if msg.meta.timestamp != self._trace_token:
+                return  # stale reply from an earlier (timed-out) pull
+            self._trace_replies[msg.meta.sender] = rep
+            self._metrics_cv.notify_all()
+
+    def _tail_hints(self) -> dict:
+        """Tail-keep threshold hints piggybacked on the TRACE_PULL
+        broadcast: windowed push/pull latency quantiles from the
+        ClusterHistory sampler (docs/observability.md).  Empty without
+        a history — nodes then fall back to their local histograms."""
+        h = self.history
+        if h is None or h.samples < 2:
+            return {}
+        hints: Dict[str, dict] = {}
+        for path, hist in (("push", "kv.push_latency_s"),
+                           ("pull", "kv.pull_latency_s")):
+            for q, label in ((0.9, "p90"), (0.95, "p95"), (0.99, "p99")):
+                worst = None
+                for nid in h.node_ids():
+                    if h.role_of(nid) != "worker":
+                        continue
+                    v = h.window_quantile(nid, hist, q)
+                    if v is not None and (worst is None or v > worst):
+                        worst = v
+                if worst is not None:
+                    hints.setdefault(path, {})[label] = worst
+        return hints
+
+    def collect_cluster_traces(self, timeout_s: float = 5.0):
+        """Scheduler-side live trace assembly: broadcast TRACE_PULL to
+        every live node (piggybacking tail-threshold hints), drain the
+        replies' span rings into the :meth:`trace_collector`, retire
+        expired partials, and return the collector.  Shares the
+        METRICS_PULL collect lock so concurrent pulls serialize."""
+        log.check(self.is_scheduler,
+                  "collect_cluster_traces runs on the scheduler")
+        hints = self._tail_hints()
+        body = json.dumps({"hints": hints}).encode() if hints else b""
+        with self._collect_mu:
+            peers = [
+                i for i in self.get_node_ids(WORKER_GROUP + SERVER_GROUP)
+                if not self.van.is_peer_down(i)
+            ]
+            with self._metrics_cv:
+                self._trace_token += 1
+                token = self._trace_token
+                self._trace_replies = {}
+            reached = 0
+            for peer in peers:
+                msg = Message()
+                msg.meta.recver = peer
+                msg.meta.sender = self.van.my_node.id
+                msg.meta.request = True
+                msg.meta.timestamp = token
+                msg.meta.body = body
+                msg.meta.control = Control(cmd=Command.TRACE_PULL)
+                try:
+                    self.van.send(msg)
+                    reached += 1
+                except Exception as exc:  # noqa: BLE001 - a dead peer
+                    # must neither fail the pull nor stall the gather.
+                    log.warning(f"TRACE_PULL to {peer} failed: {exc!r}")
+            deadline = time.monotonic() + timeout_s
+            with self._metrics_cv:
+                while len(self._trace_replies) < reached:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._metrics_cv.wait(remaining)
+                replies = dict(self._trace_replies)
+        coll = self.trace_collector()
+        # The scheduler's own ring drains locally (it rarely records,
+        # but a complete pull must not special-case the puller).
+        spans, evicted = self.tracer.drain()
+        coll.ingest(self.van.my_node.id, self.role_str(), spans,
+                    [e for e in self.flight.events() if e.get("trace")],
+                    evicted=evicted)
+        for nid, rep in replies.items():
+            coll.ingest(nid, rep.get("role", "?"),
+                        rep.get("spans") or [], rep.get("flight") or [],
+                        evicted=rep.get("evicted") or 0)
+        coll.retire()
+        return coll
 
     # -- continuous telemetry plane (docs/observability.md) ------------------
 

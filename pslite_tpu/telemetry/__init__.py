@@ -21,9 +21,16 @@ Env knobs (docs/observability.md):
 - ``PS_TELEMETRY`` (default 1): 0 swaps every instrument for a shared
   no-op singleton — near-zero cost, empty snapshots.
 - ``PS_TRACE_SAMPLE`` (default 0): probability in [0, 1] that a
-  ``KVWorker.push/pull`` mints a trace id; 0 disables tracing.
+  ``KVWorker.push/pull`` mints a trace id (legacy head sampling).
+- ``PS_TRACE_TAIL`` (default off): tail-based capture spec
+  (``slow:p95,errors,floor:0.001``) — every request is stamped, the
+  worker keeps only interesting traces at completion, and the
+  scheduler assembles them live over ``Command.TRACE_PULL``
+  (:class:`~.trace_store.TraceCollector`, ``tools/pstrace.py``).
 - ``PS_TRACE_DIR``: directory for the per-node Chrome trace-event JSON
   exports and flight-recorder dumps (default: system tempdir).
+- ``PS_TRACE_RING`` / ``PS_TRACE_FLUSH_S``: span-ring capacity and the
+  crash-safe periodic export interval.
 - ``PS_METRICS_INTERVAL`` (default 0 = off): the scheduler's
   background METRICS_PULL sampling period in seconds.
 - ``PS_METRICS_HISTORY`` (default 512): snapshots retained per node.
@@ -52,4 +59,9 @@ from .metrics import (  # noqa: F401
     merge_bucket_lists,
 )
 from .timeseries import ClusterHistory, NodeSeries  # noqa: F401
+from .trace_store import (  # noqa: F401
+    AssembledTrace,
+    TailPolicy,
+    TraceCollector,
+)
 from .tracing import NULL_TRACER, Tracer  # noqa: F401

@@ -88,9 +88,14 @@ class Histogram:
     """
 
     NBUCKETS = 64
+    # Bounded exemplar slots (docs/observability.md): at most this
+    # many buckets hold a (trace id, value, wall) exemplar at once —
+    # the hook that links a Prometheus histogram panel straight to the
+    # tail trace that produced the bucket's latest observation.
+    EXEMPLAR_SLOTS = 8
 
     __slots__ = ("name", "lo", "_mu", "count", "sum", "min", "max",
-                 "buckets")
+                 "buckets", "_exemplars")
 
     def __init__(self, name: str, lo: float = 1e-6):
         self.name = name
@@ -101,6 +106,9 @@ class Histogram:
         self.min = float("inf")
         self.max = 0.0
         self.buckets = [0] * self.NBUCKETS
+        # bucket index -> (trace id hex, value, wall time); bounded at
+        # EXEMPLAR_SLOTS distinct buckets, oldest wall evicted.
+        self._exemplars: Dict[int, tuple] = {}
 
     def bucket_index(self, v: float) -> int:
         if v <= self.lo:
@@ -124,6 +132,29 @@ class Histogram:
             if v > self.max:
                 self.max = v
             self.buckets[i] += 1
+
+    def attach_exemplar(self, v: float, trace_id: int,
+                        wall: Optional[float] = None) -> None:
+        """Attach a KEPT trace id to the bucket its latency landed in
+        (OpenMetrics exemplars — psmon ``--serve`` renders them as
+        ``# {trace_id=...}`` suffixes).  Same-bucket exemplars
+        overwrite (newest wins); past ``EXEMPLAR_SLOTS`` distinct
+        buckets the oldest-walled slot evicts, so the table stays a
+        bounded sketch, not a trace store."""
+        if not trace_id:
+            return
+        i = self.bucket_index(v)
+        wall = time.time() if wall is None else wall
+        with self._mu:
+            self._exemplars[i] = (f"{trace_id:x}", float(v), wall)
+            while len(self._exemplars) > self.EXEMPLAR_SLOTS:
+                victim = min(self._exemplars,
+                             key=lambda b: self._exemplars[b][2])
+                del self._exemplars[victim]
+
+    def exemplars(self) -> Dict[int, tuple]:
+        with self._mu:
+            return dict(self._exemplars)
 
     def quantile(self, q: float) -> float:
         """Estimated q-quantile (0..1) from the bucket counts; 0.0 when
@@ -150,8 +181,12 @@ class Histogram:
             mn = self.min if self.count else 0.0
             mx = self.max
             nonzero = [[i, n] for i, n in enumerate(self.buckets) if n]
+            ex = [[i, t, v, w]
+                  for i, (t, v, w) in sorted(self._exemplars.items())]
         out = {"count": count, "sum": total, "min": mn, "max": mx,
                "lo": self.lo, "buckets": nonzero}
+        if ex:
+            out["exemplars"] = ex
         for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
             out[label] = self.quantile(q)
         return out
@@ -163,6 +198,7 @@ class Histogram:
             self.min = float("inf")
             self.max = 0.0
             self.buckets = [0] * self.NBUCKETS
+            self._exemplars.clear()
 
 
 class TopK:
@@ -218,6 +254,12 @@ class _NullInstrument:
 
     def observe(self, v: float) -> None:
         pass
+
+    def attach_exemplar(self, v: float, trace_id: int, wall=None) -> None:
+        pass
+
+    def exemplars(self) -> dict:
+        return {}
 
     def add(self, key: int, n: int = 1) -> None:
         pass

@@ -206,6 +206,8 @@ class Van:
         # sees only absence; the counter names the failing side).
         self._c_pull_reply_failures = self._node_metrics.counter(
             "van.metrics_pull_failures")
+        self._c_trace_reply_failures = self._node_metrics.counter(
+            "van.trace_pull_failures")
         # Scheduler-side registration state.
         self._registrations: List[Node] = []
         self._registered_addrs: Dict[str, int] = {}  # addr -> assigned id
@@ -875,8 +877,14 @@ class Van:
             f"delivery to node {m.recver} failed ({exc}); failing "
             f"local request ts={m.timestamp}"
         )
+        detail = {}
+        if m.trace:
+            # Trace correlation (docs/observability.md): pstrace
+            # --slowest prints flight events carrying the trace inline.
+            detail["trace"] = f"{m.trace:x}"
         self.flight.record("send_failed", severity="warn", peer=m.recver,
-                           ts=m.timestamp, error=repr(exc)[:200])
+                           ts=m.timestamp, error=repr(exc)[:200],
+                           **detail)
         # A multi-op batch frame (docs/batching.md) carries N waiters,
         # each with its OWN timestamp: synthesize one OPT_SEND_FAILED
         # per sub-op — failing only the envelope's (first) timestamp
@@ -1024,6 +1032,55 @@ class Van:
         except Exception as exc:  # noqa: BLE001
             self._c_pull_reply_failures.inc()
             log.warning(f"METRICS_PULL reply failed: {exc!r}")
+
+    def _process_trace_pull(self, msg: Message) -> None:
+        """TRACE_PULL (docs/observability.md): a request drains this
+        node's span ring into the reply body (JSON: spans +
+        trace-correlated flight events + the eviction count), and
+        absorbs the scheduler's piggybacked tail-threshold hints; a
+        response routes to the postoffice collector
+        (``collect_cluster_traces``)."""
+        if not msg.meta.request:
+            self.po.absorb_trace_reply(msg)
+            return
+        try:
+            req_body = (json.loads(msg.meta.body.decode())
+                        if msg.meta.body else {})
+        except Exception:  # noqa: BLE001 - hints are best-effort
+            req_body = {}
+        hints = req_body.get("hints") or {}
+        if hints:
+            try:
+                self.tracer.note_hints(hints)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"bad TRACE_PULL hints: {exc!r}")
+        try:
+            spans, evicted = self.tracer.drain()
+            flight = [e for e in self.flight.events() if e.get("trace")]
+            body = json.dumps({
+                "node_id": self.my_node.id,
+                "role": self.po.role_str(),
+                "spans": spans,
+                "flight": flight,
+                "evicted": evicted,
+            }).encode()
+        except Exception as exc:  # noqa: BLE001 - never strand the
+            # collector waiting on this node's reply.
+            body = json.dumps({
+                "node_id": self.my_node.id, "error": repr(exc),
+            }).encode()
+        reply = Message()
+        reply.meta.recver = msg.meta.sender
+        reply.meta.sender = self.my_node.id
+        reply.meta.request = False
+        reply.meta.timestamp = msg.meta.timestamp  # collector token
+        reply.meta.control = Control(cmd=Command.TRACE_PULL)
+        reply.meta.body = body
+        try:
+            self._dispatch_send(reply)
+        except Exception as exc:  # noqa: BLE001
+            self._c_trace_reply_failures.inc()
+            log.warning(f"TRACE_PULL reply failed: {exc!r}")
 
     # -- elastic membership (docs/elasticity.md) -----------------------------
 
@@ -1300,6 +1357,8 @@ class Van:
                     self._process_node_failure(msg)
                 elif ctrl.cmd == Command.METRICS_PULL:
                     self._process_metrics_pull(msg)
+                elif ctrl.cmd == Command.TRACE_PULL:
+                    self._process_trace_pull(msg)
                 elif ctrl.cmd == Command.ROUTING:
                     self._process_routing(msg)
                 elif ctrl.cmd == Command.REMOVE_NODE:
@@ -1325,9 +1384,18 @@ class Van:
                 )
                 # The crash postmortem: record + dump the flight ring
                 # NOW — with PS_CHECK_FATAL the process is about to
-                # _exit and no Van.stop() will ever run.
+                # _exit and no Van.stop() will ever run.  The trace
+                # ring dumps alongside it (same PS_TRACE_DIR), so the
+                # spans leading up to the abort join the flight JSON
+                # on one timeline.
+                trace_path = None
+                try:
+                    trace_path = self.tracer.export_if_any()
+                except Exception:  # noqa: BLE001 - dying anyway
+                    pass
                 self.flight.record("check_failure", severity="crit",
-                                   error=str(exc)[:200])
+                                   error=str(exc)[:200],
+                                   trace_file=trace_path)
                 try:
                     self.flight.dump()
                 except Exception:  # noqa: BLE001 - dying anyway
@@ -1418,11 +1486,17 @@ class Van:
     def _process_data_msg(self, msg: Message) -> None:
         self.deliver_data_msg(msg)
         self.profiler.record(msg.meta.key, "recv", msg.meta.push)
-        if msg.meta.trace and self.tracer.active:
-            self.tracer.instant(msg.meta.trace, "recv", args={
-                "from": msg.meta.sender, "bytes": msg.meta.data_size,
-                "push": msg.meta.push, "request": msg.meta.request,
-            })
+        if self.tracer.active:
+            # Receive stamp (docs/observability.md): the wire→intake
+            # boundary every server_queue span starts from — stamped on
+            # every data message (batch ENVELOPES carry their traces in
+            # the per-op table, so meta.trace alone can't gate it).
+            msg._recv_us = self.tracer.now_us()
+            if msg.meta.trace:
+                self.tracer.instant(msg.meta.trace, "recv", args={
+                    "from": msg.meta.sender, "bytes": msg.meta.data_size,
+                    "push": msg.meta.push, "request": msg.meta.request,
+                })
         app_id = msg.meta.app_id
         # Workers demux by customer_id (several KVWorker customers share one
         # app); servers demux by app_id (reference: van.cc:428-438).

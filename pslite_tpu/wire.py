@@ -84,7 +84,12 @@ EXT_BATCH = 5
 _EXT_BATCH_PAYLOAD = struct.Struct("<HI")  # n_ops, table_len
 _BATCH_OP_FIXED = struct.Struct("<BBiQqqQ")
 # flags, nseg, timestamp, key, val_len, option, stamp
-_BATCH_F_PUSH, _BATCH_F_PULL, _BATCH_F_CODEC = 1, 2, 4
+# Per-op trace id (telemetry/tracing.py): a u64 appended AFTER the
+# codec block when the flag is set — untraced ops (and therefore whole
+# untraced frames) stay byte-identical to pre-trace builds.  The
+# addition is capability-gated by BATCH_WIRE_VERSION (kv/batching.py):
+# peers answering an older version never receive EXT_BATCH frames.
+_BATCH_F_PUSH, _BATCH_F_PULL, _BATCH_F_CODEC, _BATCH_F_TRACE = 1, 2, 4, 8
 BATCH_MAX_OPS = 0xFFFF  # u16 op count
 
 
@@ -95,6 +100,7 @@ def _pack_batch_table(info: BatchInfo) -> bytes:
             (_BATCH_F_PUSH if op.push else 0)
             | (_BATCH_F_PULL if op.pull else 0)
             | (_BATCH_F_CODEC if op.codec is not None else 0)
+            | (_BATCH_F_TRACE if op.trace else 0)
         )
         parts.append(_BATCH_OP_FIXED.pack(
             flags, op.nseg & 0xFF, op.timestamp, op.key % (1 << 64),
@@ -106,6 +112,8 @@ def _pack_batch_table(info: BatchInfo) -> bytes:
                 cd.codec & 0xFF, cd.flags & 0xFF, cd.block & 0xFFFF,
                 cd.raw_len % (1 << 64),
             ))
+        if op.trace:
+            parts.append(_EXT_TRACE_PAYLOAD.pack(op.trace % (1 << 64)))
     return b"".join(parts)
 
 
@@ -125,11 +133,15 @@ def _unpack_batch_table(table: memoryview, n_ops: int) -> BatchInfo:
             off += _EXT_CODEC_PAYLOAD.size
             codec = CodecInfo(codec=c_id, raw_len=c_raw, block=c_block,
                               flags=c_flags)
+        trace = 0
+        if flags & _BATCH_F_TRACE:
+            (trace,) = _EXT_TRACE_PAYLOAD.unpack_from(table, off)
+            off += _EXT_TRACE_PAYLOAD.size
         ops.append(BatchOp(
             push=bool(flags & _BATCH_F_PUSH),
             pull=bool(flags & _BATCH_F_PULL),
             timestamp=ts, key=key, val_len=val_len, option=option,
-            stamp=stamp, nseg=nseg, codec=codec,
+            stamp=stamp, nseg=nseg, codec=codec, trace=trace,
         ))
     return BatchInfo(ops=tuple(ops))
 

@@ -182,15 +182,22 @@ def test_build_split_roundtrip_preserves_ops():
 
 
 def test_batchable_declines():
-    """Structural decline rows: traced ops, custom cmds, zpull-marked,
-    chunk frames, and >3-segment (lens'd) payloads pass through."""
+    """Structural decline rows: custom cmds, zpull-marked, chunk
+    frames, and >3-segment (lens'd) payloads pass through.  Traced ops
+    MERGE (the trace id rides the per-op table — tracing must not
+    perturb the batch plane it measures, docs/observability.md)."""
     from pslite_tpu.message import OPT_ZPULL, ChunkInfo
 
     ok = _op_msg(1, 1, np.ones(4))
     assert batchable(ok)
     traced = _op_msg(1, 1, np.ones(4))
     traced.meta.trace = 99
-    assert not batchable(traced)
+    assert batchable(traced)
+    env = build_batch_message([traced, _op_msg(2, 2, np.ones(4))])
+    assert env.meta.trace == 0  # the ENVELOPE stays untraced
+    assert [op.trace for op in env.meta.batch.ops] == [99, 0]
+    subs = split_batch_message(env)
+    assert [s.meta.trace for s in subs] == [99, 0]
     cmd = _op_msg(1, 1, np.ones(4))
     cmd.meta.head = 0x77
     assert not batchable(cmd)

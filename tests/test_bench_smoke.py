@@ -193,13 +193,14 @@ def test_telemetry_overhead_guard():
     the kv loopback storm with PS_TELEMETRY on — INCLUDING the
     continuous METRICS_PULL sampler at a 1 s interval
     (docs/observability.md) — stays within 10% of telemetry-off on the
-    stub bench (min-of-3 per leg to damp scheduler noise, plus a small
-    absolute epsilon for sub-second walls)."""
+    stub bench, and so does TAIL TRACING at the production floor rate
+    (every request stamped and span-recorded, keep decided at
+    completion).  Min-of-3 per leg to damp scheduler noise, plus a
+    small absolute epsilon for sub-second walls."""
     from pslite_tpu.benchmark import kv_loopback_storm
 
-    def best(telemetry: bool) -> float:
+    def best(telemetry: bool, extra=None) -> float:
         walls = []
-        extra = {"PS_METRICS_INTERVAL": "1"} if telemetry else None
         for _ in range(3):
             r = kv_loopback_storm(
                 n_workers=2, n_servers=2, msgs_per_worker=40,
@@ -211,10 +212,15 @@ def test_telemetry_overhead_guard():
 
     # Interleave-insensitive order: off first warms every code path.
     off = best(False)
-    on = best(True)
+    on = best(True, {"PS_METRICS_INTERVAL": "1"})
     assert on <= off * 1.10 + 0.05, (
         f"telemetry overhead too high: on={on:.3f}s off={off:.3f}s "
         f"({on / off:.2f}x)"
+    )
+    tail = best(True, {"PS_TRACE_TAIL": "slow:p95,errors,floor:0.001"})
+    assert tail <= off * 1.10 + 0.05, (
+        f"tail-tracing overhead too high: tail={tail:.3f}s "
+        f"off={off:.3f}s ({tail / off:.2f}x)"
     )
     # And the instrumented leg actually measured something.
     r = kv_loopback_storm(n_workers=1, n_servers=1, msgs_per_worker=5,

@@ -1,0 +1,553 @@
+"""Tail-based request tracing (docs/observability.md): keep policy,
+live TRACE_PULL assembly, critical-path attribution, exemplars, and
+the batch-plane observer-effect fix."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker
+from pslite_tpu.environment import Environment
+from pslite_tpu.telemetry.critical_path import STAGES
+from pslite_tpu.telemetry.metrics import Histogram, Registry
+from pslite_tpu.telemetry.trace_store import TailPolicy, TraceCollector
+from pslite_tpu.telemetry.tracing import Tracer
+from pslite_tpu.utils.logging import CheckError
+
+from helpers import LoopbackCluster
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+# -- keep policy -------------------------------------------------------------
+
+
+def test_tail_policy_parse():
+    p = TailPolicy.parse("slow:p95,errors,floor:0.001")
+    assert p.slow_q == 0.95 and p.errors and p.floor == 0.001
+    # Bare truthy value expands to the default spec.
+    d = TailPolicy.parse("1")
+    assert d.slow_q == 0.95 and d.errors and d.floor == 0.001
+    assert TailPolicy.parse(None) is None
+    assert TailPolicy.parse("0") is None
+    assert TailPolicy.parse("off") is None
+    only_err = TailPolicy.parse("errors")
+    assert only_err.errors and only_err.slow_q is None \
+        and only_err.floor == 0.0
+    with pytest.raises(CheckError):
+        TailPolicy.parse("slow:p95,bogus")
+    with pytest.raises(CheckError):
+        TailPolicy.parse("floor:2.0")
+
+
+def _tail_tracer(spec, metrics=None):
+    return Tracer(Environment({"PS_TRACE_TAIL": spec}), "worker",
+                  metrics=metrics)
+
+
+def test_tail_keep_slow_kept_fast_dropped():
+    tr = _tail_tracer("slow:p95,floor:0")
+    assert tr.active and tr.tail is not None
+    h = Histogram("kv.pull_latency_s")
+    for _ in range(200):
+        h.observe(0.001)
+    tr.set_tail_source("pull", h)
+    # Fast request (at the population's bulk): dropped.
+    assert tr.tail_keep(0.001, "pull") is None
+    # 10x the p95: kept, with the slow reason.
+    assert tr.tail_keep(0.05, "pull") == "slow>p95"
+    # A COLD path (no source, no hint): slow rule inactive — nothing
+    # kept under this spec (floor 0, no errors).
+    assert tr.tail_keep(10.0, "push") is None
+
+
+def test_tail_keep_error_always_kept_floor_uniform():
+    tr = _tail_tracer("errors")
+    # Errors keep regardless of latency; the reason is the outcome.
+    assert tr.tail_keep(1e-6, "push", outcome="shed") == "shed"
+    assert tr.tail_keep(1e-6, "pull", outcome="timeout") == "timeout"
+    assert tr.tail_keep(1e-6, "push") is None  # no floor, no slow
+    everything = _tail_tracer("floor:1.0")
+    assert everything.tail_keep(1e-6, "push") == "floor"
+    # Legacy head-sampled mode: the decision was made up front.
+    legacy = Tracer(Environment({"PS_TRACE_SAMPLE": "1"}), "worker")
+    assert legacy.tail_keep(1e-6, "push") == "sampled"
+
+
+def test_trace_pull_hints_override_local_histogram():
+    tr = _tail_tracer("slow:p95")
+    h = Histogram("kv.push_latency_s")
+    for _ in range(100):
+        h.observe(0.010)
+    tr.set_tail_source("push", h)
+    local = tr.tail_threshold("push")
+    assert local is not None and 0.005 < local < 0.02
+    # A scheduler hint (windowed cluster p95) outranks the local view.
+    tr.note_hints({"push": {"p95": 0.5}, "pull": {"p95": 0.25}})
+    assert tr.tail_threshold("push") == 0.5
+    assert tr.tail_threshold("pull") == 0.25
+    # Stale hints fall back to the local histogram.
+    tr.HINT_TTL_S = 0.0
+    assert abs(tr.tail_threshold("push") - local) < 1e-9
+
+
+def test_tail_ids_unique_and_ring_evicts_oldest():
+    reg = Registry()
+    tr = _tail_tracer("floor:1.0", metrics=reg)
+    ids = {tr.begin_request() for _ in range(1000)}
+    assert len(ids) == 1000 and 0 not in ids
+    tr.MAX_EVENTS = 4
+    for i in range(10):
+        tr.span(i + 1, "request", float(i), 1.0)
+    assert tr.num_events == 4
+    evs, evicted = tr.drain()
+    # Oldest evicted, newest retained (ring, not drop-newest).
+    assert [e["ts"] for e in evs] == [6.0, 7.0, 8.0, 9.0]
+    assert evicted == 6
+    assert reg.snapshot()["counters"]["trace.ring_evictions"] == 6
+    assert tr.num_events == 0  # drained
+
+
+# -- exemplars ---------------------------------------------------------------
+
+
+def test_exemplar_slots_bounded_and_rendered():
+    import psmon
+
+    h = Histogram("kv.pull_latency_s")
+    # Distinct buckets beyond the cap: oldest-walled slots evict.
+    for i in range(Histogram.EXEMPLAR_SLOTS + 4):
+        v = 1e-5 * (2 ** i)
+        h.observe(v)
+        h.attach_exemplar(v, 0x1000 + i, wall=float(i))
+    ex = h.exemplars()
+    assert len(ex) == Histogram.EXEMPLAR_SLOTS
+    walls = sorted(w for _t, _v, w in ex.values())
+    assert walls[0] == 4.0  # the 4 oldest evicted
+    # Same-bucket attach overwrites in place (no growth).
+    h.attach_exemplar(1e-5 * (2 ** 11), 0xBEEF, wall=99.0)
+    assert len(h.exemplars()) == Histogram.EXEMPLAR_SLOTS
+    snap = h.snapshot()
+    assert len(snap["exemplars"]) == Histogram.EXEMPLAR_SLOTS
+    cluster_snap = {9: {
+        "role": "worker",
+        "metrics": {"counters": {}, "gauges": {},
+                    "histograms": {"kv.pull_latency_s": snap},
+                    "topk": {}},
+    }}
+    # OpenMetrics rendering carries the exemplar + # EOF; the classic
+    # 0.0.4 rendering must NOT (its parsers reject exemplar syntax).
+    om = psmon.to_prometheus(cluster_snap, openmetrics=True)
+    assert '# {trace_id="beef"}' in om and om.rstrip().endswith("# EOF")
+    plain = psmon.to_prometheus(cluster_snap)
+    assert "trace_id" not in plain and "# EOF" not in plain
+    # serve() negotiates on the Accept header.
+    import urllib.request
+
+    httpd = psmon.serve(lambda: cluster_snap, 0)
+    try:
+        port = httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req) as resp:
+            assert "openmetrics" in resp.headers["Content-Type"]
+            assert b"trace_id" in resp.read()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            assert "0.0.4" in resp.headers["Content-Type"]
+            assert b"trace_id" not in resp.read()
+    finally:
+        httpd.shutdown()
+    h.reset()
+    assert h.exemplars() == {}
+
+
+# -- collector assembly ------------------------------------------------------
+
+
+def _span(tid, name, ts, dur=0.0, **args):
+    a = {"trace": f"{tid:x}"}
+    a.update(args)
+    return {"name": name, "ph": "X" if dur else "i", "ts": ts,
+            "dur": dur, "tid": 1, "args": a}
+
+
+def test_collector_missing_node_partials_retire_on_ttl():
+    coll = TraceCollector(ttl_s=0.05)
+    # Server-side spans arrived, but the worker (which holds the root)
+    # is MISSING from the pull — the trace must not linger forever.
+    coll.ingest(10, "server", [_span(7, "apply", 100.0, 5.0)])
+    assert len(coll) == 1 and coll.assembled() == []
+    assert coll.retire(now=time.monotonic() + 1.0) == 1
+    assert len(coll) == 0
+    # A rooted trace survives retirement even with servers missing.
+    coll.ingest(9, "worker", [_span(8, "request", 0.0, 50.0,
+                                    keep="floor", pull=False)])
+    coll.retire(now=time.monotonic() + 1.0)
+    asm = coll.assembled()
+    assert len(asm) == 1
+    b = asm[0].breakdown()
+    # No checkpoints at all: the whole wall folds into completion —
+    # the sum identity holds regardless of which nodes answered.
+    assert abs(sum(b["stages"].values()) - b["wall_us"]) < 1e-6
+    assert b["stages"]["completion"] == b["wall_us"]
+
+
+def test_collector_bounded_eviction():
+    coll = TraceCollector(ttl_s=60.0, max_traces=16)
+    for i in range(40):
+        coll.ingest(10, "server", [_span(i + 1, "apply", float(i), 1.0)])
+    assert len(coll) == 16 and coll.evicted == 24
+
+
+# -- live cluster: capture, pull, assembly, attribution ----------------------
+
+
+def _boot(cluster):
+    servers = []
+    for po in cluster.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    workers = [KVWorker(0, 0, postoffice=po) for po in cluster.workers]
+    return servers, workers
+
+
+def _stop_all(cluster, servers, workers):
+    for w in workers:
+        w.stop()
+    for s in servers:
+        s.stop()
+    cluster.finalize()
+
+
+def test_tail_capture_live_assembly_and_exemplars():
+    """floor:1.0 keeps every request: a storm's traces assemble live
+    over TRACE_PULL, each breakdown's stages sum exactly to its wall,
+    and kept ids land as exemplars on the latency histograms."""
+    import psmon
+
+    cluster = LoopbackCluster(
+        num_workers=2, num_servers=2,
+        env_extra={"PS_TRACE_TAIL": "floor:1.0"},
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers = _boot(cluster)
+        keys = np.array([3, 2 ** 62, 2 ** 63 + 9], dtype=np.uint64)
+        vals = np.ones(len(keys) * 32, np.float32)
+        out = np.zeros_like(vals)
+        for _ in range(8):
+            tss = [w.push(keys, vals) for w in workers]
+            for w, ts in zip(workers, tss):
+                w.wait(ts)
+        workers[0].wait(workers[0].pull(keys, out))
+        coll = cluster.scheduler.collect_cluster_traces(timeout_s=10)
+        asm = coll.assembled()
+        assert len(asm) >= 17  # 16 pushes + 1 pull, all kept
+        server_pids = {po.van.my_node.id for po in cluster.servers}
+        saw_server = False
+        for tr in asm:
+            b = tr.breakdown()
+            assert set(b["stages"]) == set(STAGES)
+            assert all(v >= 0.0 for v in b["stages"].values())
+            # The acceptance identity: stages partition the wall.
+            assert abs(sum(b["stages"].values()) - b["wall_us"]) \
+                <= max(1e-6, 0.001 * b["wall_us"])
+            assert b["keep"] == "floor"
+            if b["server"] in server_pids:
+                saw_server = True
+                assert b["stages"]["apply"] > 0.0 or \
+                    b["stages"]["server_queue"] >= 0.0
+        assert saw_server, "no trace assembled server-side spans"
+        # Kept ids attached as exemplars; the scrape renders them.
+        snap = cluster.scheduler.collect_cluster_metrics(timeout_s=10)
+        wsnap = next(s for s in snap.values() if s["role"] == "worker")
+        hist = wsnap["metrics"]["histograms"]["kv.push_latency_s"]
+        assert hist.get("exemplars"), "kept traces left no exemplars"
+        assert "# {trace_id=" in psmon.to_prometheus(snap,
+                                                     openmetrics=True)
+        # A second pull drains fresh spans only (rings emptied) and
+        # keeps the earlier traces in the collector.
+        n_before = len(coll)
+        workers[0].wait(workers[0].push(keys, vals))
+        coll2 = cluster.scheduler.collect_cluster_traces(timeout_s=10)
+        assert coll2 is coll and len(coll2) >= n_before
+    finally:
+        _stop_all(cluster, servers, workers)
+
+
+def test_error_outcome_always_kept():
+    """spec='errors': fast clean requests drop, a handler failure's
+    trace is kept with the outcome as the keep reason."""
+
+    class Boom:
+        def __call__(self, meta, kvs, server):
+            if meta.push:
+                raise RuntimeError("boom")
+            server.response(meta)
+
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=1,
+        env_extra={"PS_TRACE_TAIL": "errors", "PS_APPLY_SHARDS": "0"},
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        srv = KVServer(0, postoffice=cluster.servers[0])
+        srv.set_request_handle(Boom())
+        servers = [srv]
+        workers = [KVWorker(0, 0, postoffice=cluster.workers[0])]
+        keys = np.array([3], dtype=np.uint64)
+        vals = np.ones(4, np.float32)
+        with pytest.raises(RuntimeError):
+            workers[0].wait(workers[0].push(keys, vals))
+        coll = cluster.scheduler.collect_cluster_traces(timeout_s=10)
+        asm = coll.assembled()
+        assert len(asm) == 1
+        b = asm[0].breakdown()
+        assert b["keep"] == "error" and b["outcome"] == "error"
+    finally:
+        _stop_all(cluster, servers, workers)
+
+
+# -- observer effect: traced ops ride the batch plane ------------------------
+
+
+def test_traced_run_frame_parity_with_untraced():
+    """A traced storm produces the SAME frame count as an untraced
+    one: the combiner merges traced ops (ids in the per-op table)
+    instead of forcing them out as singles."""
+    from pslite_tpu.kv.batching import OpCombiner
+    from pslite_tpu.message import Message
+    from pslite_tpu.sarray import SArray
+
+    def mk(ts, trace):
+        m = Message()
+        mm = m.meta
+        mm.app_id = 1
+        mm.request = True
+        mm.push = True
+        mm.head = 0
+        mm.timestamp = ts
+        mm.recver = 8
+        m.add_data(SArray(np.array([ts], np.uint64)))
+        m.add_data(SArray(np.ones(4, np.float32)))
+        mm.trace = trace
+        return m
+
+    def frames_for(traces):
+        import time as _t
+
+        sent = []
+        c = OpCombiner(sent.append, lambda msgs, exc: None,
+                       max_bytes=1 << 20)
+        # Deterministic: enqueue the whole run, take the group once,
+        # flush — exactly what one dispatcher pickup does mid-storm.
+        key = None
+        with c._cv:
+            for i in range(10):
+                key, _grp, _ = c._enqueue_locked(mk(i, traces[i]),
+                                                 _t.monotonic())
+            taken = c._take_locked(key)
+        c._stop = True  # no dispatcher thread needed for this test
+        c._flush(taken)
+        return sent
+
+    untraced = frames_for([0] * 10)
+    traced = frames_for([0x100 + i for i in range(10)])
+    assert len(untraced) == len(traced) == 1  # one merged frame each
+    assert len(traced[0].meta.batch.ops) == 10
+    assert [op.trace for op in traced[0].meta.batch.ops] == [
+        0x100 + i for i in range(10)]
+    assert all(op.trace == 0 for op in untraced[0].meta.batch.ops)
+
+
+def test_batch_table_trace_wire_roundtrip():
+    """The per-op trace id survives the EXT_BATCH wire table, and an
+    all-untraced table packs byte-identical to a pre-trace build."""
+    from pslite_tpu import wire
+    from pslite_tpu.message import BatchInfo, BatchOp, Meta
+
+    meta = Meta(app_id=1, request=True, push=True, timestamp=3,
+                sender=9, recver=8)
+    meta.batch = BatchInfo(ops=(
+        BatchOp(push=True, timestamp=1, key=10, val_len=16, nseg=2,
+                trace=0xABCDEF0123),
+        BatchOp(pull=True, timestamp=2, key=20, val_len=16, nseg=2),
+    ))
+    out = wire.unpack_meta(wire.pack_meta(meta))
+    assert out.batch.ops[0].trace == 0xABCDEF0123
+    assert out.batch.ops[1].trace == 0
+    untraced = Meta(app_id=1, request=True, push=True, timestamp=3,
+                    sender=9, recver=8)
+    untraced.batch = BatchInfo(ops=(
+        BatchOp(push=True, timestamp=1, key=10, val_len=16, nseg=2),
+    ))
+    buf = wire.pack_meta(untraced)
+    # trace=0 adds NOTHING: byte-for-byte what an untraced build packs.
+    assert b"".join([buf]) == wire.pack_meta(untraced)
+    traced = Meta(app_id=1, request=True, push=True, timestamp=3,
+                  sender=9, recver=8)
+    traced.batch = BatchInfo(ops=(
+        BatchOp(push=True, timestamp=1, key=10, val_len=16, nseg=2,
+                trace=5),
+    ))
+    assert len(wire.pack_meta(traced)) == len(buf) + 8  # one u64
+
+
+def test_multi_get_traced_fanin_spans_and_merging():
+    """PR 11 path: a traced multi_get fan-out still coalesces into
+    EXT_BATCH frames (one per contacted server), every sub-get's root
+    span links the shared parent id, and apply spans land on BOTH
+    servers."""
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=2,
+        env_extra={"PS_TRACE_TAIL": "floor:1.0",
+                   "PS_BATCH_BYTES": "65536",
+                   "PS_BATCH_NEGOTIATE": "0"},
+    )
+    cluster.start()
+    servers, workers = [], []
+    try:
+        servers, workers = _boot(cluster)
+        w = workers[0]
+        rows = [np.array([k], dtype=np.uint64)
+                for k in (3, 5, 2 ** 63 + 9, 2 ** 63 + 11)]
+        vals = np.ones(16, np.float32)
+        for r in rows:
+            w.wait(w.push(r, vals))
+        handle = w.multi_get(rows, val_len=16)
+        handle.wait()
+        for i, r in enumerate(rows):
+            np.testing.assert_array_equal(handle.outs[i], vals)
+        # Traced sub-gets MERGED: request-direction EXT_BATCH frames
+        # left this worker (the observer-effect fix, end to end).
+        wm = cluster.workers[0].metrics.snapshot()["counters"]
+        assert wm.get("van.batched_frames", 0) >= 1
+        assert wm.get("van.batch_ops", 0) > wm.get(
+            "van.batched_frames", 0)
+        coll = cluster.scheduler.collect_cluster_traces(timeout_s=10)
+        roots = [t.root for t in coll.assembled()]
+        parents = {}
+        for r in roots:
+            p = (r.get("args") or {}).get("parent")
+            if p:
+                parents.setdefault(p, []).append(r)
+        assert parents, "no sub-get linked a multi_get parent"
+        fan = max(parents.values(), key=len)
+        assert len(fan) == len(rows)  # one parent spans the fan-out
+        # The children's assembled trees cover BOTH servers' applies.
+        tids = {(r["args"] or {})["trace"] for r in fan}
+        apply_pids = set()
+        for tid in tids:
+            tr = coll.get(tid)
+            for ev in tr.spans:
+                if ev["name"] == "apply":
+                    apply_pids.add(ev["pid"])
+        assert apply_pids == {po.van.my_node.id
+                              for po in cluster.servers}
+    finally:
+        _stop_all(cluster, servers, workers)
+
+
+def test_psmon_watch_critical_path_footer():
+    """psmon --watch appends the tail critical-path footer when handed
+    the scheduler's trace collector."""
+    import psmon
+
+    from pslite_tpu.telemetry.timeseries import ClusterHistory
+
+    hist = ClusterHistory(po=None, env=None, interval_s=1.0)
+    coll = TraceCollector()
+    frame = psmon.format_watch(hist, traces=coll)
+    assert "critical path: no assembled tail traces" in frame
+    coll.ingest(9, "worker", [
+        _span(5, "request", 0.0, 1000.0, keep="slow>p95"),
+    ])
+    frame = psmon.format_watch(hist, traces=coll)
+    assert "critical path (1 tail traces" in frame
+    assert "completion" in frame  # root-only trace: all wall there
+
+
+# -- crash safety ------------------------------------------------------------
+
+
+def test_periodic_flush_is_crash_safe(tmp_path):
+    tr = Tracer(Environment({"PS_TRACE_TAIL": "floor:1.0",
+                             "PS_TRACE_DIR": str(tmp_path),
+                             "PS_TRACE_FLUSH_S": "0.1"}), "worker")
+    tr.node_id = 9
+    tr.span(0x77, "request", 0.0, 5.0)
+    deadline = time.monotonic() + 5.0
+    path = tr.default_path()
+    while not os.path.exists(path) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # No export()/Van.stop() ever ran — the background flush wrote it.
+    assert os.path.exists(path)
+    import json
+
+    doc = json.load(open(path))
+    assert any(e.get("name") == "request" for e in doc["traceEvents"])
+
+
+# -- acceptance: chaos delay pinned by the attribution -----------------------
+
+
+def test_chaos_delay_pins_wire_stage_on_slow_server():
+    """E2E proof (ISSUE 13): a real-TCP 2w+2s cluster with a chaos
+    receive delay on ONE server — the assembled tail's critical-path
+    attribution pins the injected stage (wire) on the slow server,
+    and every breakdown sums to its wall."""
+    import pstrace
+    from pslite_tpu.benchmark import _teardown_cluster
+
+    nodes = pstrace._demo_cluster(slow_server_delay_ms=(8, 16))
+    sched, server_pos, worker_pos = nodes[0], nodes[1:3], nodes[3:]
+    slow_pid = server_pos[1].van.my_node.id
+    servers, workers = [], []
+    try:
+        for po in server_pos:
+            s = KVServer(0, postoffice=po)
+            s.set_request_handle(KVServerDefaultHandle())
+            servers.append(s)
+        workers = [KVWorker(0, 0, postoffice=po) for po in worker_pos]
+        keys = np.array([3, 2 ** 62, 2 ** 63 + 9, 2 ** 63 + 2 ** 62],
+                        dtype=np.uint64)
+        vals = np.ones(len(keys) * 64, np.float32)
+        out = np.zeros_like(vals)
+        for i in range(30):
+            tss = [w.push(keys, vals) for w in workers]
+            for w, ts in zip(workers, tss):
+                w.wait(ts)
+            if i % 5 == 4:
+                workers[0].wait(workers[0].pull(keys, out))
+        coll = pstrace.collect(sched, timeout_s=10)
+        rows = coll.breakdowns()
+        assert rows, "no tail traces assembled"
+        for b in rows:
+            assert abs(sum(b["stages"].values()) - b["wall_us"]) \
+                <= max(1e-6, 0.001 * b["wall_us"])
+        agg = coll.aggregate()
+        # The slow set's dominant stage is the injected one, and its
+        # critical server is the chaos-delayed node.
+        assert agg["top_stage"] == "wire", agg
+        slow_rows = sorted(rows, key=lambda b: -b["wall_us"])
+        top = slow_rows[:max(1, len(slow_rows) // 4)]
+        pinned = [b for b in top if b["server"] == slow_pid]
+        assert len(pinned) >= len(top) * 0.7, (
+            f"slow traces not pinned to the delayed server: "
+            f"{[(b['server'], round(b['wall_us'])) for b in top]}"
+        )
+        # The CLI renderers digest the same collector.
+        table = pstrace.format_top(coll)
+        assert "tail lives in: wire" in table
+        slowest = pstrace.format_slowest(coll, 3)
+        assert "wall=" in slowest and "server=" in slowest
+    finally:
+        _teardown_cluster(nodes, workers, servers)

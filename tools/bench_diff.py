@@ -92,7 +92,7 @@ TRANSPORT_METRICS: Dict[str, str] = {
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
     "multi_tenant_", "small_op_batching_", "serving_fanin_",
-    "elastic_", "kv_", "fault_recovery_", "van_",
+    "elastic_", "kv_tracing_", "kv_", "fault_recovery_", "van_",
 )
 
 
@@ -124,9 +124,11 @@ def newest_two(directory: str) -> Optional[Tuple[str, str]]:
 
 # Top-level fields that are context-only by construction and never
 # comparable across rounds: the kv_telemetry section's windowed-rate
-# roll-ups depend on the measured interval and host load, so diffing
-# them only produces noise lines (docs/observability.md).
-IGNORED_PREFIXES = ("kv_windowed_",)
+# roll-ups depend on the measured interval and host load, and the
+# kv_tracing section's tail-trace counts/stage shares are shaped by
+# host load and the uniform keep floor — diffing either only produces
+# noise lines (docs/observability.md).
+IGNORED_PREFIXES = ("kv_windowed_", "kv_tracing_")
 
 
 def _numeric_items(rec: dict) -> Dict[str, float]:

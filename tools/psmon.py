@@ -336,11 +336,15 @@ def _health_lines(events, limit: int = 8) -> List[str]:
     return out or ["  (none)"]
 
 
-def format_watch(history, top_keys: int = 3) -> str:
+def format_watch(history, top_keys: int = 3, traces=None) -> str:
     """One ``--watch`` frame from the scheduler's ClusterHistory:
     per-node WINDOWED rates (counter deltas over the sampling window —
     meaningful an hour into a run, unlike uptime averages), sparkline
-    trends, stale-node ages, and the watchdog footer."""
+    trends, stale-node ages, and the watchdog footer.  ``traces`` (a
+    ``telemetry.TraceCollector`` — the scheduler's, kept warm by
+    ``collect_cluster_traces``) appends the tail critical-path footer:
+    which pipeline stage the assembled slow traces spend their wall
+    time in (tools/pstrace.py has the full view)."""
     window = history.default_window_s
     hdr = (f"{'node':>5} {'role':>9} {'req_p50ms':>9} {'req_p99ms':>9} "
            f"{'in/s':>8} {'out/s':>8} {'apply/s':>8} {'shed/s':>7} "
@@ -406,6 +410,25 @@ def format_watch(history, top_keys: int = 3) -> str:
     lines.append("")
     lines.append("health (SLO watchdog):")
     lines.extend(_health_lines(history.watchdog.events(min_severity="info")))
+    if traces is not None:
+        agg = traces.aggregate()
+        lines.append("")
+        if agg["count"]:
+            shares = agg["slow"]
+            top = sorted(shares.items(),
+                         key=lambda kv: -kv[1]["total_us"])[:4]
+            pretty = " | ".join(
+                f"{name} {info['share'] * 100:.0f}%"
+                for name, info in top if info["total_us"] > 0
+            )
+            lines.append(
+                f"critical path ({agg['count']} tail traces, slowest "
+                f"{agg['slow_count']}): {pretty}  "
+                f"[pstrace --slowest for detail]"
+            )
+        else:
+            lines.append("critical path: no assembled tail traces "
+                         "(PS_TRACE_TAIL off, or nothing kept)")
     return "\n".join(lines)
 
 
@@ -413,6 +436,9 @@ def format_watch(history, top_keys: int = 3) -> str:
 
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 _TENANT_RE = re.compile(r"^tenant\.(?P<tenant>.+)\.(?P<kind>[^.]+)$")
@@ -428,9 +454,11 @@ def _prom_float(v: float) -> str:
     return repr(float(v))
 
 
-def to_prometheus(snap: Dict[int, dict]) -> str:
+def to_prometheus(snap: Dict[int, dict],
+                  openmetrics: bool = False) -> str:
     """Render a cluster snapshot as Prometheus text exposition
-    (version 0.0.4 — what ``--serve`` answers scrapes with).
+    (version 0.0.4 by default — what ``--serve`` answers plain
+    scrapes with).
 
     - counters → ``pslite_<name>_total`` (per-tenant counters become
       one family with a ``tenant`` label),
@@ -439,6 +467,15 @@ def to_prometheus(snap: Dict[int, dict]) -> str:
       the raw log2 buckets (upper bound ``lo * 2^i``; monotone le and
       monotone cumulative counts by construction), plus ``_sum`` and
       ``_count``.
+
+    ``openmetrics=True`` switches to OpenMetrics 1.0 output (what
+    ``--serve`` answers when the scraper's Accept header asks for
+    ``application/openmetrics-text``): counter TYPE lines drop the
+    ``_total`` suffix, the exposition ends with ``# EOF``, and kept
+    tail-trace ids render as ``# {trace_id=...}`` EXEMPLARS on the
+    histogram bucket lines — exemplar syntax is ONLY legal there, so
+    the classic 0.0.4 rendering omits them (a 0.0.4 parser would
+    reject the whole scrape otherwise).
 
     Every sample carries ``node``/``role`` labels, so one scrape of
     the scheduler covers the whole cluster."""
@@ -480,7 +517,10 @@ def to_prometheus(snap: Dict[int, dict]) -> str:
         return "{" + inner + "}" if inner else ""
 
     for fam in sorted(counters):
-        out.append(f"# TYPE {fam} counter")
+        # OpenMetrics names the counter FAMILY without the _total
+        # suffix its samples carry; 0.0.4 types the sample name.
+        tname = fam[:-len("_total")] if openmetrics else fam
+        out.append(f"# TYPE {tname} counter")
         for labels, v in counters[fam]:
             out.append(f"{fam}{_labels(labels)} {int(v)}")
     for fam in sorted(gauges):
@@ -492,18 +532,34 @@ def to_prometheus(snap: Dict[int, dict]) -> str:
         for labels, h in hists[fam]:
             lo = h.get("lo", 1e-6)
             acc = 0
+            # Histogram exemplars (docs/observability.md): kept tail
+            # trace ids attach to the bucket their latency landed in,
+            # rendered in OpenMetrics exemplar syntax — a Prometheus
+            # p99 panel links straight to the trace that caused it.
+            # OPENMETRICS ONLY: the 0.0.4 text format has no exemplar
+            # grammar, and a classic parser rejects the whole scrape.
+            ex = ({int(i): (t, v, w)
+                   for i, t, v, w in h.get("exemplars") or []}
+                  if openmetrics else {})
             for i, n in sorted(
                     (int(i), int(n)) for i, n in h.get("buckets") or []):
                 acc += n
                 le = _prom_float(lo * (2.0 ** i))
                 lb = _labels({**labels, "le": le})
-                out.append(f"{fam}_bucket{lb} {acc}")
+                line = f"{fam}_bucket{lb} {acc}"
+                if i in ex:
+                    t, v, w = ex[i]
+                    line += (f' # {{trace_id="{_esc(t)}"}} '
+                             f"{_prom_float(v)} {round(float(w), 3)}")
+                out.append(line)
             lb = _labels({**labels, "le": "+Inf"})
             out.append(f"{fam}_bucket{lb} {int(h.get('count', acc))}")
             out.append(f"{fam}_sum{_labels(labels)} "
                        f"{_prom_float(h.get('sum', 0.0))}")
             out.append(f"{fam}_count{_labels(labels)} "
                        f"{int(h.get('count', acc))}")
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 
@@ -521,13 +577,22 @@ def serve(collect_fn, port: int, host: str = "127.0.0.1"):
             if self.path.split("?")[0] not in ("/", "/metrics"):
                 self.send_error(404)
                 return
+            # Content negotiation: a scraper asking for OpenMetrics
+            # (Prometheus does when exemplar scraping is on) gets the
+            # OM rendering WITH exemplars; everyone else gets classic
+            # 0.0.4 text, which has no exemplar grammar.
+            om = "openmetrics" in (self.headers.get("Accept") or "")
             try:
-                body = to_prometheus(collect_fn()).encode()
+                body = to_prometheus(collect_fn(),
+                                     openmetrics=om).encode()
             except Exception as exc:  # noqa: BLE001 - a failed pull
                 self.send_error(500, explain=repr(exc))  # not a crash
                 return
             self.send_response(200)
-            self.send_header("Content-Type", PROM_CONTENT_TYPE)
+            self.send_header(
+                "Content-Type",
+                OPENMETRICS_CONTENT_TYPE if om else PROM_CONTENT_TYPE,
+            )
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -561,6 +626,9 @@ def _demo(args) -> int:
         # through collect(), and a background sampler would only burn
         # a cluster-wide METRICS_PULL per interval alongside them.
         env["PS_METRICS_INTERVAL"] = str(args.interval)
+        # Tail tracing powers the watch footer's critical-path line
+        # (tools/pstrace.py is the full explorer).
+        env["PS_TRACE_TAIL"] = "slow:p90,errors,floor:0.05"
     nodes = _loopback_cluster(num_workers=2, num_servers=2,
                               ns="psmon-demo", env_extra=env)
     scheduler, server_pos, worker_pos = nodes[0], nodes[1:3], nodes[3:]
@@ -600,8 +668,10 @@ def _demo(args) -> int:
                     for w in workers:
                         w.wait(w.push(keys, vals))
                     time.sleep(args.interval)
+                    traces = scheduler.collect_cluster_traces(
+                        timeout_s=args.interval)
                     sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
-                    print(format_watch(history))
+                    print(format_watch(history, traces=traces))
             except KeyboardInterrupt:
                 pass
         else:
