@@ -717,6 +717,19 @@ def _transport_sections(quick: bool) -> list:
         es = elastic_scale_bench(quick=quick)
         return {f"elastic_{k}": v for k, v in es.items()}
 
+    def sec_durable_store():
+        # Durable state tier (docs/durability.md): the beyond-RAM
+        # tiered store — DLRM Zipf storm against a table ~4x
+        # PS_STORE_RAM_MB over real tcp processes, hot-set p99 vs the
+        # all-RAM twin (acceptance <= 2x, interleaved-round medians,
+        # bit-exact every 64th pull) — plus the coordinated
+        # snapshot + full-cluster-kill + PS_SNAPSHOT_RESTORE=1 boot
+        # wall times, restored store verified bit-exact.
+        from pslite_tpu.benchmark import durable_store_bench
+
+        ds = durable_store_bench(quick=quick)
+        return {f"durable_{k}": v for k, v in ds.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -777,6 +790,7 @@ def _transport_sections(quick: bool) -> list:
         ("small_op_batching", sec_small_op_batching),
         ("serving_fanin", sec_serving_fanin),
         ("elastic_scale", sec_elastic_scale),
+        ("durable_store", sec_durable_store),
         ("kv_telemetry", sec_kv_telemetry),
         ("kv_tracing", sec_kv_tracing),
         ("fault_recovery", sec_fault_recovery),
@@ -802,6 +816,7 @@ def _transport_sections(quick: bool) -> list:
             "kv_tracing": "kv_tracing_skipped",
             "van_latency": "van_skipped",
             "elastic_scale": "elastic_skipped",
+            "durable_store": "durable_skipped",
         }
         secs = [
             (name, fn) if name not in skip

@@ -3,6 +3,7 @@ from .kv_app import (ElasticZeroCopyError, KVMeta, KVPairs, KVServer,
                      KVServerDefaultHandle,
                      KVServerOptimizerHandle, KVWorker, OverloadError)
 from .simple_app import SimpleApp, SimpleData
+from .tiered import TieredStore
 
 __all__ = [
     "ElasticZeroCopyError",
@@ -16,4 +17,5 @@ __all__ = [
     "OverloadError",
     "SimpleApp",
     "SimpleData",
+    "TieredStore",
 ]

@@ -53,8 +53,10 @@ from ..sarray import SArray
 from ..utils import logging as log
 from ..utils.bounded import BoundedKeySet
 from ..vans import native
+from . import snapshot as snapshot_mod
 from .apply_shards import ApplyShardPool
 from .hot_cache import HotKeyCache
+from .snapshot import SNAPSHOT_LOCAL_CMD
 
 # meta.head marker of the hot-key introspection pull (docs/qos.md): the
 # server answers with its ``kv.hot_keys`` top-k — keys + counts — which
@@ -2589,6 +2591,29 @@ class KVServer:
         if getattr(self.po, "elastic", False):
             self._routing_hook = self._on_routing
             self.po.register_routing_hook(self._routing_hook)
+        # Durable state tier (docs/durability.md): the coordinated-
+        # snapshot fence (Command.SNAPSHOT -> the request-thread cut in
+        # _run_snapshot), restore-on-boot (PS_SNAPSHOT_RESTORE=1), and
+        # the beyond-RAM tiered store (PS_STORE_RAM_MB — installed in
+        # set_request_handle).
+        self._snapshot_dir = getattr(self.po, "snapshot_dir", None)
+        self._snapshot_quiesce_s = self.po.env.find_float(
+            "PS_SNAPSHOT_QUIESCE_S", 30.0)
+        self._h_snapshot = self.po.metrics.histogram("snapshot.duration_s")
+        self._snapshotting = False
+        self._snap_restored = False
+        self._snapshot_hook = self._on_snapshot_request
+        reg_snap = getattr(self.po, "register_snapshot_hook", None)
+        if reg_snap is not None:  # stub postoffices lack the registry
+            reg_snap(self._snapshot_hook)
+        if self._snapshot_dir:
+            # Sampled at METRICS_PULL time: the SLO watchdog's
+            # snapshot_age rule and psmon's snapshot-age line read it.
+            self.po.metrics.gauge(
+                "snapshot.age_s",
+                fn=lambda d=self._snapshot_dir:
+                    snapshot_mod.manifest_age_s(d),
+            )
         rep = self.po.env.find_int("PS_KV_REPLICATION", 1)
         if rep >= 2 and self.po.num_servers >= 2:
             from .replication import Replicator
@@ -2616,35 +2641,135 @@ class KVServer:
             self._abort_streams()  # handles reference the old pool
             self._apply_pool.stop()
             self._apply_pool = None
+        if self._handle is not None and handle is not self._handle:
+            # Handle replacement: release the displaced tiered store's
+            # segment files instead of leaking them until process exit.
+            old_store = getattr(self._handle, "store", None)
+            if callable(getattr(old_store, "close", None)):
+                old_store.close()
         self._handle = handle
         # Hand the handle this node's Environment so its apply path
         # (native.try_iadd) honors a per-node PS_NATIVE=0 override in
         # in-process clusters, like every other native.load() caller.
         if hasattr(handle, "apply_shard"):
             handle._env = self.po.env
-        if self._apply_shards > 0 and callable(
+        pool_eligible = self._apply_shards > 0 and callable(
             getattr(handle, "apply_shard", None)
-        ):
+        )
+        # Beyond-RAM tiered store (docs/durability.md): PS_STORE_RAM_MB
+        # swaps the handle's plain dict for a TieredStore — hot keys in
+        # RAM, cold keys in mmap'd append-only segment files — BEFORE
+        # the apply pool spins up, so every apply/restore/import flows
+        # through the tier from the first request.  Eviction classes
+        # mirror the pool's shard affinity (key % shards), which is
+        # what keeps eviction serialized with each key's applies and
+        # the tiered store bit-exact vs all-RAM.
+        ram_mb = self.po.env.find_float("PS_STORE_RAM_MB", 0.0)
+        if ram_mb > 0 and isinstance(getattr(handle, "store", None),
+                                     dict):
+            from .tiered import TieredStore
+
+            handle.store = TieredStore(
+                ram_bytes=int(ram_mb * (1 << 20)),
+                directory=self.po.env.find("PS_STORE_DIR") or None,
+                shards=self._apply_shards if pool_eligible else 1,
+                hot_fn=lambda k=64: [kk for kk, _ in
+                                     self._hot_keys.top(k)],
+                metrics=self.po.metrics,
+                flight=self.po.flight,
+                segment_mb=self.po.env.find_float(
+                    "PS_STORE_SEGMENT_MB", 64.0),
+            )
+        if pool_eligible:
             self._apply_pool = ApplyShardPool(
                 handle, self._apply_shards, self
             )
-        if (self._replicator is not None and self.po.is_recovery
-                and not getattr(self.po, "elastic_join", False)
-                and not self._restored):
-            # Recovered server: restore this rank's key range from its
-            # first replica BEFORE serving — the old path rejoined with
-            # a silently empty store.  Requests arriving during the
-            # restore park in _restore_buffer (workers may route back
-            # the moment the recovery roster lands) and replay after
-            # the snapshot import, preserving arrival order — applying
-            # them first and then importing would overwrite them.
-            self._restored = True
+        want_snap = (
+            self.po.env.find_int("PS_SNAPSHOT_RESTORE", 0) != 0
+            and self._snapshot_dir and not self._snap_restored
+            # An elastic joiner receives its ranges via live migration
+            # — importing the (stale) manifest here would resurrect
+            # keys deleted/migrated since the snapshot (same guard as
+            # the replica-restore path below).
+            and not getattr(self.po, "elastic_join", False)
+        )
+        want_repl = (self._replicator is not None and self.po.is_recovery
+                     and not getattr(self.po, "elastic_join", False)
+                     and not self._restored)
+        if want_snap or want_repl:
+            # Restore BEFORE serving (docs/durability.md,
+            # docs/fault_tolerance.md): the disk snapshot first (the
+            # full-cluster-kill path — replacing the silent empty-store
+            # cold start), then the replica fetch, which overwrites
+            # snapshot-restored ranges with anything newer a surviving
+            # replica holds (the "delta since the manifest" interop —
+            # set-semantics import, so the overwrite is idempotent when
+            # the replicas themselves just restored the same cut).
+            # Requests arriving during EITHER restore park in
+            # _restore_buffer (workers may route back the moment the
+            # roster lands) and replay in arrival order after the last
+            # import — applying them between the two restores would let
+            # the replica fetch silently overwrite them.
             with self._restore_mu:
-                self._restore_buffer = []
+                if self._restore_buffer is None:
+                    self._restore_buffer = []
+            # A tiered store enforces its budget DURING the restore
+            # imports (requests are parked and the pool idle, so the
+            # never-evict-on-insert shard argument doesn't apply) —
+            # otherwise a beyond-RAM restore materializes the whole
+            # table in RAM before the first get() can demote anything.
+            tier_mode = getattr(getattr(handle, "store", None),
+                                "set_evict_on_insert", None)
             try:
-                self._replicator.restore(handle)
+                if callable(tier_mode):
+                    tier_mode(True)
+                if want_snap:
+                    self._snap_restored = True
+                    self._restore_from_snapshot(handle)
+                if want_repl:
+                    self._restored = True
+                    self._replicator.restore(handle)
             finally:
+                if callable(tier_mode):
+                    tier_mode(False)
                 self._drain_restore_buffer()
+
+    def _restore_from_snapshot(self, handle) -> None:
+        """Boot-time restore from the committed snapshot manifest
+        (``PS_SNAPSHOT_RESTORE=1``): digest-verified per-range import of
+        every manifest range this server owns.  A digest mismatch or
+        missing segment raises (loud failure); a missing manifest is a
+        logged cold start."""
+        t0 = time.monotonic()
+        self.po.flight.record("restore_begin", severity="info",
+                              dir=self._snapshot_dir)
+        manifest = snapshot_mod.load_manifest(self._snapshot_dir)
+        if manifest is None:
+            log.warning(
+                f"PS_SNAPSHOT_RESTORE=1 but no committed manifest under "
+                f"{self._snapshot_dir!r}; starting with an empty store"
+            )
+            self.po.flight.record("restore_end", severity="warn",
+                                  keys=0, reason="no manifest")
+            return
+        owned = self.po.server_key_ranges_of(self.po.my_group_rank())
+        try:
+            n_keys, n_bytes = snapshot_mod.restore_into(
+                handle, self._snapshot_dir, owned, manifest=manifest
+            )
+        except Exception:
+            self.po.flight.record("restore_end", severity="crit",
+                                  keys=0, reason="restore failed")
+            raise
+        dur = time.monotonic() - t0
+        self.po.metrics.histogram("snapshot.restore_s").observe(dur)
+        self.po.flight.record(
+            "restore_end", severity="info", keys=n_keys, bytes=n_bytes,
+            epoch=manifest.get("epoch"), duration_s=round(dur, 3),
+        )
+        log.vlog(1, f"snapshot restore: {n_keys} keys "
+                    f"({n_bytes >> 20} MiB) from epoch "
+                    f"{manifest.get('epoch')} in {dur:.2f}s")
 
     def _on_self_rehab(self, node_id: int, down: bool) -> None:
         if down or node_id != self.po.van.my_node.id:
@@ -3251,8 +3376,9 @@ class KVServer:
         store = getattr(handle, "store", None)
         if store is None:
             return
+        drop = _store_drop_fn(store)
         for k in keys.tolist():
-            store.pop(int(k), None)
+            drop(int(k))
 
     def _pending_timeout(self, begin: int, epoch: int) -> None:
         """A gained range's migration data never arrived (source died
@@ -3323,6 +3449,176 @@ class KVServer:
         is no longer counted in barriers)."""
         self.po.request_decommission(timeout_s)
 
+    # -- coordinated snapshots (docs/durability.md) ---------------------------
+
+    def _on_snapshot_request(self, msg: Message) -> bool:
+        """Postoffice snapshot hook (van receive pump): post the
+        scheduler's SNAPSHOT request through the request queue so the
+        fence runs on the request-processing thread — every request
+        queued BEFORE it lands in the cut, everything after applies
+        only once the in-memory export completed.  The same ordering
+        trick as the elastic routing cutover (ROUTING_LOCAL_CMD)."""
+        marker = Message()
+        marker.meta.request = True
+        marker.meta.app_id = self._customer.app_id
+        marker.meta.customer_id = self._customer.customer_id
+        marker.meta.head = SNAPSHOT_LOCAL_CMD
+        marker._snapshot_ctl = (msg.meta.sender, msg.meta.timestamp,
+                                msg.meta.body)
+        self._customer.accept(marker)
+        return True
+
+    def _run_snapshot(self, msg: Message) -> None:
+        """The consistent cut (request thread): quiesce every apply
+        submitted so far, export the owned ranges IN MEMORY (export
+        copies — the park stays as short as the export), then hand the
+        disk writes + reply to a background thread so serving resumes
+        while segments stream out."""
+        import json
+
+        sender, token, body = msg._snapshot_ctl
+        try:
+            req = json.loads(body.decode()) if body else {}
+        except Exception:  # noqa: BLE001 - a corrupt body vetoes below
+            req = {}
+        directory = req.get("dir") or self._snapshot_dir
+        err = None
+        if self._handle is None:
+            err = "no request handle set"
+        elif not directory:
+            err = "no snapshot directory (PS_SNAPSHOT_DIR unset)"
+        elif self._snapshotting:
+            err = "a snapshot is already in progress"
+        elif self.po.group_size > 1:
+            # Instance groups: every instance of a group rank owns the
+            # same key range with its own per-instance store, so their
+            # segment files would clobber each other.  Decline loudly
+            # (docs/durability.md) — like elastic membership, the
+            # durable tier is a DMLC_GROUP_SIZE=1 feature.
+            err = "snapshots do not support instance groups " \
+                  "(DMLC_GROUP_SIZE > 1)"
+        if err is not None:
+            self._snapshot_reply(sender, token, {"error": err})
+            return
+        self._snapshotting = True
+        t0 = time.monotonic()
+        self.po.flight.record("snapshot_begin", severity="info",
+                              dir=directory)
+        if self._apply_pool is not None:
+            # The fence: everything already submitted must complete;
+            # nothing new can be submitted while this thread waits
+            # (later requests queue behind the marker).  A quiesce
+            # TIMEOUT vetoes the cut — exporting while shard threads
+            # still mutate arrays in place would commit torn values
+            # under a digest that happily verifies them.
+            tok = self._apply_pool.submit_token()
+            if not self._apply_pool.quiesce(
+                    tok, timeout_s=self._snapshot_quiesce_s):
+                self._snapshotting = False
+                err = (f"apply pool did not quiesce within "
+                       f"{self._snapshot_quiesce_s}s — refusing a "
+                       f"torn cut")
+                log.warning(f"snapshot: {err}")
+                self.po.flight.record("snapshot_end", severity="warn",
+                                      ok=False, error=err)
+                self._snapshot_reply(sender, token, {"error": err})
+                return
+        with self._streams_mu:
+            open_streams = len(self._streams)
+        if open_streams:
+            # Decline-matrix edge (docs/durability.md): a chunked push
+            # mid-STREAMING-apply straddles the fence — its fed prefix
+            # is in the cut, its tail is not.  The op is still unacked
+            # (its close has not been processed), so no acknowledged
+            # write is ever torn; surface it for the postmortem trail.
+            self.po.flight.record("snapshot_open_streams",
+                                  severity="warn", streams=open_streams)
+        from .replication import export_range as _export_range
+
+        exported = []
+        try:
+            for rng in self.po.server_key_ranges_of(
+                    self.po.my_group_rank()):
+                keys, vals, lens = _export_range(self._handle, rng.begin,
+                                                 rng.end)
+                exported.append((rng, keys, vals,
+                                 None if lens is None
+                                 else np.asarray(lens)))
+        except Exception as exc:  # noqa: BLE001 - veto the commit
+            self._snapshotting = False
+            self.po.flight.record("snapshot_end", severity="warn",
+                                  ok=False, error=repr(exc)[:200])
+            self._snapshot_reply(sender, token,
+                                 {"error": f"export failed: {exc!r}"})
+            return
+        epoch = int(req.get("epoch", -1))
+        uid = str(req.get("uid", ""))
+        fmt = self.po.env.find("PS_SNAPSHOT_FORMAT") or "npz"
+        threading.Thread(
+            target=self._write_snapshot,
+            args=(sender, token, directory, epoch, fmt, uid, exported,
+                  t0),
+            name="kv-snapshot-write", daemon=True,
+        ).start()
+
+    def _write_snapshot(self, sender: int, token: int, directory: str,
+                        epoch: int, fmt: str, uid: str, exported: list,
+                        t0: float) -> None:
+        """Background half of the cut: stream the exported ranges into
+        per-range segment files (names stamped with the scheduler's
+        attempt uid — a vetoed attempt must never overwrite the
+        committed snapshot's bytes) and reply with their digests (the
+        scheduler commits by writing the manifest only after EVERY
+        server answered clean)."""
+        entries = []
+        try:
+            for rng, keys, vals, lens in exported:
+                entries.append(snapshot_mod.write_range_segment(
+                    directory, rng.begin, rng.end, keys, vals, lens,
+                    fmt=fmt, uid=uid,
+                ))
+            dur = time.monotonic() - t0
+            self._h_snapshot.observe(dur)
+            self.po.flight.record(
+                "snapshot_end", severity="info", ok=True,
+                keys=sum(e["keys"] for e in entries),
+                bytes=sum(e["nbytes"] for e in entries),
+                duration_s=round(dur, 3),
+            )
+            self._snapshot_reply(sender, token, {
+                "rank": self.po.my_group_rank(),
+                "epoch": epoch,
+                "ranges": entries,
+                "duration_s": round(dur, 3),
+            })
+        except Exception as exc:  # noqa: BLE001 - veto the commit
+            self.po.flight.record("snapshot_end", severity="warn",
+                                  ok=False, error=repr(exc)[:200])
+            self._snapshot_reply(
+                sender, token,
+                {"error": f"segment write failed: {exc!r}"},
+            )
+        finally:
+            self._snapshotting = False
+
+    def _snapshot_reply(self, dest: int, token: int,
+                        payload: dict) -> None:
+        import json as _json
+
+        from ..message import Command, Control
+
+        msg = Message()
+        msg.meta.recver = dest
+        msg.meta.sender = self.po.van.my_node.id
+        msg.meta.request = False
+        msg.meta.timestamp = token  # the scheduler's gather token
+        msg.meta.control = Control(cmd=Command.SNAPSHOT)
+        msg.meta.body = _json.dumps(payload).encode()
+        try:
+            self.po.van.send(msg)
+        except Exception as exc:  # noqa: BLE001 - scheduler times out
+            log.warning(f"snapshot reply to {dest} failed: {exc!r}")
+
     def _tenant_counter(self, tid: int, kind: str):
         """Lazily created per-tenant counters (psmon's tenant rollup):
         ``tenant.<name>.requests`` / ``tenant.<name>.shed``."""
@@ -3377,6 +3673,9 @@ class KVServer:
     def stop(self) -> None:
         self._customer.stop()
         self.po.unregister_node_failure_hook(self._on_stream_peer_event)
+        unreg_snap = getattr(self.po, "unregister_snapshot_hook", None)
+        if unreg_snap is not None:
+            unreg_snap(self._snapshot_hook)
         if self._routing_hook is not None:
             self.po.unregister_routing_hook(self._routing_hook)
         with self._elastic_mu:
@@ -3389,6 +3688,13 @@ class KVServer:
         if self._apply_pool is not None:
             self._apply_pool.stop()
             self._apply_pool = None
+        # AFTER the apply pool: in-flight shard tasks may still read/
+        # evict through the tiered store until the pool drains (the
+        # handle-replacement path in set_request_handle orders the
+        # same way).
+        store = getattr(self._handle, "store", None)
+        if callable(getattr(store, "close", None)):
+            store.close()  # release the tiered store's segment files
         if self._resp_combiner is not None:
             # After the pool: its stop-path emits stranded responses
             # through _send_response, which must still find the lane.
@@ -3737,6 +4043,13 @@ class KVServer:
             # ownership flip serializes against every earlier request.
             self._apply_routing_update(getattr(msg, "_routing_table",
                                                None))
+            return
+        if (msg.meta.head == SNAPSHOT_LOCAL_CMD
+                and hasattr(msg, "_snapshot_ctl")):
+            # Local snapshot fence (docs/durability.md): runs on this
+            # thread so the cut serializes against every earlier queued
+            # request, exactly like the routing cutover above.
+            self._run_snapshot(msg)
             return
         if msg.meta.option == OPT_XFER_PART:
             # Partial delivery of a chunked streaming transfer: feed it
@@ -4366,6 +4679,155 @@ class KVServerOptimizerHandle:
             return parts
         return None
 
+    # -- state iterator (docs/durability.md) ---------------------------------
+    #
+    # The export_range/import_range currency is (keys, flat vals,
+    # per-key lens) — replication fetch, elastic range migration, and
+    # cluster snapshots all move state through it.  The optimizer
+    # handle PACKS ITS SLOTS into the same per-key record so every one
+    # of those planes carries them for free (the PR 9 debt: migration
+    # used to strand momentum/adam state on the old owner):
+    #
+    #   sgd           [param]                         (len n)
+    #   sgd_momentum  [param, m, kind_bits]           (len 2n + 1)
+    #   adam          [param, m, v, t_bits, kind_bits] (len 3n + 2)
+    #
+    # Missing slots export as zeros — bit-identical to the lazy
+    # zeros-on-first-push initialization, so a restored handle's next
+    # update is bit-exact vs an uninterrupted one.  The adam step
+    # count travels as the int32 BIT PATTERN viewed as float32 (this
+    # plane is never codec-quantized), so it round-trips exactly.
+    #
+    # Slot-carrying records are tagged TWICE — an explicit layout
+    # marker, not a length heuristic: (1) a NEGATIVE per-key len (the
+    # magnitude is still the record length; a params-only source —
+    # plain-dict peer, a DefaultHandle-written snapshot — always
+    # exports positive lens, so a parameter row can never be mistaken
+    # for a packed record, and the generic dict-store import refuses
+    # packed records loudly), and (2) a trailing kind_bits element
+    # (the _KIND_CODES int32 bit pattern as float32) inside the
+    # record, so a record packed by a DIFFERENT optimizer kind
+    # refuses loudly even when the lengths happen to collide
+    # (momentum n=2 and adam n=1 both pack to 4 floats without it).
+    # Every consumer of this currency (generic import, snapshot range
+    # filtering) reads lens through abs(); the files/wire carry
+    # int32, so the sign survives the whole journey.
+
+    _KIND_CODES = {"sgd_momentum": 0x70731, "adam": 0x70732}
+
+    def export_range(self, begin: int, end: int):
+        """Snapshot params + optimizer slots for keys in [begin, end)."""
+        from .replication import _snapshot_items
+
+        items = _snapshot_items(self.store, begin, end)
+        pairs = sorted((k, p) for k, p in items if begin <= k < end)
+        keys = np.asarray([k for k, _ in pairs], dtype=np.uint64)
+        recs: List[np.ndarray] = []
+        lens: List[int] = []
+        for k, p in pairs:
+            p = np.asarray(p, dtype=np.float32).reshape(-1)
+            rec = [p]
+            if self.kind in ("sgd_momentum", "adam"):
+                m = self._m.get(k)
+                rec.append(np.zeros_like(p) if m is None
+                           else np.asarray(m, np.float32).reshape(-1))
+            if self.kind == "adam":
+                v = self._v.get(k)
+                rec.append(np.zeros_like(p) if v is None
+                           else np.asarray(v, np.float32).reshape(-1))
+                rec.append(np.asarray([self._t.get(k, 0)],
+                                      dtype=np.int32).view(np.float32))
+            if self.kind != "sgd":
+                rec.append(np.asarray([self._KIND_CODES[self.kind]],
+                                      dtype=np.int32).view(np.float32))
+            recs.append(np.concatenate(rec))
+            # Negative len == "this record carries slots" (see the
+            # layout comment above); plain sgd records are just the
+            # params and stay positive.
+            lens.append(-recs[-1].size if self.kind != "sgd"
+                        else recs[-1].size)
+        vals = (np.concatenate(recs) if recs
+                else np.empty(0, np.float32))
+        return keys, vals, np.asarray(lens, dtype=np.int32)
+
+    def import_range(self, keys, vals, lens) -> None:
+        """Load records written by :meth:`export_range` (same ``kind``
+        on both sides — the cluster runs one handle type).  A record
+        tagged slot-packed (negative len) whose length does not match
+        THIS kind's packing fails loudly — silently mis-splitting it
+        would corrupt the key.  Untagged (positive-len) records are a
+        params-only source (plain-dict peer, a DefaultHandle-written
+        snapshot) and import as params with fresh slots, exactly like
+        a first push would initialize them."""
+        off = 0
+        n_keys = len(keys)
+        for i, key in enumerate(keys):
+            key = int(key)
+            raw_len = (int(lens[i]) if lens is not None
+                       else len(vals) // max(n_keys, 1))
+            rec_len = abs(raw_len)
+            rec = np.asarray(vals[off:off + rec_len], dtype=np.float32)
+            off += rec_len
+            if raw_len >= 0:
+                # Params-only source: fresh slots, like a first push.
+                self.store[key] = rec.copy()
+                continue
+            # Slot-packed: the trailing kind_bits element names the
+            # WRITER's kind — refuse a mismatch loudly even when the
+            # record lengths collide (see the layout comment).
+            log.check(
+                self.kind != "sgd",
+                f"slot-packed record for key {key} but this handle "
+                f"is kind='sgd' — mixed optimizer kinds cannot share "
+                f"state",
+            )
+            src_code = (int(rec[-1:].view(np.int32)[0])
+                        if rec_len > 0 else -1)
+            log.check(
+                src_code == self._KIND_CODES[self.kind],
+                f"slot-packed record for key {key} was written by a "
+                f"different optimizer kind (code {src_code:#x}, this "
+                f"handle wants "
+                f"{self._KIND_CODES[self.kind]:#x}/{self.kind}) — "
+                f"mixed optimizer kinds cannot share state",
+            )
+            body = rec_len - 1  # sans kind_bits
+            if self.kind == "adam":
+                log.check(
+                    body > 1 and (body - 1) % 3 == 0,
+                    f"slot-packed record of length {rec_len} for key "
+                    f"{key} does not match the adam [p,m,v,t] layout",
+                )
+                n = (body - 1) // 3
+                self.store[key] = rec[:n].copy()
+                self._m[key] = rec[n:2 * n].copy()
+                self._v[key] = rec[2 * n:3 * n].copy()
+                self._t[key] = int(
+                    rec[3 * n:3 * n + 1].view(np.int32)[0])
+            else:  # sgd_momentum (the only other slot-packing kind)
+                log.check(
+                    body > 0 and body % 2 == 0,
+                    f"slot-packed record of length {rec_len} for key "
+                    f"{key} does not match the sgd_momentum [p,m] "
+                    f"layout",
+                )
+                n = body // 2
+                self.store[key] = rec[:n].copy()
+                self._m[key] = rec[n:2 * n].copy()
+
+    def drop_keys(self, keys) -> None:
+        """Migration drop: params AND slots leave together (a stranded
+        slot would silently corrupt the key if the range ever migrated
+        back).  A tiered param store drops cold keys O(1) via
+        ``discard`` instead of deserializing bytes nobody reads."""
+        drop = _store_drop_fn(self.store)
+        for k in np.asarray(keys).reshape(-1).tolist():
+            k = int(k)
+            drop(k)
+            self._m.pop(k, None)
+            self._v.pop(k, None)
+            self._t.pop(k, None)
+
     def __call__(self, req_meta: KVMeta, req_data: KVPairs,
                  server: KVServer):
         parts = self.apply_shard(
@@ -4379,6 +4841,16 @@ class KVServerOptimizerHandle:
             ))
         else:
             server.response(req_meta)
+
+
+def _store_drop_fn(store):
+    """Key-drop callable for a handle's store: a tiered store's
+    ``discard`` drops cold keys O(1) instead of deserializing segment
+    bytes nobody will read; plain dicts fall back to ``pop``."""
+    drop = getattr(store, "discard", None)
+    if callable(drop):
+        return drop
+    return lambda k: store.pop(k, None)
 
 
 def _as_kvs(keys, vals, lens, priority: int) -> KVPairs:

@@ -28,7 +28,10 @@ emit app-level responses for forwarded pushes (``KVServer.response``
 suppresses them); delivery reliability rides the van-level resender
 when ``PS_RESEND`` is on.  Restore moves the handle's ``store`` (or the
 pair ``export_range``/``import_range`` when the handle defines them);
-optimizer slot state not exposed through those hooks restarts fresh.
+``KVServerOptimizerHandle`` packs its momentum/adam slots into that
+same iterator currency (docs/durability.md), so replica restores,
+elastic range migrations, and cluster snapshots all carry optimizer
+state — it no longer restarts fresh or strands on the old owner.
 """
 
 from __future__ import annotations
@@ -74,6 +77,27 @@ def chain_ranks(group_rank: int, k: int, num_servers: int,
     ]
 
 
+def _snapshot_items(store, begin: int, end: int):
+    """Snapshot a store's (key, value) pairs for ``[begin, end)``.
+    Prefers the store's own range-aware iterator (TieredStore: reads
+    only that range's cold bytes instead of materializing the whole
+    beyond-RAM table once per owned range); plain dicts fall back to
+    the short retry loop (apply-shard threads insert concurrently —
+    a bare iteration would raise ``dictionary changed size``)."""
+    ranged = getattr(store, "items_in_range", None)
+    if callable(ranged):
+        return ranged(begin, end)
+    items = None
+    for _ in range(100):
+        try:
+            items = list(store.items())
+            break
+        except RuntimeError:
+            continue
+    log.check(items is not None, "could not snapshot the store")
+    return items
+
+
 def export_range(handle, begin: int, end: int):
     """Snapshot every stored key of ``handle`` in ``[begin, end)`` as
     ``(keys, flat vals, per-key lens)`` — the currency of both the
@@ -84,14 +108,7 @@ def export_range(handle, begin: int, end: int):
     if callable(getattr(handle, "export_range", None)):
         return handle.export_range(begin, end)
     store = getattr(handle, "store", None) or {}
-    items = None
-    for _ in range(100):
-        try:
-            items = list(store.items())
-            break
-        except RuntimeError:
-            continue
-    log.check(items is not None, "could not snapshot the store")
+    items = _snapshot_items(store, begin, end)
     pairs = sorted((kk, arr) for kk, arr in items if begin <= kk < end)
     keys = np.asarray([kk for kk, _ in pairs], dtype=np.uint64)
     lens = np.asarray([arr.size for _, arr in pairs], dtype=np.int32)
@@ -113,11 +130,24 @@ def import_range(handle, keys, vals, lens) -> None:
               "state import needs a handle with .store or import_range()")
     off = 0
     for i, key in enumerate(keys):
-        n = int(lens[i]) if lens is not None else (
+        # A negative len tags a slot-packed optimizer record
+        # (kv_app.KVServerOptimizerHandle.export_range — magnitude =
+        # record length).  A plain dict store cannot unpack it:
+        # storing the raw record would silently serve parameters with
+        # momentum/adam state appended, so refuse loudly instead
+        # (restore an optimizer-written snapshot with an optimizer
+        # handle).
+        raw = int(lens[i]) if lens is not None else (
             len(vals) // max(len(keys), 1)
         )
-        store[int(key)] = vals[off:off + n].copy()
-        off += n
+        log.check(
+            raw >= 0,
+            f"key {int(key)}: slot-packed optimizer record cannot "
+            f"import into a plain store — use the matching optimizer "
+            f"handle",
+        )
+        store[int(key)] = vals[off:off + raw].copy()
+        off += raw
 
 
 class Replicator:

@@ -63,6 +63,14 @@ class Command(enum.IntEnum):
     # piggybacks windowed-quantile threshold hints for the node's
     # tail-keep policy.  Same broadcast+gather shape as METRICS_PULL.
     TRACE_PULL = 14
+    # Coordinated cluster snapshot (docs/durability.md): the scheduler
+    # asks every server to fence a consistent cut (apply-pool quiesce)
+    # and stream its owned ranges to per-range segment files under the
+    # snapshot directory; the reply carries the per-range digests as
+    # JSON in meta.body, and the scheduler commits the cut by writing
+    # the cluster MANIFEST.  Same broadcast+gather shape as
+    # METRICS_PULL.
+    SNAPSHOT = 15
 
 
 # Wire dtype codes (stable across hosts; independent of numpy internals).

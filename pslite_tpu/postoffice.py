@@ -12,6 +12,7 @@ the customer registry (with the 5 s readiness wait), and lifecycle
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -134,6 +135,15 @@ class Postoffice:
         self._trace_token = 0
         self._trace_replies: Dict[int, dict] = {}
         self._trace_collector = None  # telemetry.TraceCollector
+        # Coordinated snapshot plane (docs/durability.md): scheduler-
+        # side gather state (same token-gated shape as METRICS_PULL)
+        # and the server-side hook registry (a KVServer registers to
+        # receive SNAPSHOT control requests routed off the van pump).
+        self._snapshot_mu = threading.Lock()
+        self._snapshot_token = 0
+        self._snapshot_replies: Dict[int, dict] = {}
+        self._snapshot_hooks: List[Callable[[Message], bool]] = []
+        self.snapshot_dir = self.env.find("PS_SNAPSHOT_DIR") or None
         # Continuous telemetry plane (docs/observability.md): the
         # scheduler's ClusterHistory sampler + SLO watchdog.  Lazily
         # built by start_history(); started automatically by start()
@@ -799,6 +809,155 @@ class Postoffice:
                         evicted=rep.get("evicted") or 0)
         coll.retire()
         return coll
+
+    # -- coordinated snapshots (docs/durability.md) --------------------------
+
+    def register_snapshot_hook(self, hook: Callable[[Message], bool]) -> None:
+        """``hook(msg)`` receives SNAPSHOT control requests on the van
+        pump and returns True when it took ownership of the reply
+        (KVServer posts the fence through its request queue and answers
+        from there).  Keep hooks fast — never block on the van."""
+        with self._snapshot_mu:
+            self._snapshot_hooks.append(hook)
+
+    def unregister_snapshot_hook(self, hook) -> None:
+        with self._snapshot_mu:
+            try:
+                self._snapshot_hooks.remove(hook)
+            except ValueError:
+                pass
+
+    def notify_snapshot(self, msg: Message) -> bool:
+        """Run the snapshot hooks; True when one consumed the request."""
+        with self._snapshot_mu:
+            hooks = list(self._snapshot_hooks)
+        for hook in hooks:
+            try:
+                if hook(msg):
+                    return True
+            except Exception as exc:  # noqa: BLE001 - isolate hooks
+                log.warning(f"snapshot hook failed: {exc!r}")
+        return False
+
+    def absorb_snapshot_reply(self, msg: Message) -> None:
+        """Van hook: a server's SNAPSHOT reply arrived (scheduler)."""
+        try:
+            rep = json.loads(msg.meta.body.decode())
+        except Exception as exc:  # noqa: BLE001 - one corrupt reply
+            rep = {"error": f"bad reply: {exc!r}"}
+        with self._metrics_cv:
+            if msg.meta.timestamp != self._snapshot_token:
+                return  # stale reply from an earlier (timed-out) cut
+            self._snapshot_replies[msg.meta.sender] = rep
+            self._metrics_cv.notify_all()
+
+    def snapshot(self, directory: Optional[str] = None,
+                 timeout_s: float = 60.0) -> dict:
+        """Coordinate one consistent-cut cluster snapshot
+        (docs/durability.md): broadcast ``Command.SNAPSHOT`` to every
+        live server, gather their per-range digests, and COMMIT the cut
+        by writing the cluster manifest.  Scheduler only.  Raises when
+        any server errored or failed to answer — a partial snapshot is
+        never committed (the stale manifest, if any, stays the restore
+        point)."""
+        log.check(self.is_scheduler, "snapshot runs on the scheduler")
+        directory = directory or self.snapshot_dir
+        log.check(bool(directory),
+                  "snapshot needs a directory (PS_SNAPSHOT_DIR or the "
+                  "directory= argument)")
+        from .kv import snapshot as snap_mod
+
+        t0 = time.monotonic()
+        rt = self.current_routing()
+        epoch = rt.epoch if rt is not None else -1
+        self.flight.record("snapshot_begin", severity="info",
+                           dir=directory, epoch=epoch)
+        # Per-attempt uid: servers stamp it into their segment
+        # filenames so a vetoed attempt can never overwrite the
+        # previously committed snapshot's bytes (snapshot.py).
+        uid = f"{os.getpid():x}-{int(time.time() * 1000):x}"
+        body = json.dumps({"dir": directory, "epoch": epoch,
+                           "uid": uid}).encode()
+        peers = [
+            i for i in self.get_node_ids(SERVER_GROUP)
+            if not self.van.is_peer_down(i)
+        ]
+        log.check(bool(peers), "snapshot: no live servers")
+        with self._metrics_cv:
+            self._snapshot_token += 1
+            token = self._snapshot_token
+            self._snapshot_replies = {}
+        reached = []
+        for peer in peers:
+            msg = Message()
+            msg.meta.recver = peer
+            msg.meta.sender = self.van.my_node.id
+            msg.meta.request = True
+            msg.meta.timestamp = token
+            msg.meta.body = body
+            msg.meta.control = Control(cmd=Command.SNAPSHOT)
+            try:
+                self.van.send(msg)
+                reached.append(peer)
+            except Exception as exc:  # noqa: BLE001 - dead peer vetoes
+                log.warning(f"SNAPSHOT to {peer} failed: {exc!r}")
+        deadline = time.monotonic() + timeout_s
+        with self._metrics_cv:
+            while len(self._snapshot_replies) < len(reached):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._metrics_cv.wait(remaining)
+            replies = dict(self._snapshot_replies)
+        entries, errors = snap_mod.snapshot_summary(replies)
+        silent = [p for p in peers if p not in replies]
+        if silent:
+            errors.append(f"no reply from node(s) {silent} within "
+                          f"{timeout_s}s")
+        if errors:
+            self.flight.record("snapshot_end", severity="warn",
+                               ok=False, errors=errors[:4])
+            log.check(False, "snapshot NOT committed: "
+                             + "; ".join(errors))
+        manifest = snap_mod.write_manifest(
+            directory, epoch, entries,
+            extra={"servers": len(replies), "uid": uid},
+        )
+        # The new manifest is durable: the previous snapshot's (and
+        # any vetoed attempt's) segment files are garbage now.
+        snap_mod.prune_segments(
+            directory, {"ranges": entries},
+        )
+        dur = time.monotonic() - t0
+        self.metrics.histogram("snapshot.duration_s").observe(dur)
+        self.flight.record(
+            "snapshot_end", severity="info", ok=True,
+            keys=sum(e["keys"] for e in entries),
+            bytes=sum(e["nbytes"] for e in entries),
+            duration_s=round(dur, 3),
+        )
+        return {
+            "manifest": manifest,
+            "epoch": epoch,
+            "ranges": entries,
+            "servers": len(replies),
+            "duration_s": dur,
+        }
+
+    def snapshot_status(self) -> dict:
+        """Age and summary of the newest committed manifest (any
+        role; psmon's snapshot-age line reads the server gauges, this
+        is the library view)."""
+        from .kv import snapshot as snap_mod
+
+        manifest = snap_mod.load_manifest(self.snapshot_dir)
+        return {
+            "dir": self.snapshot_dir,
+            "age_s": snap_mod.manifest_age_s(self.snapshot_dir),
+            "epoch": manifest.get("epoch") if manifest else None,
+            "ranges": len(manifest.get("ranges", [])) if manifest
+            else 0,
+        }
 
     # -- continuous telemetry plane (docs/observability.md) ------------------
 

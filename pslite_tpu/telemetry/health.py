@@ -23,6 +23,12 @@ heartbeat_gap      windowed max ``heartbeat.gap_s`` (s)             2 : 10
 retransmit_burst   ``resender.retransmits`` rate (/s)              50 : 500
 node_stale         sample rounds missed (last-seen age in           2 : 5
                    units of the sampler interval)
+snapshot_age       ``snapshot.age_s`` gauge: seconds since     600 : 86400
+                   the newest committed snapshot manifest
+                   (docs/durability.md); exported only by
+                   servers with ``PS_SNAPSHOT_DIR``, and a
+                   never-snapshotted cluster (age < 0) is
+                   skipped, not alarmed
 =================  ==========================================  ===========
 
 Breaches emit structured :class:`HealthEvent`\\ s (INFO/WARN/CRIT) with
@@ -107,6 +113,7 @@ DEFAULT_THRESHOLDS: Dict[str, tuple] = {
     "heartbeat_gap": (2.0, 10.0),
     "retransmit_burst": (50.0, 500.0),
     "node_stale": (2.0, 5.0),
+    "snapshot_age": (600.0, 86400.0),
 }
 
 
@@ -260,6 +267,20 @@ class Watchdog:
                     out=out,
                     fmt="replication lag {value:.4g} queued forwards "
                         "(threshold {thr:g})",
+                )
+
+            # snapshot_age: seconds since the newest committed
+            # snapshot manifest (docs/durability.md).  Exported only
+            # by servers running with PS_SNAPSHOT_DIR; a negative age
+            # means "never snapshotted", which the rule skips — an
+            # un-configured cluster must not page.
+            snap_age = gauges.get("snapshot.age_s")
+            if snap_age is not None and float(snap_age) >= 0:
+                self._check(
+                    wall, "snapshot_age", node_id, role,
+                    "snapshot.age_s", float(snap_age), window, out=out,
+                    fmt="newest snapshot manifest is {value:.0f}s old "
+                        "(threshold {thr:g}s)",
                 )
 
             # queue_growth: lane depth + apply shard depth growth

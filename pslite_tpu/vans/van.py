@@ -1082,6 +1082,33 @@ class Van:
             self._c_trace_reply_failures.inc()
             log.warning(f"TRACE_PULL reply failed: {exc!r}")
 
+    def _process_snapshot(self, msg: Message) -> None:
+        """SNAPSHOT control (docs/durability.md): a request is the
+        scheduler asking this server to fence + export its ranges —
+        handed to the app hook (KVServer), which serializes the cut on
+        its request thread and replies from there; a response routes to
+        the scheduler's gather.  A node with no registered hook (no KV
+        server) answers an error so the commit vetoes instead of the
+        scheduler stranding on the timeout."""
+        if not msg.meta.request:
+            self.po.absorb_snapshot_reply(msg)
+            return
+        if self.po.notify_snapshot(msg):
+            return
+        reply = Message()
+        reply.meta.recver = msg.meta.sender
+        reply.meta.sender = self.my_node.id
+        reply.meta.request = False
+        reply.meta.timestamp = msg.meta.timestamp  # gather token
+        reply.meta.control = Control(cmd=Command.SNAPSHOT)
+        reply.meta.body = json.dumps(
+            {"error": "no KV server registered on this node"}
+        ).encode()
+        try:
+            self._dispatch_send(reply)
+        except Exception as exc:  # noqa: BLE001
+            log.warning(f"SNAPSHOT reply failed: {exc!r}")
+
     # -- elastic membership (docs/elasticity.md) -----------------------------
 
     # meta.option on the ADD_NODE roster reply to a live JOINER: the
@@ -1359,6 +1386,8 @@ class Van:
                     self._process_metrics_pull(msg)
                 elif ctrl.cmd == Command.TRACE_PULL:
                     self._process_trace_pull(msg)
+                elif ctrl.cmd == Command.SNAPSHOT:
+                    self._process_snapshot(msg)
                 elif ctrl.cmd == Command.ROUTING:
                     self._process_routing(msg)
                 elif ctrl.cmd == Command.REMOVE_NODE:

@@ -1,0 +1,373 @@
+"""Coordinated cluster snapshots + restore-on-boot
+(pslite_tpu/kv/snapshot.py, docs/durability.md).
+
+The headline contract: kill the WHOLE cluster, boot a fresh one with
+``PS_SNAPSHOT_RESTORE=1``, and every range restores bit-exact —
+optimizer slots included, and a snapshot racing a push storm captures
+a consistent cut (every request entirely before or after it).  A
+corrupt snapshot fails the restore loudly instead of serving garbage.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from helpers import LoopbackCluster
+from pslite_tpu.kv.kv_app import (KVMeta, KVServer,
+                                  KVServerDefaultHandle,
+                                  KVServerOptimizerHandle, KVWorker,
+                                  _push_segs)
+from pslite_tpu.kv import snapshot as snap_mod
+from pslite_tpu.utils import logging as log
+
+
+def _boot(snapdir, num_servers=1, extra=None, handle_factory=None):
+    env = {"PS_SNAPSHOT_DIR": snapdir}
+    env.update(extra or {})
+    cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
+                         env_extra=env)
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(
+            handle_factory() if handle_factory
+            else KVServerDefaultHandle()
+        )
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    return cl, servers, w
+
+
+def _kill(cl, servers):
+    cl.finalize()
+    for s in servers:
+        s.stop()
+
+
+def test_full_cluster_kill_restore_bit_exact(tmp_path):
+    snapdir = str(tmp_path / "snap")
+    cl, servers, w = _boot(snapdir, num_servers=2)
+    keys = np.array([1, 5, 9, 2**62, 2**63 + 7], dtype=np.uint64)
+    vals = np.random.default_rng(0).normal(
+        size=len(keys) * 32).astype(np.float32)
+    try:
+        w.wait(w.push(keys, vals))
+        res = cl.scheduler.snapshot()
+        assert res["servers"] == 2
+        assert os.path.exists(res["manifest"])
+        # The scheduler flight-records the commit (docs/durability.md).
+        kinds = [e["kind"] for e in cl.scheduler.flight.events()]
+        assert "snapshot_begin" in kinds and "snapshot_end" in kinds
+        expect = np.zeros_like(vals)
+        w.wait(w.pull(keys, expect))
+    finally:
+        _kill(cl, servers)
+
+    cl2, servers2, w2 = _boot(snapdir, num_servers=2,
+                              extra={"PS_SNAPSHOT_RESTORE": "1"})
+    try:
+        out = np.zeros_like(vals)
+        w2.wait(w2.pull(keys, out))
+        assert np.array_equal(out, expect)
+        # Servers flight-record the boot restore.
+        kinds = [e["kind"] for e in cl2.servers[0].flight.events()]
+        assert "restore_begin" in kinds and "restore_end" in kinds
+        # The age gauge reports a committed manifest.
+        assert cl2.scheduler.snapshot_status()["age_s"] >= 0
+    finally:
+        _kill(cl2, servers2)
+
+
+def test_restore_includes_optimizer_slots(tmp_path):
+    """Adam slots (m, v, step) ride the snapshot: a restored server's
+    NEXT update must be bit-exact vs an uninterrupted handle applying
+    the identical gradient sequence."""
+    snapdir = str(tmp_path / "snap")
+    factory = lambda: KVServerOptimizerHandle(kind="adam", lr=0.05)  # noqa: E731
+    keys = np.array([2, 7, 11], dtype=np.uint64)
+    rng = np.random.default_rng(3)
+    grads = [rng.normal(size=len(keys) * 16).astype(np.float32)
+             for _ in range(6)]
+
+    reference = factory()
+    for g in grads:
+        meta = KVMeta(push=True)
+        reference.apply_shard(meta, keys,
+                              _push_segs(meta, keys, g))
+
+    cl, servers, w = _boot(snapdir, handle_factory=factory)
+    try:
+        for g in grads[:5]:
+            w.wait(w.push(keys, g))
+        cl.scheduler.snapshot()
+    finally:
+        _kill(cl, servers)
+
+    cl2, servers2, w2 = _boot(snapdir, handle_factory=factory,
+                              extra={"PS_SNAPSHOT_RESTORE": "1"})
+    try:
+        w2.wait(w2.push(keys, grads[5]))  # the post-restore step
+        out = np.zeros(len(keys) * 16, np.float32)
+        w2.wait(w2.pull(keys, out))
+        want = np.concatenate([reference.store[int(k)] for k in keys])
+        assert np.array_equal(out, want)
+        # The step counter itself round-tripped exactly.
+        assert servers2[0]._handle._t == {int(k): 6 for k in keys}
+    finally:
+        _kill(cl2, servers2)
+
+
+def test_snapshot_racing_push_storm_is_consistent_cut(tmp_path):
+    """Chaos half of the acceptance: a snapshot taken MID push storm
+    captures every request entirely before or after the fence — with
+    each request adding 1.0 to every key of one server, a consistent
+    cut restores a store whose keys all hold the SAME count."""
+    snapdir = str(tmp_path / "snap")
+    cl, servers, w = _boot(snapdir, extra={"PS_APPLY_SHARDS": "4"})
+    keys = np.arange(8, dtype=np.uint64)
+    ones = np.ones(8 * 64, np.float32)
+    n_pushes = 120
+    try:
+        w.wait(w.push(keys, ones))  # the cut is never empty
+        stop = threading.Event()
+
+        def storm():
+            pending = []
+            for _ in range(n_pushes):
+                pending.append(w.push(keys, ones))
+                if len(pending) >= 16:
+                    w.wait(pending.pop(0))
+            for ts in pending:
+                w.wait(ts)
+            stop.set()
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        cl.scheduler.snapshot()
+        t.join(timeout=60)
+        assert stop.is_set()
+    finally:
+        _kill(cl, servers)
+
+    cl2, servers2, w2 = _boot(snapdir,
+                              extra={"PS_SNAPSHOT_RESTORE": "1"})
+    try:
+        out = np.zeros(8 * 64, np.float32)
+        w2.wait(w2.pull(keys, out))
+        per_key = out.reshape(8, 64)
+        count = per_key[0, 0]
+        # Every key of every request moved together: a torn request
+        # would leave keys at different counts.
+        assert np.all(per_key == count), per_key[:, 0]
+        assert 1.0 <= count <= n_pushes + 1
+    finally:
+        _kill(cl2, servers2)
+
+
+def test_digest_mismatch_fails_restore_loudly(tmp_path):
+    snapdir = str(tmp_path / "snap")
+    cl, servers, w = _boot(snapdir)
+    keys = np.array([4, 8], dtype=np.uint64)
+    try:
+        w.wait(w.push(keys, np.ones(2 * 16, np.float32)))
+        cl.scheduler.snapshot()
+    finally:
+        _kill(cl, servers)
+
+    # Tamper the committed manifest: the restore must refuse, not
+    # serve silently corrupted parameters.
+    mpath = os.path.join(snapdir, snap_mod.MANIFEST_NAME)
+    doc = json.load(open(mpath))
+    doc["ranges"][0]["digest"] = "00000000"
+    json.dump(doc, open(mpath, "w"))
+
+    env = {"PS_SNAPSHOT_DIR": snapdir, "PS_SNAPSHOT_RESTORE": "1"}
+    cl2 = LoopbackCluster(num_workers=1, num_servers=1, env_extra=env)
+    cl2.start()
+    s = KVServer(0, postoffice=cl2.servers[0])
+    try:
+        with pytest.raises(log.CheckError, match="digest mismatch"):
+            s.set_request_handle(KVServerDefaultHandle())
+    finally:
+        cl2.finalize(do_barrier=False)
+        s.stop()
+
+
+def test_partial_snapshot_never_commits(tmp_path):
+    """A server that errors (no handle installed) vetoes the commit:
+    no manifest appears, and the scheduler raises."""
+    snapdir = str(tmp_path / "snap")
+    env = {"PS_SNAPSHOT_DIR": snapdir}
+    cl = LoopbackCluster(num_workers=1, num_servers=1, env_extra=env)
+    cl.start()
+    s = KVServer(0, postoffice=cl.servers[0])  # handle never set
+    try:
+        with pytest.raises(log.CheckError, match="NOT committed"):
+            cl.scheduler.snapshot(timeout_s=20.0)
+        assert snap_mod.load_manifest(snapdir) is None
+    finally:
+        cl.finalize()
+        s.stop()
+
+
+def test_vetoed_attempt_never_clobbers_committed_snapshot(tmp_path):
+    """Segment filenames are stamped with a per-attempt uid: a later
+    attempt whose commit gets vetoed (one server wrote, a sibling
+    errored) must leave the previously COMMITTED snapshot restorable,
+    and the next committed snapshot prunes the orphans."""
+    snapdir = str(tmp_path / "snap")
+    keys = np.array([3, 6], dtype=np.uint64)
+    vals = np.arange(2 * 16, dtype=np.float32)
+    cl, servers, w = _boot(snapdir)
+    try:
+        w.wait(w.push(keys, vals))
+        cl.scheduler.snapshot()
+    finally:
+        _kill(cl, servers)
+
+    committed = snap_mod.load_manifest(snapdir)
+    entry = committed["ranges"][0]
+    # Simulate the vetoed attempt's survivor: same range, fresh uid,
+    # garbage contents.  The committed segment must be untouched.
+    orphan = snap_mod.write_range_segment(
+        snapdir, entry["begin"], entry["end"],
+        np.array([3], np.uint64), np.full(16, 99.0, np.float32),
+        None, uid="vetoedattempt",
+    )
+    assert orphan["file"] != entry["file"]
+    snap_mod.read_range_segment(snapdir, entry)  # digest still good
+
+    cl2, servers2, w2 = _boot(snapdir,
+                              extra={"PS_SNAPSHOT_RESTORE": "1"})
+    try:
+        out = np.zeros_like(vals)
+        w2.wait(w2.pull(keys, out))
+        assert np.array_equal(out, vals)
+        # A second COMMITTED snapshot prunes everything it does not
+        # reference: the old committed segment and the orphan.
+        res2 = cl2.scheduler.snapshot()
+        names = set(os.listdir(snapdir))
+        for e in res2["ranges"]:
+            assert f"{e['file']}.npz" in names
+        assert f"{entry['file']}.npz" not in names
+        assert f"{orphan['file']}.npz" not in names
+    finally:
+        _kill(cl2, servers2)
+
+
+def test_params_only_source_imports_with_fresh_slots():
+    """The length-collision case the lens sign tag exists for: an
+    even-length params-only record must import as FULL params with
+    fresh slots, never mis-split into [p, m]."""
+    from pslite_tpu.kv import replication as repl
+
+    src = KVServerDefaultHandle()
+    src.store[5] = np.arange(4, dtype=np.float32)
+    keys, vals, lens = repl.export_range(src, 0, 2**64)
+    assert lens[0] == 4  # params-only exports POSITIVE lens
+    dst = KVServerOptimizerHandle(kind="sgd_momentum")
+    dst.import_range(keys, vals, lens)
+    assert np.array_equal(dst.store[5], np.arange(4, dtype=np.float32))
+    assert 5 not in dst._m  # fresh slots, like a first push
+
+
+def test_slot_packed_records_tagged_and_kind_mismatch_is_loud():
+    h = KVServerOptimizerHandle(kind="sgd_momentum", lr=0.1)
+    keys = np.array([9], dtype=np.uint64)
+    meta = KVMeta(push=True)
+    h.apply_shard(meta, keys,
+                  _push_segs(meta, keys, np.ones(9, np.float32)))
+    k, v, lens = h.export_range(0, 2**64)
+    assert lens[0] == -19  # [p, m, kind_bits], tagged by the sign
+    # Same-kind roundtrip restores params AND slots bit-exact.
+    twin = KVServerOptimizerHandle(kind="sgd_momentum", lr=0.1)
+    twin.import_range(k, v, lens)
+    assert np.array_equal(twin.store[9], h.store[9])
+    assert np.array_equal(twin._m[9], h._m[9])
+    # A mismatched kind REFUSES the tagged record via the embedded
+    # kind code — even at lengths where the packings would collide
+    # (silently mis-splitting it would corrupt the key).
+    with pytest.raises(log.CheckError, match="different optimizer"):
+        KVServerOptimizerHandle(kind="adam").import_range(k, v, lens)
+    with pytest.raises(log.CheckError, match="sgd"):
+        KVServerOptimizerHandle(kind="sgd").import_range(k, v, lens)
+
+
+def test_plain_store_refuses_slot_packed_records():
+    """The generic dict-store import cannot unpack optimizer records:
+    storing the raw [p, m, ...] blob as the parameter would silently
+    serve momentum state appended to params — it must refuse."""
+    from pslite_tpu.kv import replication as repl
+
+    h = KVServerOptimizerHandle(kind="sgd_momentum", lr=0.1)
+    keys = np.array([4], dtype=np.uint64)
+    meta = KVMeta(push=True)
+    h.apply_shard(meta, keys,
+                  _push_segs(meta, keys, np.ones(4, np.float32)))
+    k, v, lens = h.export_range(0, 2**64)
+    with pytest.raises(log.CheckError, match="plain store"):
+        repl.import_range(KVServerDefaultHandle(), k, v, lens)
+
+
+def test_quiesce_timeout_vetoes_the_commit(tmp_path):
+    """A fence that cannot drain the apply pool must VETO the cut, not
+    export anyway — shard threads still mutating arrays in place would
+    commit torn values under a digest that verifies them."""
+    snapdir = str(tmp_path / "snap")
+    cl, servers, w = _boot(snapdir, extra={"PS_APPLY_SHARDS": "2"})
+    try:
+        w.wait(w.push(np.array([1], np.uint64),
+                      np.ones(16, np.float32)))
+        assert servers[0]._apply_pool is not None
+        servers[0]._apply_pool.quiesce = (
+            lambda tok, timeout_s=0.0: False)  # a wedged shard
+        with pytest.raises(log.CheckError, match="NOT committed"):
+            cl.scheduler.snapshot(timeout_s=20.0)
+        assert snap_mod.load_manifest(snapdir) is None
+    finally:
+        _kill(cl, servers)
+
+
+def test_two_stores_share_directory_without_collision(tmp_path):
+    """Two TieredStores in ONE process on one PS_STORE_DIR (in-process
+    clusters) must not cross-corrupt segment files."""
+    from pslite_tpu.kv.tiered import TieredStore
+    from pslite_tpu.telemetry.metrics import Registry
+
+    a = TieredStore(512, directory=str(tmp_path), shards=1,
+                    metrics=Registry())
+    b = TieredStore(512, directory=str(tmp_path), shards=1,
+                    metrics=Registry())
+    try:
+        for st, base in ((a, 10.0), (b, 20.0)):
+            for k in range(8):
+                st[k] = np.full(128, base + k, np.float32)
+                st.get(k)  # interleave appends into the shared dir
+        for st, base in ((a, 10.0), (b, 20.0)):
+            for k in range(8):
+                assert np.array_equal(
+                    st.get(k), np.full(128, base + k, np.float32)
+                ), (base, k)
+        a.close()  # must not unlink b's live segments
+        for k in range(8):
+            assert np.array_equal(
+                b.get(k), np.full(128, 20.0 + k, np.float32))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_manifest_age_and_slo_rule():
+    """snapshot_age is a known PS_SLO rule, and manifest_age_s reports
+    -1 (rule-skipped) for a never-snapshotted directory."""
+    from pslite_tpu.telemetry.health import parse_slo
+
+    rules = parse_slo("snapshot_age=5:50")
+    assert rules["snapshot_age"].warn == 5.0
+    assert rules["snapshot_age"].crit == 50.0
+    assert rules["snapshot_age"].grade(10.0) == "warn"
+    assert snap_mod.manifest_age_s("/nonexistent/nowhere") == -1.0

@@ -76,6 +76,11 @@ TRANSPORT_METRICS: Dict[str, str] = {
     # round trip itself must not regress.
     "elastic_p99_ratio": "lower",
     "elastic_scale_2_4_2_wall_s": "lower",
+    # durable_store (docs/durability.md) — the beyond-RAM serving tax
+    # (Zipf hot-set p99, tiered vs all-RAM; acceptance <= 2x) and the
+    # full-cluster-kill restore wall.
+    "durable_hot_p99_ratio": "lower",
+    "durable_restore_s": "lower",
     # kv_telemetry
     "kv_storm_msgs_per_s": "higher",
     # fault_recovery
@@ -92,7 +97,8 @@ TRANSPORT_METRICS: Dict[str, str] = {
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
     "multi_tenant_", "small_op_batching_", "serving_fanin_",
-    "elastic_", "kv_tracing_", "kv_", "fault_recovery_", "van_",
+    "elastic_", "durable_", "kv_tracing_", "kv_", "fault_recovery_",
+    "van_",
 )
 
 

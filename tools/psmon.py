@@ -113,6 +113,33 @@ def _req_quantiles(m: dict) -> tuple:
     )
 
 
+def _fmt_bytes(v: float) -> str:
+    """Compact byte count for fixed-width columns (999, 12K, 3.4M, 2G)."""
+    v = float(v)
+    for div, suffix in ((1 << 30, "G"), (1 << 20, "M"), (1 << 10, "K")):
+        if v >= div:
+            q = v / div
+            return f"{q:.1f}{suffix}" if q < 10 else f"{q:.0f}{suffix}"
+    return f"{v:.0f}"
+
+
+def _tier_cells(m: dict) -> tuple:
+    """(ram/cold bytes, cold-hit-rate) cells of the tiered store
+    (docs/durability.md): '-' on nodes without a TieredStore (the
+    gauges only exist under PS_STORE_RAM_MB) or with PS_TELEMETRY=0."""
+    gauges = m.get("gauges", {})
+    if ("kv.tier_ram_bytes" not in gauges
+            and "kv.tier_cold_bytes" not in gauges):
+        return f"{'-':>13}", f"{'-':>6}"
+    tier = (f"{_fmt_bytes(_g(m, 'kv.tier_ram_bytes'))}/"
+            f"{_fmt_bytes(_g(m, 'kv.tier_cold_bytes'))}")
+    gets = _c(m, "kv.tier_gets")
+    cold = _c(m, "kv.cold_hits")
+    rate = (f"{100.0 * cold / gets:>5.1f}%" if gets > 0
+            else f"{'-':>6}")
+    return f"{tier:>13}", rate
+
+
 def _apply_row(m: dict, uptime: float) -> tuple:
     n = _c(m, "apply.sharded_requests") + _c(m, "apply.global_requests")
     rate = n / uptime if uptime > 0 else 0.0
@@ -131,14 +158,16 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
     renders nodes that missed the pull as aged rows instead of
     dropping them; ``health`` (HealthEvent list) appends the
     watchdog footer."""
-    # ``epoch`` (elastic membership) and ``ops/F`` (small-op batching)
-    # ride LAST, in landing order: existing consumers parse earlier
-    # columns by index.
+    # ``epoch`` (elastic membership), ``ops/F`` (small-op batching),
+    # and the tiered-store cells (``ram/cold`` bytes + cold-hit-rate —
+    # docs/durability.md) ride LAST, in landing order: existing
+    # consumers parse earlier columns by index.
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
            f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7} "
-           f"{'epoch':>5} {'ops/F':>6} {'resp ops/F':>10}")
+           f"{'epoch':>5} {'ops/F':>6} {'resp ops/F':>10} "
+           f"{'ram/cold':>13} {'cold%':>6}")
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
     # Elastic membership (docs/elasticity.md): per-node routing epoch
@@ -195,11 +224,13 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
         rops = _c(m, "van.resp_batch_ops")
         ropsf = (f"{rops / rframes:>10.1f}" if rframes > 0
                  else f"{'-':>10}")
+        tier, coldp = _tier_cells(m)
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
-            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf} {ropsf}"
+            f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf} {ropsf} "
+            f"{tier} {coldp}"
         )
         # Silent span loss made loud (docs/observability.md): a
         # nonzero trace.dropped_events means this node's exported
@@ -394,6 +425,30 @@ def format_watch(history, top_keys: int = 3, traces=None) -> str:
         if dropped > 0:
             lines.append(f"      ^ WARNING: tracer dropped {dropped} "
                          f"span(s) — trace export incomplete")
+    # Snapshot age (docs/durability.md): the durable-tier freshness
+    # line.  Only servers configured with PS_SNAPSHOT_DIR export the
+    # gauge; a negative age means the directory holds no committed
+    # manifest yet.
+    snap_ages = []
+    for node_id in history.node_ids():
+        m = history.latest(node_id) or {}
+        age = m.get("gauges", {}).get("snapshot.age_s")
+        if age is not None:
+            snap_ages.append(float(age))
+    if snap_ages:
+        committed = [a for a in snap_ages if a >= 0]
+        lines.append("")
+        if committed:
+            lines.append(
+                f"snapshot age: {min(committed):.0f}s newest / "
+                f"{max(committed):.0f}s oldest across "
+                f"{len(snap_ages)} server(s)"
+            )
+        else:
+            lines.append(
+                f"snapshot age: no committed manifest yet "
+                f"({len(snap_ages)} server(s) configured)"
+            )
     changes = history.membership_log()
     if changes:
         lines.append("")
