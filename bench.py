@@ -706,6 +706,20 @@ def _transport_sections(quick: bool) -> list:
         sf = serving_fanin_bench(quick=quick)
         return {f"serving_fanin_{k}": v for k, v in sf.items()}
 
+    def sec_replica_read():
+        # Replica read fan-out (docs/serving_reads.md): read-heavy
+        # Zipf storm against one rank's range over real tcp, k=3
+        # (pulls spread across the whole replica chain, push-stamp
+        # validated) vs k=1 (primary funnel), interleaved rounds.
+        # Acceptance: >= 2.5x reads/s, ZERO read-your-writes
+        # violations, bit-exact spot checks — plus the live
+        # namespace publish/flip/rollback under storm with zero
+        # failed requests.
+        from pslite_tpu.benchmark import replica_read_bench
+
+        rr = replica_read_bench(quick=quick)
+        return {f"replica_read_{k}": v for k, v in rr.items()}
+
     def sec_elastic_scale():
         # Elastic membership (docs/elasticity.md): scale 2 -> 4 -> 2
         # servers mid push-storm with no global restart — stores
@@ -789,6 +803,7 @@ def _transport_sections(quick: bool) -> list:
         ("multi_tenant", sec_multi_tenant),
         ("small_op_batching", sec_small_op_batching),
         ("serving_fanin", sec_serving_fanin),
+        ("replica_read", sec_replica_read),
         ("elastic_scale", sec_elastic_scale),
         ("durable_store", sec_durable_store),
         ("kv_telemetry", sec_kv_telemetry),

@@ -30,7 +30,7 @@ import numpy as np
 MODES = ("push_then_pull", "push_pull", "push_only", "pull_only",
          "chunk_hol", "lane_goodput", "quantized_push", "multi_tenant",
          "dlrm_serve", "small_op_storm", "serving_fanin",
-         "durable_serve")
+         "durable_serve", "replica_read")
 
 
 def _recv_buffer_mode() -> bool:
@@ -577,6 +577,130 @@ def run_serving_fanin(worker, args) -> None:
           f"low_p50_ms={low_p50:.4f} store_exact={exact}", flush=True)
 
 
+def run_replica_read(worker, args) -> None:
+    """``--mode replica_read`` (docs/serving_reads.md): the read-heavy
+    serving regime — every worker aims a Zipf block storm entirely at
+    server rank 0's key range, so with ``PS_REPLICA_READS`` on the
+    pulls spread across that range's whole replica chain while k=1
+    funnels every read through one rank.  Periodic read-your-writes
+    probes (push a delta to a per-worker probe block, then IMMEDIATELY
+    pull it back) count violations — the bench's correctness gate —
+    and every 32nd storm pull is verified bit-exact against the
+    worker-held table."""
+    from collections import deque
+
+    from .base import WORKER_GROUP
+
+    secs = float(os.environ.get("PS_RR_SECONDS", "3"))
+    rows = int(os.environ.get("PS_RR_ROWS", "2048"))
+    dim = int(os.environ.get("PS_RR_DIM", "16"))
+    batch = int(os.environ.get("PS_RR_BATCH", "16"))
+    depth = int(os.environ.get("PS_RR_DEPTH", "8"))
+    k = worker.po.env.find_int("PS_KV_REPLICATION", 1)
+    servers = worker.po.num_servers
+    n_w = max(worker.po.num_workers, 1)
+    wrank = worker.po.my_group_rank()
+    keys = np.arange(rows, dtype=np.uint64)  # all in rank 0's range
+    table = np.stack([np.full(dim, 1.0 + r, np.float32)
+                      for r in range(rows)])
+    # The default handle's push ADDS: every worker pushes the base
+    # table, so the served value is n_w * table (integer-valued fp32,
+    # bit-exact).
+    worker.wait(worker.push(keys, table.reshape(-1)))
+    worker.po.barrier(0, WORKER_GROUP)
+    expected = table * n_w
+    # Cross-worker settle: a replica may not have applied the OTHER
+    # workers' base pushes yet (this worker's stamp floor only covers
+    # its own writes), so wait for the storm rows to read complete
+    # everywhere before the bit-exact checks arm.
+    warm = np.zeros(batch * dim, np.float32)
+    deadline = time.perf_counter() + 10.0
+    while True:
+        warm[:] = 0
+        worker.wait(worker.pull(keys[:batch], warm))
+        if np.array_equal(warm.reshape(batch, dim), expected[:batch]):
+            break
+        if time.perf_counter() > deadline:
+            raise RuntimeError("base table never settled on replicas")
+        time.sleep(0.05)
+    worker.po.barrier(0, WORKER_GROUP)
+    # Zipf block starts, precomputed; storm rows stay clear of every
+    # worker's probe block at the table's top (those values change
+    # mid-storm — an in-flight storm pull of a probe row would
+    # spuriously mismatch the local expectation).
+    rng = np.random.RandomState(7 + wrank)
+    zipf = np.minimum(rng.zipf(1.3, size=65536) - 1,
+                      rows - 8 * batch - 1).astype(np.int64)
+    outs_pool = [np.zeros(batch * dim, np.float32)
+                 for _ in range(depth)]
+    pending: deque = deque()
+    free = list(range(depth))
+    lats: list = []
+    n_req = 0
+    violations = 0
+
+    def _retire(check: bool) -> None:
+        t_iss, ts, start, slot = pending.popleft()
+        worker.wait(ts)
+        lats.append(time.perf_counter() - t_iss)
+        if check:
+            got = outs_pool[slot].reshape(batch, dim)
+            if not np.array_equal(got, expected[start:start + batch]):
+                raise RuntimeError(
+                    f"storm pull of rows [{start}, {start + batch}) "
+                    f"returned wrong values")
+        free.append(slot)
+
+    # Per-worker probe block: only THIS worker writes it, so its own
+    # push-stamp floor is exactly the read-your-writes frontier.
+    p0 = rows - (wrank + 1) * batch
+    probe_keys = keys[p0:p0 + batch]
+    probe_expected = np.ascontiguousarray(expected[p0:p0 + batch])
+    probe_delta = np.ones(batch * dim, np.float32)
+    probe_out = np.zeros(batch * dim, np.float32)
+    t0 = time.perf_counter()
+    t_end = t0 + secs
+    zi = 0
+    while time.perf_counter() < t_end:
+        n_req += 1
+        if n_req % 64 == 0:
+            # Read-your-writes probe: any replica whose applied stamp
+            # trails this push must be rejected and re-pulled from the
+            # primary — a violation here is a stale read.
+            probe_expected += 1.0
+            worker.wait(worker.push(probe_keys, probe_delta))
+            probe_out[:] = 0
+            worker.wait(worker.pull(probe_keys, probe_out))
+            if not np.array_equal(probe_out.reshape(batch, dim),
+                                  probe_expected):
+                violations += 1
+            continue
+        start = int(zipf[zi % len(zipf)])
+        zi += 1
+        slot = free.pop()
+        t1 = time.perf_counter()
+        ts = worker.pull(keys[start:start + batch], outs_pool[slot])
+        pending.append((t1, ts, start, slot))
+        if len(pending) >= depth:
+            _retire(check=n_req % 32 == 0)
+    while pending:
+        _retire(check=False)
+    wall = time.perf_counter() - t0
+    p50, p99 = _pctl_ms(lats)
+    fallbacks = worker.po.metrics.counter("replica_read.fallbacks").value
+    spread = worker.po.metrics.counter("replica_read.spread").value
+    out = np.zeros(batch * dim, np.float32)
+    worker.wait(worker.pull(keys[:batch], out))
+    exact = bool(np.array_equal(out.reshape(batch, dim),
+                                expected[:batch]))
+    print(f"REPLICA_READ reqs={n_req} secs={wall:.3f} "
+          f"reqs_per_s={n_req / max(wall, 1e-9):.1f} k={k} "
+          f"servers={servers} ryw_violations={violations} "
+          f"fallbacks={fallbacks} spread={spread} p50_ms={p50:.3f} "
+          f"p99_ms={p99:.3f} exact={exact}", flush=True)
+    worker.po.barrier(0, WORKER_GROUP)
+
+
 def run_worker(args) -> None:
     from . import postoffice
     from .kv.kv_app import KVWorker
@@ -607,6 +731,9 @@ def run_worker(args) -> None:
         return
     if args.mode == "durable_serve":
         run_durable_serve(worker, args)
+        return
+    if args.mode == "replica_read":
+        run_replica_read(worker, args)
         return
     ranges = po.get_server_key_ranges()
     keys_per_server = args.num_keys
@@ -2010,6 +2137,224 @@ def serving_fanin_bench(quick: bool = True) -> dict:
     }
 
 
+def _replica_read_run(secs: float, k: int, servers: int = 3,
+                      workers: int = 3) -> dict:
+    """One leg of the replica_read bench: a REAL 3w+3s tcp cluster
+    (one process per node) running ``--mode replica_read`` at
+    replication factor ``k``.  Three workers storm the same rank's
+    range — the aggregate read demand a single primary cannot absorb.
+    The k=3 leg spreads the pulls across that rank's whole chain; the
+    k=1 leg is the primary-funnel baseline.  Both legs run with the
+    push-stamp plane on (``PS_REPLICA_READS`` enables it server-side
+    even at k=1) so the comparison prices the spread, not the
+    stamps."""
+    import re
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "pslite_tpu.tracker.local",
+        "-n", str(workers), "-s", str(servers), "--van", "tcp", "--",
+        sys.executable, "-m", "pslite_tpu.benchmark",
+        "--mode", "replica_read", "--repeat", "1",
+    ]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PS_RR_SECONDS=str(secs),
+        PS_KV_REPLICATION=str(k),
+        PS_REPLICA_READS="1",
+        PS_HOT_CACHE="0",  # throughput must price network reads
+        PS_REQUEST_TIMEOUT="5.0",
+        PS_REQUEST_RETRIES="6",
+    )
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       env=env)
+    ms = re.findall(
+        r"REPLICA_READ reqs=(\d+) secs=([0-9.]+) "
+        r"reqs_per_s=([0-9.]+) k=(\d+) servers=(\d+) "
+        r"ryw_violations=(\d+) fallbacks=(\d+) spread=(\d+) "
+        r"p50_ms=([0-9.]+) p99_ms=([0-9.]+) exact=(True|False)",
+        r.stdout)
+    if len(ms) != workers:
+        raise RuntimeError(
+            f"replica_read leg expected {workers} worker reports, got "
+            f"{len(ms)} (rc={r.returncode}): "
+            f"{r.stdout[-600:]}\n{r.stderr[-600:]}"
+        )
+    p50s = sorted(float(m[8]) for m in ms)
+    return {
+        "reqs": sum(int(m[0]) for m in ms),
+        # Workers storm concurrently: the cluster rate is the sum.
+        "reqs_per_s": sum(float(m[2]) for m in ms),
+        "k": int(ms[0][3]),
+        "servers": int(ms[0][4]),
+        "ryw_violations": sum(int(m[5]) for m in ms),
+        "fallbacks": sum(int(m[6]) for m in ms),
+        "spread": sum(int(m[7]) for m in ms),
+        "p50_ms": p50s[len(p50s) // 2],
+        "p99_ms": max(float(m[9]) for m in ms),
+        "exact": all(m[10] == "True" for m in ms),
+    }
+
+
+def namespace_flip_storm(secs: float = 2.0, rows: int = 512,
+                         dim: int = 16) -> dict:
+    """Live model-version publish + flip + rollback under a replica-
+    read pull storm (docs/serving_reads.md): 1w+3s in-process cluster
+    at k=3, a background puller hammering rank 0's range while the
+    scheduler snapshots the v1 store, mutates it to v2, publishes the
+    v1 manifest as a namespace, and rolls back.  Acceptance: ZERO
+    failed requests, every answer bit-exact against exactly one of
+    the two versions."""
+    import shutil
+    import tempfile
+    import threading
+
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+
+    snapdir = tempfile.mkdtemp(prefix="ps_nsflip_")
+    nodes = _loopback_cluster(1, 3, "nsflip", env_extra={
+        "PS_KV_REPLICATION": "3",
+        "PS_REPLICA_READS": "1",
+        "PS_REQUEST_TIMEOUT": "2.0",
+        "PS_REQUEST_RETRIES": "6",
+        "PS_SNAPSHOT_DIR": snapdir,
+    })
+    scheduler, server_pos, worker_po = nodes[0], nodes[1:4], nodes[4]
+    servers = []
+    workers = []
+    result: dict = {}
+    try:
+        for po in server_pos:
+            s = KVServer(0, postoffice=po)
+            s.set_request_handle(KVServerDefaultHandle())
+            servers.append(s)
+        w = KVWorker(0, 0, postoffice=worker_po)
+        workers.append(w)
+        keys = np.arange(rows, dtype=np.uint64)  # rank 0's range
+        v1 = np.stack([np.full(dim, 1.0 + r, np.float32)
+                       for r in range(rows)])
+        w.wait(w.push(keys, v1.reshape(-1)))
+        time.sleep(0.3)  # forwards land on the whole chain
+        scheduler.snapshot()
+        w.wait(w.push(keys, v1.reshape(-1)))  # live store is now v2
+        v2 = 2 * v1
+        batch = 16
+        stop = threading.Event()
+        errors = [0]
+        pulls = [0]
+
+        def storm():
+            out = np.zeros(batch * dim, np.float32)
+            i = 0
+            while not stop.is_set():
+                start = (i * 7) % (rows - batch)
+                i += 1
+                out[:] = 0
+                try:
+                    w.wait(w.pull(keys[start:start + batch], out))
+                except Exception:
+                    errors[0] += 1
+                    continue
+                got = out.reshape(batch, dim)
+                blk1 = v1[start:start + batch]
+                blk2 = v2[start:start + batch]
+                if not (np.array_equal(got, blk1)
+                        or np.array_equal(got, blk2)):
+                    errors[0] += 1
+                pulls[0] += 1
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        time.sleep(min(0.5, secs / 4))
+        t1 = time.perf_counter()
+        scheduler.publish_model(namespace="bench", version="v1")
+        flip_ms = (time.perf_counter() - t1) * 1e3
+        time.sleep(min(0.5, secs / 4))
+        t1 = time.perf_counter()
+        scheduler.rollback_model()
+        rollback_ms = (time.perf_counter() - t1) * 1e3
+        time.sleep(min(0.5, secs / 4))
+        stop.set()
+        t.join(timeout=10)
+        # Post-rollback the live (v2) store must serve bit-exact.
+        out = np.zeros(batch * dim, np.float32)
+        w.wait(w.pull(keys[:batch], out))
+        result = {
+            "ns_flip_ms": round(flip_ms, 1),
+            "ns_rollback_ms": round(rollback_ms, 1),
+            "ns_flip_errors": errors[0],
+            "ns_flip_pulls": pulls[0],
+            "ns_flip_exact": bool(
+                np.array_equal(out.reshape(batch, dim), v2[:batch])),
+        }
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+        shutil.rmtree(snapdir, ignore_errors=True)
+    return result
+
+
+def replica_read_bench(quick: bool = True) -> dict:
+    """Replica read fan-out (docs/serving_reads.md) over real tcp
+    processes: the read-heavy Zipf storm against one rank's range at
+    k=3 (pulls spread across the whole chain, stamp-validated) vs k=1
+    (every read funnels through the primary).
+
+    Headline: k=3 moves >= 2.5x more reads/s than k=1 with ZERO
+    read-your-writes violations counted by the in-storm probes, every
+    spot check bit-exact.  Legs run in INTERLEAVED rounds, medians
+    reported.  Plus the namespace-flip leg: a live model-version
+    publish/flip/rollback under the same storm with zero failed
+    requests.
+
+    The throughput legs need real parallelism — 3 worker + 3 server
+    processes all hot — so on hosts with fewer than 8 cpus they
+    record a skip marker instead of an inverted ratio that only
+    measures context-switch pressure (the 1-core CI container cannot
+    express a spread win by construction).  The namespace-flip
+    correctness leg runs everywhere."""
+    out: dict = {}
+    ncpu = os.cpu_count() or 1
+    if ncpu < 8:
+        out["skipped"] = (
+            f"spread throughput needs >= 8 cpus, have {ncpu}")
+    else:
+        secs = 3.0 if quick else 6.0
+        rounds = 2 if quick else 3
+        legs = {"k3": [], "k1": []}
+        for _ in range(rounds):
+            legs["k3"].append(_replica_read_run(secs, k=3))
+            legs["k1"].append(_replica_read_run(secs, k=1))
+        med = statistics.median
+        r3 = med(r["reqs_per_s"] for r in legs["k3"])
+        r1 = med(r["reqs_per_s"] for r in legs["k1"])
+        out = {
+            "seconds": secs,
+            "rounds": rounds,
+            "servers": legs["k3"][0]["servers"],
+            "k3_reqs_per_s": round(r3, 1),
+            "k1_reqs_per_s": round(r1, 1),
+            # Headline: the reads/s multiple (acceptance: >= 2.5).
+            "tput_ratio": round(r3 / r1, 2) if r1 > 0 else None,
+            # Correctness gate: MUST stay 0 (bench_diff fails it).
+            "ryw_violations": sum(r["ryw_violations"]
+                                  for leg in legs.values()
+                                  for r in leg),
+            "fallbacks": sum(r["fallbacks"] for r in legs["k3"]),
+            "spread_reads": sum(r["spread"] for r in legs["k3"]),
+            "p50_k3_ms": round(
+                med(r["p50_ms"] for r in legs["k3"]), 3),
+            "p50_k1_ms": round(
+                med(r["p50_ms"] for r in legs["k1"]), 3),
+            "exact": all(r["exact"]
+                         for leg in legs.values() for r in leg),
+        }
+    out.update(namespace_flip_storm(secs=2.0 if quick else 3.0))
+    return out
+
+
 def _durable_run(n_pulls: int, ram_mb: float, rows: int,
                  dim: int) -> dict:
     """One leg of the durable_store bench: a REAL 1w+1s tcp cluster
@@ -2248,7 +2593,7 @@ def main(argv=None) -> int:
         server = KVServer(0)
         if args.mode in ("chunk_hol", "lane_goodput", "quantized_push",
                          "multi_tenant", "dlrm_serve", "serving_fanin",
-                         "durable_serve"):
+                         "durable_serve", "replica_read"):
             # Shard-capable handle: the apply pool (and the streaming
             # apply of chunked pushes) is part of what these modes price.
             from .kv.kv_app import KVServerDefaultHandle

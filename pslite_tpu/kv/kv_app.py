@@ -267,6 +267,10 @@ class _PendingSlice:
     # before re-routing — a range split mid-flight can divide one
     # slice across two new owners.
     wrong_owner: bool = False
+    # Spread pull (docs/serving_reads.md): the destination may be a
+    # replica, so the response's applied stamp is validated against
+    # the worker's newest-seen push stamp before acceptance.
+    replica_read: bool = False
 
 
 @dataclass
@@ -485,12 +489,37 @@ class KVWorker:
         # slices through the sweeper, so deadlines default ON when the
         # cluster is elastic (an explicit PS_REQUEST_TIMEOUT still
         # wins, including an explicit 0).
+        replica_reads = bool(self.po.env.find_int("PS_REPLICA_READS", 0))
         self._req_timeout = self.po.env.find_float(
             "PS_REQUEST_TIMEOUT",
-            10.0 if getattr(self.po, "elastic", False) else 0.0,
+            10.0 if (replica_reads or getattr(self.po, "elastic", False))
+            else 0.0,
         )
         self._req_retries = self.po.env.find_int("PS_REQUEST_RETRIES", 3)
         self._replication = self.po.env.find_int("PS_KV_REPLICATION", 1)
+        # Replica read fan-out (docs/serving_reads.md): spread pure
+        # pulls across each range's whole replica chain, validated
+        # against the newest push stamp this worker has seen per
+        # primary.  Needs the deadline/sweeper machinery — the stale-
+        # replica fallback is a sweeper re-route — hence the timeout
+        # default above.
+        self._replica_reads = (
+            replica_reads and self._replication >= 2
+            and self.po.num_servers >= 2 and self._req_timeout > 0
+        )
+        self._read_policy = (self.po.env.find("PS_REPLICA_READ_POLICY")
+                             or "sticky").strip().lower()
+        self._rr_counter = itertools.count()
+        # Newest push stamp ACKNOWLEDGED to this worker, per node id —
+        # the worker half of read-your-writes: a replica answer whose
+        # applied stamp trails this floor is stale for THIS worker.
+        self._seen_stamps: Dict[int, int] = {}
+        self._read_share: Dict[int, int] = {}  # dest -> spread pulls
+        self._c_replica_reads = self.po.metrics.counter(
+            "replica_read.spread")
+        self._c_replica_fallbacks = self.po.metrics.counter(
+            "replica_read.fallbacks")
+        self._fallback_logged = 0.0
         self._down_servers: set = set()
         # Dead ranks whose first failover re-route was already flight-
         # recorded (one event per outage TRANSITION — _route runs per
@@ -1575,6 +1604,11 @@ class KVWorker:
                 # Re-arm the one-shot failover flight event: a fresh
                 # outage of the recovered rank is a NEW transition.
                 self._failover_logged.discard(node_id)
+                # A recovered server restarts its push-stamp counter:
+                # the old floor would brand every replica answer stale
+                # forever (docs/serving_reads.md).
+                self._seen_stamps.pop(node_id, None)
+                self._read_share.pop(node_id, None)
         if down:
             self._wake_sweeper()
 
@@ -1667,6 +1701,52 @@ class KVWorker:
                                           **detail)
                 return cand
         return base
+
+    def _route_read(self, group_rank: int,
+                    trace: int = 0) -> Tuple[int, bool]:
+        """Spread destination for a PURE pull slice
+        (docs/serving_reads.md): any live member of the range's
+        replica chain.  ``PS_REPLICA_READ_POLICY`` picks how —
+        ``sticky`` (default) pins this worker's reads for the range
+        to ONE member by worker-rank rotation, so the cluster-wide
+        read load spreads across the chain while each worker keeps a
+        single hot connection and its request aggregation intact;
+        ``rr`` rotates per pull; ``load`` picks the member this
+        worker has sent the fewest reads.  Returns ``(dest,
+        is_replica)``; collapses to plain primary routing — keeping
+        the failover flight event — when the chain has one live
+        member or the primary itself is down."""
+        gs = self.po.group_size
+        base = server_rank_to_id(group_rank * gs + self.po.instance_idx)
+        from .replication import chain_ranks
+
+        # chain_ranks lists the REPLICAS (owner excluded) — the spread
+        # set is the primary plus every live chain member.
+        members = [] if base in self._down_servers else [base]
+        for rank in chain_ranks(group_rank, self._replication,
+                                self.po.num_servers,
+                                active=self.po.active_server_ranks):
+            cand = server_rank_to_id(rank * gs + self.po.instance_idx)
+            if cand not in self._down_servers:
+                members.append(cand)
+        if len(members) <= 1 or base in self._down_servers:
+            return self._route(group_rank, trace), False
+        if self._read_policy == "load":
+            dest = min(members,
+                       key=lambda d: self._read_share.get(d, 0))
+        elif self._read_policy == "rr":
+            dest = members[next(self._rr_counter) % len(members)]
+        else:
+            # sticky: worker-rank rotation over the chain, offset by
+            # the range's rank so one worker's reads of DIFFERENT
+            # ranges also land on different members.  Deterministic —
+            # no per-pull state, re-evaluated when membership shifts.
+            dest = members[(self.po.my_rank() + group_rank)
+                           % len(members)]
+        self._read_share[dest] = self._read_share.get(dest, 0) + 1
+        if dest != base:
+            self._c_replica_reads.inc()
+        return dest, dest != base
 
     # Wrong-owner re-routes allowed per request before it is abandoned
     # (each bounce is a live server answering; the worker's table pull
@@ -1793,6 +1873,10 @@ class KVWorker:
                     sl.wrong_owner = False
                     subs = self._resplit_slice(req, sl)
                 for sub in subs:
+                    # Retries always fall back to primary routing: a
+                    # spread pull that timed out (or answered stale)
+                    # does not get a second replica gamble.
+                    sub.replica_read = False
                     dest = self._route(sub.group_rank, req.trace)
                     old = sub.sent_msg
                     if (old is not None and dest != sub.dest
@@ -1992,9 +2076,22 @@ class KVWorker:
         if not live:
             self._finish(ts)  # also releases any _pull_dst entry
             return
+        if (self._replica_reads and pull and not push and cmd == 0
+                and zpull is None and codec is None):
+            # Replica read fan-out (docs/serving_reads.md): pure pulls
+            # spread across each range's live chain members; _process
+            # validates the response's applied stamp before accepting.
+            # Zpull and codec responses stay primary-only (decline
+            # matrix): their payloads are server-state-dependent in
+            # ways a stamp cannot vouch for.
+            routed = [self._route_read(owner, trace)
+                      for owner, _part in live]
+        else:
+            routed = [(self._route(owner, trace), False)
+                      for owner, _part in live]
         parts = [
-            (owner, part, self._route(owner, trace))
-            for owner, part in live
+            (owner, part, dest)
+            for (owner, part), (dest, _r) in zip(live, routed)
         ]
         # Encode ONCE, before any send can fail: a sweeper retry (or
         # replica failover) re-sends the identical compressed bytes —
@@ -2016,8 +2113,9 @@ class KVWorker:
                 trace=trace,
                 slices=[
                     _PendingSlice(group_rank=gr, part=part, dest=dest,
-                                  enc=enc)
-                    for (gr, part, dest), enc in zip(parts, encs)
+                                  enc=enc, replica_read=rr)
+                    for (gr, part, dest), enc, (_d, rr)
+                    in zip(parts, encs, routed)
                 ],
                 val_dtype=val_dtype, val_nbytes=val_nbytes,
                 codec=codec, zpull=zpull, tenant=tenant,
@@ -2105,6 +2203,14 @@ class KVWorker:
                                         discount = True  # dup: 1st wins
                                     else:
                                         sl.responded = True
+                            if (self._replica_reads and op.stamp
+                                    and op.stamp
+                                    > self._seen_stamps.get(sender, 0)):
+                                # Batched push acks raise the read-
+                                # your-writes floor too (every op in
+                                # this frame is a push — the fast
+                                # path's precondition).
+                                self._seen_stamps[sender] = op.stamp
                         if hc is not None and op.stamp:
                             hc.observe(sender, op.stamp)
                         if discount:
@@ -2229,7 +2335,49 @@ class KVWorker:
                     # is the one that counts.
                     discount = True
                 else:
-                    sl.responded = True
+                    stale = False
+                    if sl.replica_read:
+                        pid = server_rank_to_id(
+                            sl.group_rank * self.po.group_size
+                            + self.po.instance_idx)
+                        stale = (
+                            msg.meta.sender != pid
+                            and msg.meta.stamp
+                            < self._seen_stamps.get(pid, 0)
+                        )
+                    if stale:
+                        # Stale replica answer (docs/serving_reads.md):
+                        # its applied stamp trails a push THIS worker
+                        # already saw acknowledged.  Discard it and
+                        # re-pull from the primary — read-your-writes
+                        # beats the saved hop.
+                        discount = retry_now = True
+                        sl.retry_now = True
+                        sl.replica_read = False  # sweeper -> primary
+                        self._c_replica_fallbacks.inc()
+                        if ts in self._req_track:
+                            self._req_outcome[ts] = "replica_stale"
+                        now = time.monotonic()
+                        if now - self._fallback_logged > 1.0:
+                            # Throttled: a lagging replica under a read
+                            # storm would otherwise wrap the flight ring.
+                            self._fallback_logged = now
+                            self.po.flight.record(
+                                "replica_stale_fallback",
+                                severity="warn",
+                                replica=msg.meta.sender, primary=pid,
+                                stamp=msg.meta.stamp,
+                                seen=self._seen_stamps.get(pid, 0),
+                            )
+                    else:
+                        sl.responded = True
+            if (self._replica_reads and msg.meta.push
+                    and msg.meta.stamp):
+                # An acknowledged push raises this worker's read-your-
+                # writes floor for the acking server.
+                if msg.meta.stamp > self._seen_stamps.get(
+                        msg.meta.sender, 0):
+                    self._seen_stamps[msg.meta.sender] = msg.meta.stamp
         if wrong_owner_epoch is not None:
             # The bouncing server runs a newer routing epoch than ours:
             # pull the current table from the scheduler (throttled) so
@@ -2255,11 +2403,22 @@ class KVWorker:
                 self._overload_ts.add(ts)
                 if ts in self._req_track:
                     self._req_outcome[ts] = "shed"
+        cache_ident = msg.meta.sender
+        if sl is not None and sl.replica_read:
+            # Replica-served pull (docs/serving_reads.md): its stamp
+            # lives in the PRIMARY's counter domain (the replica's
+            # applied stamp of the primary's push stream), so cache
+            # bookkeeping files it under the primary's identity — the
+            # fill carries the replica's applied stamp, never the
+            # primary's current counter.
+            cache_ident = server_rank_to_id(
+                sl.group_rank * self.po.group_size
+                + self.po.instance_idx)
         if self._hot_cache is not None and msg.meta.stamp:
             # Push-driven invalidation (kv/hot_cache.py): every stamped
             # response advances the newest-known version of its server,
             # invalidating older cached fills.
-            self._hot_cache.observe(msg.meta.sender, msg.meta.stamp)
+            self._hot_cache.observe(cache_ident, msg.meta.stamp)
         if msg.meta.pull and len(msg.data) >= 2:
             ci = msg.meta.codec
             if ci is not None and ci.raw_len > 0 and len(msg.data) >= 3:
@@ -2299,7 +2458,7 @@ class KVWorker:
                 # at the server's request intake, so it never claims
                 # freshness past what the snapshot actually observed;
                 # fills older than a known push park invalid.
-                self._hot_cache.fill(msg.meta.sender, msg.meta.stamp,
+                self._hot_cache.fill(cache_ident, msg.meta.stamp,
                                      kvs.keys, kvs.vals)
         # The Customer increments the response count *after* this handle, so
         # "last response" is expected-1 (reference: kv_app.h:686-710).
@@ -2381,6 +2540,14 @@ class KVWorker:
             cb()
 
 
+class _StagingStore:
+    """Plain-dict shim handle ``snapshot.restore_into`` fills while the
+    live store keeps serving (model-namespace publish)."""
+
+    def __init__(self):
+        self.store: dict = {}
+
+
 class KVServer:
     """Holder of a key-range shard of the store (kv_app.h:304-420).
 
@@ -2412,6 +2579,15 @@ class KVServer:
         self._owned: Optional[List[Range]] = None
         self._table = None  # the applied RoutingTable (gate reads it)
         self._routing_epoch = -1
+        # (owner rank, begin, end) triples this server replicated under
+        # the PREVIOUS routing epoch: the diff against the new table's
+        # chains names the ranges a chain recomputation newly assigned
+        # here, which must BACKFILL existing state instead of holding
+        # only post-change forwards (docs/serving_reads.md).  None until
+        # the first table lands (the boot baseline never backfills —
+        # except an elastic joiner, whose first table IS a chain
+        # change against a populated cluster).
+        self._replicated_prev: Optional[set] = None
         # range begin -> {"range", "frm", "epoch", "parked", "timer"}
         self._pending_ranges: Dict[int, dict] = {}
         # Migrations that arrived BEFORE their routing table (begin ->
@@ -2524,14 +2700,19 @@ class KVServer:
         # push-free serving store must still hand out cacheable pulls.
         # GATED: stamping engages only when some QoS feature is
         # configured (PS_TENANTS / PS_HOT_CACHE / explicit
-        # PS_QOS_STAMPS=1) — default deployments keep every frame
-        # byte-identical to pre-tenant builds (no EXT_QOS tail).
+        # PS_QOS_STAMPS=1 / replica reads, which use the stamp as their
+        # consistency currency — docs/serving_reads.md) — default
+        # deployments keep every frame byte-identical to pre-tenant
+        # builds (no EXT_QOS tail).
         self._qos_mu = threading.Lock()
         self._push_version = 1
+        self._replica_reads = bool(
+            self.po.env.find_int("PS_REPLICA_READS", 0))
         self._qos_stamps = bool(
             self.tenants.enabled
             or self.po.env.find_int("PS_HOT_CACHE", 0)
             or self.po.env.find_int("PS_QOS_STAMPS", 0)
+            or self._replica_reads
         )
         # Serving fan-in: the response-direction aggregation plane
         # (docs/batching.md, "Response aggregation").  Independent
@@ -2602,6 +2783,14 @@ class KVServer:
         self._h_snapshot = self.po.metrics.histogram("snapshot.duration_s")
         self._snapshotting = False
         self._snap_restored = False
+        # Model namespaces (docs/serving_reads.md): a published snapshot
+        # manifest staged as an immutable store, flipped in atomically
+        # on the request thread (the customer queue IS the parking), the
+        # displaced store retained for instant rollback.
+        self._ns_staged: Optional[tuple] = None   # (name, version, store)
+        self._ns_prev: Optional[tuple] = None     # (name, version, store)
+        self._ns_current: Tuple[str, str] = ("live", "")
+        self._ns_staging = False
         self._snapshot_hook = self._on_snapshot_request
         reg_snap = getattr(self.po, "register_snapshot_hook", None)
         if reg_snap is not None:  # stub postoffices lack the registry
@@ -2733,6 +2922,14 @@ class KVServer:
                 if callable(tier_mode):
                     tier_mode(False)
                 self._drain_restore_buffer()
+        # Replica-backfill kick (docs/serving_reads.md): an elastic
+        # joiner's first routing table replays at hook registration —
+        # before this handle existed — so _note_replicated_ranges
+        # deferred.  Re-run it now that the store can accept imports.
+        with self._elastic_mu:
+            table = self._table
+        if table is not None:
+            self._note_replicated_ranges(table, self.po.my_group_rank())
 
     def _restore_from_snapshot(self, handle) -> None:
         """Boot-time restore from the committed snapshot manifest
@@ -2921,7 +3118,11 @@ class KVServer:
             # Replica-forwarded pushes are fire-and-forget at the app
             # level (van-level ACKs cover delivery under PS_RESEND): a
             # response would collide with the origin worker's timestamp
-            # numbering at the primary.
+            # numbering at the primary.  The forward's stamp is marked
+            # APPLIED here — the completion edge the
+            # replication.applied_stamp_lag gauge measures.
+            if self._replicator is not None and getattr(req, "stamp", 0):
+                self._replicator.note_applied(req.sender, req.stamp)
             return
         msg = self._response_msg(req)
         m = msg.meta
@@ -3020,6 +3221,11 @@ class KVServer:
         # is correct, a skipped one is not.
         self._qos_push_done(req)
         if req.option == OPT_REPLICA:
+            # Even a FAILED forward apply advances the applied mark —
+            # the lag gauge measures backlog, not success; the dedup
+            # cache already recorded the origin either way.
+            if self._replicator is not None and getattr(req, "stamp", 0):
+                self._replicator.note_applied(req.sender, req.stamp)
             return  # no app-level responses on the replication plane
         msg = self._response_msg(req)
         # The error marker REPLACES any echoed option (OPT_ZPULL /
@@ -3121,6 +3327,7 @@ class KVServer:
             t.daemon = True
             ent["timer"] = t
             t.start()
+        self._note_replicated_ranges(table, my)
         if losses:
             if self._handle is None:
                 log.warning("routing update assigns migrations but no "
@@ -3150,6 +3357,78 @@ class KVServer:
                 # worker thread reports when it drains — a leaver must
                 # never be retired mid-handoff.
                 self._send_remove_done()
+
+    def _note_replicated_ranges(self, table, my: int) -> None:
+        """Replica-backfill debt (docs/serving_reads.md): diff the set
+        of ranges this rank REPLICATES (someone else owns, we sit in
+        their chain) across routing epochs, and backfill the state of
+        newly gained ones from their primaries.  Without this a chain
+        recomputation (join/leave/recovery) leaves the new replica
+        holding only post-change pushes — it would answer spread reads
+        with a permanently stale store."""
+        # getattr: the __init__-time cutover runs before the
+        # replication engine is constructed.  Returning BEFORE the
+        # prev-set update matters: an elastic joiner's first table
+        # replays ahead of set_request_handle (handle still None), and
+        # recording it here would swallow the backfill debt — the
+        # set_request_handle kick re-runs this once both halves exist.
+        replicator = getattr(self, "_replicator", None)
+        if replicator is None or self._handle is None:
+            return
+        from .replication import chain_ranks
+        active = list(getattr(table, "active", []))
+        repl_now = set()
+        for e in table.entries:
+            if e.owner == my:
+                continue
+            chain = chain_ranks(e.owner, replicator.k,
+                                self.po.num_servers, active=active)
+            if my in chain:
+                repl_now.add((e.owner, e.begin, e.end))
+        prev = self._replicated_prev
+        self._replicated_prev = repl_now
+        if prev is None:
+            # First table ever seen.  A boot-time baseline needs no
+            # backfill (everyone starts empty together) — but a live
+            # elastic JOINER enters chains that already hold state.
+            if not getattr(self.po, "elastic_join", False):
+                return
+            gained = repl_now
+        else:
+            gained = repl_now - prev
+        if not gained:
+            return
+        threading.Thread(
+            target=self._backfill_replicas, args=(sorted(gained),),
+            name="kv-replica-backfill", daemon=True,
+        ).start()
+
+    def _backfill_replicas(self, gained) -> None:
+        """Background half of the replica backfill: park new arrivals
+        (restore buffer), fetch each newly replicated range from its
+        primary (quiesced cut; the response stamp floors forward
+        re-applies), then replay everything parked."""
+        with self._restore_mu:
+            if self._restore_buffer is not None:
+                return  # a restore/resync already covers this window
+            self._restore_buffer = []
+        total = 0
+        try:
+            for owner, begin, end in gained:
+                oid = server_rank_to_id(owner * self.po.group_size
+                                        + self.po.instance_idx)
+                if self.po.van.is_peer_down(oid):
+                    continue  # recovery restore covers dead primaries
+                total += self._replicator.backfill_range(
+                    self._handle, Range(begin, end), oid)
+            self.po.flight.record(
+                "replica_backfill", severity="info",
+                ranges=len(gained), keys=total,
+            )
+        except Exception as exc:  # noqa: BLE001 - keep serving
+            log.warning(f"replica backfill failed: {exc!r}")
+        finally:
+            self._drain_restore_buffer()
 
     def _elastic_gate(self, msg: Message) -> bool:
         """Ownership check at intake (request thread).  Returns True
@@ -3208,11 +3487,20 @@ class KVServer:
                         oid = server_rank_to_id(
                             e.owner * self.po.group_size
                             + self.po.instance_idx)
-                        if (self.po.van.is_peer_down(oid)
-                                and my in chain_ranks(
-                                    e.owner, self._replicator.k,
-                                    self.po.num_servers,
-                                    active=self.po.active_server_ranks)):
+                        in_chain = my in chain_ranks(
+                            e.owner, self._replicator.k,
+                            self.po.num_servers,
+                            active=self.po.active_server_ranks)
+                        # A chain member admits the dead owner's ENTIRE
+                        # traffic (failover), and — with replica reads
+                        # on — PULLS for the live owner's ranges too
+                        # (docs/serving_reads.md): the response is
+                        # stamped in the primary's currency at intake,
+                        # so the worker can judge its freshness.
+                        if in_chain and (
+                                self.po.van.is_peer_down(oid)
+                                or (self._replica_reads
+                                    and m.pull and not m.push)):
                             n_in += hi - lo
                 if n_in == len(keys):
                     return False
@@ -3481,6 +3769,13 @@ class KVServer:
             req = json.loads(body.decode()) if body else {}
         except Exception:  # noqa: BLE001 - a corrupt body vetoes below
             req = {}
+        op = req.get("op")
+        if op in ("publish", "flip", "rollback"):
+            # Model-namespace control ops (docs/serving_reads.md) ride
+            # the snapshot fence: same wire command, same request-
+            # thread ordering guarantee.
+            self._run_namespace(sender, token, op, req)
+            return
         directory = req.get("dir") or self._snapshot_dir
         err = None
         if self._handle is None:
@@ -3618,6 +3913,170 @@ class KVServer:
             self.po.van.send(msg)
         except Exception as exc:  # noqa: BLE001 - scheduler times out
             log.warning(f"snapshot reply to {dest} failed: {exc!r}")
+
+    # -- model namespaces (docs/serving_reads.md) -----------------------------
+
+    def _run_namespace(self, sender: int, token: int, op: str,
+                       req: dict) -> None:
+        """Model-namespace control ops, on the request thread behind
+        the snapshot fence so each op serializes against every earlier
+        queued request (the routing-cutover ordering trick).
+        ``publish`` stages a committed snapshot manifest into an
+        OFF-LINE store on a background thread — serving never pauses;
+        ``flip`` atomically swaps the staged store in (apply-pool
+        quiesce, then one pointer assignment); ``rollback`` swaps the
+        displaced store straight back."""
+        handle = self._handle
+        if handle is None:
+            self._snapshot_reply(sender, token,
+                                 {"error": "no request handle set"})
+            return
+        if not isinstance(getattr(handle, "store", None), dict):
+            # Tiered / custom handles keep state outside a plain dict —
+            # a store-pointer swap would strand it.  Decline loudly
+            # (decline matrix, docs/serving_reads.md).
+            self._snapshot_reply(sender, token, {
+                "error": "model namespaces need a plain dict store "
+                         "(tiered/custom handles decline)"})
+            return
+        if op == "publish":
+            directory = req.get("dir") or self._snapshot_dir
+            if not directory:
+                self._snapshot_reply(sender, token, {
+                    "error": "publish needs a snapshot directory"})
+                return
+            if self._ns_staging:
+                self._snapshot_reply(sender, token, {
+                    "error": "a namespace stage is already in progress"})
+                return
+            self._ns_staging = True
+            threading.Thread(
+                target=self._stage_namespace,
+                args=(sender, token, directory,
+                      str(req.get("namespace", "model")),
+                      str(req.get("version", ""))),
+                name="kv-ns-stage", daemon=True,
+            ).start()
+            return
+        if op == "flip":
+            staged = self._ns_staged
+            if staged is None:
+                self._snapshot_reply(sender, token, {
+                    "error": "flip without a staged namespace "
+                             "(publish first)"})
+                return
+            err = self._quiesce_applies("namespace flip")
+            if err is not None:
+                self._snapshot_reply(sender, token, {"error": err})
+                return
+            name, version, new_store = staged
+            self._ns_prev = (*self._ns_current, handle.store)
+            handle.store = new_store
+            self._ns_current = (name, version)
+            self._ns_staged = None
+            self._after_namespace_swap("namespace_flip", name, version)
+            self._snapshot_reply(sender, token, {
+                "rank": self.po.my_group_rank(),
+                "namespace": name, "version": version,
+                "keys": len(new_store),
+            })
+            return
+        prev = self._ns_prev  # rollback
+        if prev is None:
+            self._snapshot_reply(sender, token, {
+                "error": "rollback without a previous namespace"})
+            return
+        err = self._quiesce_applies("namespace rollback")
+        if err is not None:
+            self._snapshot_reply(sender, token, {"error": err})
+            return
+        name, version, old_store = prev
+        self._ns_prev = (*self._ns_current, handle.store)
+        handle.store = old_store
+        self._ns_current = (name, version)
+        self._after_namespace_swap("namespace_rollback", name, version)
+        self._snapshot_reply(sender, token, {
+            "rank": self.po.my_group_rank(),
+            "namespace": name, "version": version,
+            "keys": len(old_store),
+        })
+
+    def _quiesce_applies(self, what: str) -> Optional[str]:
+        """Drain every apply submitted so far (request thread only); a
+        timeout vetoes the store swap exactly like it vetoes a
+        snapshot cut — swapping under a shard thread mid-write would
+        tear the displaced store."""
+        if self._apply_pool is None:
+            return None
+        tok = self._apply_pool.submit_token()
+        if not self._apply_pool.quiesce(
+                tok, timeout_s=self._snapshot_quiesce_s):
+            return (f"apply pool did not quiesce within "
+                    f"{self._snapshot_quiesce_s}s — refusing {what}")
+        return None
+
+    def _after_namespace_swap(self, kind: str, name: str,
+                              version: str) -> None:
+        if self._qos_stamps:
+            # Bump the push stamp so every hot-cache entry filled under
+            # the displaced namespace fails validity on the worker's
+            # next observe — lazy, but bounded by the cache TTL.
+            with self._qos_mu:
+                self._push_version += 1
+        self.po.model_namespace = {"name": name, "version": version}
+        self.po.flight.record(kind, severity="info",
+                              namespace=name, version=version)
+
+    def _serving_ranges(self) -> list:
+        """Every range this server answers reads for: owned, plus —
+        with replication — every range whose chain it sits in (a
+        staged namespace must cover spread reads too)."""
+        my = self.po.my_group_rank()
+        with self._elastic_mu:
+            owned = self._owned
+            repl = list(self._replicated_prev or ())
+        if owned is not None:
+            ranges = list(owned)
+            ranges.extend(Range(b, e) for _, b, e in repl)
+            return ranges
+        ranges = list(self.po.server_key_ranges_of(my))
+        if self._replicator is not None and self._replicator.k > 1:
+            from .replication import chain_ranks
+            for o in range(self.po.num_servers):
+                if o != my and my in chain_ranks(
+                        o, self._replicator.k, self.po.num_servers):
+                    ranges.extend(self.po.server_key_ranges_of(o))
+        return ranges
+
+    def _stage_namespace(self, sender: int, token: int, directory: str,
+                         name: str, version: str) -> None:
+        """Background half of publish: restore the manifest into an
+        off-line store while the live one keeps serving; the later
+        ``flip`` swaps it in on the request thread."""
+        t0 = time.monotonic()
+        try:
+            manifest = snapshot_mod.load_manifest(directory)
+            if manifest is None:
+                raise RuntimeError(
+                    f"no committed manifest in {directory!r}")
+            shim = _StagingStore()
+            keys, nbytes = snapshot_mod.restore_into(
+                shim, directory, self._serving_ranges(), manifest)
+            self._ns_staged = (name, version, shim.store)
+            self.po.flight.record(
+                "namespace_stage", severity="info", namespace=name,
+                version=version, keys=keys,
+                duration_s=round(time.monotonic() - t0, 3),
+            )
+            self._snapshot_reply(sender, token, {
+                "rank": self.po.my_group_rank(), "staged": name,
+                "version": version, "keys": keys, "bytes": nbytes,
+            })
+        except Exception as exc:  # noqa: BLE001 - veto the publish
+            self._snapshot_reply(sender, token, {
+                "error": f"namespace stage failed: {exc!r}"})
+        finally:
+            self._ns_staging = False
 
     def _tenant_counter(self, tid: int, kind: str):
         """Lazily created per-tenant counters (psmon's tenant rollup):
@@ -3795,16 +4254,57 @@ class KVServer:
     # dedup/forward — used by BOTH _process_request and its batched
     # twin _process_batch, so the two paths cannot silently drift.
 
+    def _owner_rank_of(self, key: int) -> Optional[int]:
+        """Group rank owning ``key`` under the current routing (elastic
+        table when one is applied, else the static uniform split)."""
+        if self._owned is not None:
+            with self._elastic_mu:
+                table = self._table
+            if table is not None:
+                for e in table.entries:
+                    if e.begin <= key < e.end:
+                        return e.owner
+            return None
+        for i, rng in enumerate(self.po.get_server_key_ranges()):
+            if rng.begin <= key < rng.end:
+                return i
+        return None
+
     def _intake_pull_stamp(self, meta: KVMeta) -> None:
         """Hot-cache stamp (kv/hot_cache.py): captured at INTAKE —
         every push counted before this point fully applied, so the
         snapshot the shards will take is guaranteed to include them;
         later pushes only make the value newer than the stamp claims
         (conservative, never stale).  Per sub-op on batched frames, so
-        read-your-writes survives aggregation in both directions."""
-        if self._qos_stamps and meta.pull and not meta.push:
-            with self._qos_mu:
-                meta.stamp = self._push_version
+        read-your-writes survives aggregation in both directions.
+
+        Replica reads (docs/serving_reads.md): a pull for a range whose
+        LIVE owner is another rank is answered in the PRIMARY's stamp
+        currency — the newest forward stamp claimed at intake — so the
+        worker can compare it against the push stamps it has seen from
+        that primary (read-your-writes).  A down owner keeps today's
+        failover semantics: the replica answers as the range's acting
+        truth, stamping with its own counter."""
+        if not (self._qos_stamps and meta.pull and not meta.push):
+            return
+        if (self._replica_reads and self._replicator is not None
+                and meta.cmd == 0):
+            owner = self._owner_rank_of(int(meta.key))
+            my = self.po.my_group_rank()
+            if owner is not None and owner != my:
+                oid = server_rank_to_id(
+                    owner * self.po.group_size + self.po.instance_idx)
+                if not self.po.van.is_peer_down(oid):
+                    # claimed may be 0 before the first stamped forward
+                    # or backfill: advertise 1 ("the primary's initial
+                    # version") — a worker that has seen any push from
+                    # the primary then re-pulls there, a push-free
+                    # reader accepts (and may cache) it.
+                    meta.stamp = (
+                        self._replicator.claimed_stamp(oid) or 1)
+                    return
+        with self._qos_mu:
+            meta.stamp = self._push_version
 
     def _intake_hot_keys(self, keys: np.ndarray) -> None:
         """Hot-key accounting: exact per-key counts for small key
@@ -3947,19 +4447,55 @@ class KVServer:
         if (self._replicator is None or not meta.push
                 or not len(kvs.keys)):
             return False
+        if meta.option == OPT_REPLICA:
+            # Replica side: CLAIM the forward's stamp at intake —
+            # before the dedup check, since a dedup hit means the
+            # effect is already in (docs/serving_reads.md).  Pulls
+            # intaken after this point may advertise the stamp: per-key
+            # apply order == arrival order, so they observe this
+            # forward's effect on every shared key.
+            if getattr(meta, "stamp", 0):
+                self._replicator.note_claimed(meta.sender, meta.stamp)
+                if self._replicator.below_import_floor(meta):
+                    # A backfill import's cut already contains this
+                    # forward; register its origin (so a worker's
+                    # failover retry of the same push still dedups)
+                    # and skip the apply — += would double-add.
+                    self._replicator.should_apply(meta)
+                    self._replicator.note_applied(meta.sender,
+                                                  meta.stamp)
+                    return True
+            return not self._replicator.should_apply(meta)
         if not self._replicator.should_apply(meta):
+            # Duplicate origin (a failover retry racing the forwarded
+            # copy): the ORIGINAL apply already bumped/assigned a push
+            # version — stamp the ack with the CURRENT version, no
+            # bump, so _qos_push_done cannot inflate the counter with
+            # a version no forward will ever carry (replicas would lag
+            # forever against it).
+            if self._qos_stamps:
+                with self._qos_mu:
+                    meta.stamp = self._push_version
             if meta.pull:
                 meta.push = False
                 kvs.vals = np.empty(0, kvs.vals.dtype)
                 return False
             return True
-        if meta.option != OPT_REPLICA:
-            # Codec pushes forward their COMPRESSED wire bytes; a
-            # registered-buffer payload is snapshotted (copy=True) —
-            # the pump overwrites the shared buffer on the sender's
-            # next push while the replica lane may still serialize.
-            self._replicator.forward(meta, kvs, copy=copy,
-                                     wire=wire_payload)
+        if self._qos_stamps:
+            # Pre-assign the push version at INTAKE (arrival order ==
+            # forward order, single request thread) so the forward
+            # carries it — the replica-read consistency currency
+            # (docs/serving_reads.md).  _qos_push_done then no-ops
+            # (stamp != 0) and the response piggybacks this stamp.
+            with self._qos_mu:
+                self._push_version += 1
+                meta.stamp = self._push_version
+        # Codec pushes forward their COMPRESSED wire bytes; a
+        # registered-buffer payload is snapshotted (copy=True) —
+        # the pump overwrites the shared buffer on the sender's
+        # next push while the replica lane may still serialize.
+        self._replicator.forward(meta, kvs, copy=copy,
+                                 wire=wire_payload)
         return False
 
     def _stream_part(self, msg: Message) -> None:
@@ -4096,6 +4632,11 @@ class KVServer:
             trace=msg.meta.trace,
             codec=msg.meta.codec,
             tenant=msg.meta.tenant,
+            # A replication forward's intake-assigned push stamp
+            # (docs/serving_reads.md); 0 on worker requests, so the
+            # push-side one-shot bump in _qos_push_done still engages
+            # for them.
+            stamp=msg.meta.stamp,
         )
         if meta.trace and self.po.tracer.active:
             recv_us = getattr(msg, "_recv_us", None)
@@ -4283,7 +4824,7 @@ class KVServer:
                 timestamp=sm.timestamp, customer_id=env.customer_id,
                 key=sm.key, val_len=sm.val_len, option=0,
                 priority=env.priority, codec=sm.codec, tenant=env.tenant,
-                trace=sm.trace,
+                trace=sm.trace, stamp=sm.stamp,
             )
             if sm.trace and tracer.active and recv_us is not None:
                 # Per-sub-op intake-queue span off the ENVELOPE's wire

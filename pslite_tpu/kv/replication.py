@@ -37,7 +37,7 @@ state — it no longer restarts fresh or strands on the old owner.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -166,6 +166,24 @@ class Replicator:
         self._mu = threading.Lock()
         self._restore_ts: Optional[int] = None
         self._restore_msg: Optional[Message] = None
+        # One range-state fetch in flight at a time: restore() (boot /
+        # rehab thread) and replica backfill (routing-update thread)
+        # share the _restore_ts/_restore_msg interception slot.
+        self._fetch_mu = threading.Lock()
+        # Replica-read stamp bookkeeping (docs/serving_reads.md), keyed
+        # by PRIMARY node id: the newest forward stamp CLAIMED at intake
+        # (pulls answered from this replica advertise it — per-key apply
+        # order == arrival order, so a pull intaken after forward S
+        # observes S's effect on every shared key) and the newest stamp
+        # whose apply COMPLETED (the lag gauge pages on a replica whose
+        # apply pool falls behind its intake).
+        self._claimed: Dict[int, int] = {}
+        self._applied_stamps: Dict[int, int] = {}
+        # Backfill floor (satellite of docs/serving_reads.md): after a
+        # range import cut at primary stamp F, forwards stamped <= F are
+        # already IN the imported state — re-applying them would
+        # double-add (+= semantics).
+        self._import_floor: Dict[int, int] = {}
         # Observability (docs/observability.md): registry counters (the
         # forwarded/deduped properties keep the historical reads, so
         # they must keep counting under PS_TELEMETRY=0 — enabled_registry
@@ -178,6 +196,11 @@ class Replicator:
         self._c_forwarded = reg.counter("replication.forwards")
         self._c_deduped = reg.counter("replication.dedup_hits")
         self.po.metrics.gauge("replication.lag", fn=self._pending_forwards)
+        # Replica-read freshness (docs/serving_reads.md): max over
+        # primaries of (claimed - applied) — forwards this replica has
+        # accepted but not yet finished applying.
+        self.po.metrics.gauge("replication.applied_stamp_lag",
+                              fn=self.stamp_lag)
         # A recovered WORKER restarts its timestamp sequence at 0, so
         # its fresh pushes would collide with the dead incarnation's
         # origin identities still in the dedup cache and be silently
@@ -212,6 +235,14 @@ class Replicator:
             return
         with self._mu:
             n = self._applied.discard_where(lambda o: o[0] == node_id)
+            # A recovered PRIMARY restarts its push-version counter at
+            # 1: stale claimed/applied/floor entries minted by the dead
+            # incarnation would let replica reads advertise versions
+            # the new counter can never reach (or skip forwards it
+            # legitimately re-sends).
+            self._claimed.pop(node_id, None)
+            self._applied_stamps.pop(node_id, None)
+            self._import_floor.pop(node_id, None)
         if n:
             log.vlog(1, f"purged {n} dedup origins for recovered "
                         f"node {node_id}")
@@ -248,6 +279,69 @@ class Replicator:
                 self._c_deduped.inc()
                 return False
         return True
+
+    # -- replica-read stamp currency (docs/serving_reads.md) -----------------
+
+    def note_claimed(self, primary_id: int, stamp: int) -> None:
+        """A forward from ``primary_id`` carrying ``stamp`` was intaken
+        (request thread, arrival order): pulls intaken after this point
+        observe its effect on every shared key, so this replica may
+        ADVERTISE the stamp on its pull responses."""
+        if stamp <= 0:
+            return
+        with self._mu:
+            if stamp > self._claimed.get(primary_id, 0):
+                self._claimed[primary_id] = stamp
+
+    def note_applied(self, primary_id: int, stamp: int) -> None:
+        """A forward's apply completed (apply-pool shard thread / serial
+        path) — feeds the ``replication.applied_stamp_lag`` gauge."""
+        if stamp <= 0:
+            return
+        with self._mu:
+            if stamp > self._applied_stamps.get(primary_id, 0):
+                self._applied_stamps[primary_id] = stamp
+
+    def claimed_stamp(self, primary_id: int) -> int:
+        """The newest forward stamp this replica has intaken from
+        ``primary_id`` (0 before the first stamped forward/backfill)."""
+        with self._mu:
+            return self._claimed.get(primary_id, 0)
+
+    def stamp_lag(self) -> int:
+        """Max over primaries of (claimed - applied): forwards accepted
+        at intake whose apply has not yet completed."""
+        with self._mu:
+            if not self._claimed:
+                return 0
+            return max(
+                c - self._applied_stamps.get(pid, 0)
+                for pid, c in self._claimed.items()
+            )
+
+    def set_import_floor(self, primary_id: int, stamp: int) -> None:
+        """A range import from ``primary_id`` was cut at ``stamp``
+        (quiesced export — every forward <= stamp is IN the imported
+        state): forwards at or below the floor must ack without
+        applying, or += pushes would double-add."""
+        if stamp <= 0:
+            return
+        with self._mu:
+            if stamp > self._import_floor.get(primary_id, 0):
+                self._import_floor[primary_id] = stamp
+            if stamp > self._claimed.get(primary_id, 0):
+                self._claimed[primary_id] = stamp
+            if stamp > self._applied_stamps.get(primary_id, 0):
+                self._applied_stamps[primary_id] = stamp
+
+    def below_import_floor(self, meta) -> bool:
+        """True when this forward's effect is already covered by a
+        backfill import's cut (see :meth:`set_import_floor`)."""
+        stamp = getattr(meta, "stamp", 0)
+        if stamp <= 0:
+            return False
+        with self._mu:
+            return stamp <= self._import_floor.get(meta.sender, 0)
 
     # -- forwarding (primary side) -------------------------------------------
 
@@ -317,6 +411,13 @@ class Replicator:
             # apply scheduling, and admission backlogs must account the
             # TRUE tenant, not lump every forward onto tenant 0.
             m.tenant = getattr(meta, "tenant", 0)
+            # Replica-read consistency currency (docs/serving_reads.md):
+            # the push stamp the primary assigned at intake rides every
+            # forward (EXT_QOS), so the replica can advertise exactly
+            # how much of the primary's write stream its pull responses
+            # cover.  0 when stamping is off — replica reads then have
+            # no currency and stay disabled.
+            m.stamp = getattr(meta, "stamp", 0)
             msg.add_data(SArray(kvs.keys))
             if wire is not None:
                 codes, scales, lens_arr, ci = wire
@@ -345,13 +446,25 @@ class Replicator:
     # -- state fetch (replica side) ------------------------------------------
 
     def handle_fetch(self, meta, kvs, server) -> None:
-        """Serve a recovered primary's range-state fetch: every stored
-        key in [begin, end), with per-key lens."""
+        """Serve a range-state fetch (recovered primary restore, or a
+        new chain member's backfill): every stored key in [begin, end),
+        with per-key lens.  Runs on the request thread; the apply pool
+        is quiesced first so the export is a CLEAN cut — everything
+        intaken before this fetch has applied, which makes the fetch
+        response's stamp (captured at intake) the exact upper bound of
+        the cut, the backfill import floor depends on it."""
         log.check(len(kvs.keys) >= 2, "replica fetch wants [begin, end)")
         begin, end = int(kvs.keys[0]), int(kvs.keys[1])
         handle = server._handle
         from .kv_app import KVPairs
 
+        pool = getattr(server, "_apply_pool", None)
+        if pool is not None:
+            tok = pool.submit_token()
+            if not pool.quiesce(tok, timeout_s=30.0):
+                log.warning("replica fetch: apply pool did not quiesce "
+                            "in 30s; exporting anyway (stamp may "
+                            "over-claim the cut)")
         keys, vals, lens = export_range(handle, begin, end)
         log.vlog(1, f"replica fetch [{begin}, {end}): {len(keys)} keys")
         server.response(meta, KVPairs(keys=keys, vals=vals, lens=lens))
@@ -393,26 +506,51 @@ class Replicator:
         for rng in self.po.server_key_ranges_of(g):
             total += self._fetch_range(
                 handle, rng, [to_id(r) for r in chain(g)], timeout_s,
-            )
+            )[0]
         # Ranges I replicate for others: fetch from the primary first,
         # then its other chain members.
         for r in ranks:
             if r == g or g not in chain(r):
                 continue
             for rng in self.po.server_key_ranges_of(r):
-                total += self._fetch_range(
+                n, stamp, src = self._fetch_range(
                     handle, rng,
                     [to_id(r)] + [
                         to_id(c) for c in chain(r) if c != g
                     ],
                     timeout_s,
                 )
+                total += n
+                if src == to_id(r) and stamp > 0:
+                    # Fetched from the PRIMARY itself: the response
+                    # stamp is in the primary's currency, so it both
+                    # seeds the claimed stamp (replica reads can serve
+                    # right away) and floors forward re-applies.
+                    self.set_import_floor(src, stamp)
         return total
 
+    def backfill_range(self, handle, rng, primary_id: int,
+                       timeout_s: float = 30.0) -> int:
+        """Backfill one range this server newly replicates (chain
+        recomputation after join/leave/recovery — docs/serving_reads.md)
+        from its PRIMARY.  The primary's quiesced export (handle_fetch)
+        makes the response stamp the exact cut bound: it becomes the
+        import floor, so forwards racing the backfill apply exactly
+        once.  Returns the number of keys imported (0 on failure —
+        logged, the replica then converges only through new forwards)."""
+        n, stamp, src = self._fetch_range(handle, rng, [primary_id],
+                                          timeout_s)
+        if src == primary_id and stamp > 0:
+            self.set_import_floor(primary_id, stamp)
+        return n
+
     def _fetch_range(self, handle, rng, candidate_ids: List[int],
-                     timeout_s: float) -> int:
+                     timeout_s: float) -> Tuple[int, int, int]:
         """Fetch one key range's state from the first live candidate
-        and import it into ``handle``; 0 on failure (logged)."""
+        and import it into ``handle``.  Returns ``(keys imported,
+        response stamp, source node id)`` — ``(0, 0, -1)`` on failure
+        (logged).  Serialized by ``_fetch_mu``: boot restore, rehab
+        resync, and replica backfill share one interception slot."""
         van = self.po.van
         rid = next(
             (r for r in candidate_ids if not van.is_peer_down(r)), None
@@ -420,42 +558,45 @@ class Replicator:
         if rid is None:
             log.warning(f"restore of [{rng.begin}, {rng.end}) skipped: "
                         f"no live holder")
-            return 0
-        customer = self._server._customer
-        ts = customer.new_request(rid)
-        self._restore_ts = ts
-        self._restore_msg = None
-        msg = Message()
-        m = msg.meta
-        m.app_id = customer.app_id
-        m.customer_id = customer.customer_id
-        m.request = True
-        m.pull = True
-        m.head = REPLICA_FETCH_CMD
-        m.timestamp = ts
-        m.recver = rid
-        msg.add_data(SArray(np.asarray([rng.begin, rng.end], dtype=np.uint64)))
-        # Empty vals segment: the server's decode path only populates
-        # kvs.keys when the frame carries both segments.
-        msg.add_data(SArray(np.empty(0, np.float32)))
-        try:
-            van.send(msg)
-        except Exception as exc:  # noqa: BLE001 - holder died in the gap
-            log.warning(f"restore fetch to {rid} failed: {exc!r}; "
-                        f"[{rng.begin}, {rng.end}) left empty")
-            self._restore_ts = None
-            return 0
-        ok = customer.wait_request(ts, timeout=timeout_s)
-        resp, self._restore_msg, self._restore_ts = (
-            self._restore_msg, None, None
-        )
+            return 0, 0, -1
+        with self._fetch_mu:
+            customer = self._server._customer
+            ts = customer.new_request(rid)
+            self._restore_ts = ts
+            self._restore_msg = None
+            msg = Message()
+            m = msg.meta
+            m.app_id = customer.app_id
+            m.customer_id = customer.customer_id
+            m.request = True
+            m.pull = True
+            m.head = REPLICA_FETCH_CMD
+            m.timestamp = ts
+            m.recver = rid
+            msg.add_data(SArray(np.asarray([rng.begin, rng.end],
+                                           dtype=np.uint64)))
+            # Empty vals segment: the server's decode path only
+            # populates kvs.keys when the frame carries both segments.
+            msg.add_data(SArray(np.empty(0, np.float32)))
+            try:
+                van.send(msg)
+            except Exception as exc:  # noqa: BLE001 - died in the gap
+                log.warning(f"restore fetch to {rid} failed: {exc!r}; "
+                            f"[{rng.begin}, {rng.end}) left empty")
+                self._restore_ts = None
+                return 0, 0, -1
+            ok = customer.wait_request(ts, timeout=timeout_s)
+            resp, self._restore_msg, self._restore_ts = (
+                self._restore_msg, None, None
+            )
         if not ok or resp is None:
             log.warning(f"restore from {rid} timed out ({timeout_s}s); "
                         f"[{rng.begin}, {rng.end}) left empty")
-            return 0
+            return 0, 0, -1
+        stamp = getattr(resp.meta, "stamp", 0)
         if len(resp.data) < 2:
             log.vlog(1, f"restore: [{rng.begin}, {rng.end}) is empty")
-            return 0
+            return 0, stamp, rid
         keys = resp.data[0].astype_view(np.uint64).numpy()
         vals = resp.data[1].numpy()
         lens = (resp.data[2].astype_view(np.int32).numpy()
@@ -463,4 +604,4 @@ class Replicator:
         import_range(handle, keys, vals, lens)
         log.vlog(1, f"restored {len(keys)} keys of "
                     f"[{rng.begin}, {rng.end}) from node {rid}")
-        return len(keys)
+        return len(keys), stamp, rid

@@ -635,6 +635,11 @@ class Postoffice:
                     for r in rt.ranges_of(self.my_group_rank())
                 ]
             snap["routing"] = routing
+        ns = getattr(self, "model_namespace", None)
+        if ns:
+            # Published model version (docs/serving_reads.md): psmon's
+            # namespace line in the membership block.
+            snap["namespace"] = ns
         return snap
 
     def absorb_metrics_reply(self, msg: Message) -> None:
@@ -957,6 +962,106 @@ class Postoffice:
             "epoch": manifest.get("epoch") if manifest else None,
             "ranges": len(manifest.get("ranges", [])) if manifest
             else 0,
+        }
+
+    # -- model namespaces (docs/serving_reads.md) ----------------------------
+
+    def _model_ctl(self, body: dict, timeout_s: float) -> Dict[int, dict]:
+        """Broadcast one namespace control op to every live server on
+        the SNAPSHOT channel and gather their replies; raises when any
+        server errors or stays silent — an op half-applied across the
+        fleet must fail loudly, never serve mixed versions silently."""
+        log.check(self.is_scheduler, "namespace ops run on the scheduler")
+        payload = json.dumps(body).encode()
+        peers = [
+            i for i in self.get_node_ids(SERVER_GROUP)
+            if not self.van.is_peer_down(i)
+        ]
+        log.check(bool(peers), "namespace op: no live servers")
+        with self._metrics_cv:
+            self._snapshot_token += 1
+            token = self._snapshot_token
+            self._snapshot_replies = {}
+        reached = []
+        for peer in peers:
+            msg = Message()
+            msg.meta.recver = peer
+            msg.meta.sender = self.van.my_node.id
+            msg.meta.request = True
+            msg.meta.timestamp = token
+            msg.meta.body = payload
+            msg.meta.control = Control(cmd=Command.SNAPSHOT)
+            try:
+                self.van.send(msg)
+                reached.append(peer)
+            except Exception as exc:  # noqa: BLE001 - dead peer vetoes
+                log.warning(f"namespace op to {peer} failed: {exc!r}")
+        deadline = time.monotonic() + timeout_s
+        with self._metrics_cv:
+            while len(self._snapshot_replies) < len(reached):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._metrics_cv.wait(remaining)
+            replies = dict(self._snapshot_replies)
+        errors = [f"node {n}: {r['error']}" for n, r in replies.items()
+                  if r.get("error")]
+        silent = [p for p in peers if p not in replies]
+        if silent:
+            errors.append(f"no reply from node(s) {silent} within "
+                          f"{timeout_s}s")
+        log.check(not errors, f"namespace op {body.get('op')!r} failed: "
+                              + "; ".join(errors))
+        return replies
+
+    def publish_model(self, directory: Optional[str] = None,
+                      namespace: str = "model", version: str = "",
+                      timeout_s: float = 60.0) -> dict:
+        """Publish a committed snapshot manifest as a model version
+        (docs/serving_reads.md): every live server STAGES the manifest
+        into an off-line store while serving continues, then — only
+        once every stage succeeded — atomically FLIPS to it.  The
+        displaced store stays resident for :meth:`rollback_model`."""
+        directory = directory or self.snapshot_dir
+        log.check(bool(directory),
+                  "publish_model needs a snapshot directory "
+                  "(PS_SNAPSHOT_DIR or the directory= argument)")
+        if not version:
+            from .kv import snapshot as snap_mod
+
+            manifest = snap_mod.load_manifest(directory)
+            log.check(manifest is not None,
+                      f"no committed manifest in {directory!r}")
+            version = str(manifest.get("uid")
+                          or manifest.get("epoch", 0))
+        staged = self._model_ctl(
+            {"op": "publish", "dir": directory, "namespace": namespace,
+             "version": version}, timeout_s)
+        flipped = self._model_ctl(
+            {"op": "flip", "namespace": namespace, "version": version},
+            timeout_s)
+        self.flight.record("model_published", severity="info",
+                           namespace=namespace, version=version,
+                           servers=len(flipped))
+        return {
+            "namespace": namespace,
+            "version": version,
+            "servers": len(flipped),
+            "keys": sum(int(r.get("keys", 0)) for r in staged.values()),
+        }
+
+    def rollback_model(self, timeout_s: float = 60.0) -> dict:
+        """Instant rollback: every live server swaps the displaced
+        store back in — one pointer swap per server, no disk reads."""
+        replies = self._model_ctl({"op": "rollback"}, timeout_s)
+        first = next(iter(replies.values()), {})
+        self.flight.record("model_rollback", severity="info",
+                           namespace=first.get("namespace"),
+                           version=first.get("version"))
+        return {
+            "namespace": first.get("namespace"),
+            "version": first.get("version"),
+            "servers": len(replies),
         }
 
     # -- continuous telemetry plane (docs/observability.md) ------------------

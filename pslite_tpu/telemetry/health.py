@@ -29,6 +29,11 @@ snapshot_age       ``snapshot.age_s`` gauge: seconds since     600 : 86400
                    servers with ``PS_SNAPSHOT_DIR``, and a
                    never-snapshotted cluster (age < 0) is
                    skipped, not alarmed
+replica_fallbacks  ``replica_read.fallbacks`` rate (/s) on          5 : 50
+                   workers — stale-replica re-pulls
+                   (docs/serving_reads.md); a sustained rate
+                   means replicas trail their primary and the
+                   read spread is quietly collapsing onto it
 =================  ==========================================  ===========
 
 Breaches emit structured :class:`HealthEvent`\\ s (INFO/WARN/CRIT) with
@@ -114,6 +119,7 @@ DEFAULT_THRESHOLDS: Dict[str, tuple] = {
     "retransmit_burst": (50.0, 500.0),
     "node_stale": (2.0, 5.0),
     "snapshot_age": (600.0, 86400.0),
+    "replica_fallbacks": (5.0, 50.0),
 }
 
 
@@ -314,6 +320,21 @@ class Watchdog:
                     fmt="heartbeat gap up to {value:.4g}s "
                         "(threshold {thr:g}s)",
                 )
+
+            # replica_fallbacks: stale-replica re-pull rate on
+            # workers (docs/serving_reads.md).  Every fallback is a
+            # wasted round trip AND a read that landed on the primary
+            # anyway — a sustained rate means the replicas' applied
+            # stamps trail the push stream and the spread is quietly
+            # collapsing back into the primary funnel.
+            self._check(
+                wall, "replica_fallbacks", node_id, role,
+                "replica_read.fallbacks",
+                history.rate(node_id, "replica_read.fallbacks", window),
+                window, out=out,
+                fmt="stale-replica fallbacks at {value:.4g}/s "
+                    "(threshold {thr:g}/s)",
+            )
 
             # retransmit_burst: windowed retransmit rate.
             self._check(

@@ -527,10 +527,10 @@ def test_psmon_resp_ops_per_frame_column():
     table = psmon.format_table(snap)
     assert "resp ops/F" in table
     assert "32.0" in table  # 128 / 4 on the server row
-    # The resp ops/F cell sits 3rd from the row's end (the tiered-
-    # store ram/cold + cold% cells land after it — columns ride LAST
-    # in landing order, so parse relative to the column, not the
-    # line tail).
+    # The resp ops/F cell sits 4th from the row's end (the tiered-
+    # store ram/cold + cold% cells and the read% share land after it
+    # — columns ride LAST in landing order, so parse relative to the
+    # column, not the line tail).
     server_rows = [line.split() for line in table.splitlines()
                    if " server " in f" {line} "]
-    assert server_rows and server_rows[0][-3] == "32.0"
+    assert server_rows and server_rows[0][-4] == "32.0"

@@ -1,0 +1,418 @@
+"""Replica read fan-out + versioned model namespaces
+(docs/serving_reads.md): pull spread across the whole replica chain
+with push-stamp read-your-writes, stale-replica fallback, chaos
+kill-a-replica, live namespace flip/rollback under storm, hot-cache
+stamp interplay, and join-time replica backfill.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pslite_tpu import KVServer, KVServerDefaultHandle, KVWorker  # noqa: E402
+from pslite_tpu.base import server_rank_to_id  # noqa: E402
+from pslite_tpu.kv.replication import chain_ranks  # noqa: E402
+
+from helpers import LoopbackCluster  # noqa: E402
+
+# Every storm below aims at server rank 0's key range (uniform split
+# of the uint64 space over 3 servers), so its whole chain serves.
+ROWS = 96
+DIM = 8
+KEYS = np.arange(ROWS, dtype=np.uint64)
+
+RR_ENV = {
+    "PS_KV_REPLICATION": "3",
+    "PS_REPLICA_READS": "1",
+    # rr exercises every chain member even from a single worker (the
+    # sticky default would pin one worker to one member).
+    "PS_REPLICA_READ_POLICY": "rr",
+    "PS_REQUEST_TIMEOUT": "2.0",
+    "PS_REQUEST_RETRIES": "8",
+    "PS_HOT_CACHE": "0",
+}
+
+
+def _spin_up(cluster):
+    servers = []
+    for po in cluster.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    workers = [KVWorker(0, 0, postoffice=po) for po in cluster.workers]
+    return servers, workers
+
+
+def _teardown(cluster, servers, workers, dead_pos=()):
+    for w in workers:
+        w.stop()
+    for s in servers:
+        if s.po not in dead_pos:
+            s.stop()
+    for po in cluster.all_nodes():
+        try:
+            po.van.stop()
+        except Exception:  # noqa: BLE001 - already stopped
+            pass
+
+
+def _table(scale=1.0):
+    return np.stack([np.full(DIM, scale * (1.0 + r), np.float32)
+                     for r in range(ROWS)])
+
+
+def _push_table(worker, table):
+    worker.wait(worker.push(KEYS, np.ascontiguousarray(table).reshape(-1)))
+
+
+def _settle(worker, expected, timeout=10.0):
+    """Poll until replicas serve the full expected table (forwards are
+    async; only after this do bit-exact assertions arm)."""
+    out = np.zeros(ROWS * DIM, np.float32)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out[:] = 0
+        worker.wait(worker.pull(KEYS, out))
+        if np.array_equal(out.reshape(ROWS, DIM), expected):
+            return
+        time.sleep(0.05)
+    raise TimeoutError("replicas never converged on the pushed table")
+
+
+def test_spread_and_bit_exact():
+    """Round-robin replica reads hit EVERY live chain member, and every
+    answer is bit-exact with the pushed table (forwards preserve the
+    primary's arrival order)."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra=RR_ENV)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        table = _table()
+        _push_table(w, table)
+        _settle(w, table)
+        out = np.zeros(16 * DIM, np.float32)
+        for i in range(30):
+            start = (i * 3) % (ROWS - 16)
+            out[:] = 0
+            w.wait(w.pull(KEYS[start:start + 16], out))
+            np.testing.assert_array_equal(
+                out.reshape(16, DIM), table[start:start + 16])
+        # The spread reached beyond the primary...
+        assert w.po.metrics.counter("replica_read.spread").value > 0
+        # ...and every chain member answered pulls.
+        assert len(w._read_share) == 3, w._read_share
+        assert all(n > 0 for n in w._read_share.values()), w._read_share
+    finally:
+        _teardown(cluster, servers, workers)
+
+
+def test_read_your_writes_under_racing_push_storm():
+    """Push-then-immediately-pull NEVER returns a value missing the
+    worker's own push, even while a background storm keeps the forward
+    pipeline saturated and 2/3 of the pulls land on replicas."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra=RR_ENV)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        table = _table()
+        _push_table(w, table)
+        _settle(w, table)
+        stop = threading.Event()
+        storm_keys = KEYS[:32]
+        storm_delta = np.ones(32 * DIM, np.float32)
+        storm_pushes = [0]
+
+        def storm():
+            # Saturates the primary->replica forward stream so probe
+            # pulls race real replication traffic.
+            while not stop.is_set():
+                w.wait(w.push(storm_keys, storm_delta))
+                storm_pushes[0] += 1
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        try:
+            probe_keys = KEYS[ROWS - 8:]
+            expected = np.ascontiguousarray(table[ROWS - 8:])
+            delta = np.ones(8 * DIM, np.float32)
+            out = np.zeros(8 * DIM, np.float32)
+            for _ in range(40):
+                expected += 1.0
+                w.wait(w.push(probe_keys, delta))
+                out[:] = 0
+                w.wait(w.pull(probe_keys, out))
+                # Read-your-writes: the answer must include THIS
+                # worker's newest acknowledged push, whichever chain
+                # member served it.
+                np.testing.assert_array_equal(out.reshape(8, DIM),
+                                              expected)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert storm_pushes[0] > 0
+    finally:
+        _teardown(cluster, servers, workers)
+
+
+def test_stale_replica_answer_repulls_from_primary():
+    """A replica answer whose applied stamp trails the worker's own
+    push frontier is DISCARDED and re-pulled from the primary: forcing
+    the frontier far ahead makes every replica answer stale, yet every
+    pull still returns correct data (via the primary) and the fallback
+    counter + flight event record the discounts."""
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra=RR_ENV)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        table = _table()
+        _push_table(w, table)
+        _settle(w, table)
+        primary_id = server_rank_to_id(0)
+        # Pretend we have seen a push far beyond anything the replicas
+        # will ever claim: every replica-served answer is now stale.
+        with w._mu:
+            w._seen_stamps[primary_id] = 1 << 40
+        out = np.zeros(8 * DIM, np.float32)
+        for _ in range(9):
+            out[:] = 0
+            w.wait(w.pull(KEYS[:8], out))
+            np.testing.assert_array_equal(out.reshape(8, DIM),
+                                          table[:8])
+        fallbacks = w.po.metrics.counter("replica_read.fallbacks").value
+        assert fallbacks > 0
+        assert w.po.flight.events("replica_stale_fallback")
+    finally:
+        _teardown(cluster, servers, workers)
+
+
+def test_chaos_kill_replica_mid_read_storm():
+    """A replica crashing mid read storm never fails a wait: the dead
+    member drops out of the spread set (peer-down exclusion) and its
+    in-flight pulls retry onto live members."""
+    env = dict(RR_ENV)
+    env.update({
+        "PS_HEARTBEAT_INTERVAL": "0.3",
+        "PS_HEARTBEAT_TIMEOUT": "1.0",
+        "PS_REQUEST_TIMEOUT": "0.5",
+    })
+    cluster = LoopbackCluster(
+        num_workers=1, num_servers=3, env_extra=env,
+        van_type="chaos+loopback",
+        per_node_env={"server1": {"PS_CHAOS": "crash=recv:40"}},
+    )
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    dead_po = next(po for po in cluster.servers
+                   if po.van.my_node.id == server_rank_to_id(1))
+    try:
+        table = _table()
+        _push_table(w, table)
+        _settle(w, table)
+        out = np.zeros(16 * DIM, np.float32)
+        for i in range(150):
+            start = (i * 5) % (ROWS - 16)
+            out[:] = 0
+            # Every wait must succeed — a crashed replica's pull
+            # retries to a live member, never times out the request.
+            w.wait(w.pull(KEYS[start:start + 16], out))
+            np.testing.assert_array_equal(
+                out.reshape(16, DIM), table[start:start + 16])
+        assert dead_po.van.chaos_crashed.is_set(), \
+            "victim never crashed — scenario inert"
+    finally:
+        _teardown(cluster, servers, workers, dead_pos=(dead_po,))
+
+
+def test_namespace_flip_and_rollback_under_pull_storm():
+    """A published model version flips in atomically under a live pull
+    storm — zero failed requests, every answer bit-exact against
+    exactly one version — and rollback restores the displaced store."""
+    snapdir = tempfile.mkdtemp(prefix="ps_nsflip_test_")
+    env = dict(RR_ENV)
+    env["PS_SNAPSHOT_DIR"] = snapdir
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra=env)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        v1 = _table()
+        _push_table(w, v1)
+        _settle(w, v1)
+        cluster.scheduler.snapshot()
+        _push_table(w, v1)  # the live (additive) store is now 2*v1
+        v2 = 2 * v1
+        _settle(w, v2)
+        stop = threading.Event()
+        errors = []
+        pulls = [0]
+
+        def storm():
+            out = np.zeros(16 * DIM, np.float32)
+            i = 0
+            while not stop.is_set():
+                start = (i * 7) % (ROWS - 16)
+                i += 1
+                out[:] = 0
+                try:
+                    w.wait(w.pull(KEYS[start:start + 16], out))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+                    continue
+                got = out.reshape(16, DIM)
+                if not (np.array_equal(got, v1[start:start + 16])
+                        or np.array_equal(got, v2[start:start + 16])):
+                    errors.append(f"mixed-version read at row {start}")
+                pulls[0] += 1
+
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.2)
+            pub = cluster.scheduler.publish_model(namespace="m",
+                                                  version="v1")
+            assert pub["servers"] == 3, pub
+            time.sleep(0.2)
+            rb = cluster.scheduler.rollback_model()
+            assert rb["servers"] == 3, rb
+            time.sleep(0.2)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errors, errors[:5]
+        assert pulls[0] > 0
+        # Post-rollback, the LIVE (v2) store serves again.
+        out = np.zeros(ROWS * DIM, np.float32)
+        w.wait(w.pull(KEYS, out))
+        np.testing.assert_array_equal(out.reshape(ROWS, DIM), v2)
+        # Every server recorded the flip and the rollback.
+        for s in servers:
+            assert s.po.flight.events("namespace_flip")
+            assert s.po.flight.events("namespace_rollback")
+    finally:
+        _teardown(cluster, servers, workers)
+        shutil.rmtree(snapdir, ignore_errors=True)
+
+
+def test_hot_cache_fill_from_replica_carries_primary_identity():
+    """A cache fill from a replica-served pull is recorded under the
+    PRIMARY's node id with the replica's applied stamp (the same
+    counter domain): the primary's next push-ack stamp then lazily
+    invalidates it — a pull after a push never serves the displaced
+    cached value."""
+    env = dict(RR_ENV)
+    env["PS_HOT_CACHE"] = "1"
+    cluster = LoopbackCluster(num_workers=1, num_servers=3,
+                              env_extra=env)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        table = _table()
+        _push_table(w, table)
+        _settle(w, table)
+        primary_id = server_rank_to_id(0)
+        time.sleep(0.3)  # forwards drain: replicas answer fresh
+        # The settle pulls filled the cache (and cache hits never
+        # route): flush it so the probe pulls below actually travel.
+        w._hot_cache.invalidate_range(0, (1 << 64) - 1)
+        out = np.zeros(8 * DIM, np.float32)
+        # Three DISTINCT blocks (a repeated block would be served from
+        # the cache after its first fill, never advancing the rr
+        # rotation): rr lands one block on each chain member, so at
+        # least two fills come from replicas — and ALL of them must be
+        # recorded under the primary's identity.
+        for b in range(3):
+            out[:] = 0
+            w.wait(w.pull(KEYS[b * 8:(b + 1) * 8], out))
+        assert w.po.metrics.counter("replica_read.spread").value > 0
+        with w._hot_cache._mu:
+            idents = {w._hot_cache._entries[int(k)][1]
+                      for k in KEYS[:24]
+                      if int(k) in w._hot_cache._entries}
+        assert idents == {primary_id}, idents
+        # A push bumps the primary's stamp past every cached fill —
+        # the next pull must see the NEW value, not the cache.
+        delta = np.ones(8 * DIM, np.float32)
+        w.wait(w.push(KEYS[:8], delta))
+        out[:] = 0
+        w.wait(w.pull(KEYS[:8], out))
+        np.testing.assert_array_equal(out.reshape(8, DIM),
+                                      table[:8] + 1.0)
+    finally:
+        _teardown(cluster, servers, workers)
+
+
+def test_elastic_join_backfills_replicated_ranges():
+    """A server joining an elastic cluster owes replica state for the
+    ranges whose chain it lands in: the chain_ranks recompute triggers
+    an export/import backfill, after which the joiner holds bit-exact
+    copies of keys it does NOT own."""
+    env = {
+        "PS_ELASTIC": "1",
+        "PS_KV_REPLICATION": "2",
+        "PS_REPLICA_READS": "1",
+        "PS_REQUEST_TIMEOUT": "2.0",
+        "PS_REQUEST_RETRIES": "8",
+    }
+    cluster = LoopbackCluster(num_workers=1, num_servers=2,
+                              env_extra=env)
+    cluster.start()
+    servers, workers = _spin_up(cluster)
+    w = workers[0]
+    try:
+        # Spread keys across the full space so every owner rank holds
+        # some state before the join.
+        span = (1 << 64) // 8
+        keys = (np.arange(8, dtype=np.uint64) * np.uint64(span)
+                + np.uint64(3))
+        vals = np.arange(8 * DIM, dtype=np.float32) + 1.0
+        w.wait(w.push(keys, vals))
+        time.sleep(0.3)
+        po = cluster.join_server()
+        joiner = KVServer(0, postoffice=po)
+        joiner.set_request_handle(KVServerDefaultHandle())
+        servers.append(joiner)
+        # Wait for the joiner to replicate some range it does not own:
+        # its store must grow bit-exact copies via backfill (its own
+        # owned range arrives via elastic migration — backfill is the
+        # REPLICA debt).
+        deadline = time.monotonic() + 20
+        seen = False
+        while time.monotonic() < deadline and not seen:
+            rt = po.current_routing()
+            if rt is not None:
+                my = po.my_group_rank()
+                active = sorted(rt.active)
+                for e in rt.entries:
+                    if e.owner == my:
+                        continue
+                    chain = chain_ranks(e.owner, 2, po.num_servers,
+                                        active=active)
+                    if my not in chain:
+                        continue
+                    got = [int(k) for k in keys
+                           if e.begin <= int(k) < e.end
+                           and int(k) in joiner._handle.store]
+                    if got:
+                        seen = True
+                        break
+            time.sleep(0.1)
+        assert seen, "joiner never backfilled a replicated range"
+        assert po.flight.events("replica_backfill")
+    finally:
+        _teardown(cluster, servers, workers)

@@ -71,6 +71,13 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "serving_fanin_agg_reqs_per_s": "higher",
     "serving_fanin_frames_per_req": "lower",
     "serving_fanin_low_load_p50_ratio": "lower",
+    # replica_read (docs/serving_reads.md) — the reads/s multiple of
+    # spreading pulls over the whole replica chain (k=3 vs k=1), and
+    # the read-your-writes guarantee it must NEVER trade away.
+    "replica_read_tput_ratio": "higher",
+    "replica_read_k3_reqs_per_s": "higher",
+    "replica_read_ryw_violations": "lower",
+    "replica_read_ns_flip_errors": "lower",
     # elastic_scale (docs/elasticity.md) — the serving tail must stay
     # bounded through a live 2->4->2 migration window, and the scale
     # round trip itself must not regress.
@@ -97,7 +104,7 @@ TRANSPORT_METRICS: Dict[str, str] = {
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
     "multi_tenant_", "small_op_batching_", "serving_fanin_",
-    "elastic_", "durable_", "kv_tracing_", "kv_", "fault_recovery_",
+    "replica_read_", "elastic_", "durable_", "kv_tracing_", "kv_", "fault_recovery_",
     "van_",
 )
 

@@ -159,15 +159,22 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
     dropping them; ``health`` (HealthEvent list) appends the
     watchdog footer."""
     # ``epoch`` (elastic membership), ``ops/F`` (small-op batching),
-    # and the tiered-store cells (``ram/cold`` bytes + cold-hit-rate —
-    # docs/durability.md) ride LAST, in landing order: existing
-    # consumers parse earlier columns by index.
+    # the tiered-store cells (``ram/cold`` bytes + cold-hit-rate —
+    # docs/durability.md), and ``read%`` (each server's share of the
+    # cluster's served pulls — docs/serving_reads.md; with replica
+    # reads on, a healthy spread reads near-even across a chain, and
+    # 100% on one rank is the primary funnel) ride LAST, in landing
+    # order: existing consumers parse earlier columns by index.
     hdr = (f"{'node':>5} {'role':>9} {'up_s':>7} {'req_p50ms':>9} "
            f"{'req_p99ms':>9} {'lane_q':>6} {'xfers':>6} {'apply_n':>8} "
            f"{'apply/s':>8} {'retx':>6} {'repl_fwd':>8} {'repl_lag':>8} "
            f"{'cmpr':>6} {'cache%':>6} {'sent':>7} {'recv':>7} "
            f"{'epoch':>5} {'ops/F':>6} {'resp ops/F':>10} "
-           f"{'ram/cold':>13} {'cold%':>6}")
+           f"{'ram/cold':>13} {'cold%':>6} {'read%':>6}")
+    total_pulls = sum(
+        _c(s.get("metrics", {}), "kv.server_pull_requests")
+        for s in snap.values()
+    )
     lines = [hdr, "-" * len(hdr)]
     rollup: Dict[str, Dict[str, float]] = {}
     # Elastic membership (docs/elasticity.md): per-node routing epoch
@@ -225,12 +232,18 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
         ropsf = (f"{rops / rframes:>10.1f}" if rframes > 0
                  else f"{'-':>10}")
         tier, coldp = _tier_cells(m)
+        # Read share (docs/serving_reads.md): this node's slice of all
+        # served pulls cluster-wide.  "-" on non-servers or before the
+        # first pull.
+        served = _c(m, "kv.server_pull_requests")
+        readp = (f"{100.0 * served / total_pulls:>5.1f}%"
+                 if served > 0 and total_pulls > 0 else f"{'-':>6}")
         lines.append(
             f"{node_id:>5} {role:>9} {uptime:>7.1f} {p50:>9.3f} "
             f"{p99:>9.3f} {lane_q:>6.0f} {xfers:>6.0f} {apply_n:>8} "
             f"{apply_rate:>8.1f} {retx:>6} {fwd:>8} {lag:>8.0f} "
             f"{cmpr} {cache} {sent:>7} {recv:>7} {epoch} {opsf} {ropsf} "
-            f"{tier} {coldp}"
+            f"{tier} {coldp} {readp}"
         )
         # Silent span loss made loud (docs/observability.md): a
         # nonzero trace.dropped_events means this node's exported
@@ -258,6 +271,15 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
                     f"{routing.get('leaving')}  (epoch "
                     f"{routing.get('epoch')})"
                 )
+        # Published model namespace (docs/serving_reads.md): which
+        # immutable model version this server is flipped to — the
+        # cluster-wide A/B answer at a glance.
+        ns = s.get("namespace")
+        if ns:
+            membership_lines.append(
+                f"  node {node_id} ({role}) serving namespace "
+                f"{ns.get('name')!r} version {ns.get('version')!r}"
+            )
         for cname, cval in m.get("counters", {}).items():
             # tenant.<name>.<kind> — names are identifier-like (the
             # PS_TENANTS parser rejects dots), but rsplit keeps this
