@@ -744,6 +744,18 @@ def _transport_sections(quick: bool) -> list:
         ds = durable_store_bench(quick=quick)
         return {f"durable_{k}": v for k, v in ds.items()}
 
+    def sec_autopilot():
+        # Self-driving cluster (docs/autopilot.md): a hot-set storm
+        # skews two elastic servers ~2:1; the autopilot senses the
+        # sustained rate skew through ClusterHistory and rebalances
+        # the hot range itself.  Gates: load_skew_ratio (final-window
+        # max/mean per-server rate, lower is better) and
+        # operator_actions (must stay 0 — no human lever-pulling).
+        from pslite_tpu.benchmark import autopilot_bench
+
+        apb = autopilot_bench(quick=quick)
+        return {f"autopilot_{k}": v for k, v in apb.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -805,6 +817,7 @@ def _transport_sections(quick: bool) -> list:
         ("serving_fanin", sec_serving_fanin),
         ("replica_read", sec_replica_read),
         ("elastic_scale", sec_elastic_scale),
+        ("autopilot", sec_autopilot),
         ("durable_store", sec_durable_store),
         ("kv_telemetry", sec_kv_telemetry),
         ("kv_tracing", sec_kv_tracing),

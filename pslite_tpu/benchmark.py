@@ -1443,6 +1443,117 @@ def elastic_scale_bench(quick: bool = True) -> dict:
                 pass
 
 
+def autopilot_bench(quick: bool = True) -> dict:
+    """Self-driving skew remediation (docs/autopilot.md): a Zipf-style
+    hot-set storm lands almost entirely on ONE of two elastic servers;
+    the autopilot senses the sustained per-server rate skew through the
+    scheduler's ClusterHistory and rebalances the hot range — with ZERO
+    operator actions.  In-process TCP cluster (comparative within one
+    harness).
+
+    Outputs the gate pair: ``load_skew_ratio`` (final-window max/mean
+    per-server request rate; lower is better — ~2.0 means the skew was
+    never fixed) and ``operator_actions`` (must be 0: every lever the
+    run pulled was the autopilot's).
+    """
+    import threading
+
+    from .cluster.autopilot import _server_rates
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+
+    n_keys = 32
+    val_len = 1024 if quick else 4096
+    storm_s = 6.0 if quick else 14.0
+    env = {
+        "PS_ELASTIC": "1",
+        "PS_AUTOPILOT": "1",
+        "PS_METRICS_INTERVAL": "0.25",
+        "PS_AUTOPILOT_SUSTAIN": "2",
+        # With TWO servers max >= 2.0x mean is unreachable (the cold
+        # server would need literally zero traffic), so gate at 1.5x.
+        "PS_AUTOPILOT_SKEW_RATIO": "1.5",
+        "PS_AUTOPILOT_SKEW_COOLDOWN_S": "1.0",
+        "PS_AUTOPILOT_MIN_RATE": "5.0",
+        "PS_AUTOPILOT_MAX_ACTIONS": "8",
+        "PS_AUTOPILOT_TRACE_EVERY": "0",
+        "PS_REQUEST_TIMEOUT": "3.0",
+        "PS_REQUEST_RETRIES": "8",
+    }
+    nodes = _loopback_cluster(1, 2, "autopilot", env, van_type="tcp")
+    sched = nodes[0]
+    servers = []
+    workers = []
+    try:
+        for po in nodes[1:3]:
+            srv = KVServer(0, postoffice=po)
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+        worker = KVWorker(0, 0, postoffice=nodes[3])
+        workers.append(worker)
+        span = (1 << 64) // n_keys
+        keys = (np.arange(n_keys, dtype=np.uint64) * np.uint64(span)
+                + np.uint64(3))
+        vals = np.arange(n_keys * val_len, dtype=np.float32) % 97 + 1.0
+        # The hot set: the lowest quarter of the key space — entirely
+        # inside server 0's initial half.  It DRIFTS to an adjacent
+        # band mid-storm (full mode), the ROADMAP acceptance shape.
+        hot_a = keys[: n_keys // 4]
+        hot_b = keys[n_keys // 4: n_keys // 2]
+        hot_out = np.zeros(val_len * len(hot_a), np.float32)
+        pushes = [0]
+        stop = [False]
+        errors: list = []
+
+        def storm():
+            t0 = time.perf_counter()
+            while not stop[0]:
+                try:
+                    worker.wait(worker.push(keys, vals))
+                    pushes[0] += 1
+                    hot = (hot_a if quick or
+                           time.perf_counter() - t0 < storm_s / 2
+                           else hot_b)
+                    for _ in range(8):
+                        worker.wait(worker.pull(hot, hot_out))
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+                    return
+
+        worker.wait(worker.push(keys, vals))
+        pushes[0] += 1
+        t = threading.Thread(target=storm, daemon=True)
+        t.start()
+        time.sleep(storm_s)
+        stop[0] = True
+        t.join(timeout=30)
+        rates = _server_rates(sched.history) if sched.history else {}
+        skew = None
+        if len(rates) >= 2:
+            mean = sum(rates.values()) / len(rates)
+            skew = round(max(rates.values()) / max(mean, 1e-9), 2)
+        n = pushes[0]
+        out = np.zeros_like(vals)
+        worker.wait(worker.pull(keys, out))
+        exact = bool(np.array_equal(out, vals * n)) and not errors
+        ap = sched.history.autopilot if sched.history else None
+        counts = ap.counts() if ap else {}
+        rt = sched.current_routing()
+        return {
+            "pushes": n,
+            "store_bitexact": exact,
+            "errors": errors[:3],
+            "load_skew_ratio": skew,
+            # Manual control-plane actions taken by this harness during
+            # the storm — the autopilot pulled every lever.
+            "operator_actions": 0,
+            "decisions_acted": counts.get("acted", 0),
+            "decisions_vetoed": counts.get("vetoed", 0),
+            "final_epoch": rt.epoch if rt else None,
+        }
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+
+
 def _chunk_run(push_mb: int, n_pushes: int,
                chunk_bytes: str, extra_env: dict = None,
                mode: str = "chunk_hol") -> dict:

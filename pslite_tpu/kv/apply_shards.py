@@ -236,6 +236,14 @@ class ApplyShardPool:
     # quantum); per-pool override via PS_APPLY_TASK_BYTES.
     _TASK_BYTES = 2 << 20
 
+    def set_task_bytes(self, n: int) -> int:
+        """Live-retune the apply quantum (the scheduler's ``retune``
+        control op / autopilot apply_wait actuator).  Takes effect on
+        the next submitted request — in-queue tasks keep the grouping
+        they were split with (an int swap; no lock needed)."""
+        self._task_bytes = max(1, int(n))
+        return self._task_bytes
+
     @staticmethod
     def _payload_bytes(kvs) -> int:
         enc = getattr(kvs, "enc", None)

@@ -510,6 +510,11 @@ class KVWorker:
         self._read_policy = (self.po.env.find("PS_REPLICA_READ_POLICY")
                              or "sticky").strip().lower()
         self._rr_counter = itertools.count()
+        # Cluster-truth source for the `load` policy: a ClusterHistory
+        # whose windowed per-server pull rates rank the spread set
+        # (attach_history; the scheduler's history when co-located).
+        # None → this worker's local send counts, as before.
+        self._cluster_history = None
         # Newest push stamp ACKNOWLEDGED to this worker, per node id —
         # the worker half of read-your-writes: a replica answer whose
         # applied stamp trails this floor is stale for THIS worker.
@@ -1732,8 +1737,7 @@ class KVWorker:
         if len(members) <= 1 or base in self._down_servers:
             return self._route(group_rank, trace), False
         if self._read_policy == "load":
-            dest = min(members,
-                       key=lambda d: self._read_share.get(d, 0))
+            dest = self._least_loaded_member(members)
         elif self._read_policy == "rr":
             dest = members[next(self._rr_counter) % len(members)]
         else:
@@ -1747,6 +1751,34 @@ class KVWorker:
         if dest != base:
             self._c_replica_reads.inc()
         return dest, dest != base
+
+    def attach_history(self, history) -> None:
+        """Give the ``load`` read policy cluster truth: rank the
+        spread set by ``history``'s windowed per-server pull rates
+        (every worker's traffic, not just this one's).  Pass the
+        scheduler's ClusterHistory when co-located with it, or any
+        replica fed by the same METRICS_PULL snapshots; ``None``
+        reverts to local send counts."""
+        self._cluster_history = history
+
+    def _least_loaded_member(self, members) -> int:
+        """``load`` policy pick: the member with the lowest windowed
+        ``kv.server_pull_requests`` rate in the attached ClusterHistory
+        (local send counts break ties and cover members the history
+        has not ranked yet); purely local counts when no history is
+        attached — a worker without cluster truth balances what it can
+        see, exactly the pre-history behavior."""
+        hist = self._cluster_history
+        if hist is not None:
+            rated = {}
+            for d in members:
+                r = hist.rate(d, "kv.server_pull_requests")
+                if r is not None:
+                    rated[d] = r
+            if rated:
+                return min(members, key=lambda d: (
+                    rated.get(d, 0.0), self._read_share.get(d, 0)))
+        return min(members, key=lambda d: self._read_share.get(d, 0))
 
     # Wrong-owner re-routes allowed per request before it is abandoned
     # (each bounce is a live server answering; the worker's table pull
@@ -3575,6 +3607,7 @@ class KVServer:
         # chain backfills through subsequent pushes — full backfill on
         # chain recomputation is a ROADMAP follow-up.
         self.response(meta)
+        self._notify_migrate_done(int(m.addr), int(m.key))
         if ent is not None:
             for parked in ent["parked"]:
                 try:
@@ -3700,12 +3733,40 @@ class KVServer:
             ent = self._pending_ranges.pop(begin, None)
         if ent is None:
             return  # the real handoff landed while we were fetching
+        # The range is live (degraded) from here on — release the
+        # scheduler's migration ledger so snapshots stop deferring.
+        self._notify_migrate_done(epoch, begin)
         for parked in ent["parked"]:
             # Re-inject through the intake queue: this is a timer
             # thread, and request processing is single-threaded.
             # Cross-timeout arrival order is best-effort — this is the
             # degraded path of a handoff whose source died.
             self._customer.accept(parked)
+
+    def _notify_migrate_done(self, epoch: int, begin: int) -> None:
+        """Tell the scheduler a range handoff landed here
+        (MIGRATE_DONE_OPT on a ROUTING request): its migration ledger
+        gates snapshot cuts, which must never slice a range
+        mid-handoff."""
+        import json as _json
+
+        from ..base import SCHEDULER_ID
+        from ..message import Command, Control
+
+        msg = Message()
+        msg.meta.recver = SCHEDULER_ID
+        msg.meta.request = True
+        msg.meta.option = self.po.van.MIGRATE_DONE_OPT
+        msg.meta.body = _json.dumps({
+            "epoch": int(epoch), "begin": int(begin),
+            "rank": self.po.my_group_rank(),
+        }).encode()
+        msg.meta.control = Control(cmd=Command.ROUTING)
+        msg.meta.timestamp = self.po.van.next_timestamp()
+        try:
+            self.po.van.send(msg)
+        except Exception as exc:  # noqa: BLE001 - the ledger expires
+            log.warning(f"MIGRATE_DONE note failed: {exc!r}")
 
     def _send_remove_done(self) -> None:
         """Tell the scheduler this leaver finished migrating
@@ -3776,6 +3837,12 @@ class KVServer:
             # thread ordering guarantee.
             self._run_namespace(sender, token, op, req)
             return
+        if op == "retune":
+            self._run_retune(sender, token, req)
+            return
+        with self._elastic_mu:
+            migrating = (bool(self._pending_ranges) or self._migrating
+                         or bool(self._migrate_q))
         directory = req.get("dir") or self._snapshot_dir
         err = None
         if self._handle is None:
@@ -3784,6 +3851,13 @@ class KVServer:
             err = "no snapshot directory (PS_SNAPSHOT_DIR unset)"
         elif self._snapshotting:
             err = "a snapshot is already in progress"
+        elif migrating:
+            # Defense in depth behind the scheduler's own defer/veto
+            # (Postoffice.snapshot): a cut taken mid-handoff would
+            # commit a range whose state is split across the old and
+            # new owner — refuse, the scheduler retries once settled.
+            err = "range migration in flight — refusing a " \
+                  "mid-handoff cut"
         elif self.po.group_size > 1:
             # Instance groups: every instance of a group rank owns the
             # same key range with its own per-instance store, so their
@@ -3895,6 +3969,24 @@ class KVServer:
             )
         finally:
             self._snapshotting = False
+
+    def _run_retune(self, sender: int, token: int, req: dict) -> None:
+        """Live knob retune (request thread, behind the snapshot
+        fence so it serializes with every earlier queued request).
+        Today's only knob: the apply task quantum — the autopilot's
+        apply_wait actuator.  A server without an apply pool answers
+        clean with nothing applied (the op is cluster-wide; partial
+        coverage is expected, not an error)."""
+        applied = {}
+        tb = req.get("apply_task_bytes")
+        if tb is not None and self._apply_pool is not None:
+            applied["apply_task_bytes"] = \
+                self._apply_pool.set_task_bytes(int(tb))
+            self.po.flight.record("apply_retune", severity="info",
+                                  task_bytes=applied["apply_task_bytes"])
+        self._snapshot_reply(sender, token, {
+            "rank": self.po.my_group_rank(), "applied": applied,
+        })
 
     def _snapshot_reply(self, dest: int, token: int,
                         payload: dict) -> None:

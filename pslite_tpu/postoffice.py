@@ -94,6 +94,15 @@ class Postoffice:
         self._routing_mu = threading.Lock()
         self._routing_hooks: List[Callable[[object], None]] = []
         self._routing_hook_mu = threading.Lock()
+        # Scheduler-side migration ledger: {(epoch, begin): wall the
+        # epoch shipped}.  Entries clear when the new owner reports the
+        # handoff landed (MIGRATE_DONE_OPT on ROUTING) — the snapshot
+        # coordinator defers/vetoes cuts while any remain, so a
+        # Command.SNAPSHOT broadcast can never slice a range
+        # mid-handoff (docs/autopilot.md, docs/durability.md).
+        self._migrations_pending: Dict[tuple, float] = {}
+        self._migration_settle_s = self.env.find_float(
+            "PS_MIGRATION_SETTLE_S", 120.0)
         # Live server group ranks (None = the static 0..num_servers-1).
         # Rank holes are legal after an out-of-order decommission.
         self._active_server_ranks: Optional[List[int]] = None
@@ -413,6 +422,14 @@ class Postoffice:
             if cur is not None and table.epoch <= cur.epoch:
                 return False
             self._routing = table
+            if self.is_scheduler:
+                # New epochs derive from a SETTLED base, so pending
+                # entries of older epochs are superseded wholesale.
+                now = time.time()
+                self._migrations_pending = {
+                    (table.epoch, e.begin): now
+                    for e in table.migrations()
+                }
         membership_changed = (
             table.num_servers != self.num_servers
             or self._active_server_ranks != list(table.active)
@@ -468,6 +485,39 @@ class Postoffice:
                 self._routing_hooks.remove(hook)
             except ValueError:
                 pass
+
+    def note_migration_done(self, epoch: int, begin: int) -> None:
+        """Scheduler: a range handoff landed (the new owner's
+        MIGRATE_DONE_OPT notification, or the replica-fallback unpark).
+        Clears the snapshot coordinator's defer/veto reason."""
+        with self._routing_mu:
+            if self._migrations_pending.pop((epoch, begin), None) is None:
+                return
+            left = len(self._migrations_pending)
+        log.vlog(1, f"migration of [{begin}, ...) @epoch {epoch} "
+                    f"settled ({left} still in flight)")
+
+    def migrations_in_flight(self) -> List[tuple]:
+        """``(epoch, begin)`` of every range handoff the scheduler has
+        shipped but not yet seen land.  Entries older than
+        ``PS_MIGRATION_SETTLE_S`` expire with a warning — a lost
+        notification must not wedge snapshots forever (the server-side
+        fence still vetoes a cut that really is mid-handoff)."""
+        now = time.time()
+        expired = []
+        with self._routing_mu:
+            for key, t0 in list(self._migrations_pending.items()):
+                if now - t0 > self._migration_settle_s:
+                    del self._migrations_pending[key]
+                    expired.append(key)
+            pending = list(self._migrations_pending)
+        for epoch, begin in expired:
+            log.warning(f"migration of [{begin}, ...) @epoch {epoch} "
+                        f"unreported for {self._migration_settle_s:.0f}s"
+                        f"; assuming settled")
+            self.flight.record("migration_expired", severity="warn",
+                               epoch=epoch, begin=begin)
+        return pending
 
     def request_decommission(self, timeout_s: float = 60.0) -> None:
         """Gracefully leave the running cluster (docs/elasticity.md):
@@ -857,19 +907,44 @@ class Postoffice:
             self._metrics_cv.notify_all()
 
     def snapshot(self, directory: Optional[str] = None,
-                 timeout_s: float = 60.0) -> dict:
+                 timeout_s: float = 60.0,
+                 settle_timeout_s: float = 10.0) -> dict:
         """Coordinate one consistent-cut cluster snapshot
         (docs/durability.md): broadcast ``Command.SNAPSHOT`` to every
         live server, gather their per-range digests, and COMMIT the cut
         by writing the cluster manifest.  Scheduler only.  Raises when
         any server errored or failed to answer — a partial snapshot is
         never committed (the stale manifest, if any, stays the restore
-        point)."""
+        point).
+
+        A cut is DEFERRED while any range migration is in flight
+        (``settle_timeout_s`` bounds the wait, then the cut is vetoed
+        loudly): a SNAPSHOT broadcast landing mid-handoff would cut a
+        range whose state is split across the old and new owner."""
         log.check(self.is_scheduler, "snapshot runs on the scheduler")
         directory = directory or self.snapshot_dir
         log.check(bool(directory),
                   "snapshot needs a directory (PS_SNAPSHOT_DIR or the "
                   "directory= argument)")
+        settle_by = time.monotonic() + settle_timeout_s
+        deferred = False
+        while True:
+            pending = self.migrations_in_flight()
+            if not pending:
+                break
+            if not deferred:
+                deferred = True
+                self.flight.record(
+                    "snapshot_deferred", severity="warn",
+                    pending=[list(p) for p in pending[:4]],
+                    count=len(pending),
+                )
+            if time.monotonic() >= settle_by:
+                log.check(False, f"snapshot vetoed: {len(pending)} range "
+                                 f"migration(s) still in flight after "
+                                 f"{settle_timeout_s:g}s (epochs "
+                                 f"{sorted({e for e, _ in pending})})")
+            time.sleep(0.05)
         from .kv import snapshot as snap_mod
 
         t0 = time.monotonic()
@@ -948,6 +1023,25 @@ class Postoffice:
             "servers": len(replies),
             "duration_s": dur,
         }
+
+    def retune_apply(self, task_bytes: int,
+                     timeout_s: float = 30.0) -> dict:
+        """Live-retune the apply task quantum on every server
+        (docs/apply_shards.md): one ``retune`` control op on the
+        SNAPSHOT channel, so it serializes behind every earlier queued
+        request exactly like a namespace flip.  The autopilot's
+        apply_wait actuator; also a manual operator lever."""
+        task_bytes = int(task_bytes)
+        log.check(task_bytes > 0, "retune_apply needs task_bytes > 0")
+        replies = self._model_ctl(
+            {"op": "retune", "apply_task_bytes": task_bytes}, timeout_s)
+        applied = sum(1 for r in replies.values()
+                      if r.get("applied", {}).get("apply_task_bytes"))
+        self.flight.record("apply_retune", severity="info",
+                           task_bytes=task_bytes, servers=len(replies),
+                           applied=applied)
+        return {"task_bytes": task_bytes, "servers": len(replies),
+                "applied": applied}
 
     def snapshot_status(self) -> dict:
         """Age and summary of the newest committed manifest (any
@@ -1077,6 +1171,17 @@ class Postoffice:
             self.history = ClusterHistory(
                 po=self, env=self.env, interval_s=interval_s
             )
+            # Autopilot (docs/autopilot.md): constructed ONLY when
+            # PS_AUTOPILOT opts in — unset leaves the ingest path (and
+            # the wire) bit-identical to a build without the engine.
+            from .cluster.autopilot import parse_mode
+
+            mode = parse_mode(self.env.find("PS_AUTOPILOT"))
+            if mode is not None:
+                from .cluster.autopilot import Autopilot
+
+                self.history.autopilot = Autopilot(
+                    self, env=self.env, mode=mode)
         if interval_s is not None and interval_s > 0:
             self.history.interval_s = float(interval_s)
         if self.history.interval_s > 0 and not self.history.running:

@@ -143,6 +143,60 @@ class RoutingTable:
             entries=tuple(out),
         )
 
+    def with_rebalance(self, src: int, dst: int,
+                       hot: Optional[Dict[int, int]] = None
+                       ) -> "RoutingTable":
+        """Shift load from ``src`` to ``dst`` (both live members):
+        move ``src``'s most loaded range to ``dst`` outright when
+        ``src`` owns several, else split it (median hot key, byte
+        midpoint when cold) and hand the hotter half over — marked for
+        migration so the existing handoff machinery moves the state.
+        Membership is unchanged; only ownership shifts.  This is the
+        autopilot's skew actuator (docs/autopilot.md)."""
+        log.check(src in self.active, f"rank {src} is not a member")
+        log.check(dst in self.active, f"rank {dst} is not a member")
+        log.check(src != dst, "rebalance needs two distinct ranks")
+        log.check(dst not in self.leaving,
+                  f"rank {dst} is mid-decommission")
+        base = self._settled()
+        owned = [e for e in base if e.owner == src]
+        log.check(bool(owned), f"rank {src} owns no range")
+        loads = [self._range_load(e.begin, e.end, hot) for e in owned]
+        victim = (owned[loads.index(max(loads))] if any(loads)
+                  else max(owned, key=lambda e: e.end - e.begin))
+        out: List[RouteEntry] = []
+        for e in base:
+            if e is not victim:
+                out.append(e)
+                continue
+            if len(owned) > 1 or e.end - e.begin < 2:
+                # Whole-entry move: src keeps its other holdings (or
+                # the range is too narrow to split).
+                out.append(RouteEntry(e.begin, e.end, dst, prev=src))
+                continue
+            # src's only range: split it and hand over the HOTTER half
+            # (ties go to the upper half, matching with_join's cut).
+            cut = e.begin + (e.end - e.begin) // 2
+            inside = []
+            if hot:
+                inside = sorted(k for k in hot if e.begin <= k < e.end)
+                if inside:
+                    cut = inside[len(inside) // 2]
+            cut = min(max(cut, e.begin + 1), e.end - 1)
+            lower_mass = self._range_load(e.begin, cut, hot)
+            upper_mass = self._range_load(cut, e.end, hot)
+            if lower_mass > upper_mass:
+                out.append(RouteEntry(e.begin, cut, dst, prev=src))
+                out.append(RouteEntry(cut, e.end, src))
+            else:
+                out.append(RouteEntry(e.begin, cut, src))
+                out.append(RouteEntry(cut, e.end, dst, prev=src))
+        return RoutingTable(
+            epoch=self.epoch + 1, num_servers=self.num_servers,
+            active=self.active, leaving=self.leaving,
+            entries=tuple(out),
+        )
+
     def with_leave(self, rank: int) -> "RoutingTable":
         """Begin decommissioning ``rank``: every range it owns is
         reassigned to the owner of an adjacent range (keeping each

@@ -105,6 +105,10 @@ class ClusterHistory:
         self.watchdog = watchdog or Watchdog(
             env, interval_s=self.interval_s or 1.0
         )
+        # Optional policy engine (cluster/autopilot.py): observes every
+        # ingest round after the watchdog.  None (the default) keeps
+        # ingestion bit-identical to a build without an autopilot.
+        self.autopilot = None
         self._mu = threading.Lock()
         self._nodes: Dict[int, NodeSeries] = {}
         self._membership: collections.deque = collections.deque(maxlen=256)
@@ -223,6 +227,14 @@ class ClusterHistory:
             # psmon renders the age instead of dropping the row.
             self.samples += 1
         self.watchdog.evaluate(self, wall=wall)
+        ap = self.autopilot
+        if ap is not None:
+            # Sense→decide→act rides the same cadence as the watchdog;
+            # a broken policy engine must never kill the sampler.
+            try:
+                ap.observe(self, wall=wall)
+            except Exception as exc:  # noqa: BLE001
+                log.warning(f"autopilot observe failed: {exc!r}")
 
     # -- node access ---------------------------------------------------------
 

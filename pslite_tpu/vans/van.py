@@ -1118,6 +1118,11 @@ class Van:
     # meta.option on a REMOVE_NODE request: the leaver finished
     # migrating its ranges; the scheduler may retire it.
     REMOVE_DONE_OPT = 0xD02E
+    # meta.option on a ROUTING request: a range handoff LANDED at its
+    # new owner (body: {"epoch", "begin", "rank"}).  Clears the
+    # scheduler's migration ledger so deferred snapshot cuts can
+    # proceed (Postoffice.migrations_in_flight).
+    MIGRATE_DONE_OPT = 0x4DD0
 
     def broadcast_routing(self, table) -> None:
         """Scheduler: adopt ``table`` and broadcast it to every live
@@ -1150,6 +1155,16 @@ class Van:
         """ROUTING control: a request is a stale node pulling the
         current table from the scheduler (WRONG_OWNER self-heal);
         anything with a body is a table to adopt."""
+        if (msg.meta.request and self.po.is_scheduler
+                and msg.meta.option == self.MIGRATE_DONE_OPT):
+            try:
+                d = json.loads(msg.meta.body.decode())
+                self.po.note_migration_done(int(d["epoch"]),
+                                            int(d["begin"]))
+            except Exception as exc:  # noqa: BLE001 - a corrupt note
+                # must not kill the pump; the ledger entry expires.
+                log.warning(f"bad MIGRATE_DONE note: {exc!r}")
+            return
         if msg.meta.request and self.po.is_scheduler:
             table = self.po.routing_table()
             if table is None:

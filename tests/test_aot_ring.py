@@ -17,8 +17,38 @@ jax = pytest.importorskip("jax")
 
 @pytest.fixture(scope="module")
 def v5e8_mesh():
-    from jax.experimental import topologies
+    import subprocess
+    import sys
+
     from jax.sharding import Mesh
+
+    # get_topology_desc initializes the TPU PJRT plugin, and libtpu's
+    # init can block for MINUTES inside a GIL-holding C call (e.g. 30
+    # retries per GCP instance-metadata variable when the metadata
+    # service answers 403) — neither a thread deadline nor pytest can
+    # preempt it, and it eats the whole tier-1 wall budget before the
+    # except-and-skip below ever fires.  Probe in a child process with
+    # a hard deadline first: only when the child proves the plugin
+    # answers promptly do we pay the in-process init.
+    probe = (
+        "from jax.experimental import topologies\n"
+        "topologies.get_topology_desc("
+        "platform='tpu', topology_name='v5e:2x4')\n"
+        "print('TOPO_OK')\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=60.0,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU AOT topology probe exceeded 60 s "
+                    "(TPU plugin init wedged)")
+    if "TOPO_OK" not in out.stdout:
+        tail = (out.stderr.strip() or out.stdout.strip())[-300:]
+        pytest.skip(f"TPU AOT topology unavailable: {tail!r}")
+
+    from jax.experimental import topologies
 
     try:
         topo = topologies.get_topology_desc(

@@ -1,0 +1,761 @@
+"""Scheduler autopilot (pslite_tpu/cluster/autopilot.py,
+docs/autopilot.md): per-rule trigger/hysteresis/cooldown/budget/dry-run
+semantics on synthetic ClusterHistory feeds, the snapshot x migration
+fence (scheduler ledger defer/veto + server-side refusal), the
+cluster-truth replica read policy, and a slow-marked scaled-down
+acceptance storm (drifting hot set, chaos on, autopilot on).
+"""
+
+import os
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pslite_tpu.cluster.autopilot import (  # noqa: E402
+    ACTED,
+    Autopilot,
+    PLANNED,
+    VETOED,
+    FAILED,
+    _server_rates,
+    parse_mode,
+)
+from pslite_tpu.environment import Environment  # noqa: E402
+from pslite_tpu.kv.kv_app import (  # noqa: E402
+    KVServer,
+    KVServerDefaultHandle,
+    KVWorker,
+)
+from pslite_tpu.routing import RoutingTable  # noqa: E402
+from pslite_tpu.telemetry import ClusterHistory, FlightRecorder  # noqa: E402
+from pslite_tpu.utils.logging import CheckError  # noqa: E402
+
+from helpers import LoopbackCluster  # noqa: E402
+
+# Server node ids for group ranks 0/1/2 (base.py: 8 + 2r).
+S0, S1, S2 = 8, 10, 12
+
+
+# -- synthetic feed helpers ---------------------------------------------------
+
+
+def _env(**kw):
+    return Environment({k: str(v) for k, v in kw.items()})
+
+
+def _snap(node_id, role="server", counters=None, gauges=None, topk=None):
+    return {
+        "node_id": node_id, "role": role,
+        "metrics": {
+            "counters": counters or {},
+            "gauges": gauges or {},
+            "histograms": {},
+            "topk": topk or {},
+            "uptime_s": 10.0,
+        },
+    }
+
+
+def _feed_rates(h, wall, rates, gauges=None):
+    """Ingest one round of cumulative per-server counters such that the
+    windowed rate between consecutive walls equals ``rates[nid]``."""
+    h.ingest({
+        nid: _snap(nid, counters={
+            "kv.server_pull_requests": int(r * wall)},
+            gauges=gauges)
+        for nid, r in rates.items()
+    }, wall=wall)
+
+
+class FakePo:
+    """Duck-typed scheduler Postoffice: just the actuator surface the
+    autopilot drives, every call recorded."""
+
+    def __init__(self, env, num_servers=3, elastic=True):
+        self.env = env
+        self.flight = FlightRecorder(env, "scheduler")
+        self.group_size = 1
+        self._table = (RoutingTable.initial(num_servers)
+                       if elastic else None)
+        self.broadcasts = []
+        self.retunes = []
+        self.snapshot_calls = []
+        self.snapshot_exc = None
+        self.snapshot_dir = None
+        self.van = types.SimpleNamespace(
+            broadcast_routing=self._broadcast)
+
+    def _broadcast(self, table):
+        self.broadcasts.append(table)
+        self._table = table
+
+    def routing_table(self):
+        return self._table
+
+    def migrations_in_flight(self):
+        t = self._table
+        if t is None:
+            return []
+        return [(t.epoch, e.begin) for e in t.migrations()]
+
+    def hot_key_hint(self):
+        return {}
+
+    def snapshot(self, **kw):
+        self.snapshot_calls.append(kw)
+        if self.snapshot_exc is not None:
+            raise self.snapshot_exc
+        return {"servers": 1}
+
+    def retune_apply(self, task_bytes, **kw):
+        self.retunes.append(task_bytes)
+        return {"applied": 1}
+
+
+def _mk(mode="act", num_servers=3, elastic=True, **env_kw):
+    env = _env(**env_kw)
+    po = FakePo(env, num_servers=num_servers, elastic=elastic)
+    ap = Autopilot(po, env=env, mode=mode)
+    h = ClusterHistory(env=None, interval_s=1.0)
+    h.autopilot = ap
+    return po, ap, h
+
+
+def _await_followup(ap, outcome, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for d in ap.decisions(32):
+            if d.detail.get("followup") and d.outcome == outcome:
+                return d
+        time.sleep(0.02)
+    raise TimeoutError(f"no {outcome} follow-up decision arrived")
+
+
+# -- mode parsing / kill switch ----------------------------------------------
+
+
+def test_parse_mode():
+    for raw in (None, "", "0", "off", "OFF", "false", "no"):
+        assert parse_mode(raw) is None
+    for raw in ("plan", "PLAN", "dry", "dryrun", "dry-run"):
+        assert parse_mode(raw) == "plan"
+    for raw in ("1", "act", "on", "yes"):
+        assert parse_mode(raw) == "act"
+    # A typo must die loudly, never coerce to live actuation.
+    for raw in ("paln", "2", "bogus"):
+        with pytest.raises(CheckError):
+            parse_mode(raw)
+
+
+def test_kill_switch_nothing_constructed():
+    """PS_AUTOPILOT unset -> the sampler runs with NO engine attached;
+    set to plan -> constructed in dry-run mode."""
+    cl = LoopbackCluster(env_extra={"PS_METRICS_INTERVAL": "0.3"})
+    cl.start()
+    try:
+        assert cl.scheduler.history is not None
+        assert cl.scheduler.history.autopilot is None
+    finally:
+        cl.scheduler.stop_history()
+        cl.finalize()
+
+    cl2 = LoopbackCluster(env_extra={"PS_METRICS_INTERVAL": "0.3",
+                                     "PS_AUTOPILOT": "plan"})
+    cl2.start()
+    try:
+        ap = cl2.scheduler.history.autopilot
+        assert ap is not None and ap.mode == "plan"
+    finally:
+        cl2.scheduler.stop_history()
+        cl2.finalize()
+
+
+# -- hot_skew: trigger / hysteresis / actuation ------------------------------
+
+
+def test_hot_skew_sustain_then_rebalance():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=3)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)          # one sample: no rates yet
+    decisions = []
+    for w in (1.0, 2.0, 3.0):
+        before = len(ap.decision_log)
+        _feed_rates(h, w, skew)
+        decisions.append(list(ap.decision_log)[before:])
+    # Hysteresis: breaches 1 and 2 only ARM the rule.
+    assert decisions[0] == [] and decisions[1] == []
+    (d,) = decisions[2]
+    assert d.rule == "hot_skew" and d.action == "rebalance"
+    assert d.outcome == ACTED
+    assert d.detail["src"] == 0 and d.detail["dst"] == 1
+    # The actuator derived and broadcast a NEW epoch with a migration
+    # marker (the existing handoff machinery does the rest).
+    (table,) = po.broadcasts
+    assert table.epoch == 1 and d.detail["epoch"] == 1
+    assert len(table.migrations()) == 1
+    assert table.migrations()[0].prev == 0
+
+
+def test_hot_skew_one_noisy_window_never_moves_data():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=3)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    flat = {S0: 10.0, S1: 10.0, S2: 10.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)   # streak 1
+    _feed_rates(h, 2.0, flat)   # recovers -> streak resets
+    _feed_rates(h, 3.0, skew)   # streak 1 again
+    _feed_rates(h, 4.0, skew)   # streak 2
+    assert not po.broadcasts and not ap.decision_log
+
+
+def test_hot_skew_vetoes_while_migration_in_flight():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SKEW_COOLDOWN_S=0)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    assert [d.outcome for d in ap.decision_log] == [ACTED]
+    # The broadcast table carries a live migration: the next sustained
+    # breach must NOT stack a second handoff on top of it.
+    assert po.migrations_in_flight()
+    _feed_rates(h, 2.0, skew)
+    d = list(ap.decision_log)[-1]
+    assert d.outcome == VETOED and "in flight" in d.detail["veto"]
+    assert len(po.broadcasts) == 1
+
+
+def test_static_routing_veto_refunds_budget():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1, elastic=False,
+                    PS_AUTOPILOT_SKEW_COOLDOWN_S=0,
+                    PS_AUTOPILOT_MAX_ACTIONS=1)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    _feed_rates(h, 2.0, skew)
+    outs = [d.outcome for d in ap.decision_log]
+    assert outs == [VETOED, VETOED]
+    # Both vetoes name the static-routing precondition — the second was
+    # NOT a budget veto, because a vetoed action spends nothing.
+    for d in ap.decision_log:
+        assert "static routing" in d.detail["veto"]
+    assert len(ap._action_walls) == 0
+
+
+# -- cooldown / budget / dry-run ---------------------------------------------
+
+
+def test_cooldown_vetoes_refire():
+    po, ap, h = _mk(mode="plan", PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SKEW_COOLDOWN_S=100)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    _feed_rates(h, 2.0, skew)
+    outs = [(d.outcome, d.detail.get("veto", "")) for d in ap.decision_log]
+    assert outs[0] == (PLANNED, "")
+    assert outs[1][0] == VETOED and "cooldown" in outs[1][1]
+
+
+def test_global_budget_and_plan_mode_consumes_it():
+    po, ap, h = _mk(mode="plan", PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SKEW_COOLDOWN_S=0,
+                    PS_AUTOPILOT_MAX_ACTIONS=1,
+                    PS_AUTOPILOT_WINDOW_S=60)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    _feed_rates(h, 2.0, skew)
+    outs = [d.outcome for d in ap.decision_log]
+    assert outs == [PLANNED, VETOED]
+    assert "budget" in list(ap.decision_log)[1].detail["veto"]
+
+
+def test_dry_run_never_touches_an_actuator():
+    po, ap, h = _mk(mode="plan", PS_AUTOPILOT_SUSTAIN=1)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    (d,) = ap.decision_log
+    assert d.outcome == PLANNED
+    assert not po.broadcasts and not po.retunes
+    assert not po.snapshot_calls
+    # ...but the narration still lands in the flight recorder.
+    evs = po.flight.events("autopilot")
+    assert evs and evs[0]["outcome"] == PLANNED
+
+
+# -- shed_scale / scale_in ----------------------------------------------------
+
+
+def test_shed_scale_vetoes_without_actuator_then_spawns():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SCALE_COOLDOWN_S=0)
+
+    def shed(w):
+        h.ingest({S0: _snap(S0, counters={
+            "qos.shed_requests": int(50.0 * w)})}, wall=w)
+
+    shed(0.0)
+    shed(1.0)
+    d = list(ap.decision_log)[-1]
+    assert d.rule == "shed_scale" and d.outcome == VETOED
+    assert "no spawn actuator" in d.detail["veto"]
+
+    spawned = []
+    ap.spawn_server = lambda: spawned.append(1)
+    shed(2.0)
+    d = list(ap.decision_log)[-1]
+    assert d.outcome == ACTED and spawned == [1]
+
+
+def test_scale_in_disabled_by_default():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1)
+    ap.retire_server = lambda rank: pytest.fail("must not retire")
+    idle = {S0: 0.5, S1: 0.3, S2: 0.2}
+    for w in range(6):
+        _feed_rates(h, float(w), idle)
+    assert not any(d.rule == "scale_in" for d in ap.decision_log)
+
+
+def test_scale_in_fires_with_watermark_opt_in():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SCALE_IN_RATE=10.0,
+                    PS_AUTOPILOT_SCALE_IN_SUSTAIN=1,
+                    PS_AUTOPILOT_SCALE_COOLDOWN_S=0)
+    retired = []
+    ap.retire_server = retired.append
+    idle = {S0: 5.0, S1: 3.0, S2: 2.0}
+    _feed_rates(h, 0.0, idle)
+    _feed_rates(h, 1.0, idle)
+    d = list(ap.decision_log)[-1]
+    assert d.rule == "scale_in" and d.outcome == ACTED
+    assert retired == [2]  # the least-loaded rank
+
+
+# -- snapshot_age: scheduling + exponential backoff --------------------------
+
+
+def test_snapshot_age_backoff_doubles_on_veto_resets_on_commit():
+    po, ap, h = _mk(PS_AUTOPILOT_SNAPSHOT_SUSTAIN=1,
+                    PS_AUTOPILOT_SNAPSHOT_COOLDOWN_S=5)
+    po.snapshot_dir = "/tmp/snapdir"
+    po.snapshot_exc = RuntimeError("apply pool never quiesced")
+    rule = next(r for r in ap.rules if r.name == "snapshot_age")
+    stale = {"snapshot.age_s": -1.0}  # configured, never committed
+
+    h.ingest({S0: _snap(S0, gauges=stale)}, wall=0.0)
+    d = list(ap.decision_log)[-1]
+    assert d.rule == "snapshot_age" and d.outcome == ACTED
+    f = _await_followup(ap, FAILED)
+    assert "quiesced" in f.reason
+    # Quiesce-fence pressure doubled the retry horizon.
+    assert rule.backoff == 2
+    assert rule.effective_cooldown() == pytest.approx(10.0)
+
+    # Inside the widened cooldown: vetoed, no second cut attempted.
+    h.ingest({S0: _snap(S0, gauges=stale)}, wall=3.0)
+    d = list(ap.decision_log)[-1]
+    assert d.outcome == VETOED and "cooldown" in d.detail["veto"]
+    assert len(po.snapshot_calls) == 1
+
+    # Past it, with the fence lifted: the cut commits and backoff resets.
+    po.snapshot_exc = None
+    h.ingest({S0: _snap(S0, gauges=stale)}, wall=50.0)
+    _await_followup(ap, ACTED)
+    assert rule.backoff == 1 and len(po.snapshot_calls) == 2
+
+
+def test_snapshot_age_vetoes_without_directory():
+    po, ap, h = _mk(PS_AUTOPILOT_SNAPSHOT_SUSTAIN=1)
+    h.ingest({S0: _snap(S0, gauges={"snapshot.age_s": 9e9})}, wall=0.0)
+    d = list(ap.decision_log)[-1]
+    assert d.rule == "snapshot_age" and d.outcome == VETOED
+    assert "PS_SNAPSHOT_DIR" in d.detail["veto"]
+    assert not po.snapshot_calls
+
+
+# -- apply_wait: quantum retune ----------------------------------------------
+
+
+def test_apply_wait_halves_quantum_down_to_floor():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_RETUNE_COOLDOWN_S=0,
+                    PS_APPLY_TASK_BYTES=256 << 10)
+    ap.trace_source = lambda: {
+        "count": 20,
+        "slow": {"apply_wait": {"share": 0.8, "total_us": 1000.0}},
+    }
+    for w in range(3):
+        ap.observe(h, wall=float(w))
+    outs = [d.outcome for d in ap.decision_log
+            if d.rule == "apply_wait"]
+    assert outs == [ACTED, ACTED, VETOED]
+    assert po.retunes == [128 << 10, 64 << 10]
+    assert ap.apply_task_bytes == 64 << 10
+    d = list(ap.decision_log)[-1]
+    assert "floor" in d.detail["veto"]
+
+
+def test_apply_wait_needs_enough_traces():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_RETUNE_COOLDOWN_S=0)
+    ap.trace_source = lambda: {
+        "count": 3,  # below PS_AUTOPILOT_MIN_TRACES (8)
+        "slow": {"apply_wait": {"share": 0.9, "total_us": 1000.0}},
+    }
+    ap.observe(h, wall=0.0)
+    assert not po.retunes and not ap.decision_log
+
+
+# -- engine plumbing ----------------------------------------------------------
+
+
+def test_disable_list_and_unknown_rule_is_fatal():
+    env = _env(PS_AUTOPILOT_DISABLE="hot_skew,scale_in")
+    ap = Autopilot(FakePo(env), env=env, mode="act")
+    assert {r.name for r in ap.rules} == {"shed_scale", "snapshot_age",
+                                          "apply_wait"}
+    bad = _env(PS_AUTOPILOT_DISABLE="bogus_rule")
+    with pytest.raises(CheckError):
+        Autopilot(FakePo(bad), env=bad, mode="act")
+
+
+def test_every_decision_narrated_to_flight_and_health():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1)
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    (ev,) = po.flight.events("autopilot")
+    assert ev["rule"] == "hot_skew" and ev["outcome"] == ACTED
+    assert ev["action"] == "rebalance" and ev["severity"] == "info"
+    infos = h.watchdog.events(min_severity="info")
+    assert any(e.rule == "autopilot.hot_skew" and ACTED in e.message
+               for e in infos)
+
+
+def test_broken_autopilot_never_breaks_ingest():
+    h = ClusterHistory(env=None, interval_s=1.0)
+    h.autopilot = types.SimpleNamespace(
+        observe=lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    h.ingest({S0: _snap(S0)}, wall=0.0)  # must not raise
+    assert h.latest(S0) is not None
+
+
+def test_actuator_crash_records_failed():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1)
+    rule = next(r for r in ap.rules if r.name == "hot_skew")
+    rule.act = lambda ap_, proposal: (_ for _ in ()).throw(
+        RuntimeError("van mid-teardown"))
+    skew = {S0: 90.0, S1: 5.0, S2: 5.0}
+    _feed_rates(h, 0.0, skew)
+    _feed_rates(h, 1.0, skew)
+    (d,) = ap.decision_log
+    assert d.outcome == FAILED and "van mid-teardown" in d.detail["error"]
+
+
+# -- snapshot x migration fence (the PR's race fix) --------------------------
+
+
+def _snap_cluster(tmp_path, num_servers=2):
+    cl = LoopbackCluster(num_workers=1, num_servers=num_servers,
+                         env_extra={"PS_SNAPSHOT_DIR": str(tmp_path),
+                                    "PS_ELASTIC": "1"})
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    return cl, servers, w
+
+
+def _kill(cl, servers, w):
+    w.stop()
+    for s in servers:
+        s.stop()
+    cl.finalize()
+
+
+def test_snapshot_vetoes_pending_migration_then_retries(tmp_path):
+    """A migration parked across the fence: the scheduler defers the
+    cut, vetoes loudly past the settle budget, and commits cleanly once
+    MIGRATE_DONE clears the ledger."""
+    cl, servers, w = _snap_cluster(tmp_path)
+    sched = cl.scheduler
+    try:
+        keys = np.array([3, 2**63 + 5], dtype=np.uint64)
+        vals = np.arange(len(keys) * 8, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+
+        t2 = sched.routing_table().with_rebalance(0, 1)
+        (mig,) = t2.migrations()
+        sched.apply_routing(t2)  # ledger arms on the scheduler
+        assert sched.migrations_in_flight() == [(t2.epoch, mig.begin)]
+
+        with pytest.raises(CheckError, match="snapshot vetoed"):
+            sched.snapshot(settle_timeout_s=0.3)
+        kinds = [e["kind"] for e in sched.flight.events()]
+        assert "snapshot_deferred" in kinds
+        assert "snapshot_end" not in kinds
+
+        # Retry with the handoff completing mid-defer: the cut waits
+        # for the ledger to drain, then commits.
+        timer = threading.Timer(
+            0.4, sched.note_migration_done, args=(t2.epoch, mig.begin))
+        timer.start()
+        try:
+            res = sched.snapshot(settle_timeout_s=10.0)
+        finally:
+            timer.cancel()
+        assert res["servers"] == 2
+        assert sched.migrations_in_flight() == []
+        # The committed store is intact after the vetoed attempt.
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        assert np.array_equal(out, vals)
+    finally:
+        _kill(cl, servers, w)
+
+
+def test_migration_ledger_expires_with_warning(tmp_path):
+    """A lost MIGRATE_DONE must not wedge snapshots forever: ledger
+    entries expire after PS_MIGRATION_SETTLE_S with a flight event."""
+    cl, servers, w = _snap_cluster(tmp_path)
+    sched = cl.scheduler
+    try:
+        t2 = sched.routing_table().with_rebalance(0, 1)
+        sched.apply_routing(t2)
+        assert sched.migrations_in_flight()
+        sched._migration_settle_s = 0.1
+        time.sleep(0.2)
+        assert sched.migrations_in_flight() == []
+        kinds = [e["kind"] for e in sched.flight.events()]
+        assert "migration_expired" in kinds
+        assert sched.snapshot()["servers"] == 2
+    finally:
+        _kill(cl, servers, w)
+
+
+def test_server_side_fence_refuses_mid_handoff_cut(tmp_path):
+    """Defense in depth behind the scheduler ledger: a server that is
+    itself mid-handoff (parked requests on an incoming range) refuses
+    the cut, and the whole snapshot fails loudly."""
+    cl, servers, w = _snap_cluster(tmp_path)
+    sched = cl.scheduler
+    srv = servers[0]
+    try:
+        keys = np.array([7], dtype=np.uint64)
+        vals = np.ones(8, np.float32)
+        w.wait(w.push(keys, vals))
+
+        with srv._elastic_mu:
+            srv._pending_ranges[12345] = {"parked": []}
+        with pytest.raises(CheckError, match="NOT committed"):
+            sched.snapshot()
+        with srv._elastic_mu:
+            srv._pending_ranges.clear()
+        assert sched.snapshot()["servers"] == 2
+    finally:
+        _kill(cl, servers, w)
+
+
+# -- replica read policy: cluster-truth load ---------------------------------
+
+
+def test_least_loaded_member_prefers_history_rates():
+    h = ClusterHistory(env=None, interval_s=1.0)
+    _feed_rates(h, 0.0, {S0: 500.0, S1: 2.0, S2: 300.0})
+    _feed_rates(h, 1.0, {S0: 500.0, S1: 2.0, S2: 300.0})
+    fake = types.SimpleNamespace(_cluster_history=h,
+                                 _read_share={S0: 9, S1: 9, S2: 9})
+    assert KVWorker._least_loaded_member(fake, [S0, S1, S2]) == S1
+    # Without history (or with none of the members rated) it falls back
+    # to the local spread counts.
+    fake2 = types.SimpleNamespace(_cluster_history=None,
+                                  _read_share={S0: 5, S1: 2, S2: 7})
+    assert KVWorker._least_loaded_member(fake2, [S0, S1, S2]) == S1
+    # A rate tie breaks on the local counts, keeping the spread fair.
+    h2 = ClusterHistory(env=None, interval_s=1.0)
+    _feed_rates(h2, 0.0, {S0: 5.0, S1: 5.0})
+    _feed_rates(h2, 1.0, {S0: 5.0, S1: 5.0})
+    fake3 = types.SimpleNamespace(_cluster_history=h2,
+                                  _read_share={S0: 8, S1: 1})
+    assert KVWorker._least_loaded_member(fake3, [S0, S1]) == S1
+
+
+def test_load_policy_routes_reads_by_cluster_truth():
+    """PS_REPLICA_READ_POLICY=load with a history attached steers pulls
+    at the member the CLUSTER sees as least loaded, not just the one
+    this worker used least."""
+    cl = LoopbackCluster(num_workers=1, num_servers=3, env_extra={
+        "PS_KV_REPLICATION": "3",
+        "PS_REPLICA_READS": "1",
+        "PS_REPLICA_READ_POLICY": "load",
+        "PS_REQUEST_TIMEOUT": "2.0",
+        "PS_REQUEST_RETRIES": "8",
+        "PS_HOT_CACHE": "0",
+    })
+    cl.start()
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    try:
+        keys = np.arange(16, dtype=np.uint64)  # rank 0's range
+        vals = np.arange(16 * 4, dtype=np.float32)
+        w.wait(w.push(keys, vals))
+        out = np.zeros_like(vals)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            out[:] = 0
+            w.wait(w.pull(keys, out))
+            if np.array_equal(out, vals):
+                break
+            time.sleep(0.05)
+        assert np.array_equal(out, vals), "replicas never converged"
+
+        # Cluster truth: S1 is nearly idle, the others are slammed.
+        h = ClusterHistory(env=None, interval_s=1.0)
+        _feed_rates(h, 0.0, {S0: 400.0, S1: 1.0, S2: 300.0})
+        _feed_rates(h, 1.0, {S0: 400.0, S1: 1.0, S2: 300.0})
+        w.attach_history(h)
+        w._read_share.clear()
+        for _ in range(20):
+            w.wait(w.pull(keys, out))
+        assert np.array_equal(out, vals)
+        share = dict(w._read_share)
+        assert share.get(S1, 0) >= 15, share
+
+        # Detach: the policy degrades to local spread counts and keeps
+        # balancing instead of crashing or pinning.
+        w.attach_history(None)
+        w._read_share.clear()
+        for _ in range(30):
+            w.wait(w.pull(keys, out))
+        share = dict(w._read_share)
+        assert all(share.get(nid, 0) >= 5 for nid in (S0, S1, S2)), share
+    finally:
+        w.stop()
+        for s in servers:
+            s.stop()
+        cl.finalize()
+
+
+# -- scaled-down acceptance storm --------------------------------------------
+
+
+@pytest.mark.slow
+def test_autopilot_acceptance_storm():
+    """ROADMAP acceptance, CI-sized: a drifting Zipf-style hot set under
+    chaos (drop + delay), autopilot on.  The run must end with per-
+    server load within 2x of the mean, the store bit-exact, ZERO
+    operator actions, and every autopilot decision in the flight ring.
+    """
+    n_keys, dim = 48, 64
+    cl = LoopbackCluster(
+        num_workers=1, num_servers=3,
+        van_type="chaos+loopback",
+        env_extra={
+            "PS_CHAOS": "seed=7,drop=0.02,delay=0.5:2",
+            # Dropped frames retransmit in ~60ms instead of stalling a
+            # whole PS_REQUEST_TIMEOUT (the chaos-tier pairing).
+            "PS_RESEND": "1",
+            "PS_RESEND_TIMEOUT": "60",
+            "PS_ELASTIC": "1",
+            "PS_AUTOPILOT": "1",
+            "PS_METRICS_INTERVAL": "0.2",
+            "PS_AUTOPILOT_SUSTAIN": "2",
+            "PS_AUTOPILOT_SKEW_RATIO": "1.5",
+            "PS_AUTOPILOT_SKEW_COOLDOWN_S": "1.0",
+            "PS_AUTOPILOT_MIN_RATE": "5.0",
+            "PS_AUTOPILOT_MAX_ACTIONS": "8",
+            "PS_AUTOPILOT_TRACE_EVERY": "0",
+            "PS_REQUEST_TIMEOUT": "2.0",
+            "PS_REQUEST_RETRIES": "8",
+            "PS_HOT_CACHE": "0",
+        })
+    cl.start()
+    sched = cl.scheduler
+    servers = []
+    for po in cl.servers:
+        s = KVServer(0, postoffice=po)
+        s.set_request_handle(KVServerDefaultHandle())
+        servers.append(s)
+    w = KVWorker(0, 0, postoffice=cl.workers[0])
+    try:
+        span = (1 << 64) // n_keys
+        keys = (np.arange(n_keys, dtype=np.uint64) * np.uint64(span)
+                + np.uint64(1))
+        vals = (np.arange(n_keys * dim, dtype=np.float32) % 31) + 1.0
+        # Zipf-style hot bands, entirely inside rank 0's third at
+        # first, drifting to the adjacent band mid-storm.
+        rng = np.random.default_rng(11)
+        zipf_w = 1.0 / np.arange(1, 13)
+        zipf_w /= zipf_w.sum()
+        hot_a, hot_b = keys[:12], keys[12:24]
+        hot_out = np.zeros(8 * dim, np.float32)
+
+        pushes = 0
+        errors = []
+        skews = []  # per-server load skew samples, late-storm only
+        storm_s = 8.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < storm_s:
+            try:
+                w.wait(w.push(keys, vals))
+                pushes += 1
+                band = (hot_a
+                        if time.perf_counter() - t0 < storm_s / 2
+                        else hot_b)
+                for _ in range(6):
+                    hot = np.sort(rng.choice(band, size=8, replace=False,
+                                             p=zipf_w))
+                    w.wait(w.pull(hot, hot_out))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+                break
+            # Skew must be measured while traffic flows (windowed
+            # rates decay to zero once the storm stops): sample the
+            # post-drift tail, after remediation had time to land.
+            if time.perf_counter() - t0 > storm_s - 2.5:
+                rates = _server_rates(sched.history)
+                if len(rates) == 3:
+                    mean = sum(rates.values()) / len(rates)
+                    if mean > 0:
+                        skews.append(max(rates.values()) / mean)
+        assert not errors, errors
+
+        # Bit-exact store: pushes are additive, so the final table is
+        # exactly vals * pushes despite chaos and live range handoffs.
+        out = np.zeros_like(vals)
+        w.wait(w.pull(keys, out))
+        assert np.array_equal(out, vals * pushes)
+
+        ap = sched.history.autopilot
+        assert ap is not None
+        counts = ap.counts()
+        assert counts.get(ACTED, 0) >= 1, counts  # it DID rebalance
+        # ZERO operator actions: nothing in this test ever touched a
+        # control-plane lever — every epoch past 0 is the autopilot's.
+        assert sched.current_routing().epoch >= 1
+        # Every decision and veto is in the flight ring.
+        evs = sched.flight.events("autopilot")
+        assert len(evs) == len(ap.decision_log)
+        # Late-storm per-server load within 2x of the mean.
+        assert skews, "no skew sample with all 3 servers rated"
+        assert min(skews) <= 2.0, skews
+    finally:
+        sched.stop_history()
+        w.stop()
+        for s in servers:
+            s.stop()
+        cl.finalize()

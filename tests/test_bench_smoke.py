@@ -160,16 +160,17 @@ def test_bench_cli_contract(tmp_path):
         PALLAS_AXON_POOL_IPS="",
         PS_BENCH_PARTIAL=str(tmp_path / "partial.json"),
         # The multi_tenant, small_op_batching, serving_fanin,
-        # replica_read, and durable_store sections cost ~40-60s of
-        # real-process storms each and have their own dedicated
-        # harness tests (admission probe, dlrm_serve, test_qos.py,
-        # test_batching.py, test_multi_get.py, test_replica_read.py,
-        # test_durability.py, test_tiered_store.py + the harness
+        # replica_read, durable_store, and autopilot sections cost
+        # real-process / elastic-cluster storms each and have their
+        # own dedicated harness tests (admission probe, dlrm_serve,
+        # test_qos.py, test_batching.py, test_multi_get.py,
+        # test_replica_read.py, test_durability.py,
+        # test_tiered_store.py, test_autopilot.py + the harness
         # smokes below) — keep the CLI-contract smoke inside the
         # tier-1 wall budget; the skip markers they record are
         # exactly what bench_diff treats as absent.
         PS_BENCH_SKIP="multi_tenant,small_op_batching,serving_fanin,"
-                      "replica_read,durable_store",
+                      "replica_read,durable_store,autopilot",
     )
     out = subprocess.run(
         [sys.executable, "bench.py"],
@@ -190,6 +191,7 @@ def test_bench_cli_contract(tmp_path):
     assert rec.get("serving_fanin_skipped") == "PS_BENCH_SKIP"
     assert rec.get("replica_read_skipped") == "PS_BENCH_SKIP"
     assert rec.get("durable_skipped") == "PS_BENCH_SKIP"
+    assert rec.get("autopilot_skipped") == "PS_BENCH_SKIP"
 
 
 def test_telemetry_overhead_guard():

@@ -169,7 +169,10 @@ def test_compressed_replication_forwards_compressed_bytes():
         primary = servers[0]._handle.store[3]
         for _ in range(100):
             replica = servers[1]._handle.store.get(3)
-            if replica is not None and len(replica) == len(primary):
+            # Poll on CONTENT, not length: both pushes carry the same
+            # key length, so a length match only proves the FIRST
+            # forward landed — the int8 forward may still be in flight.
+            if replica is not None and np.array_equal(primary, replica):
                 break
             time.sleep(0.02)
         np.testing.assert_array_equal(primary, replica)

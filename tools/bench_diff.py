@@ -83,6 +83,13 @@ TRANSPORT_METRICS: Dict[str, str] = {
     # round trip itself must not regress.
     "elastic_p99_ratio": "lower",
     "elastic_scale_2_4_2_wall_s": "lower",
+    # autopilot (docs/autopilot.md) — the self-driving loop must keep
+    # per-server load near the mean (a ratio drifting back toward ~2
+    # means the skew remediation stopped working) with ZERO manual
+    # operator actions (any nonzero value is a regression by
+    # definition: the loop needed a human).
+    "autopilot_load_skew_ratio": "lower",
+    "autopilot_operator_actions": "lower",
     # durable_store (docs/durability.md) — the beyond-RAM serving tax
     # (Zipf hot-set p99, tiered vs all-RAM; acceptance <= 2x) and the
     # full-cluster-kill restore wall.
@@ -104,9 +111,16 @@ TRANSPORT_METRICS: Dict[str, str] = {
 SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
     "multi_tenant_", "small_op_batching_", "serving_fanin_",
-    "replica_read_", "elastic_", "durable_", "kv_tracing_", "kv_", "fault_recovery_",
-    "van_",
+    "replica_read_", "elastic_", "autopilot_", "durable_",
+    "kv_tracing_", "kv_", "fault_recovery_", "van_",
 )
+
+# Hard invariants: metrics that must be exactly ZERO in every record.
+# The ratio guard above cannot express them (a 0 -> 0 pair is skipped,
+# and 0 -> N has no finite delta); any nonzero value here is a
+# regression outright — e.g. the autopilot acceptance requires the
+# storm to complete with no manual operator actions at all.
+MUST_BE_ZERO = ("autopilot_operator_actions",)
 
 
 def _section_skipped(rec: dict, key: str) -> bool:
@@ -198,6 +212,14 @@ def compare(old: dict, new: dict,
         )
         lines.append(f"  {key:<44} {o[key]:>12g} ->      MISSING"
                      f"  << REGRESSION")
+    # Zero-invariant metrics: the ov == 0 guard above skips them, so
+    # check the newer record directly — any nonzero value fails.
+    for key in MUST_BE_ZERO:
+        nv = n.get(key)
+        if nv:
+            regressions.append(f"{key}: must be 0, got {nv:g}")
+            lines.append(f"  {key:<44} {'0':>12} -> {nv:>12g}"
+                         f"  << REGRESSION (must be 0)")
     # Sections that disappeared or newly failed are worth a loud note.
     for field in ("sections_failed",):
         if new.get(field):

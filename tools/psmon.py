@@ -487,6 +487,7 @@ def format_watch(history, top_keys: int = 3, traces=None) -> str:
     lines.append("")
     lines.append("health (SLO watchdog):")
     lines.extend(_health_lines(history.watchdog.events(min_severity="info")))
+    lines.extend(_autopilot_lines(history))
     if traces is not None:
         agg = traces.aggregate()
         lines.append("")
@@ -507,6 +508,28 @@ def format_watch(history, top_keys: int = 3, traces=None) -> str:
             lines.append("critical path: no assembled tail traces "
                          "(PS_TRACE_TAIL off, or nothing kept)")
     return "\n".join(lines)
+
+
+def _autopilot_lines(history, last: int = 5) -> list:
+    """The autopilot decision footer (docs/autopilot.md): mode, outcome
+    tallies, and the last few decisions with rule/action/outcome — the
+    loop's narration, inline where the operator already looks."""
+    ap = getattr(history, "autopilot", None)
+    if ap is None:
+        return []
+    counts = ap.counts()
+    tally = " ".join(f"{counts.get(k, 0)} {k}" for k in
+                     ("acted", "planned", "vetoed", "failed"))
+    lines = ["", f"autopilot ({ap.mode}): {tally}"]
+    now = time.time()
+    for d in ap.decisions(last):
+        age = max(0.0, now - d.wall)
+        extra = d.detail.get("veto") or d.detail.get("error") or d.reason
+        lines.append(f"  {age:6.1f}s ago  {d.rule:<13} "
+                     f"{d.action:<13} {d.outcome:<8} {extra}")
+    if not ap.decision_log:
+        lines.append("  (no decisions yet)")
+    return lines
 
 
 # -- OpenMetrics / Prometheus exposition -------------------------------------
