@@ -756,6 +756,17 @@ def _transport_sections(quick: bool) -> list:
         apb = autopilot_bench(quick=quick)
         return {f"autopilot_{k}": v for k, v in apb.items()}
 
+    def sec_wire():
+        # Wire-plane observatory (docs/observability.md): syscalls/op,
+        # frames/op, combiner batch fill, lane residency p99, zc byte
+        # share — the wire.* counter deltas of a bursty small-op tcp
+        # storm with the combiner on.  Host-side only; the syscall and
+        # frame ratios gate (lower is better), the rest is context.
+        from pslite_tpu.benchmark import wire_observatory_storm
+
+        wo = wire_observatory_storm(quick=quick)
+        return {f"wire_{k}": v for k, v in wo.items()}
+
     def sec_fault_recovery():
         # Recovery path gets a tracked number like the perf paths:
         # server kill -> detector broadcast -> failover pull success
@@ -821,6 +832,7 @@ def _transport_sections(quick: bool) -> list:
         ("durable_store", sec_durable_store),
         ("kv_telemetry", sec_kv_telemetry),
         ("kv_tracing", sec_kv_tracing),
+        ("wire", sec_wire),
         ("fault_recovery", sec_fault_recovery),
     ]
     if not quick:

@@ -76,7 +76,11 @@ struct Frame {
 // 8: fused wire-codec kernels (psl_codec_encode/decode + the fp8 table
 // registration) backing the quantized transport tier
 // (docs/compression.md).
-constexpr int kAbiVersion = 8;
+// 9: wire-plane observatory — per-core syscall/frame/byte counters
+// exported through the one-struct psl_stats_snapshot call
+// (docs/observability.md): a pre-9 library would leave the native
+// lanes dark while Python reports them instrumented.
+constexpr int kAbiVersion = 9;
 
 // Fixed offsets inside the python wire format's meta block (wire.py
 // _META_FIXED, little-endian, no padding): enough to peek a frame's
@@ -114,6 +118,28 @@ constexpr uint8_t kExtChunkTag = 2;       // wire.py EXT_CHUNK
 // ChunkAssembler.  Never produced by any sender, so it cannot collide
 // with a real chunk index (senders cap transfers far below 2^32).
 constexpr uint32_t kChunkCompleteIndex = 0xFFFFFFFFu;
+
+// Wire-plane counter block (docs/observability.md): one POD struct of
+// relaxed monotonic totals, snapshotted whole by psl_stats_snapshot so
+// the Python side folds the native plane into the metrics registry as
+// deltas with a single FFI call.  Layout is ABI-guarded: the abi field
+// echoes kAbiVersion and the struct only ever grows at the end.
+struct psl_wire_stats {
+  uint64_t abi;
+  uint64_t tx_syscalls;    // writev calls (socket path; pipes cost 0)
+  uint64_t tx_frames;      // frames fully written (chunks individually)
+  uint64_t tx_chunks;      // chunk frames from the native splitter
+  uint64_t tx_bytes;       // wire bytes out (header+lens+meta+payload)
+  uint64_t tx_msgs;        // logical sends completed clean (sync sends
+                           // + lane descriptors)
+  uint64_t rx_syscalls;    // read calls (socket pumps; pipes cost 0)
+  uint64_t rx_frames;      // frames delivered to the recv queue
+  uint64_t rx_bytes_copy;  // bytes staged into pool blocks / pipe ring
+  uint64_t rx_bytes_zc;    // bytes scatter-read straight into transfer
+                           // buffers (direct-read reassembly)
+  uint64_t rx_pool_hits;   // frame blocks recycled from the pool
+  uint64_t rx_pool_misses; // frame blocks freshly malloc'd
+};
 
 // True when this frame rides the express receive lane, mirroring the
 // pure-Python PriorityRecvQueue discipline (utils/queues.py,
@@ -190,7 +216,7 @@ class FramePool {
  public:
   static constexpr size_t kHdr = 16;  // capacity stash, keeps 16-align
 
-  static uint8_t* Alloc(size_t n) {
+  static uint8_t* Alloc(size_t n, bool* pool_hit = nullptr) {
     size_t cap = ClassOf(n);
     {
       std::lock_guard<std::mutex> lk(Mu());
@@ -199,9 +225,11 @@ class FramePool {
         uint8_t* base = cls.back();
         cls.pop_back();
         Total() -= cap;
+        if (pool_hit != nullptr) *pool_hit = true;
         return base + kHdr;
       }
     }
+    if (pool_hit != nullptr) *pool_hit = false;
     auto* base = static_cast<uint8_t*>(malloc(cap + kHdr));
     if (base == nullptr) return nullptr;
     memcpy(base, &cap, sizeof(cap));
@@ -800,7 +828,9 @@ class Core {
     for (uint32_t i = 0; i < n_data; ++i) {
       div[i] = {const_cast<uint8_t*>(data[i]), static_cast<size_t>(lens[i])};
     }
-    return TransmitFrame(node_id, meta, meta_len, div.data(), n_data);
+    long long rc = TransmitFrame(node_id, meta, meta_len, div.data(), n_data);
+    if (rc >= 0) wx_tx_msgs_.fetch_add(1, std::memory_order_relaxed);
+    return rc;
   }
 
   // Frame one message and write it to the peer's route (pipe or
@@ -865,7 +895,16 @@ class Core {
     // pipe and socket frames would lose ordering).
     if (pipe != nullptr) {
       long long rc = PipeSendFrame(pipe, iov.data(), iov.size(), total);
-      if (rc != -EPIPE) return rc;
+      if (rc != -EPIPE) {
+        if (rc >= 0) {
+          // Pipe frames are ring memcpys: a frame and its bytes, zero
+          // syscalls — exactly the story the observatory should tell.
+          wx_tx_frames_.fetch_add(1, std::memory_order_relaxed);
+          wx_tx_bytes_.fetch_add(static_cast<uint64_t>(rc),
+                                 std::memory_order_relaxed);
+        }
+        return rc;
+      }
       // Reader declared dead (see PipeWriteVec): retire the pipe and
       // fall back to the socket connection, which connect_transport
       // established before the pipe took over routing.  Frames already
@@ -883,6 +922,7 @@ class Core {
     size_t idx = 0;
     size_t off = 0;
     long long sent_total = 0;
+    uint64_t calls = 0;
     while (idx < iov.size()) {
       iovec cur[64];
       int cnt = 0;
@@ -894,8 +934,10 @@ class Core {
         }
       }
       ssize_t n = writev(fd, cur, cnt);
+      ++calls;
       if (n < 0) {
         if (errno == EINTR) continue;
+        wx_tx_syscalls_.fetch_add(calls, std::memory_order_relaxed);
         return -errno;
       }
       sent_total += n;
@@ -916,6 +958,12 @@ class Core {
       }
     }
     (void)total;
+    // One committed batch per frame (local counter, like the Python
+    // _sendv): a fully-accepted vector costs exactly one fetch_add.
+    wx_tx_syscalls_.fetch_add(calls, std::memory_order_relaxed);
+    wx_tx_frames_.fetch_add(1, std::memory_order_relaxed);
+    wx_tx_bytes_.fetch_add(static_cast<uint64_t>(sent_total),
+                           std::memory_order_relaxed);
     return sent_total;
   }
 
@@ -1077,6 +1125,26 @@ class Core {
       return 1;
     }
     return stopped_ ? -1 : 0;
+  }
+
+  // One-call wire-plane snapshot: every counter read relaxed into the
+  // caller's struct.  Totals are monotonic; the Python side diffs
+  // against its previous snapshot, so relaxed reads racing live
+  // increments only ever defer a count to the next snapshot.
+  void StatsSnapshot(psl_wire_stats* out) const {
+    out->abi = kAbiVersion;
+    out->tx_syscalls = wx_tx_syscalls_.load(std::memory_order_relaxed);
+    out->tx_frames = wx_tx_frames_.load(std::memory_order_relaxed);
+    out->tx_chunks = wx_tx_chunks_.load(std::memory_order_relaxed);
+    out->tx_bytes = wx_tx_bytes_.load(std::memory_order_relaxed);
+    out->tx_msgs = wx_tx_msgs_.load(std::memory_order_relaxed);
+    out->rx_syscalls = wx_rx_syscalls_.load(std::memory_order_relaxed);
+    out->rx_frames = wx_rx_frames_.load(std::memory_order_relaxed);
+    out->rx_bytes_copy = wx_rx_bytes_copy_.load(std::memory_order_relaxed);
+    out->rx_bytes_zc = wx_rx_bytes_zc_.load(std::memory_order_relaxed);
+    out->rx_pool_hits = wx_rx_pool_hits_.load(std::memory_order_relaxed);
+    out->rx_pool_misses =
+        wx_rx_pool_misses_.load(std::memory_order_relaxed);
   }
 
   void Stop() {
@@ -1354,6 +1422,9 @@ class Core {
                              slices.data(),
                              static_cast<uint32_t>(slices.size()),
                              RailFd(node_id, rail));
+          if (rc >= 0) {
+            wx_tx_chunks_.fetch_add(1, std::memory_order_relaxed);
+          }
           lk.lock();
         }
       }
@@ -1379,6 +1450,9 @@ class Core {
             if (pos != qit->second.end()) qit->second.erase(pos);
             if (qit->second.empty()) lane->q.erase(qit);
           }
+        }
+        if (!d->canceled && d->error == 0) {
+          wx_tx_msgs_.fetch_add(1, std::memory_order_relaxed);
         }
         lane->done.emplace_back(
             d->ticket, d->canceled ? -ECANCELED
@@ -1617,6 +1691,7 @@ class Core {
       uint64_t pos = head % size;
       if (n > size - pos) n = size - pos;
       memcpy(StageDst(c), rp->data + pos, n);
+      wx_rx_bytes_copy_.fetch_add(n, std::memory_order_relaxed);
       c->got += n;
       head += n;
       consumed += static_cast<long long>(n);
@@ -1768,7 +1843,10 @@ class Core {
       // Lens + meta land in one right-sized block; the payload's
       // destination is decided only after the meta is readable.
       c->body_size = 8ull * n_data + meta_len;
-      c->frame.buf = FramePool::Alloc(c->body_size);
+      bool pool_hit = false;
+      c->frame.buf = FramePool::Alloc(c->body_size, &pool_hit);
+      (pool_hit ? wx_rx_pool_hits_ : wx_rx_pool_misses_)
+          .fetch_add(1, std::memory_order_relaxed);
       c->stage = 1;
       c->want = 8ull * n_data;  // lens arrive first
       c->got = 0;
@@ -1884,6 +1962,7 @@ class Core {
   }
 
   void EnqueueFrame(const Frame& f) {
+    wx_rx_frames_.fetch_add(1, std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> lk(queue_mu_);
       if (recv_priority_ && FrameIsExpress(f)) {
@@ -2075,9 +2154,19 @@ class Core {
   bool ReadConn(Conn* c) {
     while (true) {
       ssize_t n = read(c->fd, StageDst(c), c->want - c->got);
+      wx_rx_syscalls_.fetch_add(1, std::memory_order_relaxed);
       if (n == 0) return false;
       if (n < 0) {
         return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      }
+      // Direct-read scatter (stage 3 into a transfer buffer) is the
+      // zero-copy path; everything else stages into a pool block.
+      if (c->stage == 3 && c->scatter_dst != nullptr) {
+        wx_rx_bytes_zc_.fetch_add(static_cast<uint64_t>(n),
+                                  std::memory_order_relaxed);
+      } else {
+        wx_rx_bytes_copy_.fetch_add(static_cast<uint64_t>(n),
+                                    std::memory_order_relaxed);
       }
       c->got += static_cast<size_t>(n);
       // A stage may complete with want == got (empty lens table of a
@@ -2139,6 +2228,20 @@ class Core {
   // different receive pumps but scatter into ONE shared buffer (the
   // payload reads themselves are lock-free — disjoint byte ranges).
   std::atomic<bool> reassemble_{false};
+  // Wire-plane observatory counters (StatsSnapshot): relaxed monotonic
+  // totals — one cheap fetch_add at each syscall/frame event, mutable
+  // so the const snapshot can load them.
+  mutable std::atomic<uint64_t> wx_tx_syscalls_{0};
+  mutable std::atomic<uint64_t> wx_tx_frames_{0};
+  mutable std::atomic<uint64_t> wx_tx_chunks_{0};
+  mutable std::atomic<uint64_t> wx_tx_bytes_{0};
+  mutable std::atomic<uint64_t> wx_tx_msgs_{0};
+  mutable std::atomic<uint64_t> wx_rx_syscalls_{0};
+  mutable std::atomic<uint64_t> wx_rx_frames_{0};
+  mutable std::atomic<uint64_t> wx_rx_bytes_copy_{0};
+  mutable std::atomic<uint64_t> wx_rx_bytes_zc_{0};
+  mutable std::atomic<uint64_t> wx_rx_pool_hits_{0};
+  mutable std::atomic<uint64_t> wx_rx_pool_misses_{0};
   std::map<std::pair<long long, unsigned long long>, ConnXfer> xfers_;
   uint64_t xfer_seq_ = 0;  // xfers_mu_
   std::mutex xfers_mu_;
@@ -2485,6 +2588,15 @@ long long psl_send(void* h, int node_id, const uint8_t* meta,
 }
 
 int psl_abi_version() { return kAbiVersion; }
+
+// Wire-plane observatory (docs/observability.md): fill the caller's
+// counter block in one call.  Returns the struct size actually
+// written, so a caller built against a newer layout can detect a
+// short (older) library without a separate version probe.
+int psl_stats_snapshot(void* h, psl_wire_stats* out) {
+  static_cast<Core*>(h)->StatsSnapshot(out);
+  return static_cast<int>(sizeof(psl_wire_stats));
+}
 
 long long psl_send_enqueue(void* h, int node_id, int priority,
                            const uint8_t* meta, uint32_t meta_len,

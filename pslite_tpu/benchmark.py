@@ -1155,6 +1155,90 @@ def kv_loopback_storm(n_workers: int = 2, n_servers: int = 2,
         _teardown_cluster(nodes, workers, servers)
 
 
+def wire_observatory_storm(quick: bool = False) -> dict:
+    """Wire-plane observatory numbers (docs/observability.md) over a
+    live in-process tcp cluster: syscalls/op, frames/op, combiner
+    batch fill, lane residency p99, and the zero-copy byte share —
+    all from ``wire.*`` counter deltas across a bursty small-op push
+    storm with the combiner on (the regime the occupancy histogram
+    prices).  Both planes summed: a van is judged by its whole data
+    plane, whichever half carried the traffic."""
+    from .kv.kv_app import KVServer, KVServerDefaultHandle, KVWorker
+
+    env = {"PS_BATCH_BYTES": str(64 << 10)}
+    nodes = _loopback_cluster(1, 1, "wire-obs", env, van_type="tcp")
+    servers: list = []
+    workers: list = []
+    try:
+        srv = KVServer(0, postoffice=nodes[1])
+        srv.set_request_handle(KVServerDefaultHandle())
+        servers.append(srv)
+        w = KVWorker(0, 0, postoffice=nodes[2])
+        workers.append(w)
+        keys = np.arange(8, dtype=np.uint64) * ((1 << 64) // 8) + 3
+        vals = np.ones(8 * 256, np.float32)  # 8 KiB ops: batchable
+        out = np.zeros_like(vals)
+        rounds, burst = (6, 8) if quick else (20, 16)
+        w.wait(w.push(keys, vals))  # warm the path before the window
+        pre = [po.telemetry_snapshot()["metrics"] for po in nodes]
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            tss = [w.push(keys, vals) for _ in range(burst)]
+            for ts in tss:
+                w.wait(ts)
+            w.wait(w.pull(keys, out))
+        wall = time.perf_counter() - t0
+        post = [po.telemetry_snapshot()["metrics"] for po in nodes]
+    finally:
+        _teardown_cluster(nodes, workers, servers)
+
+    def delta(name: str) -> int:
+        tot = 0
+        for p0, p1 in zip(pre, post):
+            d = (p1.get("counters", {}).get(name, 0)
+                 - p0.get("counters", {}).get(name, 0))
+            if d > 0:
+                tot += d
+        return tot
+
+    def both(suffix: str) -> int:
+        return delta("wire." + suffix) + delta("wire.native." + suffix)
+
+    ops = both("tx.ops") + delta("wire.rx.ops")
+    syscalls = both("tx.syscalls") + both("rx.syscalls")
+    frames = (both("tx.frames") + delta("wire.rx.frames")
+              + delta("wire.native.rx.frames"))
+    zc = (both("tx.bytes_zc") + delta("wire.rx.bytes_zc")
+          + delta("wire.native.rx.bytes_zc"))
+    copied = (delta("wire.tx.bytes_copy") + delta("wire.rx.bytes_copy")
+              + delta("wire.native.rx.bytes_copy"))
+    occ_n = 0
+    occ_sum = 0.0
+    res_p99 = 0.0
+    for p0, p1 in zip(pre, post):
+        h1 = p1.get("histograms", {}).get("wire.batch_occupancy") or {}
+        h0 = p0.get("histograms", {}).get("wire.batch_occupancy") or {}
+        occ_n += max(h1.get("count", 0) - h0.get("count", 0), 0)
+        occ_sum += max(h1.get("sum", 0.0) - h0.get("sum", 0.0), 0.0)
+        hr = p1.get("histograms", {}).get("wire.lane_residency_s") or {}
+        res_p99 = max(res_p99, hr.get("p99") or 0.0)
+    recs = delta("wire.telemetry.records")
+    flushes = delta("wire.telemetry.flushes")
+    return {
+        "ops": ops,
+        "wall_s": round(wall, 4),
+        "ops_per_s": round(ops / max(wall, 1e-9), 1),
+        "syscalls_per_op": (round(syscalls / ops, 3) if ops else None),
+        "frames_per_op": (round(frames / ops, 3) if ops else None),
+        "batch_fill": (round(occ_sum / occ_n, 2) if occ_n else None),
+        "residency_p99_ms": round(res_p99 * 1e3, 3),
+        "zc_share": (round(zc / (zc + copied), 3)
+                     if zc + copied else None),
+        "records_per_flush": (round(recs / flushes, 1)
+                              if flushes else None),
+    }
+
+
 def kv_tracing_storm(n_workers: int = 2, n_servers: int = 2,
                      msgs_per_worker: int = 40, keys_per_msg: int = 8,
                      val_len: int = 512,

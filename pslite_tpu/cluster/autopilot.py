@@ -26,6 +26,9 @@ freshest window:
                    flight).
 - ``apply_wait``   critical-path dominance of the apply-shard wait
                    stage → halve the apply task quantum cluster-wide.
+- ``apply_widen``  the symmetric recovery: apply-wait share collapsed
+                   with the quantum narrowed → double it back toward
+                   the configured baseline, same guardrails.
 
 Safety is the point, not the afterthought:
 
@@ -435,6 +438,57 @@ class ApplyWaitRule(PolicyRule):
         proposal["task_bytes"] = new
 
 
+class ApplyWidenRule(PolicyRule):
+    """Symmetric recovery for :class:`ApplyWaitRule`: when the
+    apply-wait share of the slow-quartile wall has COLLAPSED and the
+    quantum sits below its configured baseline, double it back toward
+    the baseline (bigger tasks amortize dispatch overhead;
+    docs/apply_shards.md).  Same sustain/cooldown guardrails as the
+    narrowing rule, so a transient lull can't thrash the quantum."""
+
+    name = "apply_widen"
+
+    def __init__(self, env):
+        super().__init__(
+            sustain=env.find_int("PS_AUTOPILOT_SUSTAIN", 3),
+            cooldown_s=env.find_float(
+                "PS_AUTOPILOT_RETUNE_COOLDOWN_S", 60.0),
+        )
+        self.share = env.find_float("PS_AUTOPILOT_APPLY_WIDEN_SHARE",
+                                    0.15)
+        self.min_traces = env.find_int("PS_AUTOPILOT_MIN_TRACES", 8)
+        # The quantum the operator configured — the ceiling widening
+        # converges back to, never beyond.
+        self.baseline = env.find_int("PS_APPLY_TASK_BYTES", 2 << 20)
+
+    def sense(self, ap, history, wall):
+        if ap.apply_task_bytes >= self.baseline:
+            return None  # nothing was narrowed; nothing to undo
+        agg = ap.trace_aggregate()
+        if not agg or agg.get("count", 0) < self.min_traces:
+            return None  # no evidence the pressure is gone — hold
+        info = (agg.get("slow") or {}).get("apply_wait") or {}
+        share = float(info.get("share", 0.0))
+        if share > self.share:
+            return None
+        return {
+            "action": "retune_apply",
+            "reason": (f"apply_wait fell to {share * 100:.0f}% of the "
+                       f"slow-quartile wall (≤ {self.share * 100:.0f}%) "
+                       f"with the quantum narrowed"),
+            "share": round(share, 3),
+        }
+
+    def act(self, ap, proposal):
+        cur = ap.apply_task_bytes
+        if cur >= self.baseline:
+            raise Veto(f"apply quantum already at baseline ({cur} B)")
+        new = min(self.baseline, cur * 2)
+        ap.po.retune_apply(new)
+        ap.apply_task_bytes = new
+        proposal["task_bytes"] = new
+
+
 class Autopilot:
     """The policy engine.  Constructed by ``Postoffice.start_history``
     when ``PS_AUTOPILOT`` is set; ``observe`` rides every
@@ -486,6 +540,7 @@ class Autopilot:
             ScaleInRule(env),
             SnapshotAgeRule(env, warn=_thresh("snapshot_age", 0)),
             ApplyWaitRule(env),
+            ApplyWidenRule(env),
         ]
         disabled = {
             r.strip() for r in

@@ -42,7 +42,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from ..telemetry.metrics import enabled_registry
+from ..telemetry.metrics import node_registry
 from ..telemetry.tracing import NULL_TRACER
 from ..utils import logging as log
 from ..utils.queues import PriorityRecvQueue
@@ -207,11 +207,11 @@ class ApplyShardPool:
         # counters (the sharded_requests/global_requests properties
         # below keep the historical read surface), per-shard queue-depth
         # gauges, and an apply-latency histogram — the server-side
-        # numbers psmon's "apply" columns render.  Legacy views must
-        # keep counting without a live registry (stub servers,
-        # PS_TELEMETRY=0) — enabled_registry falls back privately.
+        # numbers psmon's "apply" columns render.  Node registry
+        # proper (the sharded_requests property is a thin
+        # read-through); stub servers get a private one.
         po = getattr(server, "po", None)
-        self._metrics = enabled_registry(getattr(po, "metrics", None))
+        self._metrics = node_registry(getattr(po, "metrics", None))
         self._tracer = getattr(po, "tracer", None) or NULL_TRACER
         self._c_sharded = self._metrics.counter("apply.sharded_requests")
         self._c_global = self._metrics.counter("apply.global_requests")

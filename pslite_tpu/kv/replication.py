@@ -184,15 +184,13 @@ class Replicator:
         # already IN the imported state — re-applying them would
         # double-add (+= semantics).
         self._import_floor: Dict[int, int] = {}
-        # Observability (docs/observability.md): registry counters (the
-        # forwarded/deduped properties keep the historical reads, so
-        # they must keep counting under PS_TELEMETRY=0 — enabled_registry
-        # falls back privately) plus a replication-lag gauge — forwards
-        # still parked in the send lanes toward this primary's replicas,
-        # i.e. writes the replicas have not yet even been sent.
-        from ..telemetry.metrics import enabled_registry
-
-        reg = enabled_registry(self.po.metrics)
+        # Observability (docs/observability.md): registry counters
+        # (the forwarded/deduped properties are thin read-throughs —
+        # PS_TELEMETRY=0 no-ops them like every other metric) plus a
+        # replication-lag gauge — forwards still parked in the send
+        # lanes toward this primary's replicas, i.e. writes the
+        # replicas have not yet even been sent.
+        reg = self.po.metrics
         self._c_forwarded = reg.counter("replication.forwards")
         self._c_deduped = reg.counter("replication.dedup_hits")
         self.po.metrics.gauge("replication.lag", fn=self._pending_forwards)

@@ -64,7 +64,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..telemetry.flight import NULL_FLIGHT
-from ..telemetry.metrics import enabled_registry
+from ..telemetry.metrics import node_registry
 
 # Cold reads within one pressure window that trip a tier_pressure
 # flight event (coalesced: at most one event per window).
@@ -117,7 +117,7 @@ class TieredStore:
         # beyond-RAM restore would materialize the whole table in RAM
         # before the first get() ever runs.
         self._evict_on_insert = False
-        reg = enabled_registry(metrics)
+        reg = node_registry(metrics)
         self._c_gets = reg.counter("kv.tier_gets")
         self._c_cold_hits = reg.counter("kv.cold_hits")
         self._c_cold_misses = reg.counter("kv.cold_misses")

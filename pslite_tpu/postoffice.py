@@ -660,6 +660,14 @@ class Postoffice:
     def telemetry_snapshot(self) -> dict:
         """This node's registry snapshot plus identity, the payload a
         METRICS_PULL reply carries (and what psmon renders per node)."""
+        # Wire-plane shards flush lazily every few dozen ops; drain them
+        # (and the native core's counter block) so the snapshot never
+        # reads a stale plane.  Best-effort: a dying transport must not
+        # break an unrelated snapshot.
+        try:
+            self.van.wire_sync()
+        except Exception:  # noqa: BLE001
+            pass
         snap = {
             "node_id": self.van.my_node.id,
             "role": self.role_str(),

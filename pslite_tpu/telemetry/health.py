@@ -34,6 +34,10 @@ replica_fallbacks  ``replica_read.fallbacks`` rate (/s) on          5 : 50
                    (docs/serving_reads.md); a sustained rate
                    means replicas trail their primary and the
                    read spread is quietly collapsing onto it
+syscalls_per_op    windowed wire-plane syscalls per op, both        8 : 64
+                   Python and native planes summed
+                   (docs/observability.md); graded only once
+                   the window holds >= 16 ops
 =================  ==========================================  ===========
 
 Breaches emit structured :class:`HealthEvent`\\ s (INFO/WARN/CRIT) with
@@ -120,7 +124,13 @@ DEFAULT_THRESHOLDS: Dict[str, tuple] = {
     "node_stale": (2.0, 5.0),
     "snapshot_age": (600.0, 86400.0),
     "replica_fallbacks": (5.0, 50.0),
+    "syscalls_per_op": (8.0, 64.0),
 }
+
+# syscalls_per_op needs a minimum op population before it grades: a
+# window with three control round-trips and no data traffic would
+# otherwise read as a catastrophic ratio.
+_WIRE_MIN_OPS = 16
 
 
 def parse_slo(spec: Optional[str]) -> Dict[str, Rule]:
@@ -344,6 +354,22 @@ class Watchdog:
                 window, out=out,
                 fmt="retransmits at {value:.4g}/s (threshold {thr:g}/s)",
             )
+
+            # syscalls_per_op: windowed wire-plane efficiency, both
+            # planes summed (docs/observability.md).  A drifting ratio
+            # is usually batching regressing to singletons or the
+            # vectored writer degenerating into per-chunk writes — the
+            # op stream looks healthy while the kernel does 10x the
+            # work.  Skipped below a minimum op population.
+            ws = history.wire_summary(node_id, window)
+            if ws is not None and ws["ops"] >= _WIRE_MIN_OPS:
+                self._check(
+                    wall, "syscalls_per_op", node_id, role,
+                    "wire.syscalls_per_op", ws["syscalls_per_op"],
+                    window, out=out,
+                    fmt="{value:.4g} syscalls per op over the window "
+                        "(threshold {thr:g})",
+                )
 
         # node_stale: nodes that missed recent sample rounds (value in
         # units of the sampler interval, so thresholds read "rounds").

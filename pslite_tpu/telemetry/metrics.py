@@ -191,6 +191,25 @@ class Histogram:
             out[label] = self.quantile(q)
         return out
 
+    def merge_shard(self, count: int, total: float, mn: float, mx: float,
+                    buckets: Dict[int, int]) -> None:
+        """Fold a pre-bucketed shard (same ``lo`` geometry) in under one
+        lock acquisition — the flush half of the wire-plane thread-local
+        shards, which observe into private bucket arrays off the hot
+        path and merge here every few dozen ops."""
+        if count <= 0:
+            return
+        with self._mu:
+            self.count += count
+            self.sum += total
+            if mn < self.min:
+                self.min = mn
+            if mx > self.max:
+                self.max = mx
+            for i, n in buckets.items():
+                if 0 <= i < self.NBUCKETS and n > 0:
+                    self.buckets[i] += n
+
     def reset(self) -> None:
         with self._mu:
             self.count = 0
@@ -256,6 +275,9 @@ class _NullInstrument:
         pass
 
     def attach_exemplar(self, v: float, trace_id: int, wall=None) -> None:
+        pass
+
+    def merge_shard(self, count, total, mn, mx, buckets) -> None:
         pass
 
     def exemplars(self) -> dict:
@@ -423,15 +445,17 @@ def bucket_quantile(counts: Dict[int, int], lo: float, q: float,
 NULL_REGISTRY = Registry(enabled=False)
 
 
-def enabled_registry(maybe_reg: Optional[Registry]) -> Registry:
-    """``maybe_reg`` when it is a live registry, else a PRIVATE enabled
-    one.  For components whose counters pre-date telemetry and are read
-    through legacy attributes (``van._send_syscalls``,
-    ``pool.sharded_requests``, ``replicator.forwarded``,
-    ``van.chaos_stats``): those must keep counting even with
-    ``PS_TELEMETRY=0`` (their pre-registry cost was the same bare int
-    add), while the node's snapshot — which reads ``po.metrics``, not
-    the private fallback — stays empty as the knob promises."""
-    if maybe_reg is not None and maybe_reg.enabled:
-        return maybe_reg
-    return Registry()
+def node_registry(maybe_reg: Optional[Registry]) -> Registry:
+    """``maybe_reg`` when present — even disabled — else a PRIVATE
+    enabled registry for registry-less harnesses (stub postoffices).
+
+    This replaced ``enabled_registry``: components whose counters
+    pre-date telemetry (``van._send_syscalls``, ``pool.sharded_requests``,
+    ``replicator.forwarded``, ``van.chaos_stats``) used to get a private
+    always-on registry under ``PS_TELEMETRY=0`` so their legacy
+    attributes kept counting while the node snapshot stayed empty.  Those
+    counters now live in the node registry proper (the attributes are
+    thin read-throughs), so the knob means one thing everywhere: off is
+    off, and the export path has no special case to skip the private
+    shadow registries."""
+    return maybe_reg if maybe_reg is not None else Registry()

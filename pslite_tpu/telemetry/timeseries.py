@@ -398,3 +398,58 @@ class ClusterHistory:
             dt = w1 - w0
             out.append(d / dt if d >= 0 and dt > 0 else None)
         return out
+
+    def wire_summary(self, node_id: int,
+                     window_s: Optional[float] = None) -> Optional[dict]:
+        """Windowed wire-plane digest for one node — the ``wire``
+        section psmon/pssoak render.  Sums the Python shards
+        (``wire.tx.*``) and the native core's block
+        (``wire.native.tx.*``) so a van is judged by its whole data
+        plane, whichever half carried the traffic.  Ratios are None
+        when the window saw no ops; returns None entirely without two
+        samples."""
+        def d(counter: str) -> int:
+            v = self.counter_delta(node_id, counter, window_s)
+            return v if v is not None and v > 0 else 0
+
+        def both(suffix: str) -> int:
+            return d("wire." + suffix) + d("wire.native." + suffix)
+
+        if self.sample_pair(node_id, window_s) is None:
+            return None
+        tx_ops = both("tx.ops")
+        rx_ops = d("wire.rx.ops")          # pump-side: counts both planes
+        ops = tx_ops + rx_ops
+        syscalls = both("tx.syscalls") + both("rx.syscalls")
+        frames = both("tx.frames") + d("wire.rx.frames") \
+            + d("wire.native.rx.frames")
+        bytes_zc = (both("tx.bytes_zc") + d("wire.rx.bytes_zc")
+                    + d("wire.native.rx.bytes_zc"))
+        bytes_copy = (d("wire.tx.bytes_copy") + d("wire.rx.bytes_copy")
+                      + d("wire.native.rx.bytes_copy"))
+        occ = self.window_buckets(node_id, "wire.batch_occupancy", window_s)
+        batch_fill = None
+        if occ and occ["count"]:
+            # Mean ops per flushed frame: tx+rx ops over occupancy count
+            # understates under partial windows, so derive from the
+            # bucket mass itself (bucket i holds values <= lo * 2**i).
+            total = sum(n * (occ["lo"] * (2 ** max(i - 1, 0)) *
+                             (1.5 if i > 0 else 1.0))
+                        for i, n in occ["buckets"].items())
+            batch_fill = total / occ["count"]
+        return {
+            "ops": ops,
+            "tx_ops": tx_ops,
+            "rx_ops": rx_ops,
+            "syscalls": syscalls,
+            "frames": frames,
+            "bytes_zc": bytes_zc,
+            "bytes_copy": bytes_copy,
+            "syscalls_per_op": (syscalls / ops) if ops else None,
+            "frames_per_op": (frames / ops) if ops else None,
+            "batch_fill": batch_fill,
+            "zc_share": (bytes_zc / (bytes_zc + bytes_copy)
+                         if (bytes_zc + bytes_copy) else None),
+            "residency_p99": self.window_quantile(
+                node_id, "wire.lane_residency_s", 0.99, window_s),
+        }

@@ -186,9 +186,9 @@ class _ChaosStats:
     _PREFIX = "chaos."
 
     def __init__(self, registry):
-        from ..telemetry.metrics import enabled_registry
+        from ..telemetry.metrics import node_registry
 
-        self._registry = enabled_registry(registry)
+        self._registry = node_registry(registry)
 
     def inc(self, key: str, n: int = 1) -> None:
         self._registry.counter(self._PREFIX + key).inc(n)

@@ -30,7 +30,7 @@ _LIB_PATHS = [
 # not rerun after a source update) is rejected LOUDLY at load time —
 # the old posture silently fell back per-symbol, which left half-built
 # hosts running the pure-Python path with no hint why.
-ABI_VERSION = 8  # 8: fused wire-codec kernels (docs/compression.md)
+ABI_VERSION = 9  # 9: wire-plane counter snapshot (docs/observability.md)
 
 _lib = None
 _load_warned = False
@@ -42,6 +42,29 @@ class _FrameView(ctypes.Structure):
         ("buf", ctypes.POINTER(ctypes.c_uint8)),
         ("meta_len", ctypes.c_uint32),
         ("n_data", ctypes.c_uint32),
+    ]
+
+
+class _WireStats(ctypes.Structure):
+    """Mirror of ``psl_wire_stats`` (cpp/pslite_core.cc): the native
+    wire-plane counter block, snapshotted whole in one FFI call.  The
+    leading ``abi`` field echoes the library's stamp; the struct only
+    grows at the end, and ``psl_stats_snapshot`` returns the byte size
+    it wrote so layout drift is detectable."""
+
+    _fields_ = [
+        ("abi", ctypes.c_uint64),
+        ("tx_syscalls", ctypes.c_uint64),
+        ("tx_frames", ctypes.c_uint64),
+        ("tx_chunks", ctypes.c_uint64),
+        ("tx_bytes", ctypes.c_uint64),
+        ("tx_msgs", ctypes.c_uint64),
+        ("rx_syscalls", ctypes.c_uint64),
+        ("rx_frames", ctypes.c_uint64),
+        ("rx_bytes_copy", ctypes.c_uint64),
+        ("rx_bytes_zc", ctypes.c_uint64),
+        ("rx_pool_hits", ctypes.c_uint64),
+        ("rx_pool_misses", ctypes.c_uint64),
     ]
 
 
@@ -132,6 +155,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     AttributeError here (caught by load's candidate loop)."""
     lib.psl_abi_version.restype = ctypes.c_int
     lib.psl_abi_version.argtypes = []
+    lib.psl_stats_snapshot.restype = ctypes.c_int
+    lib.psl_stats_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_WireStats)
+    ]
     lib.psl_create.restype = ctypes.c_void_p
     lib.psl_bind.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     lib.psl_connect.argtypes = [
@@ -405,6 +432,20 @@ class NativeTransport:
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
         return rc
+
+    def stats(self) -> dict:
+        """The core's wire-plane counter block as a dict of absolute
+        monotonic totals (one struct-snapshot FFI call; the van folds
+        these into ``wire.native.*`` registry counters as deltas)."""
+        out = _WireStats()
+        n = self._lib.psl_stats_snapshot(self._h, ctypes.byref(out))
+        if n < ctypes.sizeof(_WireStats):
+            raise RuntimeError(
+                f"psl_stats_snapshot wrote {n} bytes, expected "
+                f"{ctypes.sizeof(_WireStats)} — ABI drift"
+            )
+        return {name: int(getattr(out, name))
+                for name, _ in _WireStats._fields_ if name != "abi"}
 
     def connect(self, node_id: int, host: str, port: int,
                 timeout_ms: int = 30000) -> None:

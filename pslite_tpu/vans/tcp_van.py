@@ -67,16 +67,23 @@ def _local_sock_path(port: int) -> str:
     return os.path.join(tempfile.gettempdir(), f"pslite_ipc_{port}.sock")
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[memoryview]:
+def _recv_exact(sock: socket.socket, n: int,
+                wire_stats=None) -> Optional[memoryview]:
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            return None
-        got += r
-    return memoryview(buf)
+    calls = 0
+    try:
+        while got < n:
+            r = sock.recv_into(view[got:], n - got)
+            calls += 1
+            if r == 0:
+                return None
+            got += r
+        return memoryview(buf)
+    finally:
+        if wire_stats is not None and calls:
+            wire_stats.rx_syscalls(calls)
 
 
 def _free_block_refcount() -> int:
@@ -116,7 +123,7 @@ class _RecvPool:
     _MAX_BLOCK = 128 << 20
 
     def __init__(self, metrics=None, budget_mb: int = 128):
-        from ..telemetry.metrics import enabled_registry
+        from ..telemetry.metrics import node_registry
 
         self._mu = threading.Lock()  # several reader threads share us
         self._entries: List[np.ndarray] = []
@@ -128,9 +135,9 @@ class _RecvPool:
         # permanently locking the arena to whatever sizes came first.
         self._max_total = max(1, budget_mb) << 20
         # Registry counters (one counter idiom everywhere); .hits /
-        # .misses stay readable as before via the properties below, so
-        # pool accounting works even untelemetered (private fallback).
-        self._reg = enabled_registry(metrics)
+        # .misses stay readable as before via the properties below.
+        # PS_TELEMETRY=0 no-ops them like every other metric.
+        self._reg = node_registry(metrics)
         self._c_hits = self._reg.counter("tcp.recv_pool_hits")
         self._c_misses = self._reg.counter("tcp.recv_pool_misses")
         # Per-size-class hit/miss counters (class = the power-of-two
@@ -215,12 +222,14 @@ class _RecvPool:
             return block
 
     def recv_exact_into(self, sock: socket.socket, block: np.ndarray,
-                        n: int) -> bool:
+                        n: int, wire_stats=None) -> bool:
         view = memoryview(block)
+        calls = 0
         try:
             got = 0
             while got < n:
                 r = sock.recv_into(view[got:n], n - got)
+                calls += 1
                 if r == 0:
                     return False
                 got += r
@@ -229,6 +238,8 @@ class _RecvPool:
             # Promptly drop the buffer ref so the block's refcount
             # baseline only reflects real message views.
             view.release()
+            if wire_stats is not None and calls:
+                wire_stats.rx_syscalls(calls)
 
 
 class TcpVan(Van):
@@ -422,6 +433,17 @@ class TcpVan(Van):
     @property
     def _recv_pool_hits(self) -> int:
         return self._recv_pool.hits if self._recv_pool is not None else 0
+
+    def wire_sync(self) -> None:
+        """Python shards + the native core's counter block (one
+        struct-snapshot FFI call, folded in as ``wire.native.*``
+        deltas) — the C++ lanes stop being dark at every snapshot."""
+        super().wire_sync()
+        if self._native is not None and self.wire.enabled:
+            try:
+                self.wire.sync_native(self._native.stats())
+            except Exception:  # noqa: BLE001 - teardown race: a core
+                pass           # being destroyed must not break snapshots
 
     # -- transport interface -------------------------------------------------
 
@@ -772,6 +794,7 @@ class TcpVan(Van):
         finally:
             if calls:
                 self._c_syscalls.inc(calls)
+                self.wire.tx_syscalls(calls)
 
     def _send_msg_once(self, msg: Message) -> int:
         recver = msg.meta.recver
@@ -1089,17 +1112,18 @@ class TcpVan(Van):
             self._reader_threads.append(t)
 
     def _reader_loop(self, conn: socket.socket) -> None:
+        wstats = self.wire if self.wire.enabled else None
         try:
             while not self._closing:
-                hdr = _recv_exact(conn, wire.FRAME_HEADER_SIZE)
+                hdr = _recv_exact(conn, wire.FRAME_HEADER_SIZE, wstats)
                 if hdr is None:
                     break
                 meta_len, n_data = wire.unpack_frame_header(bytes(hdr))
-                lens_buf = _recv_exact(conn, 8 * n_data)
+                lens_buf = _recv_exact(conn, 8 * n_data, wstats)
                 if lens_buf is None:
                     break
                 lens = struct.unpack(f"<{n_data}Q", bytes(lens_buf))
-                meta_buf = _recv_exact(conn, meta_len)
+                meta_buf = _recv_exact(conn, meta_len, wstats)
                 if meta_buf is None:
                     break
                 meta = wire.unpack_meta(bytes(meta_buf))
@@ -1110,7 +1134,7 @@ class TcpVan(Van):
                     if ln and self._recv_pool is not None:
                         block = self._recv_pool.acquire(ln)
                         if not self._recv_pool.recv_exact_into(
-                            conn, block, ln
+                            conn, block, ln, wstats
                         ):
                             ok = False
                             break

@@ -139,23 +139,25 @@ class Van:
         self.resender: Optional[Resender] = None
         self.profiler = Profiler(self.env, postoffice.role_str())
         # Telemetry (docs/observability.md): the owning node's registry
-        # and tracer.  A stub postoffice (benchmark/test harnesses) or
-        # a PS_TELEMETRY=0 node gets a PRIVATE enabled registry so the
-        # van's legacy-view counters (syscalls, pool hits, chaos stats)
-        # keep counting either way; the node snapshot still reads
-        # po.metrics and stays empty when disabled.
-        from ..telemetry.metrics import enabled_registry
+        # and tracer.  Every van instrument — legacy-view counters
+        # (syscalls, pool hits, chaos stats) included — lives on the
+        # node registry, so PS_TELEMETRY=0 uniformly no-ops them; a stub
+        # postoffice (benchmark/test harnesses) gets a private enabled
+        # registry so transport-less vans still observe.
+        from ..telemetry.metrics import node_registry
 
         node_metrics = getattr(postoffice, "metrics", None)
-        self.metrics = enabled_registry(node_metrics)
-        # Instruments with NO legacy read surface go on the node's real
-        # registry so PS_TELEMETRY=0 actually no-ops them (the private
-        # fallback above exists only to keep pre-registry counters
-        # counting); stub postoffices fall back to the private one so
-        # transport-less test vans still observe.
-        self._node_metrics = (
-            node_metrics if node_metrics is not None else self.metrics
-        )
+        self.metrics = node_registry(node_metrics)
+        # Historical split (instruments with/without a legacy read
+        # surface) — the two registries collapsed when the legacy
+        # counters migrated into the registry proper.
+        self._node_metrics = self.metrics
+        # Wire-plane observatory (docs/observability.md): syscalls/op,
+        # frames/op, copy-vs-zero-copy bytes, combiner occupancy, lane
+        # residency.  PS_WIRE_TELEMETRY=0 swaps in the shared no-op.
+        from ..telemetry.wire import make_wire_stats
+
+        self.wire = make_wire_stats(self.metrics, self.env)
         self.tracer = getattr(postoffice, "tracer", None) or NULL_TRACER
         # Fault flight recorder (docs/observability.md): the bounded
         # per-node ring of health-relevant events, dumped on abnormal
@@ -593,6 +595,15 @@ class Van:
             else:
                 self._c_resp_batched_frames.inc()
                 self._c_resp_batch_ops.inc(len(msg.meta.batch.ops))
+        n_ops = 0
+        if msg.meta.control.empty():
+            # Wire-plane occupancy: ops on this frame — singleton
+            # combiner flushes land as 1, keeping the fill
+            # distribution honest.  Recorded with the op count in ONE
+            # shard visit (tx_msg) on the Python plane, occupancy-only
+            # on the native branch below.
+            n_ops = (len(msg.meta.batch.ops)
+                     if msg.meta.batch is not None else 1)
         if msg.meta.control.empty() and not self.tenants.enabled:
             # Native data plane (docs/native_core.md): transports with
             # native sender lanes take the whole hot path — frame
@@ -604,7 +615,17 @@ class Van:
             # decline pattern as the resender/chaos paths.
             nbytes = self._native_submit(msg)
             if nbytes is not None:
+                # Occupancy is plane-independent; the op itself rides
+                # the core's own counter block (wire.native.tx.ops).
+                self.wire.batch_occupancy(n_ops)
                 return nbytes
+        if n_ops:
+            # Python-plane logical ops (the syscalls/op and frames/op
+            # denominator) + the frame's occupancy, one record.
+            # Counted only after the native plane declined — native
+            # ops arrive as wire.native.tx.ops from the core's own
+            # counter block, keeping the planes distinct.
+            self.wire.tx_msg(n_ops)
         if (self._chunk_bytes > 0 and msg.meta.control.empty()
                 and msg.meta.chunk is None
                 and msg.meta.data_size > self._chunk_bytes
@@ -685,6 +706,12 @@ class Van:
             self.send_bytes += nbytes
             self._c_sent_msgs.inc()
             self._c_sent_bytes.inc(nbytes)
+        # Wire frame accounting: payload views go to the kernel borrowed
+        # (zero-copy); the header/meta envelope is serialized (copied).
+        zc = msg.meta.data_size if msg.meta.control.empty() else 0
+        if zc > nbytes:
+            zc = nbytes
+        self.wire.tx_frame(msg.meta.recver, zc, nbytes - zc)
         return nbytes
 
     def _lane_sender(self, lane: _SendLane) -> None:
@@ -705,6 +732,7 @@ class Van:
             if enq is not None:
                 wait = time.monotonic() - enq
                 self._h_lane_wait.observe(wait)
+                self.wire.lane_residency(wait)
                 # Head-of-line accounting (docs/chunking.md): a
                 # >= NORMAL-priority message that waited while LOWER-
                 # priority bytes went out ahead of it is exactly the
@@ -1002,6 +1030,13 @@ class Van:
             self.po.notify_node_failure(node.id, True)
 
     # -- cluster telemetry pull (docs/observability.md) ----------------------
+
+    def wire_sync(self) -> None:
+        """Drain the wire-plane thread-local shards into the registry.
+        Transports with a native data plane extend this to fold the C++
+        core's counter block in too (``TcpVan.wire_sync``).  Called from
+        the snapshot path; safe to call from any thread."""
+        self.wire.flush()
 
     def _process_metrics_pull(self, msg: Message) -> None:
         """METRICS_PULL: a request snapshots this node's registry into
@@ -1370,6 +1405,14 @@ class Van:
             self.recv_bytes += nbytes
             self._c_recv_msgs.inc()
             self._c_recv_bytes.inc(nbytes)
+            if msg.meta.control.empty():
+                # Wire-plane rx accounting (mirror of the tx side), one
+                # record per message: payload bytes land in borrowed/
+                # pooled buffers (zc), the meta envelope is
+                # deserialized (copy).
+                self.wire.rx_msg(len(msg.meta.batch.ops)
+                                 if msg.meta.batch is not None else 1,
+                                 nbytes)
             ctrl = msg.meta.control
             if (
                 self._drop_rate > 0

@@ -413,6 +413,64 @@ def test_apply_wait_needs_enough_traces():
     assert not po.retunes and not ap.decision_log
 
 
+def test_apply_widen_doubles_back_to_baseline():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_RETUNE_COOLDOWN_S=0,
+                    PS_APPLY_TASK_BYTES=256 << 10)
+    ap.apply_task_bytes = 64 << 10  # as left by a narrowing streak
+    ap.trace_source = lambda: {
+        "count": 20,
+        "slow": {"apply_wait": {"share": 0.02, "total_us": 40.0}},
+    }
+    for w in range(3):
+        ap.observe(h, wall=float(w))
+    outs = [d.outcome for d in ap.decision_log
+            if d.rule == "apply_widen"]
+    # Two doublings reach the baseline; the third round senses nothing
+    # (quantum already restored) rather than vetoing forever.
+    assert outs == [ACTED, ACTED]
+    assert po.retunes == [128 << 10, 256 << 10]
+    assert ap.apply_task_bytes == 256 << 10
+
+
+def test_apply_widen_holds_inside_hysteresis_band():
+    # Share between the widen threshold (0.15) and the narrow
+    # threshold (0.5): NEITHER rule moves the quantum — the band is
+    # the thrash guard.
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_RETUNE_COOLDOWN_S=0,
+                    PS_APPLY_TASK_BYTES=256 << 10)
+    ap.apply_task_bytes = 64 << 10
+    ap.trace_source = lambda: {
+        "count": 20,
+        "slow": {"apply_wait": {"share": 0.3, "total_us": 500.0}},
+    }
+    for w in range(3):
+        ap.observe(h, wall=float(w))
+    assert not po.retunes
+    assert ap.apply_task_bytes == 64 << 10
+
+
+def test_apply_narrow_then_recover_round_trip():
+    po, ap, h = _mk(PS_AUTOPILOT_SUSTAIN=1,
+                    PS_AUTOPILOT_RETUNE_COOLDOWN_S=0,
+                    PS_APPLY_TASK_BYTES=256 << 10)
+    share = {"v": 0.8}
+    ap.trace_source = lambda: {
+        "count": 20,
+        "slow": {"apply_wait": {"share": share["v"],
+                                "total_us": 1000.0}},
+    }
+    for w in range(2):  # pressure: halve twice, down to the floor
+        ap.observe(h, wall=float(w))
+    assert po.retunes == [128 << 10, 64 << 10]
+    share["v"] = 0.0  # pressure gone: widen back out
+    for w in range(2, 5):
+        ap.observe(h, wall=float(w))
+    assert po.retunes == [128 << 10, 64 << 10, 128 << 10, 256 << 10]
+    assert ap.apply_task_bytes == 256 << 10
+
+
 # -- engine plumbing ----------------------------------------------------------
 
 
@@ -420,7 +478,7 @@ def test_disable_list_and_unknown_rule_is_fatal():
     env = _env(PS_AUTOPILOT_DISABLE="hot_skew,scale_in")
     ap = Autopilot(FakePo(env), env=env, mode="act")
     assert {r.name for r in ap.rules} == {"shed_scale", "snapshot_age",
-                                          "apply_wait"}
+                                          "apply_wait", "apply_widen"}
     bad = _env(PS_AUTOPILOT_DISABLE="bogus_rule")
     with pytest.raises(CheckError):
         Autopilot(FakePo(bad), env=bad, mode="act")

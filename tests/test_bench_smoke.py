@@ -391,8 +391,15 @@ def test_bench_diff_history(tmp_path):
     # status line shows zero guarded fields.
     (tmp_path / "BENCH_r04.json").write_text(json.dumps(
         {"error": "tunnel down", "sections_done": []}))
-    text2 = "\n".join(bench_diff.history(str(tmp_path)))
+    lines2 = bench_diff.history(str(tmp_path))
+    text2 = "\n".join(lines2)
     assert "BLIND" in text2
+    # The blind round renders an explicit ∅ sparkline cell (distinct
+    # from '·' = metric predates its section) plus the legend.
+    row2 = next(l for l in lines2
+                if l.strip().startswith("small_op_batching_msgs_ratio"))
+    assert "∅" in row2 and "∅ blind" in row2
+    assert any("legend" in l for l in lines2)
     # CLI flag: exits 0 and prints the table.
     assert bench_diff.main(["--history", "--dir", str(tmp_path)]) == 0
 

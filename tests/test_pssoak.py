@@ -1,0 +1,91 @@
+"""tools/pssoak.py smoke coverage (``make soak-smoke``): the graded
+soak harness must boot its matrix cells, verify them bit-exactly, and
+keep the wire-telemetry overhead self-assertion under its limit — all
+inside tier-1's CPU-only, non-slow envelope."""
+
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(0, "tools")
+import pssoak  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def smoke_report():
+    """One scaled-down soak shared by every assertion below (the run
+    itself is the expensive part; ~15s on the CPU mesh)."""
+    return pssoak.run_soak(20.0, smoke=True)
+
+
+def test_smoke_matrix_runs_and_verifies(smoke_report):
+    rep = smoke_report
+    assert rep["smoke"] is True and rep["native_plane"] is False
+    cells = rep["cells"]
+    assert [c["cell"] for c in cells] == [
+        "baseline", "batching", "combined"
+    ]
+    for c in cells:
+        assert c["verified"], c.get("error") or c.get("verify_detail")
+        assert c["rounds"] >= 1 and c["pushes"] > c["rounds"]
+        wire = c["wire"]
+        assert wire["ops"] > 0 and wire["records"] > 0
+
+
+def test_smoke_grade_and_overhead_assertion(smoke_report):
+    rep = smoke_report
+    assert rep["grade"] in ("A", "B"), pssoak.format_report(rep)
+    oh = rep["telemetry_overhead"]
+    assert oh["ok"], (f"telemetry overhead {oh['share']} breached "
+                      f"the {oh['limit']} limit")
+    assert oh["records"] > 0 and oh["per_record_ns"] > 0
+
+
+def test_smoke_report_renders(smoke_report):
+    text = pssoak.format_report(smoke_report)
+    assert f"pssoak grade {smoke_report['grade']}" in text
+    assert "telemetry overhead" in text
+    for c in smoke_report["cells"]:
+        assert c["cell"] in text
+
+
+def test_batching_cell_fills_batches(smoke_report):
+    """The PS_BATCH_BYTES cell must show the combiner actually packing
+    ops: higher occupancy and fewer frames per op than baseline."""
+    by = {c["cell"]: c["wire"] for c in smoke_report["cells"]}
+    base, batch = by["baseline"], by["batching"]
+    assert batch["batch_fill"] > base["batch_fill"]
+    assert batch["frames_per_op"] < base["frames_per_op"]
+
+
+def test_matrix_shape():
+    smoke = pssoak._matrix(native=True, smoke=True)
+    assert [n for n, _ in smoke] == ["baseline", "batching", "combined"]
+    assert all(e["PS_NATIVE"] == "0" for _, e in smoke)
+    full = pssoak._matrix(native=True, smoke=False)
+    assert len(full) == 14  # 7 cells x {python, native}
+    assert sum(1 for n, _ in full if n.endswith("+native")) == 7
+    full_py = pssoak._matrix(native=False, smoke=False)
+    assert len(full_py) == 7
+
+
+def test_grade_rules():
+    base = {"cell": "baseline", "verified": True, "ops_per_s": 100.0}
+    ok = {"cell": "batching", "verified": True, "ops_per_s": 90.0}
+    assert pssoak.grade([base, ok], overhead_share=0.001) == "A"
+    # any correctness failure is terminal
+    bad = dict(ok, verified=False)
+    assert pssoak.grade([base, bad], overhead_share=0.001) == "F"
+    # overhead breach outranks drift
+    assert pssoak.grade([base, ok], overhead_share=0.05) == "C"
+    # a slow feature cell drifts to B...
+    slow = dict(ok, ops_per_s=10.0)
+    assert pssoak.grade([base, slow], overhead_share=0.001) == "B"
+    # ...but a budget-skipped cell is starvation, not drift
+    skipped = {"cell": "combined", "verified": True, "starved": True,
+               "skipped": "wall budget exhausted", "rounds": 0}
+    graded = pssoak.grade([base, ok, skipped], overhead_share=0.001)
+    assert graded == "B"
+    assert "drift" not in skipped

@@ -1,0 +1,182 @@
+"""Wire-plane observatory unit coverage (pslite_tpu/telemetry/wire.py):
+amortization, label cardinality, merged recorders, native delta
+folding, and the PS_WIRE_TELEMETRY=0 send-path guarantee."""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pslite_tpu.environment import Environment  # noqa: E402
+from pslite_tpu.telemetry.metrics import Registry  # noqa: E402
+from pslite_tpu.telemetry import wire  # noqa: E402
+from pslite_tpu.telemetry.wire import (  # noqa: E402
+    NULL_WIRE, WireStats, make_wire_stats)
+
+
+def _stats(**env):
+    reg = Registry()
+    return reg, WireStats(reg, Environment({k: str(v)
+                                            for k, v in env.items()}))
+
+
+def test_records_amortized_off_hot_path():
+    """N records must fold into ~N/flush_ops registry visits — the
+    cost model the 2% pssoak overhead budget is built on."""
+    reg, ws = _stats(PS_WIRE_FLUSH_OPS=64)
+    n = 10_000
+    for _ in range(n):
+        ws.tx_syscalls(1)
+    ws.flush()
+    c = reg.snapshot()["counters"]
+    assert c["wire.telemetry.records"] == n
+    assert c["wire.tx.syscalls"] == n
+    # one flush per 64 records, plus the final drain
+    assert c["wire.telemetry.flushes"] <= n // 64 + 1
+
+
+def test_flush_drains_every_thread_shard():
+    reg, ws = _stats(PS_WIRE_FLUSH_OPS=1_000_000)
+
+    def work():
+        for _ in range(10):
+            ws.tx_op()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # nothing visible yet: flush interval far above the record count
+    assert reg.snapshot()["counters"]["wire.tx.ops"] == 0
+    ws.flush()
+    assert reg.snapshot()["counters"]["wire.tx.ops"] == 40
+
+
+def test_lane_cardinality_bounded():
+    """Traffic beyond PS_WIRE_MAX_LANES distinct peers aggregates into
+    wire.lane.other.* — a big cluster cannot explode the registry."""
+    reg, ws = _stats(PS_WIRE_MAX_LANES=4, PS_WIRE_FLUSH_OPS=1)
+    for peer in range(32):
+        ws.tx_frame(9000 + peer, zc_bytes=1024)
+    ws.flush()
+    c = reg.snapshot()["counters"]
+    lanes = sorted(k for k in c if k.startswith("wire.lane.")
+                   and k.endswith(".tx.frames"))
+    assert len(lanes) == 5  # 4 named peers + the overflow bucket
+    assert "wire.lane.other.tx.frames" in lanes
+    assert c["wire.lane.other.tx.frames"] == 32 - 4
+    assert c["wire.lane.other.tx.bytes"] == (32 - 4) * 1024
+    # total frame accounting is conserved across the cap
+    assert c["wire.tx.frames"] == 32
+
+
+def test_merged_recorders_single_visit_semantics():
+    """tx_msg / rx_msg fold the op count and its occupancy / frame
+    accounting into ONE record each (halving hot-path cost)."""
+    reg, ws = _stats(PS_WIRE_FLUSH_OPS=1_000_000)
+    ws.tx_msg(4)
+    ws.tx_msg(1)
+    ws.rx_msg(4, zc_bytes=4096, copy_bytes=128)
+    ws.flush()
+    snap = reg.snapshot()
+    c = snap["counters"]
+    assert c["wire.tx.ops"] == 5
+    assert c["wire.rx.ops"] == 4
+    assert c["wire.rx.frames"] == 1
+    assert c["wire.rx.bytes_zc"] == 4096
+    assert c["wire.rx.bytes_copy"] == 128
+    assert c["wire.telemetry.records"] == 3
+    occ = snap["histograms"][wire.OCCUPANCY_HIST]
+    assert occ["count"] == 2 and occ["sum"] == 5.0
+    assert occ["min"] == 1.0 and occ["max"] == 4.0
+
+
+def test_sync_native_folds_deltas():
+    reg, ws = _stats()
+    ws.sync_native({"tx_syscalls": 10, "tx_frames": 7, "tx_msgs": 40})
+    ws.sync_native({"tx_syscalls": 25, "tx_frames": 9, "tx_msgs": 90})
+    c = reg.snapshot()["counters"]
+    assert c["wire.native.tx.syscalls"] == 25
+    assert c["wire.native.tx.frames"] == 9
+    assert c["wire.native.tx.ops"] == 90
+    # a core restart (counter regression) must not go negative
+    ws.sync_native({"tx_syscalls": 3, "tx_frames": 1, "tx_msgs": 2})
+    c = reg.snapshot()["counters"]
+    assert c["wire.native.tx.syscalls"] == 25
+    # None / empty snapshots are tolerated (core unloadable mid-run)
+    ws.sync_native(None)
+    ws.sync_native({})
+
+
+def test_factory_disables_cleanly():
+    assert make_wire_stats(None, Environment({})) is NULL_WIRE
+    assert make_wire_stats(Registry(enabled=False),
+                           Environment({})) is NULL_WIRE
+    off = Environment({"PS_WIRE_TELEMETRY": "0"})
+    assert make_wire_stats(Registry(), off) is NULL_WIRE
+    on = make_wire_stats(Registry(), Environment({}))
+    assert isinstance(on, WireStats) and on.enabled
+
+
+def test_null_wire_records_nothing():
+    """Every recorder the vans call must exist on the null object and
+    leave no trace — the PS_WIRE_TELEMETRY=0 contract."""
+    NULL_WIRE.tx_op()
+    NULL_WIRE.tx_msg(4)
+    NULL_WIRE.tx_frame(11, 4096, 128)
+    NULL_WIRE.tx_syscalls(2)
+    NULL_WIRE.rx_op()
+    NULL_WIRE.rx_frame(4096)
+    NULL_WIRE.rx_msg(4, 4096, 128)
+    NULL_WIRE.rx_syscalls(3)
+    NULL_WIRE.batch_occupancy(4)
+    NULL_WIRE.lane_residency(1e-4)
+    NULL_WIRE.sync_native({"tx_syscalls": 5})
+    NULL_WIRE.flush()
+    assert not NULL_WIRE.enabled
+
+
+def test_disabled_telemetry_send_path_identical():
+    """PS_WIRE_TELEMETRY=0 end-to-end: the van runs on NULL_WIRE, no
+    wire.* metric ever appears, and pulls stay bit-identical to the
+    telemetry-on run — observation must not perturb the wire."""
+    from pslite_tpu.benchmark import _loopback_cluster, _teardown_cluster
+    from pslite_tpu.kv.kv_app import (KVServer, KVServerDefaultHandle,
+                                      KVWorker)
+
+    keys = np.array([3, (1 << 63) + 5], dtype=np.uint64)
+    vals = np.arange(2 * 32, dtype=np.float32) + 1.0
+    pulled = {}
+    for tag, extra in (("on", {}), ("off", {"PS_WIRE_TELEMETRY": "0"})):
+        nodes = _loopback_cluster(1, 1, f"wiretel-{tag}", dict(extra),
+                                  van_type="tcp")
+        workers: list = []
+        servers: list = []
+        try:
+            srv = KVServer(0, postoffice=nodes[1])
+            srv.set_request_handle(KVServerDefaultHandle())
+            servers.append(srv)
+            w = KVWorker(0, 0, postoffice=nodes[2])
+            workers.append(w)
+            for van in (nodes[1].van, nodes[2].van):
+                if tag == "off":
+                    assert van.wire is NULL_WIRE
+                else:
+                    assert van.wire is not NULL_WIRE
+            w.wait(w.push(keys, vals))
+            out = np.zeros_like(vals)
+            w.wait(w.pull(keys, out))
+            pulled[tag] = out.copy()
+            for po in nodes:
+                m = po.telemetry_snapshot()["metrics"]
+                wire_keys = [k for k in m.get("counters", {})
+                             if k.startswith("wire.")]
+                if tag == "off":
+                    assert wire_keys == []
+        finally:
+            _teardown_cluster(nodes, workers, servers)
+    assert np.array_equal(pulled["on"], pulled["off"])
+    assert pulled["off"].tobytes() == vals.tobytes()

@@ -97,6 +97,12 @@ TRANSPORT_METRICS: Dict[str, str] = {
     "durable_restore_s": "lower",
     # kv_telemetry
     "kv_storm_msgs_per_s": "higher",
+    # wire (docs/observability.md) — wire-plane efficiency of the
+    # bursty small-op tcp storm: kernel crossings and frames per
+    # logical op must not creep up (batching regressing to singletons
+    # or the vectored writer degenerating shows up here first).
+    "wire_syscalls_per_op": "lower",
+    "wire_frames_per_op": "lower",
     # fault_recovery
     "fault_recovery_detect_s": "lower",
     "fault_recovery_failover_pull_s": "lower",
@@ -112,7 +118,7 @@ SECTION_PREFIXES = (
     "send_lanes_", "server_apply_", "chunk_", "native_", "quantized_",
     "multi_tenant_", "small_op_batching_", "serving_fanin_",
     "replica_read_", "elastic_", "autopilot_", "durable_",
-    "kv_tracing_", "kv_", "fault_recovery_", "van_",
+    "kv_tracing_", "kv_", "fault_recovery_", "van_", "wire_",
 )
 
 # Hard invariants: metrics that must be exactly ZERO in every record.
@@ -230,18 +236,28 @@ def compare(old: dict, new: dict,
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
-def _sparkline(series: List[Optional[float]]) -> str:
-    """Unicode mini-chart of one metric's round-by-round values;
-    rounds where the metric was absent/skipped render as '·'."""
+def _sparkline(series: List[Optional[float]],
+               blind: Optional[List[bool]] = None) -> str:
+    """Unicode mini-chart of one metric's round-by-round values.
+    Rounds where the metric was absent render as '·' — EXCEPT blind
+    device rounds (the record carries an ``error``, e.g. "backend
+    init timed out": nothing device-side ran at all), which render as
+    an explicit '∅' so a tunnel outage reads as an outage, not as a
+    metric that merely hadn't been invented yet."""
+    blind = blind or [False] * len(series)
+
+    def absent(i: int) -> str:
+        return "∅" if blind[i] else "·"
+
     vals = [v for v in series if v is not None]
     if not vals:
-        return "·" * len(series)
+        return "".join(absent(i) for i in range(len(series)))
     lo, hi = min(vals), max(vals)
     span = hi - lo
     out = []
-    for v in series:
+    for i, v in enumerate(series):
         if v is None:
-            out.append("·")
+            out.append(absent(i))
         elif span <= 0:
             out.append(_SPARK[3])
         else:
@@ -299,6 +315,14 @@ def history(directory: str) -> List[str]:
             status.append("BLIND (no guarded transport fields)")
         lines.append(f"  r{rnd:02d}  sha={sha:<9} "
                      f"guarded={n_metrics:>2}  " + "; ".join(status))
+    # Blind device rounds: the record carries an explicit error
+    # ("backend init timed out...") — every guarded cell of that round
+    # renders '∅', distinct from '·' (metric predates its section).
+    blind_rounds = [bool(rec.get("error")) for rec in objs]
+    if any(blind_rounds):
+        lines.append("")
+        lines.append("  legend: ∅ = blind device round (bench errored; "
+                     "no device numbers exist), · = metric absent")
     lines.append("")
     lines.append(
         f"  {'metric':<44} {'trend':<{max(5, len(recs))}} "
@@ -314,14 +338,17 @@ def history(directory: str) -> List[str]:
         vals = [v for v in series if v is not None]
         if not vals:
             continue  # metric never emitted (older than its section)
-        spark = _sparkline(series)
-        blind = series[-1] is None
+        spark = _sparkline(series, blind_rounds)
+        tail = ""
+        if series[-1] is None:
+            tail = ("   << ∅ blind (newest round errored)"
+                    if blind_rounds[-1]
+                    else "   << BLIND (absent in newest record)")
         lines.append(
             f"  {key:<44} {spark:<{max(5, len(recs))}} "
             f"{min(vals):>10g} {max(vals):>10g} "
             f"{(series[-1] if series[-1] is not None else float('nan')):>10g}"
-            f"  {TRANSPORT_METRICS[key]}"
-            + ("   << BLIND (absent in newest record)" if blind else "")
+            f"  {TRANSPORT_METRICS[key]}" + tail
         )
     return lines
 

@@ -348,6 +348,80 @@ def format_table(snap: Dict[int, dict], top_keys: int = 3,
     return "\n".join(lines)
 
 
+# -- wire-plane table (docs/observability.md) --------------------------------
+
+
+def _hist(m: dict, name: str) -> dict:
+    return m.get("histograms", {}).get(name) or {}
+
+
+def format_wire(snap: Dict[int, dict]) -> str:
+    """Per-(node, plane) wire-plane table: syscalls/op, frames/op,
+    combiner batch fill, lane-queue residency p99, and the zero-copy
+    byte share.  One row per plane that actually carried traffic —
+    the Python shards (``wire.*``) and the native core's counter
+    block (``wire.native.*``) are judged side by side, so a regressed
+    fallback path can't hide behind a healthy native plane."""
+    header = (f"{'node':>5} {'role':>9} {'plane':>6} {'ops':>9} "
+              f"{'sys/op':>7} {'frm/op':>7} {'fill':>6} "
+              f"{'resid p99':>10} {'zc%':>6} {'bytes':>8}")
+    lines = [header, "-" * len(header)]
+
+    def ratio(num: float, den: float, w: int = 7) -> str:
+        return f"{num / den:>{w}.2f}" if den > 0 else f"{'-':>{w}}"
+
+    for node_id in sorted(snap):
+        s = snap[node_id]
+        m = s.get("metrics", {})
+        role = s.get("role", "?")
+        planes = []
+        py_ops = _c(m, "wire.tx.ops") + _c(m, "wire.rx.ops")
+        py_sys = _c(m, "wire.tx.syscalls") + _c(m, "wire.rx.syscalls")
+        py_frm = _c(m, "wire.tx.frames") + _c(m, "wire.rx.frames")
+        py_zc = _c(m, "wire.tx.bytes_zc") + _c(m, "wire.rx.bytes_zc")
+        py_cp = _c(m, "wire.tx.bytes_copy") + _c(m, "wire.rx.bytes_copy")
+        if py_ops or py_frm:
+            planes.append(("py", py_ops, py_sys, py_frm, py_zc, py_cp))
+        nt_ops = _c(m, "wire.native.tx.ops")
+        nt_sys = (_c(m, "wire.native.tx.syscalls")
+                  + _c(m, "wire.native.rx.syscalls"))
+        nt_frm = (_c(m, "wire.native.tx.frames")
+                  + _c(m, "wire.native.rx.frames"))
+        nt_zc = (_c(m, "wire.native.tx.bytes_zc")
+                 + _c(m, "wire.native.rx.bytes_zc"))
+        nt_cp = _c(m, "wire.native.rx.bytes_copy")
+        if nt_ops or nt_frm:
+            planes.append(("native", nt_ops, nt_sys, nt_frm, nt_zc, nt_cp))
+        occ = _hist(m, "wire.batch_occupancy")
+        fill = (f"{occ['sum'] / occ['count']:>6.2f}"
+                if occ.get("count") else f"{'-':>6}")
+        res = _hist(m, "wire.lane_residency_s")
+        resid = (f"{res.get('p99', 0.0) * 1e3:>8.2f}ms"
+                 if res.get("count") else f"{'-':>10}")
+        for plane, ops, sys_n, frm, zc, cp in planes:
+            tot = zc + cp
+            zc_pct = f"{100.0 * zc / tot:>5.1f}%" if tot else f"{'-':>6}"
+            lines.append(
+                f"{node_id:>5} {role:>9} {plane:>6} {ops:>9} "
+                f"{ratio(sys_n, ops)} {ratio(frm, ops)} {fill} "
+                f"{resid} {zc_pct} {_fmt_bytes(tot):>8}"
+            )
+        if not planes:
+            lines.append(f"{node_id:>5} {role:>9} {'-':>6} {'-':>9} "
+                         f"{'-':>7} {'-':>7} {fill} {resid} "
+                         f"{'-':>6} {'-':>8}")
+    rec = sum(_c(snap[n].get("metrics", {}), "wire.telemetry.records")
+              for n in snap)
+    fl = sum(_c(snap[n].get("metrics", {}), "wire.telemetry.flushes")
+             for n in snap)
+    lines.append("")
+    lines.append(f"telemetry self-accounting: {rec} records in {fl} "
+                 f"flushes ({rec / fl:.0f} records/flush)" if fl
+                 else "telemetry self-accounting: wire plane dark "
+                      "(PS_WIRE_TELEMETRY=0 or no traffic)")
+    return "\n".join(lines)
+
+
 # -- live watch (windowed rates + sparklines + health footer) ----------------
 
 
@@ -776,9 +850,12 @@ def _demo(args) -> int:
                 pass
         else:
             snap = collect(scheduler)
-            stale = stale_ages(scheduler, snap)
-            print(to_json(snap) if args.json
-                  else format_table(snap, stale=stale))
+            if args.wire:
+                print(format_wire(snap))
+            elif args.json:
+                print(to_json(snap))
+            else:
+                print(format_table(snap, stale=stale_ages(scheduler, snap)))
     finally:
         _teardown_cluster(nodes, workers, servers)
     return 0
@@ -791,6 +868,10 @@ def main(argv=None) -> int:
     ap.add_argument("--watch", action="store_true",
                     help="live refreshing table with windowed rates, "
                          "sparklines, and the health-event footer")
+    ap.add_argument("--wire", action="store_true",
+                    help="wire-plane table: syscalls/op, frames/op, "
+                         "batch fill, lane residency p99 per node and "
+                         "plane (docs/observability.md)")
     ap.add_argument("--serve", type=int, metavar="PORT", default=None,
                     help="serve OpenMetrics/Prometheus text exposition "
                          "on PORT (0 = OS-assigned)")
